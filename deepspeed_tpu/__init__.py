@@ -1,0 +1,84 @@
+"""deepspeed_tpu — a TPU-native large-scale training & inference framework
+with the capabilities of DeepSpeed, built on JAX/XLA/Pallas/pjit.
+
+Top-level API mirrors the reference (``deepspeed/__init__.py``):
+
+    import deepspeed_tpu as ds
+    engine, optimizer, dataloader, lr_scheduler = ds.initialize(
+        model=ds.models.get_model_config("gpt2-125m"),
+        config="ds_config.json")
+    loss = engine.train_batch(batch)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+__version__ = "0.1.0"
+__git_branch__ = "main"
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.parallel.topology import MeshTopology, get_topology, set_topology
+from deepspeed_tpu import comm  # noqa: F401
+from deepspeed_tpu.utils.logging import logger, log_dist  # noqa: F401
+
+
+def initialize(args=None,
+               model: Any = None,
+               optimizer: Any = None,
+               model_parameters: Any = None,
+               training_data: Any = None,
+               lr_scheduler: Any = None,
+               distributed_port: Optional[int] = None,
+               mpu: Any = None,
+               dist_init_required: Optional[bool] = None,
+               collate_fn: Any = None,
+               config: Union[str, Dict[str, Any], None] = None,
+               config_params: Union[str, Dict[str, Any], None] = None,
+               mesh_param=None,
+               seed: Optional[int] = None):
+    """Initialize the engine. Ref: ``deepspeed.initialize`` (__init__.py:78).
+
+    Returns the reference's 4-tuple ``(engine, optimizer, dataloader,
+    lr_scheduler)``.  ``model`` is a :class:`TransformerConfig` from the model
+    zoo or any object with ``init(rng)``/``loss(params, batch)``;
+    ``model_parameters`` may carry a pre-built param pytree.
+    """
+    from deepspeed_tpu.comm.comm import init_distributed
+
+    config = config if config is not None else config_params
+    if args is not None and config is None:
+        config = getattr(args, "deepspeed_config", None)
+
+    init_distributed()
+    engine = DeepSpeedEngine(model=model,
+                             config=config,
+                             model_params=model_parameters,
+                             optimizer=optimizer,
+                             lr_scheduler=lr_scheduler,
+                             seed=seed)
+
+    dataloader = None
+    if training_data is not None:
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+        dataloader = DeepSpeedDataLoader(
+            training_data,
+            batch_size=engine.train_batch_size_value,
+            collate_fn=collate_fn,
+            drop_last=engine.config.dataloader_drop_last)
+
+    return engine, engine.optimizer, dataloader, engine.lr_scheduler
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Ref: ``deepspeed.init_inference`` (__init__.py:302)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    return InferenceEngine(model=model, config=config, **kwargs)
+
+
+# subpackage conveniences
+from deepspeed_tpu.models import registry as models  # noqa: E402
+from deepspeed_tpu.models.registry import get_model_config  # noqa: E402

@@ -1,0 +1,392 @@
+"""Functional transformer model family (GPT-2 and Llama class).
+
+TPU-first design notes (vs the reference's per-module eager torch models):
+
+* Parameters are a plain pytree (nested dicts of jnp arrays); the per-layer
+  params are **stacked along a leading layer axis** and the forward is a
+  ``lax.scan`` over layers — one compiled layer body regardless of depth,
+  which is the idiomatic XLA replacement for DeepSpeed's per-module hook
+  machinery (SURVEY §7 hard part (a)).
+* Activation checkpointing is ``jax.checkpoint`` with a configurable policy
+  (ref: runtime/activation_checkpointing/checkpointing.py:948 — here the
+  compiler does the re-materialisation).
+* Compute runs in ``config.dtype`` (bf16 by default), master params stay in
+  ``param_dtype`` (fp32) — the engine's mixed-precision contract.
+* Param paths are stable strings (e.g. ``layers/attn/wq``) so parallelism
+  sharding rules can be expressed as path-pattern → PartitionSpec maps
+  (AutoTP-equivalent, ref module_inject/auto_tp.py:193).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    """Architecture hyperparameters covering GPT-2 and Llama families."""
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None  # < num_heads → GQA (Llama-3)
+    head_dim: Optional[int] = None
+    max_seq_len: int = 1024
+    # architecture switches
+    arch: str = "gpt2"  # "gpt2" | "llama"
+    norm: str = "layernorm"  # "layernorm" | "rmsnorm"
+    activation: str = "gelu"  # "gelu" | "swiglu"
+    use_rope: bool = False
+    rope_theta: float = 10000.0
+    tie_embeddings: bool = True
+    # MoE (0 ⇒ dense; ref deepspeed/moe)
+    num_experts: int = 0
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    moe_layer_freq: int = 2  # every Nth layer is MoE, matching ref PR-MoE style
+    # numerics
+    dtype: Any = jnp.bfloat16  # compute dtype
+    param_dtype: Any = jnp.float32  # master dtype
+    layernorm_eps: float = 1e-5
+    # remat policy name: none|full|nothing_saveable|dots_saveable|dots_with_no_batch_dims_saveable
+    remat_policy: str = "nothing_saveable"
+    attn_impl: str = "auto"  # "auto" | "xla" | "pallas_flash"
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def dim_per_head(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    def replace(self, **kw) -> "TransformerConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# ----------------------------------------------------------------------
+# Init
+# ----------------------------------------------------------------------
+def _dense_init(key, shape, scale, dtype):
+    return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+
+def init_layer_params(cfg: TransformerConfig, key) -> Params:
+    """One transformer block's params (unstacked)."""
+    h, ffn = cfg.hidden_size, cfg.intermediate_size
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
+    keys = jax.random.split(key, 8)
+    scale = 1.0 / math.sqrt(h)
+    out_scale = scale / math.sqrt(2 * cfg.num_layers)  # GPT-2 style residual scaling
+    pd = cfg.param_dtype
+
+    attn = {
+        "wq": _dense_init(keys[0], (h, nh * hd), scale, pd),
+        "wk": _dense_init(keys[1], (h, nkv * hd), scale, pd),
+        "wv": _dense_init(keys[2], (h, nkv * hd), scale, pd),
+        "wo": _dense_init(keys[3], (nh * hd, h), out_scale, pd),
+    }
+    if cfg.arch == "gpt2":
+        attn["bq"] = jnp.zeros((nh * hd,), pd)
+        attn["bk"] = jnp.zeros((nkv * hd,), pd)
+        attn["bv"] = jnp.zeros((nkv * hd,), pd)
+        attn["bo"] = jnp.zeros((h,), pd)
+
+    def mlp_params(k1, k2, k3):
+        if cfg.activation == "swiglu":
+            return {
+                "wi": _dense_init(k1, (h, ffn), scale, pd),
+                "wg": _dense_init(k2, (h, ffn), scale, pd),
+                "wo": _dense_init(k3, (ffn, h), out_scale, pd),
+            }
+        mlp = {
+            "wi": _dense_init(k1, (h, ffn), scale, pd),
+            "wo": _dense_init(k3, (ffn, h), out_scale, pd),
+        }
+        if cfg.arch == "gpt2":
+            mlp["bi"] = jnp.zeros((ffn,), pd)
+            mlp["bo"] = jnp.zeros((h,), pd)
+        return mlp
+
+    block: Params = {"attn": attn, "mlp": mlp_params(keys[4], keys[5], keys[6])}
+
+    if cfg.is_moe:
+        # Expert weights stacked on a leading expert axis (sharded over the
+        # "expert" mesh axis); router is replicated. Ref: moe/experts.py +
+        # sharded_moe.py TopKGate.
+        ek = jax.random.split(keys[7], 4)
+        e = cfg.num_experts
+        block["moe"] = {
+            "router": _dense_init(ek[0], (h, e), scale, pd),
+            "wi": _dense_init(ek[1], (e, h, ffn), scale, pd),
+            "wg": _dense_init(ek[2], (e, h, ffn), scale, pd) if cfg.activation == "swiglu" else None,
+            "wo": _dense_init(ek[3], (e, ffn, h), out_scale, pd),
+        }
+        block["moe"] = {k: v for k, v in block["moe"].items() if v is not None}
+
+    def norm_params():
+        p = {"scale": jnp.ones((h,), pd)}
+        if cfg.norm == "layernorm":
+            p["bias"] = jnp.zeros((h,), pd)
+        return p
+
+    block["ln1"] = norm_params()
+    block["ln2"] = norm_params()
+    return block
+
+
+def init_params(cfg: TransformerConfig, key) -> Params:
+    """Full model params with per-layer params stacked on axis 0."""
+    keys = jax.random.split(key, cfg.num_layers + 3)
+    scale = 1.0 / math.sqrt(cfg.hidden_size)
+    pd = cfg.param_dtype
+
+    layer_list = [init_layer_params(cfg, keys[i]) for i in range(cfg.num_layers)]
+    layers = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *layer_list)
+
+    params: Params = {
+        "embed": {"tokens": _dense_init(keys[-3], (cfg.vocab_size, cfg.hidden_size), scale, pd)},
+        "layers": layers,
+        "final_norm": {"scale": jnp.ones((cfg.hidden_size,), pd)},
+    }
+    if cfg.norm == "layernorm":
+        params["final_norm"]["bias"] = jnp.zeros((cfg.hidden_size,), pd)
+    if cfg.arch == "gpt2":
+        params["embed"]["positions"] = _dense_init(
+            keys[-2], (cfg.max_seq_len, cfg.hidden_size), scale, pd)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _dense_init(keys[-1], (cfg.hidden_size, cfg.vocab_size), scale, pd)
+    return params
+
+
+def count_params(params: Params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+# ----------------------------------------------------------------------
+# Forward pieces
+# ----------------------------------------------------------------------
+def _norm(x, p, cfg: TransformerConfig):
+    dt = x.dtype
+    x32 = x.astype(jnp.float32)
+    if cfg.norm == "rmsnorm":
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        out = x32 * lax.rsqrt(var + cfg.layernorm_eps) * p["scale"].astype(jnp.float32)
+    else:
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        out = (x32 - mean) * lax.rsqrt(var + cfg.layernorm_eps)
+        out = out * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return out.astype(dt)
+
+
+def _rope(q, k, positions, cfg: TransformerConfig):
+    """Rotary embeddings (Llama). q,k: [B, S, H, D]."""
+    d = cfg.dim_per_head
+    freqs = 1.0 / (cfg.rope_theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    def rot(x):
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+    return rot(q).astype(q.dtype), rot(k).astype(k.dtype)
+
+
+def _attention_scores(q, k, v, cfg: TransformerConfig, segment_pos=None):
+    """Causal MHA/GQA over [B, S, H, D] via XLA einsums (MXU-friendly).
+    Pallas flash attention is selected by the engine when attn_impl allows."""
+    b, s, nh, d = q.shape
+    nkv = k.shape[2]
+    if nkv != nh:  # GQA: repeat kv heads
+        rep = nh // nkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    scores = jnp.where(mask[None, None, :, :], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _attn_block(x, p, positions, cfg: TransformerConfig):
+    b, s, h = x.shape
+    nh, nkv, d = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
+    dt = x.dtype
+
+    def proj(w, b_, out_dim):
+        y = x @ w.astype(dt)
+        if b_ is not None:
+            y = y + b_.astype(dt)
+        return y
+
+    q = proj(p["wq"], p.get("bq"), nh * d).reshape(b, s, nh, d)
+    k = proj(p["wk"], p.get("bk"), nkv * d).reshape(b, s, nkv, d)
+    v = proj(p["wv"], p.get("bv"), nkv * d).reshape(b, s, nkv, d)
+    if cfg.use_rope:
+        q, k = _rope(q, k, positions, cfg)
+
+    if cfg.attn_impl == "pallas_flash":
+        from deepspeed_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, causal=True)
+    else:
+        out = _attention_scores(q, k, v, cfg)
+    out = out.reshape(b, s, nh * d) @ p["wo"].astype(dt)
+    if p.get("bo") is not None:
+        out = out + p["bo"].astype(dt)
+    return out
+
+
+def _mlp_block(x, p, cfg: TransformerConfig):
+    dt = x.dtype
+    if cfg.activation == "swiglu":
+        gate = jax.nn.silu(x @ p["wg"].astype(dt))
+        up = x @ p["wi"].astype(dt)
+        return (gate * up) @ p["wo"].astype(dt)
+    y = x @ p["wi"].astype(dt)
+    if p.get("bi") is not None:
+        y = y + p["bi"].astype(dt)
+    y = jax.nn.gelu(y, approximate=True)
+    y = y @ p["wo"].astype(dt)
+    if p.get("bo") is not None:
+        y = y + p["bo"].astype(dt)
+    return y
+
+
+def _moe_block(x, p, cfg: TransformerConfig):
+    """Dense-dispatch MoE block used inside the scan (einsum dispatch).
+    The expert-parallel all-to-all version lives in deepspeed_tpu/moe."""
+    from deepspeed_tpu.moe.sharded_moe import moe_forward
+
+    out, aux = moe_forward(x, p, cfg)
+    return out, aux
+
+
+def transformer_layer(x, layer_params, positions, cfg: TransformerConfig,
+                      layer_is_moe=False):
+    """One pre-norm transformer block. Returns (x, moe_aux_loss).
+
+    ``layer_is_moe`` may be a traced bool (layer index inside a scan): the
+    MoE-vs-dense choice then lowers to ``lax.cond``, which is how the
+    reference's per-layer MoE placement (PR-MoE, moe_layer_freq) maps onto a
+    uniform scan-over-layers body.
+    """
+    x = x + _attn_block(_norm(x, layer_params["ln1"], cfg), layer_params["attn"], positions, cfg)
+    h = _norm(x, layer_params["ln2"], cfg)
+    if "moe" not in layer_params:
+        return x + _mlp_block(h, layer_params["mlp"], cfg), jnp.zeros((), jnp.float32)
+
+    def moe_branch(h):
+        return _moe_block(h, layer_params["moe"], cfg)
+
+    def dense_branch(h):
+        return _mlp_block(h, layer_params["mlp"], cfg), jnp.zeros((), jnp.float32)
+
+    if isinstance(layer_is_moe, bool):
+        y, aux = moe_branch(h) if layer_is_moe else dense_branch(h)
+    else:
+        y, aux = lax.cond(layer_is_moe, moe_branch, dense_branch, h)
+    return x + y, aux
+
+
+_REMAT_POLICIES = {
+    "none": None,
+    "full": None,
+    "nothing_saveable": "nothing_saveable",
+    "dots_saveable": "dots_saveable",
+    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+}
+
+
+def _maybe_remat(fn, cfg: TransformerConfig):
+    if cfg.remat_policy in ("none",):
+        return fn
+    policy = None
+    name = _REMAT_POLICIES.get(cfg.remat_policy)
+    if name:
+        policy = getattr(jax.checkpoint_policies, name)
+    return jax.checkpoint(fn, policy=policy, prevent_cse=False)
+
+
+def forward(params: Params, input_ids, cfg: TransformerConfig,
+            positions=None) -> jnp.ndarray:
+    """Token ids [B, S] → logits [B, S, V]. lax.scan over stacked layers."""
+    b, s = input_ids.shape
+    dt = cfg.dtype
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+
+    x = params["embed"]["tokens"].astype(dt)[input_ids]
+    if cfg.arch == "gpt2":
+        x = x + params["embed"]["positions"].astype(dt)[positions]
+
+    moe_every = max(1, cfg.moe_layer_freq)
+
+    def body(carry, scanned):
+        h, aux_acc = carry
+        layer_params, layer_idx = scanned
+        if cfg.is_moe:
+            is_moe_layer = (layer_idx % moe_every) == (moe_every - 1)
+        else:
+            is_moe_layer = False
+        h2, aux = transformer_layer(h, layer_params, positions, cfg,
+                                    layer_is_moe=is_moe_layer)
+        return (h2, aux_acc + aux), None
+
+    body = _maybe_remat(body, cfg)
+    layer_indices = jnp.arange(cfg.num_layers)
+    (x, moe_aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                               (params["layers"], layer_indices))
+
+    x = _norm(x, params["final_norm"], cfg)
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"]["tokens"].astype(dt).T
+    else:
+        logits = x @ params["lm_head"].astype(dt)
+    if cfg.is_moe:
+        # stash aux loss on the fwd for the engine loss fn via closure return
+        return logits, moe_aux
+    return logits
+
+
+def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfig):
+    """Causal LM cross-entropy. ``batch``: input_ids [B,S], labels [B,S]
+    (-100 = ignore, HF convention), optional loss_mask."""
+    out = forward(params, batch["input_ids"], cfg)
+    moe_aux = jnp.zeros((), jnp.float32)
+    if isinstance(out, tuple):
+        logits, moe_aux = out
+    else:
+        logits = out
+    labels = batch["labels"]
+    mask = (labels != -100)
+    if "loss_mask" in batch:
+        mask = mask & (batch["loss_mask"] > 0)
+    safe_labels = jnp.where(mask, labels, 0)
+    logits32 = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits32, axis=-1)
+    gold = jnp.take_along_axis(logits32, safe_labels[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * mask
+    denom = jnp.maximum(mask.sum(), 1)
+    loss = nll.sum() / denom
+    if cfg.is_moe:
+        loss = loss + 0.01 * moe_aux
+    return loss

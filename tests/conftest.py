@@ -1,0 +1,48 @@
+"""Test harness: 8 virtual CPU devices.
+
+TPU translation of the reference's distributed-without-a-cluster fixture
+(tests/unit/common.py DistributedExec): instead of spawning N processes with
+a file store, we run single-process JAX with
+``--xla_force_host_platform_device_count=8`` so every mesh shape up to 8
+"chips" is exercised for real (collectives included) on a GPU/TPU-less CI
+machine — the same role the CPU accelerator plays for the reference.
+"""
+
+import os
+
+# Must be set before the CPU backend initializes (backends are lazy, so
+# setting it at conftest import is early enough even though sitecustomize
+# may have imported jax already).
+_flag = "--xla_force_host_platform_device_count=8"
+if _flag not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " " + _flag
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# The axon TPU plugin pins jax_platforms via jax.config at sitecustomize
+# time; env vars alone cannot override it — force CPU through the config.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_topology():
+    """Each test builds its own mesh; clear the global between tests."""
+    yield
+    from deepspeed_tpu.parallel import topology
+
+    topology._GLOBAL_TOPOLOGY = None
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+def make_lm_batch(rng, batch: int, seq: int, vocab: int):
+    ids = rng.integers(0, vocab, size=(batch, seq + 1), dtype=np.int32)
+    return {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}

@@ -1,0 +1,100 @@
+"""Config system tests (ref test model: tests/unit/runtime/test_ds_config_dict.py)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_batch_resolution_infer_gas():
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4},
+                          world_size=2)
+    assert cfg.gradient_accumulation_steps == 4
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_resolution_infer_train():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2,
+                           "gradient_accumulation_steps": 3}, world_size=4)
+    assert cfg.train_batch_size == 24
+
+
+def test_batch_resolution_infer_micro():
+    cfg = DeepSpeedConfig({"train_batch_size": 16, "gradient_accumulation_steps": 2},
+                          world_size=4)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+def test_batch_inconsistent_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 10, "train_micro_batch_size_per_gpu": 4},
+                        world_size=2)
+
+
+def test_no_batch_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, world_size=1)
+
+
+def test_zero_config():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 3,
+            "offload_optimizer": {"device": "cpu", "pin_memory": True},
+            "reduce_bucket_size": 1000,
+        },
+    })
+    assert cfg.zero_config.stage == 3
+    assert cfg.zero_config.offload_optimizer_device == "cpu"
+    assert cfg.zero_enabled
+
+
+def test_zero_stage_range():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8, "zero_optimization": {"stage": 4}})
+
+
+def test_fp16_bf16_conflict():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}})
+
+
+def test_fp16_dynamic_scale():
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "fp16": {"enabled": True, "initial_scale_power": 12}})
+    assert cfg.fp16.dynamic
+    assert cfg.fp16.initial_scale_power == 12
+
+
+def test_optimizer_scheduler_blocks():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "betas": [0.9, 0.95]}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 100}},
+    })
+    assert cfg.optimizer.type == "adamw"
+    assert cfg.optimizer.lr == 3e-4
+    assert cfg.scheduler.type == "WarmupLR"
+
+
+def test_mesh_resolution():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "mesh": {"tensor": 2, "data": -1}})
+    sizes = cfg.mesh.resolved(8)
+    assert sizes["tensor"] == 2 and sizes["data"] == 4
+
+
+def test_mesh_from_tp_config():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "tensor_parallel": {"autotp_size": 4}})
+    assert cfg.mesh.tensor == 4
+
+
+def test_unknown_keys_ignored():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True, "bogus": 1}})
+    assert cfg.fp16.enabled
+
+
+def test_deprecated_cpu_offload_alias():
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "zero_optimization": {"stage": 2, "cpu_offload": True}})
+    assert cfg.zero_config.offload_optimizer_device == "cpu"

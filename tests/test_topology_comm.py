@@ -1,0 +1,92 @@
+"""Mesh topology + collectives facade tests (ref: tests/unit/comm/test_dist.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.parallel.topology import (DATA_AXIS, TENSOR_AXIS, MeshTopology,
+                                             set_topology)
+
+
+def test_topology_resolution():
+    topo = MeshTopology({"data": -1, "tensor": 2})
+    assert topo.tp_size == 2
+    assert topo.dp_size == 4
+    assert topo.world_size == 8
+
+
+def test_topology_all_axes():
+    topo = MeshTopology({"pipe": 2, "data": 2, "seq": 2, "tensor": 1})
+    assert topo.pp_size == 2 and topo.sp_size == 2
+    assert topo.zero_size == 4  # data * expert * seq
+
+
+def test_topology_bad_product():
+    with pytest.raises(ValueError):
+        MeshTopology({"data": 5, "tensor": 2})  # 10 > 8 devices
+
+
+def test_topology_submesh():
+    topo = MeshTopology({"data": 3, "tensor": 2})  # 6 of 8 devices
+    assert topo.world_size == 6
+
+
+def test_all_reduce_in_shard_map():
+    topo = MeshTopology({"data": 8})
+    set_topology(topo)
+    x = jnp.arange(8.0)
+
+    def f(shard):
+        return comm.all_reduce(shard, group=DATA_AXIS)
+
+    out = shard_map(f, mesh=topo.mesh, in_specs=P(DATA_AXIS), out_specs=P(DATA_AXIS))(x)
+    np.testing.assert_allclose(np.asarray(out), np.full(8, 28.0))
+
+
+def test_reduce_scatter_all_gather_roundtrip():
+    topo = MeshTopology({"data": 4, "tensor": 2})
+    set_topology(topo)
+    x = jnp.arange(32.0).reshape(4, 8)
+
+    def f(shard):
+        rs = comm.reduce_scatter(shard, group=DATA_AXIS, axis=0)
+        return comm.all_gather(rs, group=DATA_AXIS, axis=0)
+
+    out = shard_map(f, mesh=topo.mesh, in_specs=P(None, TENSOR_AXIS),
+                    out_specs=P(None, TENSOR_AXIS), check_vma=False)(x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 4)
+
+
+def test_all_to_all():
+    topo = MeshTopology({"data": 4, "tensor": 2})
+    set_topology(topo)
+    x = jnp.arange(16.0).reshape(4, 4)
+
+    def f(shard):
+        return comm.all_to_all(shard, group=DATA_AXIS, split_axis=1, concat_axis=0)
+
+    out = shard_map(f, mesh=topo.mesh, in_specs=P(DATA_AXIS, None),
+                    out_specs=P(DATA_AXIS, None))(x)
+    # tiled all_to_all redistributes: global result is the block transpose
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x).T.reshape(16, 1))
+
+
+def test_eager_all_reduce():
+    topo = MeshTopology({"data": 8})
+    set_topology(topo)
+    x = jnp.ones((8, 4))
+    out = comm.all_reduce_eager(x, group=DATA_AXIS, shard_dim=0)
+    np.testing.assert_allclose(np.asarray(out), np.full((8, 4), 8.0))
+
+
+def test_world_size_queries():
+    topo = MeshTopology({"data": 4, "tensor": 2})
+    set_topology(topo)
+    assert comm.get_world_size() == 8
+    assert comm.get_world_size("tensor") == 2
+    assert comm.get_world_size(("data", "tensor")) == 8
+    assert comm.get_rank() == 0
