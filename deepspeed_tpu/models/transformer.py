@@ -55,6 +55,10 @@ class TransformerConfig:
     top_k: int = 2
     capacity_factor: float = 1.25
     moe_layer_freq: int = 2  # every Nth layer is MoE, matching ref PR-MoE style
+    # pipeline parallelism: microbatches per forward call, i.e. per
+    # gradient-accumulation micro-step (0 → pp size); must divide the
+    # per-call batch dim
+    pipeline_microbatches: int = 0
     # numerics
     dtype: Any = jnp.bfloat16  # compute dtype
     param_dtype: Any = jnp.float32  # master dtype
@@ -243,13 +247,21 @@ def _attn_block(x, p, positions, cfg: TransformerConfig):
     if cfg.use_rope:
         q, k = _rope(q, k, positions, cfg)
 
+    # Ulysses SP: re-shard seq-sharded q/k/v to head-sharded (XLA lowers the
+    # layout switch to all-to-all over ICI; ref sequence/layer.py:331).
+    from deepspeed_tpu.sequence.layer import (ulysses_output_constraint,
+                                              ulysses_qkv_constraint)
+
+    q, k, v = ulysses_qkv_constraint(q, k, v)
+
     if cfg.attn_impl == "pallas_flash":
         from deepspeed_tpu.ops.flash_attention import flash_attention
 
         out = flash_attention(q, k, v, causal=True)
     else:
         out = _attention_scores(q, k, v, cfg)
-    out = out.reshape(b, s, nh * d) @ p["wo"].astype(dt)
+    out = ulysses_output_constraint(out.reshape(b, s, nh * d))
+    out = out @ p["wo"].astype(dt)
     if p.get("bo") is not None:
         out = out + p["bo"].astype(dt)
     return out
@@ -340,21 +352,45 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
 
     moe_every = max(1, cfg.moe_layer_freq)
 
-    def body(carry, scanned):
-        h, aux_acc = carry
-        layer_params, layer_idx = scanned
-        if cfg.is_moe:
-            is_moe_layer = (layer_idx % moe_every) == (moe_every - 1)
-        else:
-            is_moe_layer = False
-        h2, aux = transformer_layer(h, layer_params, positions, cfg,
-                                    layer_is_moe=is_moe_layer)
-        return (h2, aux_acc + aux), None
+    from deepspeed_tpu.parallel.topology import get_topology
 
-    body = _maybe_remat(body, cfg)
-    layer_indices = jnp.arange(cfg.num_layers)
-    (x, moe_aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
-                               (params["layers"], layer_indices))
+    topo = get_topology()
+    moe_aux = jnp.zeros((), jnp.float32)
+    if topo is not None and topo.pp_size > 1:
+        # Pipeline path: layers circulate microbatches over the "pipe" axis
+        # (ref runtime/pipe/engine.py TrainSchedule → spmd_pipeline here).
+        if cfg.is_moe:
+            raise NotImplementedError("MoE + pipeline parallelism not yet supported")
+        from deepspeed_tpu.parallel.pipeline import spmd_pipeline
+
+        def stage_fn(stage_params, h, pos_mb):
+            def body(h_, lp):
+                h2, _ = transformer_layer(h_, lp, pos_mb, cfg, layer_is_moe=False)
+                return h2, None
+
+            body = _maybe_remat(body, cfg)
+            h, _ = lax.scan(body, h, stage_params)
+            return h
+
+        n_micro = cfg.pipeline_microbatches or topo.pp_size
+        x = spmd_pipeline(stage_fn, params["layers"], x, topo=topo,
+                          n_micro=n_micro, extras=positions)
+    else:
+        def body(carry, scanned):
+            h, aux_acc = carry
+            layer_params, layer_idx = scanned
+            if cfg.is_moe:
+                is_moe_layer = (layer_idx % moe_every) == (moe_every - 1)
+            else:
+                is_moe_layer = False
+            h2, aux = transformer_layer(h, layer_params, positions, cfg,
+                                        layer_is_moe=is_moe_layer)
+            return (h2, aux_acc + aux), None
+
+        body = _maybe_remat(body, cfg)
+        layer_indices = jnp.arange(cfg.num_layers)
+        (x, moe_aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                                   (params["layers"], layer_indices))
 
     x = _norm(x, params["final_norm"], cfg)
     if cfg.tie_embeddings:

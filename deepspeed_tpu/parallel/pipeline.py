@@ -1,0 +1,120 @@
+"""Pipeline parallelism via SPMD collective-permute.
+
+TPU-native re-design of ``runtime/pipe/`` (PipelineModule module.py:86,
+PipelineEngine engine.py:337, TrainSchedule schedule.py:189, P2P p2p.py):
+instead of an instruction-schedule interpreter issuing eager P2P sends
+between stage processes, the whole pipeline is ONE ``shard_map`` over the
+"pipe" mesh axis:
+
+* layer params are stacked ``[L, ...]`` and sharded over "pipe", so each
+  stage holds ``L/pp`` layers — the analog of ``PipelineModule``'s layer
+  partitioning ("uniform" method, ref module.py:393);
+* microbatches circulate between stages with ``lax.ppermute`` (ICI
+  neighbour exchange), the analog of SendActivation/RecvActivation
+  (ref engine.py:1016/:1108);
+* the schedule is the classic GPipe fill-drain: ``n_micro + pp - 1`` ticks,
+  expressed as a differentiable ``lax.scan`` — backward reuses the same
+  rotation in reverse (the transpose of ppermute), replacing
+  SendGrad/RecvGrad (ref engine.py:1052/:1151).
+
+Other mesh axes (data/tensor/seq/expert) stay in GSPMD "auto" mode inside
+the shard_map (jax 0.9 ``axis_names``), so pipeline composes with ZeRO/DP/TP
+sharding unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.parallel.topology import PIPE_AXIS, MeshTopology
+
+
+def spmd_pipeline(layer_fn: Callable,
+                  stage_params,
+                  x: jnp.ndarray,
+                  *,
+                  topo: MeshTopology,
+                  n_micro: int,
+                  extras=None):
+    """Run stacked layers over the "pipe" axis in pipelined fashion.
+
+    ``layer_fn(stage_local_params, h, extras_mb) -> h`` must apply this
+    stage's layers to a microbatch of activations ``[mb, S, H]`` (typically
+    a scan over the local ``L/pp`` stacked layers).  ``stage_params`` leaves
+    have a leading layer axis sharded over "pipe".  ``x``: ``[B, S, H]``
+    activations after the (replicated) embedding; ``B % n_micro == 0``.
+    ``extras`` is an optional pytree of per-example side inputs (leading dim
+    B, e.g. RoPE positions); each stage receives the slice belonging to the
+    microbatch it is currently processing (microbatch ``t - stage_idx``).
+
+    Returns ``[B, S, H]`` activations after all L layers, replicated over
+    the pipe axis.
+
+    NOTE: every stage carries the full outputs accumulator through the scan
+    (only the last stage writes it) and the final psum broadcasts it across
+    the pipe axis — simple and correct; a ring-drain collection would save
+    (pp-1)/pp of that buffer and is a planned optimisation.
+    """
+    pp = topo.pp_size
+    b = x.shape[0]
+    assert b % n_micro == 0, f"batch {b} not divisible by microbatches {n_micro}"
+    mb = b // n_micro
+    extras = extras if extras is not None else ()
+    if pp == 1:
+        return layer_fn(stage_params, x, extras)
+
+    def per_stage(stage_local_params, x_local, extras_local):
+        idx = lax.axis_index(PIPE_AXIS)
+        micro = x_local.reshape((n_micro, mb) + x_local.shape[1:])
+        micro_extras = jax.tree.map(
+            lambda e: e.reshape((n_micro, mb) + e.shape[1:]), extras_local)
+        state = jnp.zeros_like(micro[0])
+        outputs = jnp.zeros_like(micro)
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # Stage 0 ingests microbatch t (while t < n_micro); other stages
+            # use what arrived from the previous stage.
+            inp = micro[jnp.minimum(t, n_micro - 1)]
+            feed = jnp.where((idx == 0) & (t < n_micro), 1.0, 0.0).astype(state.dtype)
+            h = feed * inp + (1 - feed) * state
+            # This stage is processing microbatch t - idx right now.
+            cur_mb = jnp.clip(t - idx, 0, n_micro - 1)
+            extras_mb = jax.tree.map(lambda e: e[cur_mb], micro_extras)
+            out = layer_fn(stage_local_params, h, extras_mb)
+            # Last stage emits microbatch t-(pp-1): masked dynamic update so
+            # non-emitting ticks/stages leave the slot untouched.
+            out_t = t - (pp - 1)
+            emit = (idx == pp - 1) & (out_t >= 0)
+            safe_t = jnp.maximum(out_t, 0)
+            cur = lax.dynamic_index_in_dim(outputs, safe_t, axis=0, keepdims=False)
+            upd = jnp.where(emit, out.astype(outputs.dtype), cur)
+            outputs = lax.dynamic_update_index_in_dim(outputs, upd, safe_t, axis=0)
+            state = lax.ppermute(out, PIPE_AXIS, perm)
+            return (state, outputs), None
+
+        (state, outputs), _ = lax.scan(tick, (state, outputs),
+                                       jnp.arange(n_micro + pp - 1))
+        # outputs are valid only on the last stage → broadcast via psum.
+        mask = (idx == pp - 1).astype(outputs.dtype)
+        outputs = lax.psum(outputs * mask, PIPE_AXIS)
+        return outputs.reshape(x_local.shape)
+
+    from jax.sharding import PartitionSpec as P
+
+    param_specs = jax.tree.map(lambda _: P(PIPE_AXIS), stage_params)
+    extras_specs = jax.tree.map(lambda _: P(), extras)
+    return jax.shard_map(
+        per_stage,
+        mesh=topo.mesh,
+        in_specs=(param_specs, P(), extras_specs),
+        out_specs=P(),
+        axis_names={PIPE_AXIS},
+        check_vma=False,
+    )(stage_params, x, extras)

@@ -33,8 +33,8 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu.parallel.topology import (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS,
-                                             MeshTopology)
+from deepspeed_tpu.parallel.topology import (DATA_AXIS, EXPERT_AXIS, PIPE_AXIS, SEQ_AXIS,
+                                             TENSOR_AXIS, MeshTopology)
 from deepspeed_tpu.utils.logging import logger
 
 # path-pattern → logical dims, one entry per array dim.
@@ -129,7 +129,12 @@ class ShardingRules:
 
         spec: List[Any] = [None] * ndim
         for i, d in enumerate(dims):
-            if d == "expert" and self.topo.ep_size > 1:
+            if d == "layer" and self.topo.pp_size > 1:
+                # stacked-layer axis → pipeline stages (ref PipelineModule
+                # uniform partitioning, runtime/pipe/module.py:393)
+                if shape[i] % self.topo.pp_size == 0:
+                    spec[i] = PIPE_AXIS
+            elif d == "expert" and self.topo.ep_size > 1:
                 if shape[i] % self.topo.ep_size == 0:
                     spec[i] = EXPERT_AXIS
             elif d in ("mlp", "heads", "vocab") and tp:

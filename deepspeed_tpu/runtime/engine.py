@@ -140,6 +140,8 @@ class DeepSpeedEngine:
                             if cfg.activation_checkpointing.partition_activations
                             or cfg.activation_checkpointing.remat_policy != "nothing_saveable"
                             else mc.remat_policy)
+            if cfg.pipeline.num_microbatches:
+                mc = mc.replace(pipeline_microbatches=cfg.pipeline.num_microbatches)
             self.model_config = mc
             self._init_fn = partial(tf_model.init_params, mc)
             self._loss_fn = partial(tf_model.loss_fn, cfg=mc)
