@@ -1,0 +1,157 @@
+// Asynchronous file I/O engine for NVMe tiering (DeepNVMe equivalent).
+//
+// TPU-native re-implementation of the reference's AIO stack
+// (csrc/aio/common + csrc/aio/py_lib: deepspeed_aio_thread.cpp,
+// deepspeed_py_io_handle.cpp): a pthread worker pool drains a task queue of
+// pread/pwrite jobs, each optionally split into block_size chunks so
+// multiple threads cooperate on one large tensor (the reference's
+// single_submit/overlap_events scheduling collapses to queue order here).
+// Exposed as a plain C API consumed from Python via ctypes — no pybind11
+// in this image.
+//
+// Build: g++ -O3 -shared -fPIC -pthread ds_aio.cpp -o libds_aio.so
+
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <string>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Task {
+    bool write;
+    char* buf;
+    long nbytes;
+    std::string path;
+    long file_offset;
+    long buf_offset;
+    int job_id;
+};
+
+struct Handle {
+    long block_size;
+    int queue_depth;  // max in-flight tasks before submit blocks
+    std::vector<std::thread> workers;
+    std::deque<Task> queue;
+    std::mutex mu;
+    std::condition_variable cv_task;   // workers wait for tasks
+    std::condition_variable cv_done;   // waiters wait for drain
+    std::atomic<long> inflight{0};
+    std::atomic<int> next_job{0};
+    std::atomic<long> errors{0};
+    bool shutdown = false;
+
+    explicit Handle(long bs, int qd, int n_threads) : block_size(bs), queue_depth(qd) {
+        for (int i = 0; i < n_threads; ++i)
+            workers.emplace_back([this] { this->worker_loop(); });
+    }
+
+    ~Handle() {
+        {
+            std::lock_guard<std::mutex> lk(mu);
+            shutdown = true;
+        }
+        cv_task.notify_all();
+        for (auto& t : workers) t.join();
+    }
+
+    void worker_loop() {
+        for (;;) {
+            Task task;
+            {
+                std::unique_lock<std::mutex> lk(mu);
+                cv_task.wait(lk, [this] { return shutdown || !queue.empty(); });
+                if (shutdown && queue.empty()) return;
+                task = queue.front();
+                queue.pop_front();
+            }
+            run(task);
+            long left = --inflight;
+            if (left == 0) cv_done.notify_all();
+        }
+    }
+
+    void run(const Task& t) {
+        int flags = t.write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+        int fd = ::open(t.path.c_str(), flags, 0644);
+        if (fd < 0) {
+            ++errors;
+            return;
+        }
+        long done = 0;
+        while (done < t.nbytes) {
+            long chunk = t.nbytes - done;
+            ssize_t r = t.write
+                ? ::pwrite(fd, t.buf + t.buf_offset + done, chunk, t.file_offset + done)
+                : ::pread(fd, t.buf + t.buf_offset + done, chunk, t.file_offset + done);
+            if (r <= 0) {
+                ++errors;
+                break;
+            }
+            done += r;
+        }
+        ::close(fd);
+    }
+
+    int submit(bool write, char* buf, long nbytes, const char* path, long file_offset) {
+        int job = next_job++;
+        // split into block_size chunks so the pool parallelises one tensor
+        long nchunks = (nbytes + block_size - 1) / block_size;
+        {
+            std::unique_lock<std::mutex> lk(mu);
+            cv_done.wait(lk, [this] {
+                return inflight.load() < (long)queue_depth * (long)workers.size() + 1024;
+            });
+            for (long c = 0; c < nchunks; ++c) {
+                long off = c * block_size;
+                long len = std::min(block_size, nbytes - off);
+                inflight++;
+                queue.push_back(Task{write, buf, len, path, file_offset + off, off, job});
+            }
+        }
+        cv_task.notify_all();
+        return job;
+    }
+
+    long wait_all() {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_done.wait(lk, [this] { return inflight.load() == 0; });
+        return errors.exchange(0);
+    }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ds_aio_create(long block_size, int queue_depth, int n_threads) {
+    if (block_size <= 0) block_size = 1 << 20;
+    if (n_threads <= 0) n_threads = 1;
+    return new Handle(block_size, queue_depth, n_threads);
+}
+
+void ds_aio_destroy(void* h) { delete static_cast<Handle*>(h); }
+
+int ds_aio_pread(void* h, void* buf, long nbytes, const char* path, long offset) {
+    return static_cast<Handle*>(h)->submit(false, static_cast<char*>(buf), nbytes, path, offset);
+}
+
+int ds_aio_pwrite(void* h, const void* buf, long nbytes, const char* path, long offset) {
+    return static_cast<Handle*>(h)->submit(true, const_cast<char*>(static_cast<const char*>(buf)),
+                                           nbytes, path, offset);
+}
+
+// Blocks until every submitted op completes; returns the number of failed
+// chunk ops since the last wait (0 == success).
+long ds_aio_wait(void* h) { return static_cast<Handle*>(h)->wait_all(); }
+
+long ds_aio_pending(void* h) { return static_cast<Handle*>(h)->inflight.load(); }
+
+}  // extern "C"

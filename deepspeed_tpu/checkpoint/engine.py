@@ -46,9 +46,11 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[Dict[str, Any]] = None) -> None:
     tag = tag if tag is not None else f"global_step{engine.global_steps}"
     os.makedirs(os.path.join(save_dir, str(tag)), exist_ok=True)
+    opt_tree = (engine.opt_state if getattr(engine, "_opt_store", None) is None
+                else engine._opt_store.swap_in())
     state = {
         "module": _to_host(engine.params),
-        "optimizer": _to_host(engine.opt_state),
+        "optimizer": _to_host(opt_tree),
         "loss_scale_state": _to_host(engine.loss_scale_state),
         "lr_scheduler": engine.lr_scheduler.state_dict(),
         "global_steps": engine.global_steps,

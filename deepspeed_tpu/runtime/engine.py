@@ -183,8 +183,60 @@ class DeepSpeedEngine:
         opt_state_shape = jax.eval_shape(self.optimizer.init, params_shape)
         self.opt_shardings = _match_state_shardings(
             opt_state_shape, params_treedef, opt_param_shardings, self._replicated)
+
+        # -- ZeRO-Offload / -Infinity tiering --------------------------
+        # Two realisations (runtime/offload.py): streaming mode keeps opt
+        # state in host memory via XLA memory kinds with device↔host
+        # transfers compiled into the step (TPU); store mode keeps numpy
+        # arrays on the host / NVMe and swaps around each step.
+        self._opt_store = None
+        self._opt_stream_offload = False
+        self._opt_device_shardings = self.opt_shardings
+        off_opt = cfg.zero_config.offload_optimizer
+        if off_opt and off_opt.device == "cpu":
+            from deepspeed_tpu.runtime.offload import (HostOptimizerStore,
+                                                       host_offload_supported,
+                                                       partial_offload_shardings)
+
+            if host_offload_supported(topology):
+                self.opt_shardings = partial_offload_shardings(
+                    opt_state_shape, self.opt_shardings, off_opt.ratio)
+                self._opt_stream_offload = True
+                log_dist(f"ZeRO-Offload: opt state → host RAM via memory kinds "
+                         f"(ratio={off_opt.ratio})")
+            else:
+                self._opt_store = HostOptimizerStore()
+                log_dist("ZeRO-Offload: opt state → host-store (numpy) mode")
+        off_param = cfg.zero_config.offload_param
+        if off_param and off_param.device == "nvme":
+            logger.warning("offload_param.device='nvme' is not yet supported on TPU; "
+                           "params stay in HBM (use offload_optimizer nvme instead)")
+        if off_param and off_param.device == "cpu":
+            from deepspeed_tpu.runtime.offload import (host_offload_supported,
+                                                       with_memory_kind)
+
+            if host_offload_supported(topology):
+                self.param_shardings = with_memory_kind(self.param_shardings,
+                                                        "pinned_host")
+                self.params = jax.device_put(self.params, self.param_shardings)
+                log_dist("ZeRO-Infinity: params → host RAM")
+            else:
+                log_dist("ZeRO-Infinity: param host offload unsupported on this "
+                         "backend; params stay on device")
+
         opt_init_jit = jax.jit(self.optimizer.init, out_shardings=self.opt_shardings)
         self.opt_state = opt_init_jit(self.params)
+
+        if off_opt and off_opt.device == "nvme":
+            from deepspeed_tpu.runtime.offload import NVMeOptimizerSwapper
+
+            swap_dir = off_opt.nvme_path or os.path.join(
+                os.environ.get("TMPDIR", "/tmp"), "dstpu_nvme_swap")
+            self._opt_store = NVMeOptimizerSwapper(swap_dir, cfg.aio_config)
+            log_dist(f"ZeRO-Infinity: optimizer state → NVMe at {swap_dir}")
+        if self._opt_store is not None:
+            self._opt_store.swap_out(self.opt_state)
+            self.opt_state = None  # store is authoritative between steps
 
         self.grad_shardings = self.rules.grad_accum_shardings(params_shape)
 
@@ -226,6 +278,7 @@ class DeepSpeedEngine:
         # grad accumulation buffer for the forward/backward/step trio
         self._grad_buffer = None
         self._micro_in_step = 0
+        self._checkpoint_engine = None
 
         self._compile_steps()
 
@@ -260,7 +313,14 @@ class DeepSpeedEngine:
             sloss, grads = jax.value_and_grad(scaled_loss)(params)
             return sloss / scale, grads
 
+        stream_offload = self._opt_stream_offload
+        opt_device_shardings = self._opt_device_shardings
+
         def apply_update(params, opt_state, grads, lr, ls_state):
+            if stream_offload:
+                # ZeRO-Offload streaming: state arrives in host memory; move
+                # to device for the update (XLA schedules the transfers).
+                opt_state = jax.device_put(opt_state, opt_device_shardings)
             scale = ls_state["scale"]
             inv = 1.0 / (scale * gas)
             grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
@@ -355,6 +415,32 @@ class DeepSpeedEngine:
         self._eval_step_jit = jax.jit(eval_step, out_shardings=self._replicated)
 
     # ------------------------------------------------------------------
+    # NVMe optimizer-state swapping (ZeRO-Infinity)
+    # ------------------------------------------------------------------
+    def _swap_in_opt_state(self):
+        if self._opt_store is None:
+            return self.opt_state
+        return jax.device_put(self._opt_store.swap_in(), self._opt_device_shardings)
+
+    def _swap_out_opt_state(self, opt_state) -> None:
+        if self._opt_store is None:
+            self.opt_state = opt_state
+            return
+        self._opt_store.swap_out(opt_state)
+        self.opt_state = None
+
+    def offload_states(self, include=None) -> None:
+        """Move params/optimizer state to host RAM (ref offload_states.py:90)."""
+        from deepspeed_tpu.runtime.offload import offload_states as _off
+
+        _off(self, include)
+
+    def reload_states(self, include=None) -> None:
+        from deepspeed_tpu.runtime.offload import reload_states as _rl
+
+        _rl(self, include)
+
+    # ------------------------------------------------------------------
     # Batch handling
     # ------------------------------------------------------------------
     def _batch_sharding_for(self, arr, stacked: bool) -> NamedSharding:
@@ -402,8 +488,10 @@ class DeepSpeedEngine:
         batch_stack = self._stack_micro_batches(data)
         batch_stack = self._put_batch(batch_stack, stacked=True)
         lr = jnp.float32(self.lr_scheduler(self.global_steps))
-        self.params, self.opt_state, self.loss_scale_state, metrics = self._train_step_jit(
-            self.params, self.opt_state, self.loss_scale_state, batch_stack, lr)
+        opt_state = self._swap_in_opt_state()
+        self.params, opt_state, self.loss_scale_state, metrics = self._train_step_jit(
+            self.params, opt_state, self.loss_scale_state, batch_stack, lr)
+        self._swap_out_opt_state(opt_state)
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps_value
         self.lr_scheduler.step()
@@ -445,8 +533,10 @@ class DeepSpeedEngine:
             self.timers(STEP_GLOBAL_TIMER).stop()
             return
         lr = jnp.float32(self.lr_scheduler(self.global_steps))
-        self.params, self.opt_state, self.loss_scale_state, metrics = self._apply_step_jit(
-            self.params, self.opt_state, self.loss_scale_state, self._grad_buffer, lr)
+        opt_state = self._swap_in_opt_state()
+        self.params, opt_state, self.loss_scale_state, metrics = self._apply_step_jit(
+            self.params, opt_state, self.loss_scale_state, self._grad_buffer, lr)
+        self._swap_out_opt_state(opt_state)
         self._grad_buffer = None
         self._micro_in_step = 0
         self.global_steps += 1
@@ -503,8 +593,28 @@ class DeepSpeedEngine:
     # Checkpointing (basic pickle-of-host-arrays; checkpoint/ has the full
     # sharded + universal formats)
     # ------------------------------------------------------------------
+    @property
+    def checkpoint_engine(self):
+        """Pluggable writer (ref runtime/checkpoint_engine/): 'orbax' (sharded
+        tensorstore, optional async) or the default pickle engine."""
+        if self._checkpoint_engine is None:
+            cc = self.config.checkpoint_config
+            writer_type = (cc.writer or {}).get("type", "")
+            if writer_type == "orbax" or cc.async_save:
+                from deepspeed_tpu.checkpoint.orbax_engine import OrbaxCheckpointEngine
+
+                self._checkpoint_engine = OrbaxCheckpointEngine(async_save=cc.async_save)
+            else:
+                self._checkpoint_engine = "pickle"
+        return self._checkpoint_engine
+
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None) -> None:
+        ce = self.checkpoint_engine
+        if ce != "pickle":
+            ce.save(self, save_dir, tag or f"global_step{self.global_steps}",
+                    client_state=client_state or {})
+            return
         from deepspeed_tpu.checkpoint.engine import save_checkpoint as _save
 
         _save(self, save_dir, tag=tag, client_state=client_state or {})
@@ -512,8 +622,39 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True):
-        from deepspeed_tpu.checkpoint.engine import load_checkpoint as _load
+        if self.config.load_universal_checkpoint:
+            from deepspeed_tpu.checkpoint.universal import (load_universal,
+                                                            resolve_universal_dir)
 
-        return _load(self, load_dir, tag=tag,
-                     load_optimizer_states=load_optimizer_states,
-                     load_lr_scheduler_states=load_lr_scheduler_states)
+            load_universal(self, resolve_universal_dir(load_dir, tag))
+            self._sync_store_after_load()
+            return load_dir, {}
+        ce = self.checkpoint_engine
+        if ce != "pickle":
+            result = ce.load(self, load_dir, tag=tag,
+                             load_optimizer_states=load_optimizer_states,
+                             load_lr_scheduler_states=load_lr_scheduler_states)
+        else:
+            from deepspeed_tpu.checkpoint.engine import load_checkpoint as _load
+
+            result = _load(self, load_dir, tag=tag,
+                           load_optimizer_states=load_optimizer_states,
+                           load_lr_scheduler_states=load_lr_scheduler_states)
+        self._sync_store_after_load()
+        return result
+
+    def _opt_state_template(self):
+        """Optimizer-state pytree usable as a structure/shape template even
+        when an offload store (host/NVMe) is authoritative."""
+        if self.opt_state is not None:
+            return self.opt_state
+        if self._opt_store is not None:
+            return self._opt_store.swap_in()
+        return None
+
+    def _sync_store_after_load(self) -> None:
+        """After any checkpoint load: if an offload store is authoritative,
+        push the freshly-loaded optimizer state into it."""
+        if self._opt_store is not None and self.opt_state is not None:
+            self._opt_store.swap_out(self.opt_state)
+            self.opt_state = None

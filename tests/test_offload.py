@@ -1,0 +1,207 @@
+"""Offload tests: host-RAM optimizer/param offload via memory kinds, partial
+(TwinFlow) ratio, NVMe swapping via the native AIO engine, offload_states
+API, and raw AIO round-trips (ref test model: tests/unit/runtime/zero/
+test_offload_states & tests/unit/ops/aio)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import get_model_config
+from tests.conftest import make_lm_batch
+
+
+def _cfg(**over):
+    cfg = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 1000,
+        "mesh": {"data": 8},
+    }
+    for k, v in over.items():
+        if k == "zero_optimization":
+            cfg["zero_optimization"].update(v)
+        else:
+            cfg[k] = v
+    return cfg
+
+
+def _mk(model, cfg, seed=3):
+    from deepspeed_tpu.parallel import topology
+
+    topology._GLOBAL_TOPOLOGY = None
+    engine, _, _, _ = ds.initialize(model=model, config=cfg, seed=seed)
+    return engine
+
+
+def _train(engine, batches):
+    return [float(np.asarray(engine.train_batch(b))) for b in batches]
+
+
+def _batches(model, n=4):
+    rng = np.random.default_rng(0)
+    return [make_lm_batch(rng, 8, 16, model.vocab_size)] * n
+
+
+def _memory_kinds(tree):
+    return {x.sharding.memory_kind for x in jax.tree.leaves(tree)
+            if hasattr(x, "sharding")}
+
+
+def test_cpu_offload_matches_baseline():
+    """Offload is placement only — numerics must equal the non-offload run.
+    On the CPU test backend the engine takes the host-store fallback (memory
+    kinds under SPMD are unimplemented there); on TPU it streams via
+    pinned_host memory kinds. Both paths are numerics-preserving."""
+    model = get_model_config("gpt2-tiny")
+    batches = _batches(model)
+    base = _train(_mk(model, _cfg()), batches)
+    eng = _mk(model, _cfg(zero_optimization={"offload_optimizer": {"device": "cpu"}}))
+    if eng._opt_stream_offload:
+        assert "pinned_host" in _memory_kinds(eng.opt_state)
+    else:
+        assert eng.opt_state is None and eng._opt_store is not None
+    off = _train(eng, batches)
+    np.testing.assert_allclose(base, off, rtol=1e-5, atol=1e-5)
+
+
+def test_partial_offload_shardings_split():
+    """The TwinFlow ratio splits leaves host/device by size (unit-level; the
+    streaming mode that consumes these shardings is TPU-only)."""
+    import jax
+    from deepspeed_tpu.runtime.offload import partial_offload_shardings
+    from deepspeed_tpu.parallel.topology import MeshTopology
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    topo = MeshTopology({"data": 8})
+    dev = {"big": NamedSharding(topo.mesh, P()), "small": NamedSharding(topo.mesh, P()),
+           "count": NamedSharding(topo.mesh, P())}
+    shapes = {"big": jax.ShapeDtypeStruct((1024, 64), np.float32),
+              "small": jax.ShapeDtypeStruct((8,), np.float32),
+              "count": jax.ShapeDtypeStruct((), np.int32)}
+    out = partial_offload_shardings(shapes, dev, 0.5)
+    assert out["big"].memory_kind == "pinned_host"
+    assert out["small"].memory_kind != "pinned_host"
+    assert out["count"].memory_kind != "pinned_host"  # scalars never offload
+    full = partial_offload_shardings(shapes, dev, 1.0)
+    assert full["small"].memory_kind == "pinned_host"
+    assert full["count"].memory_kind != "pinned_host"
+
+
+def test_param_offload():
+    model = get_model_config("gpt2-tiny")
+    eng = _mk(model, _cfg(zero_optimization={
+        "stage": 3, "offload_param": {"device": "cpu"}}))
+    from deepspeed_tpu.runtime.offload import host_offload_supported
+
+    if host_offload_supported(eng.topology):
+        assert _memory_kinds(eng.params) == {"pinned_host"}
+    losses = _train(eng, _batches(model, 2))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_nvme_offload_matches_baseline(tmp_path):
+    model = get_model_config("gpt2-tiny")
+    batches = _batches(model)
+    base = _train(_mk(model, _cfg()), batches)
+    eng = _mk(model, _cfg(zero_optimization={
+        "offload_optimizer": {"device": "nvme", "nvme_path": str(tmp_path)}}))
+    assert eng.opt_state is None  # NVMe is authoritative between steps
+    assert os.listdir(str(tmp_path))  # swap files exist
+    off = _train(eng, batches)
+    np.testing.assert_allclose(base, off, rtol=1e-5, atol=1e-5)
+
+
+def test_nvme_checkpoint(tmp_path):
+    model = get_model_config("gpt2-tiny")
+    swap = tmp_path / "swap"
+    eng = _mk(model, _cfg(zero_optimization={
+        "offload_optimizer": {"device": "nvme", "nvme_path": str(swap)}}))
+    _train(eng, _batches(model, 2))
+    eng.save_checkpoint(str(tmp_path / "ck"), tag="t")
+    eng2 = _mk(model, _cfg(), seed=9)
+    eng2.load_checkpoint(str(tmp_path / "ck"), tag="t")
+    assert eng2.global_steps == 2
+
+
+def test_store_mode_checkpoint_roundtrip_restores_optimizer(tmp_path):
+    """Loading a checkpoint into an offload-store engine must push the loaded
+    optimizer state into the store — continuation numerics must match a
+    non-offload engine continuing from the same checkpoint."""
+    model = get_model_config("gpt2-tiny")
+    batches = _batches(model, 6)
+    off_cfg = _cfg(zero_optimization={
+        "offload_optimizer": {"device": "nvme", "nvme_path": str(tmp_path / "sw")}})
+    eng = _mk(model, off_cfg)
+    _train(eng, batches[:3])
+    eng.save_checkpoint(str(tmp_path / "ck"), tag="t")
+
+    # plain engine continues from checkpoint
+    ref = _mk(model, _cfg(), seed=11)
+    ref.load_checkpoint(str(tmp_path / "ck"), tag="t")
+    ref_cont = _train(ref, batches[3:])
+
+    # offload-store engine continues from the same checkpoint
+    eng2 = _mk(model, _cfg(zero_optimization={
+        "offload_optimizer": {"device": "nvme", "nvme_path": str(tmp_path / "sw2")}}),
+        seed=22)
+    eng2.load_checkpoint(str(tmp_path / "ck"), tag="t")
+    off_cont = _train(eng2, batches[3:])
+    np.testing.assert_allclose(ref_cont, off_cont, rtol=1e-5, atol=1e-5)
+
+
+def test_offload_states_api():
+    from deepspeed_tpu.runtime.offload import host_offload_supported
+
+    model = get_model_config("gpt2-tiny")
+    eng = _mk(model, _cfg())
+    if not host_offload_supported(eng.topology):
+        pytest.skip("memory-kind offload unsupported on this backend")
+    eng.offload_states()
+    assert _memory_kinds(eng.params) == {"pinned_host"}
+    eng.reload_states()
+    assert _memory_kinds(eng.params) == {"device"}
+    losses = _train(eng, _batches(model, 2))
+    assert all(np.isfinite(losses))
+
+
+def test_aio_roundtrip(tmp_path):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+    h = AsyncIOHandle(block_size=1 << 16, queue_depth=4, thread_count=2)
+    x = np.random.default_rng(0).standard_normal((1 << 18,)).astype(np.float32)
+    path = str(tmp_path / "t.bin")
+    h.pwrite(x, path)
+    y = np.empty_like(x)
+    h.pread(y, path)
+    np.testing.assert_array_equal(x, y)
+
+
+def test_aio_async_overlap(tmp_path):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+    h = AsyncIOHandle(thread_count=4)
+    arrays = [np.full((1 << 16,), i, np.float32) for i in range(8)]
+    for i, a in enumerate(arrays):
+        h.async_pwrite(a, str(tmp_path / f"f{i}.bin"))
+    assert h.wait() == 0
+    outs = [np.empty_like(a) for a in arrays]
+    for i, o in enumerate(outs):
+        h.async_pread(o, str(tmp_path / f"f{i}.bin"))
+    assert h.wait() == 0
+    for a, o in zip(arrays, outs):
+        np.testing.assert_array_equal(a, o)
+
+
+def test_aio_missing_file_reports_error(tmp_path):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+    h = AsyncIOHandle()
+    buf = np.empty((1024,), np.float32)
+    with pytest.raises(IOError):
+        h.pread(buf, str(tmp_path / "missing.bin"))
