@@ -92,3 +92,12 @@ class AsyncIOHandle:
                 self._h = None
         except Exception:
             pass
+
+
+def aio_available() -> bool:
+    """True when the native csrc/aio library builds/loads on this host."""
+    try:
+        _lib()
+        return True
+    except Exception:
+        return False

@@ -1,0 +1,150 @@
+"""Launcher CLI + elasticity tests (ref tests/unit/launcher/, elasticity/)."""
+
+import io
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.elasticity import (ElasticityConfigError,
+                                      ElasticityIncompatibleWorldSize,
+                                      compute_elastic_config,
+                                      get_compatible_gpus_v01, get_valid_gpus)
+from deepspeed_tpu.launcher.runner import (build_parser, decode_world_info,
+                                           encode_world_info, parse_hostfile,
+                                           parse_resource_filter,
+                                           OpenMPIRunner, PDSHRunner, SlurmRunner)
+from deepspeed_tpu.launcher.launch import compute_ranks
+
+
+def test_parse_hostfile():
+    hosts = parse_hostfile(["worker-0 slots=4", "# comment", "",
+                            "worker-1 slots=8  # trailing"])
+    assert hosts == {"worker-0": 4, "worker-1": 8}
+
+
+def test_parse_hostfile_rejects_bad_lines():
+    with pytest.raises(ValueError):
+        parse_hostfile(["worker-0 gpus=4"])
+    with pytest.raises(ValueError):
+        parse_hostfile(["w slots=2", "w slots=2"])
+
+
+def test_resource_filter_include_exclude():
+    res = parse_hostfile(["a slots=4", "b slots=4", "c slots=2"])
+    inc = parse_resource_filter(res, include="a:0,1@c")
+    assert inc == {"a": [0, 1], "c": [0, 1]}
+    exc = parse_resource_filter(res, exclude="b@a:3")
+    assert exc == {"a": [0, 1, 2], "c": [0, 1]}
+    with pytest.raises(ValueError):
+        parse_resource_filter(res, include="a", exclude="b")
+    with pytest.raises(ValueError):
+        parse_resource_filter(res, include="zzz")
+
+
+def test_world_info_roundtrip_and_ranks():
+    active = {"a": [0, 1], "b": [0, 1, 2]}
+    blob = encode_world_info(active)
+    assert decode_world_info(blob) == active
+    base, slots = compute_ranks(active, 1)
+    assert base == 2 and slots == [0, 1, 2]
+
+
+def test_runner_cmds_contain_rendezvous():
+    args = build_parser().parse_args(
+        ["--master_addr", "10.0.0.1", "train.py", "--foo", "1"])
+    active = {"a": [0], "b": [0]}
+    env = {"DSTPU_COORDINATOR": "10.0.0.1:29500", "DSTPU_NUM_PROCS": "2"}
+    blob = encode_world_info(active)
+    pdsh = PDSHRunner(args, blob).get_cmd(env, active)
+    assert pdsh[0] == "pdsh" and "a,b" in pdsh
+    assert any("deepspeed_tpu.launcher.launch" in c for c in pdsh)
+    mpi = OpenMPIRunner(args, blob).get_cmd(env, active)
+    assert mpi[:3] == ["mpirun", "-n", "2"]
+    srun = SlurmRunner(args, blob).get_cmd(env, active)
+    assert srun[:3] == ["srun", "-n", "2"]
+
+
+def test_single_node_dry_run():
+    from deepspeed_tpu.launcher.runner import main
+    import contextlib
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(["--hostfile", "/nonexistent", "--dry_run", "train.py"])
+    assert rc == 0
+    assert "deepspeed_tpu.launcher.launch" in buf.getvalue()
+
+
+def test_env_report_runs():
+    from deepspeed_tpu.env_report import report_lines
+    lines = report_lines()
+    text = "\n".join(lines)
+    assert "deepspeed_tpu" in text and "op compatibility" in text
+
+
+# ---------------------------------------------------------------------------
+# Elasticity (ref tests/unit/elasticity/test_elastic.py)
+# ---------------------------------------------------------------------------
+BASE = {"elasticity": {"enabled": True, "max_train_batch_size": 10000,
+                       "micro_batch_sizes": [8, 12, 16, 17], "min_gpus": 32,
+                       "max_gpus": 1500, "min_time": 20, "version": 0.1}}
+
+
+def test_valid_gpus():
+    assert get_valid_gpus(20, [2, 4, 5], 1, 100) == [1, 2, 4, 5, 10]
+
+
+def test_compatible_gpus_known_case():
+    batch, gpus = get_compatible_gpus_v01([8, 12, 16, 17],
+                                          max_acceptable_batch_size=10000,
+                                          min_gpus=32, max_gpus=1500)
+    assert batch % 8 == 0 and batch <= 10000
+    assert all(32 <= g <= 1500 for g in gpus)
+    # every valid gpu count must evenly produce the final batch
+    for g in gpus:
+        assert any(batch % (mb * g) == 0 for mb in [8, 12, 16, 17])
+
+
+def test_compute_elastic_config_and_world_size():
+    batch, gpus = compute_elastic_config(BASE)
+    assert gpus
+    ws = gpus[0]
+    b2, g2, micro = compute_elastic_config(BASE, world_size=ws,
+                                           return_microbatch=True)
+    assert b2 == batch and micro in BASE["elasticity"]["micro_batch_sizes"]
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(BASE, world_size=7919)
+
+
+def test_elasticity_requires_block():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({})
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+
+
+def test_elastic_v2_model_parallel():
+    cfg = {"elasticity": {**BASE["elasticity"], "version": 0.2,
+                          "model_parallel_size": 4, "num_gpus_per_node": 8,
+                          "min_gpus": 4, "max_gpus": 256}}
+    batch, gpus = compute_elastic_config(cfg)
+    assert all(g % 4 == 0 for g in gpus)
+
+
+def test_openmpi_rejects_filters():
+    args = build_parser().parse_args(["--include", "a", "train.py"])
+    active = {"a": [0]}
+    with pytest.raises(ValueError):
+        OpenMPIRunner(args, encode_world_info(active)).get_cmd({}, active)
+
+
+def test_slurm_nodelist():
+    args = build_parser().parse_args(["train.py"])
+    active = {"a": [0], "b": [0]}
+    cmd = SlurmRunner(args, encode_world_info(active)).get_cmd({}, active)
+    assert cmd[3] == "-w" and cmd[4] == "a,b"
+
+
+def test_elasticity_micro_batch_over_cap_raises():
+    with pytest.raises(ElasticityConfigError):
+        get_compatible_gpus_v01([7, 11], max_acceptable_batch_size=5)
