@@ -126,8 +126,11 @@ def get_compatible_gpus_v02(micro_batches, max_acceptable_batch_size,
             raise ElasticityConfigError(
                 f"model_parallel_size {model_parallel_size} must divide "
                 f"chips per node {num_gpus_per_node}")
-    dp_min = max(1, min_gpus // model_parallel_size)
-    dp_max = max(dp_min, max_gpus // model_parallel_size)
+    if max_gpus < model_parallel_size:
+        raise ElasticityConfigError(
+            f"max_gpus {max_gpus} < model_parallel_size {model_parallel_size}")
+    dp_min = -(-min_gpus // model_parallel_size)  # ceil: stay ≥ min_gpus
+    dp_max = max_gpus // model_parallel_size      # floor: stay ≤ max_gpus
     batch, dp_counts = get_compatible_gpus_v01(
         micro_batches, max_acceptable_batch_size, dp_min, dp_max, prefer_larger)
     return batch, [c * model_parallel_size for c in dp_counts]

@@ -44,7 +44,8 @@ def op_compat_report() -> "list[tuple[str, bool, str]]":
     # Host offload (memory kinds)
     try:
         import jax
-        kinds = jax.devices()[0].memory_kinds() if jax.devices() else ()
+        kinds = sorted({m.kind for m in jax.devices()[0].addressable_memories()}) \
+            if jax.devices() else []
         ok = "pinned_host" in kinds or "unpinned_host" in kinds
         rows.append(("host_offload (memory kinds)", ok, ",".join(kinds)))
     except Exception as e:  # pragma: no cover

@@ -1,0 +1,171 @@
+"""Ragged (paged-KV) transformer forward for continuous batching.
+
+TPU-native replacement for the reference's blocked-flash-attention kernels
+(ref inference/v2/kernels/ragged_ops/: blocked flash attn w/ KV-block table,
+linear+blocked-KV rotary, logits_gather, embed): one forward processes an
+arbitrary prefill/decode mix as a flat token list with per-token metadata.
+
+Design (vs the reference's CUDA kernels):
+* KV cache pages are rows of a flat per-layer array ``[L, P, kv_heads, d]``
+  (P = num_blocks·block_size). Token KV is *scattered* to its page slot and
+  context KV is *gathered* through the block table — both are XLA
+  scatter/gather ops on static shapes, which XLA fuses around the attention
+  einsums; a Pallas kernel can later replace the gather+einsum pair without
+  changing this interface.
+* Every shape is fixed by (token_budget, max_seqs, max_ctx): one compiled
+  executable serves all batch mixes (the reference re-launches variable-size
+  kernels instead).
+* The layer loop is ``lax.scan`` threading the cache as scan xs/ys, matching
+  the training forward's stacked-parameter layout.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.models.transformer import (TransformerConfig, _mlp_block,
+                                              _norm)
+
+
+def _rope_tok(x, positions, cfg: TransformerConfig):
+    """Rotary embedding over per-token positions. x: [T, H, D], positions: [T]."""
+    d = cfg.dim_per_head
+    freqs = 1.0 / (cfg.rope_theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, None].astype(jnp.float32) * freqs  # [T, D/2]
+    cos = jnp.cos(angles)[:, None, :]
+    sin = jnp.sin(angles)[:, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _paged_attention(q, k_pages, v_pages, gather_idx, token_pos, token_ctx_len,
+                     cfg: TransformerConfig):
+    """Attention of T query tokens against their sequences' KV pages.
+
+    q: [T, nh, d]; k_pages/v_pages: [P, nkv, d] (already contain this step's
+    scattered KV); gather_idx: [T, C] flat page indices of each token's
+    context; token_pos: [T]; token_ctx_len: [T] context length of the token's
+    sequence. Ref kernel: ragged_ops/blocked_flash.
+    """
+    nh = q.shape[1]
+    nkv = k_pages.shape[1]
+    k_ctx = k_pages[gather_idx]  # [T, C, nkv, d]
+    v_ctx = v_pages[gather_idx]
+    if nkv != nh:
+        rep = nh // nkv
+        k_ctx = jnp.repeat(k_ctx, rep, axis=2)
+        v_ctx = jnp.repeat(v_ctx, rep, axis=2)
+    scale = 1.0 / math.sqrt(cfg.dim_per_head)
+    scores = jnp.einsum("thd,tchd->thc", q, k_ctx) * scale  # [T, nh, C]
+    c_pos = jnp.arange(scores.shape[-1], dtype=jnp.int32)
+    valid = (c_pos[None, :] <= token_pos[:, None]) & \
+            (c_pos[None, :] < token_ctx_len[:, None])       # [T, C]
+    scores = jnp.where(valid[:, None, :], scores.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("thc,tchd->thd", probs, v_ctx)
+
+
+def _ragged_layer(x, lp, k_pages, v_pages, meta, cfg: TransformerConfig,
+                  layer_is_moe=False):
+    """One block over flat tokens [T, H]; scatters KV, attends via pages."""
+    token_pos, token_dest, gather_idx, token_ctx_len = meta
+    t = x.shape[0]
+    nh, nkv, d = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
+    dt = x.dtype
+
+    h = _norm(x, lp["ln1"], cfg)
+
+    def proj(w, b_):
+        y = h @ w.astype(dt)
+        return y + b_.astype(dt) if b_ is not None else y
+
+    q = proj(lp["attn"]["wq"], lp["attn"].get("bq")).reshape(t, nh, d)
+    k = proj(lp["attn"]["wk"], lp["attn"].get("bk")).reshape(t, nkv, d)
+    v = proj(lp["attn"]["wv"], lp["attn"].get("bv")).reshape(t, nkv, d)
+    if cfg.use_rope:
+        q = _rope_tok(q, token_pos, cfg)
+        k = _rope_tok(k, token_pos, cfg)
+
+    # Write this step's KV to its pages (padding tokens target page 0 =
+    # garbage, so no mask needed; ref: linear_blocked_kv_copy).
+    k_pages = k_pages.at[token_dest].set(k.astype(k_pages.dtype))
+    v_pages = v_pages.at[token_dest].set(v.astype(v_pages.dtype))
+
+    attn = _paged_attention(q, k_pages, v_pages, gather_idx, token_pos,
+                            token_ctx_len, cfg)
+    attn = attn.reshape(t, nh * d) @ lp["attn"]["wo"].astype(dt)
+    if lp["attn"].get("bo") is not None:
+        attn = attn + lp["attn"]["bo"].astype(dt)
+    x = x + attn
+
+    h2 = _norm(x, lp["ln2"], cfg)
+    if "moe" not in lp:
+        return x + _mlp_block(h2, lp["mlp"], cfg), k_pages, v_pages
+
+    from deepspeed_tpu.moe.sharded_moe import moe_forward
+
+    def moe_branch(hh):
+        out, _ = moe_forward(hh[None], lp["moe"], cfg)
+        return out[0]
+
+    def dense_branch(hh):
+        return _mlp_block(hh, lp["mlp"], cfg)
+
+    if isinstance(layer_is_moe, bool):
+        y = moe_branch(h2) if layer_is_moe else dense_branch(h2)
+    else:
+        y = lax.cond(layer_is_moe, moe_branch, dense_branch, h2)
+    return x + y, k_pages, v_pages
+
+
+def ragged_forward(params, cache_k, cache_v, token_ids, token_slot, token_pos,
+                   token_dest, block_tables, ctx_lens, logits_idx,
+                   cfg: TransformerConfig,
+                   block_size: int) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One ragged step.
+
+    cache_k/cache_v: [L, P, nkv, d]; block_tables: [S+1, NB]; returns
+    (logits [S+1, V], cache_k', cache_v').
+    """
+    dt = cfg.dtype
+    x = params["embed"]["tokens"].astype(dt)[token_ids]  # [T, H]
+    if cfg.arch == "gpt2":
+        x = x + params["embed"]["positions"].astype(dt)[token_pos]
+
+    # Context gather indices, shared by all layers (ref: atom_builder).
+    nb = block_tables.shape[1]
+    c = jnp.arange(nb * block_size, dtype=jnp.int32)
+    ctx_idx = block_tables[:, c // block_size] * block_size + c % block_size  # [S+1, C]
+    gather_idx = ctx_idx[token_slot]          # [T, C]
+    token_ctx_len = ctx_lens[token_slot]      # [T]
+    meta = (token_pos, token_dest, gather_idx, token_ctx_len)
+
+    moe_every = max(1, cfg.moe_layer_freq)
+
+    def body(h, scanned):
+        lp, ck_l, cv_l, idx = scanned
+        if cfg.is_moe:
+            is_moe_layer = (idx % moe_every) == (moe_every - 1)
+        else:
+            is_moe_layer = False
+        h, ck_l, cv_l = _ragged_layer(h, lp, ck_l, cv_l, meta, cfg,
+                                      layer_is_moe=is_moe_layer)
+        return h, (ck_l, cv_l)
+
+    layer_idx = jnp.arange(cfg.num_layers)
+    x, (cache_k, cache_v) = lax.scan(
+        body, x, (params["layers"], cache_k, cache_v, layer_idx))
+
+    x = _norm(x, params["final_norm"], cfg)
+    last = x[logits_idx]  # [S+1, H] — ref: logits_gather
+    if cfg.tie_embeddings:
+        logits = last @ params["embed"]["tokens"].astype(dt).T
+    else:
+        logits = last @ params["lm_head"].astype(dt)
+    return logits.astype(jnp.float32), cache_k, cache_v

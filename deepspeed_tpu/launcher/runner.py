@@ -257,16 +257,22 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if not resources:
         # Single node: exec the per-node launcher directly.
+        if args.include or args.exclude:
+            raise ValueError("--include/--exclude require a hostfile")
         nprocs = args.num_procs if args.num_procs > 0 else 1
         cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
                "--nproc", str(nprocs),
                "--coordinator_addr", args.master_addr or "127.0.0.1",
                "--coordinator_port", str(args.master_port),
                args.user_script] + args.user_args
+        env = dict(os.environ)
+        for kv in args.export or []:
+            k, _, v = kv.partition("=")
+            env[k] = v
         if args.dry_run:
             print(shlex.join(cmd))
             return 0
-        return subprocess.call(cmd)
+        return subprocess.call(cmd, env=env)
 
     active = parse_resource_filter(resources, args.include, args.exclude)
     if args.num_nodes > 0:

@@ -148,3 +148,12 @@ def test_slurm_nodelist():
 def test_elasticity_micro_batch_over_cap_raises():
     with pytest.raises(ElasticityConfigError):
         get_compatible_gpus_v01([7, 11], max_acceptable_batch_size=5)
+
+
+def test_elastic_v2_respects_gpu_envelope():
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 6,
+                          "max_gpus": 256, "version": 0.2,
+                          "model_parallel_size": 4, "num_gpus_per_node": 8}}
+    _, gpus = compute_elastic_config(cfg)
+    assert all(6 <= g <= 256 and g % 4 == 0 for g in gpus)
