@@ -103,11 +103,19 @@ class InferenceEngineV2:
         pending decode token) was processed this step; uids mid-prefill
         return nothing yet — call put([], []) again to continue.
         """
+        # Validate the whole batch before touching any state, so a bad entry
+        # cannot leave earlier prompts half-admitted.
+        if len(batch_uids) != len(batch_tokens):
+            raise ValueError(f"{len(batch_uids)} uids vs {len(batch_tokens)} "
+                             "token lists")
+        seen = set()
         for uid, toks in zip(batch_uids, batch_tokens):
-            if uid in self.state_manager:
+            if uid in self.state_manager or uid in seen:
                 raise ValueError(f"uid {uid} already active")
             if not len(toks):
                 raise ValueError(f"uid {uid}: empty prompt")
+            seen.add(uid)
+        for uid, toks in zip(batch_uids, batch_tokens):
             self.state_manager.open(uid, [int(x) for x in toks])
             self.scheduler.add(uid)
         schedule = self.scheduler.next_schedule()
@@ -149,19 +157,28 @@ class InferenceEngineV2:
         rng = np.random.default_rng(seed)
 
         total_blocks = self.cfg.num_blocks - 1  # block 0 reserved
+        bs = self.cfg.block_size
+        max_per_seq = self.state_manager.max_blocks_per_seq
         while pending or any(u in self.state_manager for u in uids):
             admit_uids, admit_toks = [], []
+            # Active sequences will still claim pages as they decode: reserve
+            # their remaining future blocks so admission never overcommits.
             reserved = 0
+            for u in uids:
+                if u in self.state_manager:
+                    seq = self.state_manager.get(u)
+                    final = -(-(len(seq.tokens) + remaining[u]) // bs)
+                    reserved += max(0, final - len(seq.blocks))
             # Admit while slots and KV pages allow (continuous batching).
             while pending and (self.state_manager.n_active + len(admit_uids)
                                < self.state_manager.max_seqs):
                 u, toks = pending[0]
-                need = -(-(len(toks) + max_new_tokens) // self.cfg.block_size)
-                if need > total_blocks:
+                need = -(-(len(toks) + max_new_tokens) // bs)
+                if need > total_blocks or need > max_per_seq:
                     raise RuntimeError(
                         f"prompt uid {u} needs {need} KV blocks but the cache "
-                        f"holds only {total_blocks}; raise num_blocks or "
-                        "max_context")
+                        f"allows {min(total_blocks, max_per_seq)} per sequence; "
+                        "raise num_blocks/max_context or shorten the prompt")
                 if need + reserved > self.state_manager.allocator.free_blocks:
                     break
                 pending.pop(0)

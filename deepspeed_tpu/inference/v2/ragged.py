@@ -171,6 +171,10 @@ def build_ragged_batch(schedule: "List[tuple]", mgr: DSStateManager,
     sample_mask = np.zeros((mgr.max_seqs + 1,), bool)
     uids_by_slot: Dict[int, int] = {}
 
+    total = sum(n_new for _, n_new in schedule)
+    if total > t:
+        raise RuntimeError(f"schedule ({total} tokens) exceeds budget {t}")
+
     # Reserve all pages up front so an allocator failure leaves every
     # sequence untouched (no num_cached advance without a KV write).
     for seq, n_new in schedule:
@@ -196,8 +200,6 @@ def build_ragged_batch(schedule: "List[tuple]", mgr: DSStateManager,
             uids_by_slot[sl] = seq.uid
         cursor += n_new
         seq.num_cached = end
-    if cursor > t:
-        raise RuntimeError(f"schedule ({cursor} tokens) exceeds budget {t}")
 
     return RaggedBatch(token_ids=token_ids, token_slot=token_slot,
                        token_pos=token_pos, token_dest=token_dest,

@@ -1,0 +1,104 @@
+"""Accelerator abstraction conformance (ref tests/unit/accelerator/)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.accelerator import (DeepSpeedAccelerator, get_accelerator,
+                                       set_accelerator)
+from deepspeed_tpu.accelerator.cpu_accelerator import CPU_Accelerator
+from deepspeed_tpu.accelerator.real_accelerator import _probe_platform
+
+
+@pytest.fixture(autouse=True)
+def _fresh_accelerator():
+    set_accelerator(None)
+    yield
+    set_accelerator(None)
+
+
+def test_get_accelerator_probes_platform():
+    acc = get_accelerator()
+    assert isinstance(acc, DeepSpeedAccelerator)
+    assert acc.device_name().split(":")[0] == _probe_platform()
+    assert get_accelerator() is acc  # cached
+
+
+def test_abstract_surface_complete():
+    """Every abstract method is implemented on both backends."""
+    import inspect
+
+    for cls in (CPU_Accelerator,):
+        acc = cls()
+        for name, member in inspect.getmembers(DeepSpeedAccelerator):
+            if getattr(member, "__isabstractmethod__", False):
+                assert callable(getattr(acc, name)), name
+
+
+def test_device_and_memory_api():
+    acc = CPU_Accelerator()
+    assert acc.device_count() >= 1
+    assert acc.is_available()
+    acc.set_device(0)
+    assert acc.current_device() == 0
+    assert acc.device(0) in jax.devices("cpu")
+    stats = acc.memory_stats()
+    assert stats.get("bytes_in_use", 0) > 0  # /proc RSS
+    assert acc.total_memory() > 0
+    assert 0 < acc.available_memory() <= acc.total_memory()
+
+
+def test_rng_state_roundtrip():
+    acc = CPU_Accelerator()
+    acc.manual_seed(42)
+    assert acc.initial_seed() == 42
+    state = acc.get_rng_state()
+    k1 = acc.next_key()
+    acc.set_rng_state(state)
+    k2 = acc.next_key()
+    np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))
+
+
+def test_dtype_support():
+    acc = CPU_Accelerator()
+    assert acc.is_bf16_supported()
+    assert not acc.is_fp16_supported()
+    assert jnp.bfloat16 in acc.supported_dtypes()
+    assert acc.preferred_dtype() == jnp.bfloat16
+
+
+def test_stream_event_nullops_and_sync():
+    acc = CPU_Accelerator()
+    s = acc.Stream()
+    ev = acc.Event(enable_timing=True)
+    ev.record()
+    e2 = acc.Event(enable_timing=True)
+    e2.record()
+    assert ev.elapsed_time(e2) >= 0.0
+    s.synchronize()
+    acc.synchronize()
+    with acc.stream(s):
+        pass
+
+
+def test_graph_capture_is_jit():
+    acc = CPU_Accelerator()
+    g = acc.create_graph()
+    g.capture(lambda x: x * 2)
+    out = acc.replay_graph(g, jnp.ones((4,)))
+    np.testing.assert_allclose(np.asarray(out), 2 * np.ones((4,)))
+
+
+def test_range_push_pop_no_crash():
+    acc = CPU_Accelerator()
+    acc.range_push("test-range")
+    acc.range_pop()
+
+
+def test_env_override(monkeypatch):
+    monkeypatch.setenv("DS_ACCELERATOR", "cpu")
+    acc = get_accelerator()
+    assert isinstance(acc, CPU_Accelerator)
+    assert acc.communication_backend_name() == "xla-cpu"

@@ -142,3 +142,52 @@ def test_generate_raises_on_impossible_prompt():
                               "max_context": 16}, seed=0)
     with pytest.raises(RuntimeError):
         v2.generate([list(range(1, 30))], max_new_tokens=8)
+
+
+def test_admission_reserves_active_seq_future_blocks():
+    """Tight KV cache: active sequences' future pages are reserved, so the
+    second prompt waits instead of overcommitting and crashing mid-stream."""
+    model = get_model_config("llama-tiny", num_layers=1)
+    v2 = build_engine(model, {"dtype": "float32",
+                              "state_manager": {"max_tracked_sequences": 4,
+                                                "max_ragged_batch_size": 16},
+                              "memory_config": {"num_blocks": 8, "block_size": 4},
+                              "max_context": 32}, seed=0)
+    out = v2.generate([[1, 2, 3, 4, 5], [6, 7, 8, 9, 10]], max_new_tokens=12)
+    assert all(len(o) == 12 for o in out)
+    assert v2.free_blocks == v2.cfg.num_blocks - 1
+
+
+def test_admission_enforces_per_seq_block_cap():
+    """Prompt fits the cache but exceeds max_blocks_per_seq → friendly error
+    at admission, not a mid-generate crash."""
+    model = get_model_config("llama-tiny", num_layers=1)
+    v2 = build_engine(model, {"dtype": "float32",
+                              "memory_config": {"num_blocks": 64, "block_size": 4},
+                              "max_context": 16}, seed=0)
+    with pytest.raises(RuntimeError, match="per sequence"):
+        v2.generate([[1, 2, 3], list(range(1, 25))], max_new_tokens=4)
+
+
+def test_put_validates_batch_before_mutating():
+    model = get_model_config("llama-tiny", num_layers=1)
+    v2 = build_engine(model, {"dtype": "float32",
+                              "memory_config": {"num_blocks": 32, "block_size": 4},
+                              "max_context": 32}, seed=0)
+    with pytest.raises(ValueError):
+        v2.put([1, 1], [[5, 6], [7, 8]])     # duplicate uid in one batch
+    assert 1 not in v2.state_manager          # nothing half-admitted
+    with pytest.raises(ValueError):
+        v2.put([2, 3], [[5, 6]])              # mismatched lengths
+    assert 2 not in v2.state_manager
+
+
+def test_build_ragged_batch_checks_budget_first():
+    from deepspeed_tpu.inference.v2.ragged import build_ragged_batch
+
+    mgr = DSStateManager(max_seqs=2, num_blocks=16, block_size=4,
+                         max_blocks_per_seq=4)
+    seq = mgr.open(1, list(range(10)))
+    with pytest.raises(RuntimeError, match="budget"):
+        build_ragged_batch([(seq, 10)], mgr, token_budget=8)
+    assert seq.num_cached == 0  # state untouched
