@@ -34,7 +34,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.parallel.topology import (DATA_AXIS, EXPERT_AXIS, PIPE_AXIS, SEQ_AXIS,
-                                             TENSOR_AXIS, MeshTopology)
+                                             SUBDATA_AXIS, TENSOR_AXIS, MeshTopology)
 from deepspeed_tpu.utils.logging import logger
 
 # path-pattern → logical dims, one entry per array dim.
@@ -84,16 +84,36 @@ class ShardingRules:
 
     def __init__(self, topology: MeshTopology, zero_stage: int = 0,
                  rules: Optional[List[Tuple[str, Tuple[Optional[str], ...]]]] = None,
-                 shard_norms: bool = True):
+                 shard_norms: bool = True, secondary_mode: str = "none"):
+        """``secondary_mode``: hierarchical partitioning over the factored
+        (data=outer, subdata=inner) DP world —
+          "hpz"  — ZeRO++ secondary partition: PARAMS shard only over the
+                   inner axes (within-node gather rides ICI), optimizer/grad
+                   state still shards over the full ZeRO world
+                   (ref zero_hpz_partition_size, runtime/zero/config.py:300);
+          "mics" — MiCS: params AND optimizer/grad state shard only within
+                   the sub-group; the outer data axis is pure replication
+                   with (XLA-inserted) hierarchical gradient allreduce
+                   (ref MiCS_Init/MiCS_Optimizer, runtime/zero/mics.py).
+        """
         self.topo = topology
         self.zero_stage = zero_stage
         self.rules = [(re.compile(pat), dims) for pat, dims in (rules or DEFAULT_RULES)]
         self.shard_norms = shard_norms
+        if secondary_mode not in ("none", "hpz", "mics"):
+            raise ValueError(f"secondary_mode {secondary_mode!r}")
+        self.secondary_mode = secondary_mode
 
     # ------------------------------------------------------------------
-    def _fsdp_axes(self, is_expert_param: bool) -> Tuple[str, ...]:
+    def _fsdp_axes(self, is_expert_param: bool,
+                   param_style: bool) -> Tuple[str, ...]:
+        if self.secondary_mode == "mics" or (self.secondary_mode == "hpz"
+                                             and param_style):
+            candidates = (SUBDATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
+        else:
+            candidates = (DATA_AXIS, SUBDATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
         axes = []
-        for ax in (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS):
+        for ax in candidates:
             if is_expert_param and ax == EXPERT_AXIS:
                 continue  # expert dim already consumes the expert axis
             if self.topo.axis_size(ax) > 1:
@@ -123,7 +143,7 @@ class ShardingRules:
         if dims is None:
             return P()
         is_expert = "expert" in dims
-        fsdp_axes = self._fsdp_axes(is_expert)
+        fsdp_axes = self._fsdp_axes(is_expert, param_style)
         apply_fsdp = bool(fsdp_axes) and (not param_style or self.zero_stage >= 3)
         tp = self.topo.tp_size > 1
 

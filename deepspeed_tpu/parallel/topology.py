@@ -27,16 +27,26 @@ from deepspeed_tpu.utils.logging import logger
 # Canonical axis names, outer→inner.
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+# Inner factor of the DP world for hierarchical partitioning: ZeRO++ hpZ
+# secondary partition / MiCS sub-groups (ref zero_hpz_partition_size,
+# runtime/zero/config.py:300; MiCS_Init, runtime/zero/mics.py:63).  Size 1
+# unless the engine factors the DP world; "data" is then the *outer*
+# (replication / DCN) factor and "subdata" the *inner* (shard / ICI) one.
+SUBDATA_AXIS = "subdata"
 EXPERT_AXIS = "expert"
 SEQ_AXIS = "seq"
 TENSOR_AXIS = "tensor"
-MESH_AXES: Tuple[str, ...] = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS)
+MESH_AXES: Tuple[str, ...] = (PIPE_AXIS, DATA_AXIS, SUBDATA_AXIS, EXPERT_AXIS,
+                              SEQ_AXIS, TENSOR_AXIS)
 
 # Axes over which the *global batch* is sharded (ref: DP world = data×expert;
 # groups._create_expert_and_data_parallel, groups.py:240).
-BATCH_AXES: Tuple[str, ...] = (DATA_AXIS, EXPERT_AXIS)
+BATCH_AXES: Tuple[str, ...] = (DATA_AXIS, SUBDATA_AXIS, EXPERT_AXIS)
 # Axes over which ZeRO partitions optimizer/gradient/parameter state.
-ZERO_AXES: Tuple[str, ...] = (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
+ZERO_AXES: Tuple[str, ...] = (DATA_AXIS, SUBDATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
+# Inner (ICI-adjacent) ZeRO axes: the secondary partition group for hpZ
+# params / the MiCS shard group.
+ZERO_INNER_AXES: Tuple[str, ...] = (SUBDATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
 
 
 def resolve_mesh_sizes(sizes: Optional[Dict[str, int]], n_devices: int) -> Dict[str, int]:
@@ -64,6 +74,23 @@ def resolve_mesh_sizes(sizes: Optional[Dict[str, int]], n_devices: int) -> Dict[
     elif prod < n_devices:
         logger.warning(f"mesh product {prod} < {n_devices} devices; using a submesh")
     return {ax: int(sizes[ax]) for ax in MESH_AXES}
+
+
+def factor_data_axis(sizes: Dict[str, int], shard_size: int) -> Dict[str, int]:
+    """Factor the resolved data axis into (outer=data, inner=subdata) for
+    hierarchical partitioning (hpZ secondary partition / MiCS sub-groups).
+
+    ``shard_size`` devices form the inner shard group (ICI-adjacent); the
+    remaining data-parallel factor replicates across them.
+    """
+    sizes = dict(sizes)
+    data = sizes.get(DATA_AXIS, 1) * sizes.get(SUBDATA_AXIS, 1)
+    if shard_size <= 0 or data % shard_size != 0:
+        raise ValueError(f"data-parallel world {data} not divisible by "
+                         f"secondary partition size {shard_size}")
+    sizes[DATA_AXIS] = data // shard_size
+    sizes[SUBDATA_AXIS] = shard_size
+    return sizes
 
 
 class MeshTopology:
@@ -108,14 +135,15 @@ class MeshTopology:
     @property
     def dp_size(self) -> int:
         """Data-parallel world as the reference defines it (data×expert)."""
-        return self.sizes[DATA_AXIS] * self.sizes[EXPERT_AXIS]
+        return (self.sizes[DATA_AXIS] * self.sizes[SUBDATA_AXIS]
+                * self.sizes[EXPERT_AXIS])
 
     @property
     def zero_size(self) -> int:
         """World over which ZeRO shards state (data×expert×seq): sequence
         parallel ranks hold identical params so they join the ZeRO shard
         group, matching Ulysses+ZeRO-3 composition (ref ulysses_sp.py)."""
-        return self.sizes[DATA_AXIS] * self.sizes[EXPERT_AXIS] * self.sizes[SEQ_AXIS]
+        return self.dp_size * self.sizes[SEQ_AXIS]
 
     @property
     def tp_size(self) -> int:

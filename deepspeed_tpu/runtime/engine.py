@@ -111,8 +111,20 @@ class DeepSpeedEngine:
             self.config = DeepSpeedConfig(config or {}, world_size=None)
 
         # -- topology: mesh block merged with tensor_parallel/pipeline/etc.
+        zc = self.config.zero_config
+        self._secondary_mode = ("hpz" if zc.zero_hpz_partition_size > 1 else
+                                "mics" if zc.mics_shard_size > 0 else "none")
         if topology is None:
             mesh_sizes = self.config.mesh.resolved(len(jax.devices()))
+            if self._secondary_mode != "none":
+                from deepspeed_tpu.parallel.topology import factor_data_axis
+
+                shard = (zc.zero_hpz_partition_size
+                         if self._secondary_mode == "hpz" else zc.mics_shard_size)
+                mesh_sizes = factor_data_axis(mesh_sizes, shard)
+                log_dist(f"ZeRO++ {self._secondary_mode}: DP world factored "
+                         f"into outer={mesh_sizes['data']} × "
+                         f"inner={mesh_sizes['subdata']}")
             topology = MeshTopology(mesh_sizes)
         self.topology = topology
         set_topology(topology)
@@ -150,7 +162,8 @@ class DeepSpeedEngine:
             self._loss_fn = model.loss
 
         # -- sharding rules --------------------------------------------
-        self.rules = ShardingRules(topology, zero_stage=self.zero_stage)
+        self.rules = ShardingRules(topology, zero_stage=self.zero_stage,
+                                   secondary_mode=self._secondary_mode)
         rng = jax.random.PRNGKey(self.seed)
 
         params_shape = jax.eval_shape(self._init_fn, rng)
@@ -305,8 +318,15 @@ class DeepSpeedEngine:
         ls_window, ls_min = self._ls_window, self._ls_min
         fp16 = self.fp16_enabled
 
+        qwz = (cfg.zero_config.zero_quantized_weights and self.zero_stage >= 3)
+        rules = self.rules
+
         def micro_grads(params, batch, scale):
             def scaled_loss(p):
+                if qwz:
+                    from deepspeed_tpu.parallel.zeropp import qwz_weight_gather
+
+                    p = qwz_weight_gather(p, rules)
                 loss = loss_fn(p, batch)
                 return loss * scale.astype(loss.dtype)
 
