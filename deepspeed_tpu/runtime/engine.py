@@ -293,6 +293,38 @@ class DeepSpeedEngine:
         self._micro_in_step = 0
         self._checkpoint_engine = None
 
+        # -- 1-bit compressed-DP mode (OnebitAdam/OnebitLamb/ZeroOneAdam) --
+        self._onebit = None
+        self._onebit_state = None
+        _dp_only = (self.topology.dp_size > 1 and self.topology.tp_size == 1
+                    and self.topology.pp_size == 1 and self.topology.sp_size == 1)
+        if (cfg.optimizer is not None and _dp_only
+                and cfg.optimizer.type in ("onebitadam", "onebitlamb",
+                                           "zerooneadam", "0/1adam")):
+            from deepspeed_tpu.runtime.onebit import OnebitConfig, OnebitTrainStep
+
+            variant = ("zerooneadam" if cfg.optimizer.type in ("zerooneadam",
+                                                               "0/1adam")
+                       else cfg.optimizer.type)
+            ob_cfg = OnebitConfig(cfg.optimizer.params, variant)
+            self._onebit = OnebitTrainStep(self.topology, self._loss_fn,
+                                           self.params, ob_cfg,
+                                           gas=self.gradient_accumulation_steps_value,
+                                           grad_clip=cfg.gradient_clipping)
+            self._onebit_state = self._onebit.init_state(self.params)
+        elif (zc.zero_quantized_gradients and _dp_only and self.zero_stage <= 1
+              and cfg.optimizer is not None
+              and cfg.optimizer.type in ("adam", "adamw", "fusedadam")):
+            # qgZ without ZeRO-3: int8-compressed DP gradient reduction
+            from deepspeed_tpu.runtime.onebit import OnebitConfig, OnebitTrainStep
+
+            ob_cfg = OnebitConfig(cfg.optimizer.params, "qgz")
+            self._onebit = OnebitTrainStep(self.topology, self._loss_fn,
+                                           self.params, ob_cfg,
+                                           gas=self.gradient_accumulation_steps_value,
+                                           grad_clip=cfg.gradient_clipping)
+            self._onebit_state = self._onebit.init_state(self.params)
+
         self._compile_steps()
 
     # ------------------------------------------------------------------
@@ -503,6 +535,8 @@ class DeepSpeedEngine:
     def train_batch(self, data) -> jnp.ndarray:
         """Run one full train batch (gas micro-batches + optimizer step).
         Ref: PipelineEngine.train_batch / engine forward+backward+step."""
+        if self._onebit is not None:
+            return self._train_batch_onebit(data)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         batch_stack = self._stack_micro_batches(data)
@@ -519,6 +553,34 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).stop(ready=metrics["loss"])
         self.tput_timer.stop()
         return metrics["loss"]
+
+    def _train_batch_onebit(self, data) -> jnp.ndarray:
+        """Compressed-DP train batch: explicit shard_map step with 1-bit
+        error-feedback momentum allreduce (ref onebit/adam.py step)."""
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.parallel.topology import BATCH_AXES
+
+        self.tput_timer.start()
+        self.timers(TRAIN_BATCH_TIMER).start()
+        batch_stack = self._stack_micro_batches(data)
+        batch_stack = self._put_batch(batch_stack, stacked=True)
+        if not self._onebit._built:
+            batch_specs = {
+                k: P(*([None, BATCH_AXES] + [None] * (np.ndim(v) - 2)))
+                for k, v in batch_stack.items()}
+            self._onebit.build(self.param_shardings, batch_specs)
+        lr = jnp.float32(self.lr_scheduler(self.global_steps))
+        self.params, self._onebit_state, loss = self._onebit(
+            self.params, self._onebit_state, batch_stack, lr)
+        self.global_steps += 1
+        self.micro_steps += self.gradient_accumulation_steps_value
+        self.lr_scheduler.step()
+        metrics = {"loss": loss}
+        self._after_step(metrics)
+        self.timers(TRAIN_BATCH_TIMER).stop(ready=loss)
+        self.tput_timer.stop()
+        return loss
 
     def forward(self, batch: Batch) -> jnp.ndarray:
         """Compute loss AND gradients for one micro-batch (accumulated).
