@@ -79,6 +79,26 @@ def init_inference(model=None, config=None, **kwargs):
     return InferenceEngine(model=model, config=config, **kwargs)
 
 
+def tp_model_init(model=None, tp_size: int = 1, dtype=None, config=None,
+                  **kwargs):
+    """AutoTP training init: shard a param tree over the "tensor" mesh axis.
+    Ref: ``deepspeed.tp_model_init`` (deepspeed/__init__.py:380)."""
+    from deepspeed_tpu.comm.comm import init_distributed
+    from deepspeed_tpu.module_inject.auto_tp import tp_model_init as _tp_init
+    from deepspeed_tpu.parallel.topology import get_topology
+
+    topo = get_topology()
+    if topo is None or (tp_size > 1 and topo.tp_size != tp_size):
+        topo = init_distributed(mesh_sizes={"tensor": tp_size} if tp_size > 1
+                                else None)
+    params = model
+    if dtype is not None:
+        import jax
+
+        params = jax.tree.map(lambda x: x.astype(dtype), params)
+    return _tp_init(params, topo, **kwargs)
+
+
 # subpackage conveniences
 from deepspeed_tpu.models import registry as models  # noqa: E402
 from deepspeed_tpu.models.registry import get_model_config  # noqa: E402
