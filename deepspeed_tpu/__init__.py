@@ -82,15 +82,33 @@ def init_inference(model=None, config=None, **kwargs):
 def tp_model_init(model=None, tp_size: int = 1, dtype=None, config=None,
                   **kwargs):
     """AutoTP training init: shard a param tree over the "tensor" mesh axis.
-    Ref: ``deepspeed.tp_model_init`` (deepspeed/__init__.py:380)."""
+    Ref: ``deepspeed.tp_model_init`` (deepspeed/__init__.py:380).
+
+    ``config`` may carry a ``tensor_parallel.autotp_size`` override (the
+    reference reads the same key). An existing topology with other mesh axes
+    (pipe/expert/seq) is an error if its tp size conflicts — rebuilding the
+    mesh here would silently drop those axes.
+    """
     from deepspeed_tpu.comm.comm import init_distributed
     from deepspeed_tpu.module_inject.auto_tp import tp_model_init as _tp_init
     from deepspeed_tpu.parallel.topology import get_topology
 
+    if config:
+        tp_size = (config.get("tensor_parallel", {}) or {}).get(
+            "autotp_size", tp_size)
     topo = get_topology()
-    if topo is None or (tp_size > 1 and topo.tp_size != tp_size):
+    if topo is None:
         topo = init_distributed(mesh_sizes={"tensor": tp_size} if tp_size > 1
                                 else None)
+    elif tp_size > 1 and topo.tp_size != tp_size:
+        extra = {a: s for a, s in topo.sizes.items()
+                 if a not in ("data", "tensor") and s > 1}
+        if extra:
+            raise ValueError(
+                f"tp_model_init(tp_size={tp_size}) conflicts with existing "
+                f"topology {topo.sizes}; re-run init_distributed with the "
+                f"full mesh instead of rebuilding it here")
+        topo = init_distributed(mesh_sizes={"tensor": tp_size})
     params = model
     if dtype is not None:
         import jax

@@ -73,19 +73,28 @@ class AutoTP:
         """→ "row" | "column" | "embedding" | "replicate"."""
         if any(p.search(path) for p in self._emb):
             return "embedding"
-        if len(shape) < 2:
-            # bias vectors follow their matrix: column-parallel biases shard,
-            # row-parallel biases replicate (they come after the reduce)
-            if any(p.search(path.replace("/b", "/w")) for p in self._col):
+        # Biases follow their matrix: column-parallel biases shard their
+        # feature (last) dim, row-parallel biases replicate (they are added
+        # once, after the psum). Detected by name, not ndim — stacked
+        # per-layer biases are [L, dim] and must still classify as biases.
+        leaf = path.rsplit("/", 1)[-1]
+        if leaf == "bias" or (len(leaf) == 2 and leaf[0] == "b"):
+            parent = path[:-(len(leaf) + 1)] if "/" in path else ""
+            cands = [parent]
+            if leaf != "bias":
+                cands.append(f"{parent}/w{leaf[1:]}" if parent else f"w{leaf[1:]}")
+            if any(p.search(c) for p in self._row for c in cands):
+                return "replicate"
+            if any(p.search(c) for p in self._col for c in cands):
                 return "column_bias"
+            return "replicate"  # norm biases & unknowns: safe under GSPMD
+        if len(shape) < 2:
             return "replicate"
         if any(p.search(path) for p in self._row):
             return "row"
         if any(p.search(path) for p in self._col):
             return "column"
-        if len(shape) >= 2:
-            return "column"  # default Linear → split output (ref LinearLayer)
-        return "replicate"
+        return "column"  # default Linear → split output (ref LinearLayer)
 
     def _divisible(self, n: int) -> bool:
         return (n % (self.tp_size * max(1, self.tp_grain_size))) == 0 or \

@@ -48,7 +48,9 @@ def domino_transformer_layer(x, layer_params, positions, cfg: TransformerConfig,
         yc, aux = tf.transformer_layer(xc, layer_params, pc, cfg)
         outs.append(yc)
         auxes.append(aux)
-    return jnp.concatenate(outs, axis=0), sum(auxes)
+    # Per-chunk aux losses are batch means — average, don't sum, so the
+    # MoE auxiliary objective matches the unchunked layer.
+    return jnp.concatenate(outs, axis=0), sum(auxes) / len(auxes)
 
 
 def domino_forward(params, input_ids, cfg: TransformerConfig, n_chunks: int = 2):
@@ -59,12 +61,9 @@ def domino_forward(params, input_ids, cfg: TransformerConfig, n_chunks: int = 2)
     scheduler the longest independent chains (TP-only; the engine selects
     this path when ``mesh.tensor > 1`` and domino is enabled).
     """
-    b = input_ids.shape[0]
-    if b % n_chunks != 0:
-        raise ValueError(f"batch {b} not divisible into {n_chunks} domino chunks")
-    chunks = jnp.split(input_ids, n_chunks, axis=0)
+    chunks = split_batch(input_ids, n_chunks)
     outs = [tf.forward(params, c, cfg) for c in chunks]
     if isinstance(outs[0], tuple):
         return (jnp.concatenate([o[0] for o in outs], axis=0),
-                sum(o[1] for o in outs))
+                sum(o[1] for o in outs) / n_chunks)
     return jnp.concatenate(outs, axis=0)
