@@ -210,6 +210,33 @@ class ActivationCheckpointingConfig:
 
 
 @dataclass
+class DataEfficiencyConfig:
+    """Ref: data_efficiency JSON block (runtime/data_pipeline/config.py):
+    curriculum learning under data_sampling, random-LTD under data_routing.
+    Legacy top-level ``curriculum_learning`` is also accepted."""
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: Dict[str, Any] = field(default_factory=dict)
+    data_routing: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def curriculum_config(self) -> Optional[Dict[str, Any]]:
+        cl = (self.data_sampling or {}).get("curriculum_learning", {})
+        if cl.get("enabled"):
+            # single-metric shorthand or per-metric "curriculum_metrics"
+            metrics = cl.get("curriculum_metrics")
+            if metrics:
+                return next(iter(metrics.values()))
+            return cl
+        return None
+
+    @property
+    def random_ltd_config(self) -> Optional[Dict[str, Any]]:
+        rl = (self.data_routing or {}).get("random_ltd", {})
+        return rl if rl.get("enabled") else None
+
+
+@dataclass
 class MonitorBackendConfig:
     enabled: bool = False
     output_path: str = ""
@@ -378,6 +405,14 @@ class DeepSpeedConfig:
         self.pipeline = _from_dict(PipelineConfig, d.get(C.PIPELINE), "pipeline")
         self.checkpoint_config = _from_dict(CheckpointConfig, d.get(C.CHECKPOINT), "checkpoint")
         self.aio_config = _from_dict(AIOConfig, d.get("aio"), "aio")
+        de = d.get(C.DATA_EFFICIENCY)
+        if de is None and d.get(C.CURRICULUM_LEARNING_LEGACY, {}).get("enabled"):
+            # legacy top-level curriculum_learning block → wrap it
+            de = {"enabled": True,
+                  "data_sampling": {"curriculum_learning":
+                                    d[C.CURRICULUM_LEARNING_LEGACY]}}
+        self.data_efficiency = _from_dict(DataEfficiencyConfig, de,
+                                          "data_efficiency")
 
         # -- mesh --
         mesh_dict = dict(d.get(C.MESH) or {})

@@ -288,6 +288,19 @@ class DeepSpeedEngine:
                                           steps_per_output=cfg.steps_per_print)
         self.monitor = self._build_monitor(cfg)
 
+        # -- data efficiency: curriculum learning (seqlen truncation) ----
+        # Ref: engine curriculum integration — batches are truncated to the
+        # schedule's current difficulty; difficulty_step rounding bounds the
+        # number of distinct shapes (= XLA recompiles).
+        self.curriculum_scheduler = None
+        cl_cfg = cfg.data_efficiency.curriculum_config \
+            if cfg.data_efficiency.enabled else None
+        if cl_cfg:
+            from deepspeed_tpu.runtime.data_pipeline import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(cl_cfg)
+            self._curriculum_type = cl_cfg.get("curriculum_type", "seqlen")
+
         # grad accumulation buffer for the forward/backward/step trio
         self._grad_buffer = None
         self._micro_in_step = 0
@@ -529,6 +542,30 @@ class DeepSpeedEngine:
         micros = [next(data) for _ in range(gas)]
         return {k: np.stack([np.asarray(m[k]) for m in micros], axis=0) for k in micros[0]}
 
+    def _apply_curriculum(self, data):
+        """Truncate seq-dim batch keys to the curriculum's current
+        difficulty (seqlen curricula only)."""
+        if self.curriculum_scheduler is None or self._curriculum_type != "seqlen":
+            return data
+        seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps)
+
+        def trunc(batch):
+            out = {}
+            for k, v in batch.items():
+                if k in ("input_ids", "labels", "attention_mask",
+                         "position_ids") and np.ndim(v) >= 2 \
+                        and np.shape(v)[1] > seqlen:
+                    out[k] = v[:, :seqlen]
+                else:
+                    out[k] = v
+            return out
+
+        if isinstance(data, dict):
+            return trunc(data)
+        if isinstance(data, (list, tuple)):
+            return type(data)(trunc(b) if isinstance(b, dict) else b for b in data)
+        return data
+
     # ------------------------------------------------------------------
     # Public API (DeepSpeed parity)
     # ------------------------------------------------------------------
@@ -537,6 +574,7 @@ class DeepSpeedEngine:
         Ref: PipelineEngine.train_batch / engine forward+backward+step."""
         if self._onebit is not None:
             return self._train_batch_onebit(data)
+        data = self._apply_curriculum(data)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         batch_stack = self._stack_micro_batches(data)
@@ -561,6 +599,7 @@ class DeepSpeedEngine:
 
         from deepspeed_tpu.parallel.topology import BATCH_AXES
 
+        data = self._apply_curriculum(data)
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         batch_stack = self._stack_micro_batches(data)

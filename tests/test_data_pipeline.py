@@ -1,0 +1,198 @@
+"""Data efficiency: curriculum schedules, curriculum sampler, random-LTD,
+variable batch/LR, and engine seqlen-curriculum integration.
+
+Mirrors the reference's data-pipeline unit coverage
+(tests/unit/runtime/test_data_efficiency.py style)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import get_model_config
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler,
+                                                 DeepSpeedDataSampler,
+                                                 RandomLTDScheduler,
+                                                 batch_by_token_budget,
+                                                 random_ltd_drop,
+                                                 random_ltd_restore,
+                                                 scale_lr_by_batch_size)
+from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+    RandomLTDLayerWrapper, random_ltd_indices)
+
+
+def test_curriculum_fixed_linear():
+    cs = CurriculumScheduler({
+        "curriculum_type": "seqlen", "min_difficulty": 8,
+        "max_difficulty": 64, "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+    assert cs.get_difficulty(0) == 8
+    assert cs.get_difficulty(50) == 8 + (64 - 8) // 2 // 8 * 8  # rounded to 8s
+    assert cs.get_difficulty(100) == 64
+    assert cs.get_difficulty(10**6) == 64
+    # monotone non-decreasing
+    vals = [cs.get_difficulty(s) for s in range(0, 120, 5)]
+    assert all(a <= b for a, b in zip(vals, vals[1:]))
+
+
+def test_curriculum_fixed_root_and_discrete():
+    root = CurriculumScheduler({
+        "min_difficulty": 4, "max_difficulty": 100,
+        "schedule_type": "fixed_root",
+        "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 1,
+                            "root_degree": 2}})
+    # sqrt schedule grows faster early than linear
+    assert root.get_difficulty(25) == 4 + int((100 - 4) * 0.5)
+    disc = CurriculumScheduler({
+        "schedule_type": "fixed_discrete", "min_difficulty": 1,
+        "max_difficulty": 3,
+        "schedule_config": {"difficulty": [16, 32, 64], "max_step": [10, 20]}})
+    assert disc.get_difficulty(5) == 16
+    assert disc.get_difficulty(15) == 32
+    assert disc.get_difficulty(25) == 64
+
+
+def test_curriculum_validation():
+    with pytest.raises(ValueError):
+        CurriculumScheduler({"schedule_type": "fixed_linear"})
+    with pytest.raises(ValueError):
+        CurriculumScheduler({"schedule_type": "bogus"})
+
+
+def test_sampler_plain_partitions_ranks():
+    s0 = DeepSpeedDataSampler(32, batch_size=8, dp_rank=0, dp_size=2, seed=3)
+    s1 = DeepSpeedDataSampler(32, batch_size=8, dp_rank=1, dp_size=2, seed=3)
+    b0, b1 = list(s0), list(s1)
+    assert len(b0) == len(b1) == 4
+    seen = set()
+    for x, y in zip(b0, b1):
+        assert len(x) == len(y) == 4
+        assert not (set(x) & set(y))  # disjoint rank slices
+        seen |= set(x) | set(y)
+    assert seen == set(range(32))  # every sample exactly once
+
+
+def test_sampler_curriculum_filters_difficulty():
+    # difficulties = seqlens 1..64; curriculum caps at 16 for first steps
+    n = 64
+    diffs = np.arange(1, n + 1)
+    cs = CurriculumScheduler({
+        "min_difficulty": 16, "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 16}})
+    s = DeepSpeedDataSampler(n, batch_size=8, difficulties=diffs,
+                             curriculum=cs, shuffle=True, seed=0)
+    batches = list(s)
+    # first batch: only samples with difficulty <= 16
+    assert all(diffs[i] <= 16 for i in batches[0])
+    # every sample seen at most once
+    flat = [i for b in batches for i in b]
+    assert len(flat) == len(set(flat))
+
+
+def test_random_ltd_gather_scatter_roundtrip():
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 16, 4)),
+                    jnp.float32)
+    idx = random_ltd_indices(jax.random.PRNGKey(0), 16, 8, 2)
+    assert idx.shape == (2, 8)
+    # sorted, unique per row
+    for r in np.asarray(idx):
+        assert (np.diff(r) > 0).all()
+    kept = random_ltd_drop(x, idx)
+    assert kept.shape == (2, 8, 4)
+    restored = random_ltd_restore(x, kept, idx)
+    np.testing.assert_allclose(np.asarray(restored), np.asarray(x))  # identity
+
+
+def test_random_ltd_layer_wrapper():
+    w = jnp.asarray(np.random.default_rng(1).standard_normal((4, 4)), jnp.float32)
+    layer = lambda x, pos: x @ w  # noqa: E731
+    sched = RandomLTDScheduler(8, 16, total_steps=10, step_size=4)
+    wrapper = RandomLTDLayerWrapper(layer, sched)
+    x = jnp.asarray(np.random.default_rng(2).standard_normal((2, 16, 4)), jnp.float32)
+    pos = jnp.tile(jnp.arange(16), (2, 1))
+    y = wrapper(x, pos, jax.random.PRNGKey(1), kept=8)
+    assert y.shape == x.shape
+    # exactly 8 tokens per row transformed, the rest passed through
+    changed = (np.abs(np.asarray(y - x)).sum(-1) > 1e-6).sum(axis=1)
+    assert (changed <= 8).all()
+    # kept >= seq → plain layer
+    y_full = wrapper(x, pos, jax.random.PRNGKey(1), kept=16)
+    np.testing.assert_allclose(np.asarray(y_full), np.asarray(x @ w), atol=1e-6)
+
+
+def test_random_ltd_schedule():
+    s = RandomLTDScheduler(64, 512, total_steps=100, step_size=64)
+    assert s.get_seqlen(0) == 64
+    assert s.get_seqlen(100) == 512
+    assert s.get_seqlen(50) == (64 + (512 - 64) // 2) // 64 * 64
+
+
+def test_batch_by_token_budget():
+    seqlens = [10, 20, 30, 40, 50, 60]
+    batches = batch_by_token_budget(seqlens, token_budget=100, shuffle_seed=-1)
+    flat = sorted(i for b in batches for i in b)
+    assert flat == list(range(6))
+    for b in batches:
+        rows = len(b)
+        assert rows * max(seqlens[i] for i in b) <= 100
+    with pytest.raises(ValueError):
+        batch_by_token_budget([200], token_budget=100)
+
+
+def test_scale_lr():
+    assert scale_lr_by_batch_size(0.1, 64, 32, "linear") == pytest.approx(0.2)
+    assert scale_lr_by_batch_size(0.1, 64, 16, "sqrt") == pytest.approx(0.2)
+    assert scale_lr_by_batch_size(0.1, 64, 32, "none") == 0.1
+
+
+def test_engine_curriculum_truncates_seqlen():
+    model = get_model_config("gpt2-tiny")
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "mesh": {"data": 1},
+        "data_efficiency": {
+            "enabled": True,
+            "data_sampling": {"curriculum_learning": {
+                "enabled": True, "curriculum_type": "seqlen",
+                "min_difficulty": 8, "max_difficulty": 16,
+                "schedule_type": "fixed_linear",
+                "schedule_config": {"total_curriculum_step": 2,
+                                    "difficulty_step": 8}}}},
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, model.vocab_size, size=(2, 16), dtype=np.int32)
+    batch = {"input_ids": ids, "labels": ids}
+    seen = []
+    orig = engine._stack_micro_batches
+
+    def spy(data):
+        out = orig(data)
+        seen.append(out["input_ids"].shape[-1])
+        return out
+
+    engine._stack_micro_batches = spy
+    for _ in range(3):
+        loss = engine.train_batch(batch)
+    assert np.isfinite(float(np.asarray(loss)))
+    assert seen[0] == 8 and seen[-1] == 16  # difficulty ramped 8 → 16
+    from deepspeed_tpu.parallel import topology
+
+    topology._GLOBAL_TOPOLOGY = None
+
+
+def test_legacy_curriculum_key():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        "curriculum_learning": {"enabled": True, "curriculum_type": "seqlen",
+                                "min_difficulty": 2, "max_difficulty": 4,
+                                "schedule_type": "fixed_linear",
+                                "schedule_config": {"total_curriculum_step": 2}}})
+    assert cfg.data_efficiency.enabled
+    assert cfg.data_efficiency.curriculum_config["min_difficulty"] == 2
