@@ -1,0 +1,145 @@
+"""Compression (QAT/pruning/layer reduction) and OptimizedLinear/LoRA.
+
+Mirrors the reference's tests/unit/compression/ and tests/unit/linear/."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.compression import (CompressionManager, init_compression,
+                                       quantize_activation_ste,
+                                       quantize_weight_ste,
+                                       sparse_pruning_mask)
+from deepspeed_tpu.compression.basic_layers import (channel_pruning_mask,
+                                                    head_pruning_mask,
+                                                    row_pruning_mask)
+from deepspeed_tpu.linear import (LoRAConfig, OptimizedLinear,
+                                  QuantizationConfig, QuantizedParameter,
+                                  init_lora_params, lora_linear)
+
+RNG = np.random.default_rng(0)
+
+
+def _w(*shape):
+    return jnp.asarray(RNG.standard_normal(shape), jnp.float32)
+
+
+def test_quantize_weight_ste_value_and_grad():
+    w = _w(16, 32)
+    q = quantize_weight_ste(w, bits=8)
+    assert float(jnp.abs(q - w).max()) < float(jnp.abs(w).max()) / 100
+    # STE: gradient passes through ~identity
+    g = jax.grad(lambda w: (quantize_weight_ste(w, bits=8) ** 2).sum())(w)
+    g_ref = jax.grad(lambda w: (w ** 2).sum())(w)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=0.1, rtol=0.2)
+
+
+def test_quantize_activation():
+    x = _w(4, 64)
+    for sym in (True, False):
+        q = quantize_activation_ste(x, bits=8, symmetric=sym)
+        assert float(jnp.abs(q - x).max()) < 0.1
+
+
+def test_pruning_masks():
+    w = _w(32, 64)
+    m = sparse_pruning_mask(w, 0.25)
+    assert abs(float(m.mean()) - 0.25) < 0.05
+    rm = row_pruning_mask(w, 0.5)
+    kept_rows = np.asarray(rm)[0].sum()
+    assert kept_rows == 32  # half of 64 output features
+    assert (np.asarray(rm).std(axis=0) == 0).all()  # whole columns
+    cm = channel_pruning_mask(w, 0.5)
+    assert (np.asarray(cm).std(axis=1) == 0).all()  # whole rows
+    hm = head_pruning_mask(w, 0.5, num_heads=4)
+    hk = np.asarray(hm).reshape(4, 8, 64)
+    per_head = hk.reshape(4, -1).mean(axis=1)
+    assert set(per_head.tolist()) <= {0.0, 1.0}
+    assert per_head.sum() == 2  # half of 4 heads kept
+
+
+def test_compression_manager_schedule_and_apply():
+    cfg = {"compression_training": {
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 5},
+            "different_groups": {"g1": {"params": {"dense_ratio": 0.5},
+                                        "modules": ["mlp"]}}},
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {"q1": {"params": {"start_bits": 8},
+                                        "modules": ["*"]}}},
+    }}
+    params = {"mlp": {"wi": _w(8, 16)}, "attn": {"wq": _w(8, 8)}}
+    mgr = CompressionManager(cfg)
+    # before offset: no pruning, but quantization active at step 0
+    p0 = mgr.apply(params, step=0)
+    assert float(jnp.abs(p0["mlp"]["wi"]) .min()) >= 0  # smoke
+    assert (np.asarray(p0["mlp"]["wi"]) != 0).mean() > 0.9
+    p5 = mgr.apply(params, step=5)
+    assert abs((np.asarray(p5["mlp"]["wi"]) != 0).mean() - 0.5) < 0.1
+    # attn not in pruning scope
+    assert (np.asarray(p5["attn"]["wq"]) != 0).mean() > 0.9
+
+
+def test_layer_reduction():
+    params = {"layers": {"wi": _w(8, 4, 4)}, "embed": _w(16, 4)}
+    out, mgr = init_compression(params, {"compression_training": {
+        "layer_reduction": {"enabled": True, "teacher_layer": [0, 2, 5]}}})
+    assert out["layers"]["wi"].shape == (3, 4, 4)
+    np.testing.assert_allclose(np.asarray(out["layers"]["wi"][1]),
+                               np.asarray(params["layers"]["wi"][2]))
+    assert out["embed"].shape == (16, 4)  # non-layer params untouched
+
+
+def test_redundancy_clean_bakes_masks():
+    cfg = {"compression_training": {"sparse_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 100},
+        "different_groups": {"g": {"params": {"dense_ratio": 0.25}}}}}}
+    mgr = CompressionManager(cfg)
+    params = {"w": _w(16, 16)}
+    cleaned = mgr.redundancy_clean(params)
+    assert abs((np.asarray(cleaned["w"]) != 0).mean() - 0.25) < 0.1
+
+
+# ----------------------------------------------------------------------
+def test_quantized_parameter_roundtrip():
+    w = _w(64, 128)
+    for bits in (8, 4):
+        qp = QuantizedParameter(w, q_bits=bits, group_size=64)
+        deq = qp.dequantized()
+        assert deq.shape == w.shape
+        err = float(jnp.abs(deq - w).max())
+        assert err < (0.05 if bits == 8 else 0.6)
+        assert qp.nbytes < w.size * 4  # actually compressed
+
+
+def test_lora_linear_forward_and_grads():
+    key = jax.random.PRNGKey(0)
+    w = _w(32, 16)
+    x = _w(4, 32)
+    lc = LoRAConfig(lora_r=8, lora_alpha=16)
+    p = init_lora_params(key, 32, 16, lc)
+    # B=0 → output equals base at init
+    y0 = lora_linear(x, w, p["lora_A"], p["lora_B"], lora_alpha=16, lora_r=8)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(x @ w), atol=1e-5)
+
+    def loss(p, w):
+        y = lora_linear(x, w, p["lora_A"], p["lora_B"], lora_alpha=16, lora_r=8)
+        return (y ** 2).sum()
+
+    gp, gw = jax.grad(loss, argnums=(0, 1))(p, w)
+    # B=0 at init → grad flows to B first (A's grad passes through B)
+    assert float(jnp.abs(gp["lora_B"]).max()) > 0  # adapters train
+    assert float(jnp.abs(gw).max()) == 0  # base frozen
+
+
+def test_optimized_linear_quantized_base():
+    w = _w(64, 32)
+    x = _w(2, 64)
+    ol = OptimizedLinear(w, lora_config=LoRAConfig(lora_r=4),
+                         quantization_config=QuantizationConfig(q_bits=8, group_size=32),
+                         key=jax.random.PRNGKey(1))
+    y = ol(x)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x @ w), atol=0.2, rtol=0.1)
+    assert set(ol.trainable_params()) == {"lora_A", "lora_B"}
