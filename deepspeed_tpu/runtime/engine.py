@@ -301,6 +301,14 @@ class DeepSpeedEngine:
             self.curriculum_scheduler = CurriculumScheduler(cl_cfg)
             self._curriculum_type = cl_cfg.get("curriculum_type", "seqlen")
 
+        # -- flops profiler (XLA cost analysis at profile_step) ----------
+        self._flops_profiler = None
+        self._last_flops_profile = None
+        if cfg.flops_profiler.enabled:
+            from deepspeed_tpu.profiling import FlopsProfiler
+
+            self._flops_profiler = FlopsProfiler(cfg.flops_profiler)
+
         # grad accumulation buffer for the forward/backward/step trio
         self._grad_buffer = None
         self._micro_in_step = 0
@@ -581,6 +589,13 @@ class DeepSpeedEngine:
         batch_stack = self._put_batch(batch_stack, stacked=True)
         lr = jnp.float32(self.lr_scheduler(self.global_steps))
         opt_state = self._swap_in_opt_state()
+        if (self._flops_profiler is not None
+                and not self._flops_profiler.profile_done
+                and self.global_steps + 1 >= self.config.flops_profiler.profile_step):
+            self._last_flops_profile = self._flops_profiler.profile_engine_step(
+                self, self.params, opt_state, self.loss_scale_state,
+                batch_stack, lr)
+            self._flops_profiler.print_profile(self._last_flops_profile)
         self.params, opt_state, self.loss_scale_state, metrics = self._train_step_jit(
             self.params, opt_state, self.loss_scale_state, batch_stack, lr)
         self._swap_out_opt_state(opt_state)
