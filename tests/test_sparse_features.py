@@ -1,0 +1,153 @@
+"""Sparse attention configs/kernel + model features (PLD, eigenvalue,
+tiled linear, sparse tensors).
+
+Mirrors reference coverage: tests/unit/ops/sparse_attention/, runtime
+feature tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                BSLongformerSparsityConfig,
+                                                DenseSparsityConfig,
+                                                FixedSparsityConfig,
+                                                VariableSparsityConfig,
+                                                layout_to_token_mask,
+                                                sparse_attention)
+from deepspeed_tpu.runtime.model_features import (Eigenvalue,
+                                                  ProgressiveLayerDrop,
+                                                  SparseTensor, layer_drop,
+                                                  tiled_linear)
+
+
+def _qkv(b=1, s=64, h=2, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.float32)
+                 for _ in range(3))
+
+
+def test_layouts_shapes_and_coverage():
+    for cfg in [FixedSparsityConfig(2, block=8, num_local_blocks=2),
+                BSLongformerSparsityConfig(2, block=8),
+                BigBirdSparsityConfig(2, block=8),
+                VariableSparsityConfig(2, block=8, local_window_blocks=[2, 4])]:
+        layout = cfg.make_layout(64)
+        assert layout.shape == (2, 8, 8)
+        assert layout.sum() > 0
+        # every query block attends at least one key block
+        assert (layout.sum(-1) > 0).all()
+    with pytest.raises(ValueError):
+        FixedSparsityConfig(2, block=16).make_layout(40)
+
+
+def test_longformer_window_and_global():
+    cfg = BSLongformerSparsityConfig(1, block=8, num_sliding_window_blocks=3,
+                                     global_block_indices=[0])
+    lay = cfg.make_layout(64)[0]
+    assert lay[0].all() and lay[:, 0].all()  # global row+col
+    assert lay[4, 3] and lay[4, 4] and lay[4, 5]  # window
+    assert not lay[4, 6]  # outside window, not global
+
+
+def test_dense_config_matches_full_attention():
+    q, k, v = _qkv()
+    cfg = DenseSparsityConfig(2, block=8)
+    out = sparse_attention(q, k, v, cfg)
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_sparse_attention_respects_mask():
+    q, k, v = _qkv(s=32)
+    cfg = BSLongformerSparsityConfig(2, block=8, num_sliding_window_blocks=1,
+                                     global_block_indices=[])
+    out = sparse_attention(q, k, v, cfg, causal=True)
+    # block-diagonal layout + causal: token 8 only sees keys 8..8 in its
+    # block → changing key 0 must not affect query 8's output
+    k2 = k.at[:, 0].set(k[:, 0] + 100.0)
+    out2 = sparse_attention(q, k2, v, cfg, causal=True)
+    np.testing.assert_allclose(np.asarray(out[:, 8:16]),
+                               np.asarray(out2[:, 8:16]), atol=1e-6)
+
+
+def test_causal_sparse_attention():
+    q, k, v = _qkv(s=32)
+    cfg = DenseSparsityConfig(2, block=8)
+    out = sparse_attention(q, k, v, cfg, causal=True)
+    # first token attends only itself → output == v[0]
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(v[:, 0]),
+                               atol=2e-5)
+
+
+def test_layout_to_token_mask():
+    lay = np.zeros((1, 2, 2), np.int64)
+    lay[0, 1, 0] = 1
+    m = layout_to_token_mask(lay, 4)
+    assert m.shape == (1, 8, 8)
+    assert bool(m[0, 5, 2]) and not bool(m[0, 1, 1])
+
+
+# ----------------------------------------------------------------------
+def test_progressive_layer_drop_schedule():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta(0) == pytest.approx(1.0)
+    assert pld.update_state(10**6) == pytest.approx(0.5, abs=1e-3)
+    ths = [pld.get_theta(s) for s in range(0, 1000, 100)]
+    assert all(a >= b for a, b in zip(ths, ths[1:]))  # monotone decay
+    assert pld.get_state()["pld_theta"] == pld.current_theta
+
+
+def test_layer_drop_keep_and_skip():
+    f = lambda x: x * 2.0  # noqa: E731
+    x = jnp.ones((2, 4))
+    kept = layer_drop(f, x, keep_prob=1.0, key=jax.random.PRNGKey(0))
+    np.testing.assert_allclose(np.asarray(kept), 2.0)
+    skipped = layer_drop(f, x, keep_prob=0.0, key=jax.random.PRNGKey(0),
+                         layer_idx=1, num_layers=1)
+    np.testing.assert_allclose(np.asarray(skipped), 1.0)  # identity
+
+
+def test_eigenvalue_quadratic():
+    # loss = 0.5 x^T A x with known top eigenvalue
+    a = np.diag([4.0, 1.0, 0.5]).astype(np.float32)
+    A = jnp.asarray(a)
+
+    def loss(p):
+        x = p["x"]
+        return 0.5 * x @ A @ x
+
+    eig = Eigenvalue(max_iter=50, tol=1e-6)
+    out = eig.compute(loss, {"x": jnp.ones((3,), jnp.float32)},
+                      jax.random.PRNGKey(0))
+    assert out["__global__"] == pytest.approx(4.0, rel=1e-2)
+
+
+def test_tiled_linear_matches():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((4, 12)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((12, 8)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((8,)), jnp.float32)
+    out = tiled_linear(x, w, b, in_splits=3, out_splits=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w + b),
+                               atol=1e-5)
+    act = tiled_linear(x, w, b, in_splits=2, out_splits=4,
+                       activation=jax.nn.relu)
+    np.testing.assert_allclose(np.asarray(act),
+                               np.asarray(jax.nn.relu(x @ w + b)), atol=1e-5)
+    with pytest.raises(ValueError):
+        tiled_linear(x, w, None, in_splits=5)
+
+
+def test_sparse_tensor_roundtrip_and_add():
+    dense = jnp.zeros((6, 3)).at[1].set(2.0).at[4].set(-1.0)
+    st = SparseTensor.from_dense(dense)
+    assert st.indices.shape[0] == 2
+    np.testing.assert_allclose(np.asarray(st.to_dense()), np.asarray(dense))
+    both = SparseTensor.add(st, st)
+    np.testing.assert_allclose(np.asarray(both.to_dense()),
+                               np.asarray(dense * 2))
+    assert st.sparse_size() < dense.size
