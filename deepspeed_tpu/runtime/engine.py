@@ -736,7 +736,15 @@ class DeepSpeedEngine:
         if self._checkpoint_engine is None:
             cc = self.config.checkpoint_config
             writer_type = (cc.writer or {}).get("type", "")
-            if writer_type == "orbax" or cc.async_save:
+            if writer_type == "fast":
+                from deepspeed_tpu.checkpoint.fast_engine import FastCheckpointEngine
+
+                self._checkpoint_engine = FastCheckpointEngine()
+            elif writer_type == "decoupled":
+                from deepspeed_tpu.checkpoint.fast_engine import DecoupledCheckpointEngine
+
+                self._checkpoint_engine = DecoupledCheckpointEngine()
+            elif writer_type == "orbax" or cc.async_save:
                 from deepspeed_tpu.checkpoint.orbax_engine import OrbaxCheckpointEngine
 
                 self._checkpoint_engine = OrbaxCheckpointEngine(async_save=cc.async_save)
