@@ -84,19 +84,22 @@ class SuperOffloadOptimizer:
 
     def _bucket_step(self, bucket: List[int], grads: List[np.ndarray],
                      step: int) -> None:
+        from deepspeed_tpu.ops.cpu_optimizer import _lib, _ptr, adam_step_numpy
+
+        lib = _lib()
         b1, b2 = self.beta1, self.beta2
         for j, i in enumerate(bucket):
-            g = grads[j]
-            if self.weight_decay:
-                g = g + self.weight_decay * self._master[i]
-            m, v = self._m[i], self._v[i]
-            m *= b1
-            m += (1 - b1) * g
-            v *= b2
-            v += (1 - b2) * g * g
-            mh = m / (1 - b1 ** step)
-            vh = v / (1 - b2 ** step)
-            self._master[i] -= self.lr * mh / (np.sqrt(vh) + self.eps)
+            g = np.ascontiguousarray(grads[j], np.float32)
+            p, m, v = self._master[i], self._m[i], self._v[i]
+            if lib is not None:
+                # vectorized fused step (csrc/cpu_optimizer) — classic Adam
+                # with coupled weight decay, matching the numpy fallback
+                lib.ds_adam_step(_ptr(p), _ptr(g), _ptr(m), _ptr(v), p.size,
+                                 self.lr, b1, b2, self.eps,
+                                 self.weight_decay, step, 0)
+            else:
+                adam_step_numpy(p, g, m, v, self.lr, b1, b2, self.eps,
+                                self.weight_decay, step, adamw=False)
 
     def step(self, params: Any, grads: Any) -> Any:
         """grads (device tree) → updated device params.  Transfers and host
