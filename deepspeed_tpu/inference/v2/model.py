@@ -33,14 +33,19 @@ from deepspeed_tpu.models.transformer import (TransformerConfig, _mlp_block,
 
 
 def _rope_tok(x, positions, cfg: TransformerConfig):
-    """Rotary embedding over per-token positions. x: [T, H, D], positions: [T]."""
+    """Rotary embedding over per-token positions. x: [T, H, D], positions:
+    [T].  Honors ``rotary_pct`` (Phi partial rotary) like models._rope."""
     d = cfg.dim_per_head
-    freqs = 1.0 / (cfg.rope_theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    angles = positions[:, None].astype(jnp.float32) * freqs  # [T, D/2]
+    rot_d = d if cfg.rotary_pct >= 1.0 else max(2, int(d * cfg.rotary_pct) // 2 * 2)
+    freqs = 1.0 / (cfg.rope_theta ** (jnp.arange(0, rot_d, 2, dtype=jnp.float32) / rot_d))
+    angles = positions[:, None].astype(jnp.float32) * freqs  # [T, rot_d/2]
     cos = jnp.cos(angles)[:, None, :]
     sin = jnp.sin(angles)[:, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    xf = x.astype(jnp.float32)
+    xr, x_pass = xf[..., :rot_d], xf[..., rot_d:]
+    x1, x2 = jnp.split(xr, 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin, x_pass],
+                          axis=-1)
     return out.astype(x.dtype)
 
 
@@ -66,6 +71,9 @@ def _paged_attention(q, k_pages, v_pages, gather_idx, token_pos, token_ctx_len,
     c_pos = jnp.arange(scores.shape[-1], dtype=jnp.int32)
     valid = (c_pos[None, :] <= token_pos[:, None]) & \
             (c_pos[None, :] < token_ctx_len[:, None])       # [T, C]
+    if cfg.sliding_window:
+        valid = valid & (token_pos[:, None] - c_pos[None, :]
+                         < cfg.sliding_window)
     scores = jnp.where(valid[:, None, :], scores.astype(jnp.float32), -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("thc,tchd->thd", probs, v_ctx)
@@ -102,6 +110,11 @@ def _ragged_layer(x, lp, k_pages, v_pages, meta, cfg: TransformerConfig,
     attn = attn.reshape(t, nh * d) @ lp["attn"]["wo"].astype(dt)
     if lp["attn"].get("bo") is not None:
         attn = attn + lp["attn"]["bo"].astype(dt)
+
+    if cfg.parallel_block:
+        # Falcon/Phi: attention and MLP both read the shared input norm
+        return x + attn + _mlp_block(h, lp["mlp"], cfg), k_pages, v_pages
+
     x = x + attn
 
     h2 = _norm(x, lp["ln2"], cfg)

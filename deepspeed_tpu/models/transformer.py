@@ -44,12 +44,21 @@ class TransformerConfig:
     head_dim: Optional[int] = None
     max_seq_len: int = 1024
     # architecture switches
-    arch: str = "gpt2"  # "gpt2" | "llama"
+    arch: str = "gpt2"  # "gpt2" | "llama" | "opt" | "mistral" | "qwen2" | "falcon" | "phi"
     norm: str = "layernorm"  # "layernorm" | "rmsnorm"
-    activation: str = "gelu"  # "gelu" | "swiglu"
+    activation: str = "gelu"  # "gelu" | "swiglu" | "relu"
     use_rope: bool = False
     rope_theta: float = 10000.0
+    rotary_pct: float = 1.0  # Phi-style partial rotary (fraction of head dim)
     tie_embeddings: bool = True
+    # family features (ref inference/v2/model_implementations/{opt,phi,qwen,
+    # falcon,mistral}): learned absolute positions, projection biases,
+    # sliding-window attention, parallel attn+MLP residual blocks
+    learned_positions: Optional[bool] = None  # None → arch == "gpt2"/"opt"
+    use_bias: Optional[bool] = None  # all proj biases; None → gpt2/opt
+    qkv_bias: bool = False  # qkv-only bias (Qwen2)
+    sliding_window: Optional[int] = None  # Mistral
+    parallel_block: bool = False  # Falcon/Phi: x + attn(n) + mlp(n)
     # MoE (0 ⇒ dense; ref deepspeed/moe)
     num_experts: int = 0
     top_k: int = 2
@@ -79,6 +88,18 @@ class TransformerConfig:
     def is_moe(self) -> bool:
         return self.num_experts > 0
 
+    @property
+    def has_learned_positions(self) -> bool:
+        if self.learned_positions is not None:
+            return self.learned_positions
+        return self.arch in ("gpt2", "opt")
+
+    @property
+    def has_bias(self) -> bool:
+        if self.use_bias is not None:
+            return self.use_bias
+        return self.arch in ("gpt2", "opt", "phi")
+
     def replace(self, **kw) -> "TransformerConfig":
         return dataclasses.replace(self, **kw)
 
@@ -105,10 +126,11 @@ def init_layer_params(cfg: TransformerConfig, key) -> Params:
         "wv": _dense_init(keys[2], (h, nkv * hd), scale, pd),
         "wo": _dense_init(keys[3], (nh * hd, h), out_scale, pd),
     }
-    if cfg.arch == "gpt2":
+    if cfg.has_bias or cfg.qkv_bias:
         attn["bq"] = jnp.zeros((nh * hd,), pd)
         attn["bk"] = jnp.zeros((nkv * hd,), pd)
         attn["bv"] = jnp.zeros((nkv * hd,), pd)
+    if cfg.has_bias:
         attn["bo"] = jnp.zeros((h,), pd)
 
     def mlp_params(k1, k2, k3):
@@ -122,7 +144,7 @@ def init_layer_params(cfg: TransformerConfig, key) -> Params:
             "wi": _dense_init(k1, (h, ffn), scale, pd),
             "wo": _dense_init(k3, (ffn, h), out_scale, pd),
         }
-        if cfg.arch == "gpt2":
+        if cfg.has_bias:
             mlp["bi"] = jnp.zeros((ffn,), pd)
             mlp["bo"] = jnp.zeros((h,), pd)
         return mlp
@@ -170,7 +192,7 @@ def init_params(cfg: TransformerConfig, key) -> Params:
     }
     if cfg.norm == "layernorm":
         params["final_norm"]["bias"] = jnp.zeros((cfg.hidden_size,), pd)
-    if cfg.arch == "gpt2":
+    if cfg.has_learned_positions:
         params["embed"]["positions"] = _dense_init(
             keys[-2], (cfg.max_seq_len, cfg.hidden_size), scale, pd)
     if not cfg.tie_embeddings:
@@ -200,16 +222,22 @@ def _norm(x, p, cfg: TransformerConfig):
 
 
 def _rope(q, k, positions, cfg: TransformerConfig):
-    """Rotary embeddings (Llama). q,k: [B, S, H, D]."""
+    """Rotary embeddings (Llama). q,k: [B, S, H, D].  ``rotary_pct`` < 1
+    rotates only the leading fraction of the head dim (Phi partial rotary,
+    ref inference/v2 phi containers)."""
     d = cfg.dim_per_head
-    freqs = 1.0 / (cfg.rope_theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, D/2]
+    rot_d = d if cfg.rotary_pct >= 1.0 else max(2, int(d * cfg.rotary_pct) // 2 * 2)
+    freqs = 1.0 / (cfg.rope_theta ** (jnp.arange(0, rot_d, 2, dtype=jnp.float32) / rot_d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, rot_d/2]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
 
     def rot(x):
-        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-        return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        xf = x.astype(jnp.float32)
+        xr, x_pass = xf[..., :rot_d], xf[..., rot_d:]
+        x1, x2 = jnp.split(xr, 2, axis=-1)
+        xr = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        return jnp.concatenate([xr, x_pass], axis=-1)
 
     return rot(q).astype(q.dtype), rot(k).astype(k.dtype)
 
@@ -225,6 +253,11 @@ def _attention_scores(q, k, v, cfg: TransformerConfig, segment_pos=None):
         v = jnp.repeat(v, rep, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
     mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    if cfg.sliding_window:
+        # Mistral sliding-window: key within the last `window` positions
+        qpos = lax.broadcasted_iota(jnp.int32, (s, s), 0)
+        kpos = lax.broadcasted_iota(jnp.int32, (s, s), 1)
+        mask = mask & (qpos - kpos < cfg.sliding_window)
     scores = jnp.where(mask[None, None, :, :], scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
@@ -254,7 +287,7 @@ def _attn_block(x, p, positions, cfg: TransformerConfig):
 
     q, k, v = ulysses_qkv_constraint(q, k, v)
 
-    if cfg.attn_impl == "pallas_flash":
+    if cfg.attn_impl == "pallas_flash" and not cfg.sliding_window:
         from deepspeed_tpu.ops.flash_attention import flash_attention
 
         out = flash_attention(q, k, v, causal=True)
@@ -276,7 +309,8 @@ def _mlp_block(x, p, cfg: TransformerConfig):
     y = x @ p["wi"].astype(dt)
     if p.get("bi") is not None:
         y = y + p["bi"].astype(dt)
-    y = jax.nn.gelu(y, approximate=True)
+    y = jax.nn.relu(y) if cfg.activation == "relu" \
+        else jax.nn.gelu(y, approximate=True)
     y = y @ p["wo"].astype(dt)
     if p.get("bo") is not None:
         y = y + p["bo"].astype(dt)
@@ -301,6 +335,16 @@ def transformer_layer(x, layer_params, positions, cfg: TransformerConfig,
     reference's per-layer MoE placement (PR-MoE, moe_layer_freq) maps onto a
     uniform scan-over-layers body.
     """
+    if cfg.parallel_block:
+        # Falcon/Phi residual form: one shared input norm feeds attention
+        # and MLP in parallel (ref falcon/phi v2 containers).
+        n = _norm(x, layer_params["ln1"], cfg)
+        attn_out = _attn_block(n, layer_params["attn"], positions, cfg)
+        if "moe" not in layer_params:
+            return (x + attn_out + _mlp_block(n, layer_params["mlp"], cfg),
+                    jnp.zeros((), jnp.float32))
+        y, aux = _moe_block(n, layer_params["moe"], cfg)
+        return x + attn_out + y, aux
     x = x + _attn_block(_norm(x, layer_params["ln1"], cfg), layer_params["attn"], positions, cfg)
     h = _norm(x, layer_params["ln2"], cfg)
     if "moe" not in layer_params:
@@ -347,7 +391,7 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
 
     x = params["embed"]["tokens"].astype(dt)[input_ids]
-    if cfg.arch == "gpt2":
+    if cfg.has_learned_positions:
         x = x + params["embed"]["positions"].astype(dt)[positions]
 
     moe_every = max(1, cfg.moe_layer_freq)
