@@ -1,0 +1,82 @@
+"""Indexed dataset + data analyzer (ref data_sampling tests)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler,
+                                                 DataAnalyzer,
+                                                 DeepSpeedDataSampler,
+                                                 IndexedDataset,
+                                                 IndexedDatasetBuilder,
+                                                 load_metric)
+
+
+def _build(tmp_path, n=20, dtype=np.int32):
+    rng = np.random.default_rng(0)
+    seqs = [rng.integers(0, 1000, size=rng.integers(3, 40)).astype(dtype)
+            for _ in range(n)]
+    b = IndexedDatasetBuilder(str(tmp_path / "corpus"), dtype=dtype)
+    b.add_items(seqs)
+    b.finalize()
+    return seqs
+
+
+def test_indexed_roundtrip(tmp_path):
+    seqs = _build(tmp_path)
+    ds = IndexedDataset(str(tmp_path / "corpus"))
+    assert len(ds) == len(seqs)
+    for i in (0, 7, len(seqs) - 1, -1):
+        np.testing.assert_array_equal(ds[i], seqs[i])
+    np.testing.assert_array_equal(ds.sizes, [len(s) for s in seqs])
+    with pytest.raises(IndexError):
+        ds[len(seqs)]
+
+
+def test_indexed_dtypes(tmp_path):
+    for dt in (np.uint16, np.int64, np.uint8):
+        _build(tmp_path / str(np.dtype(dt)), n=3, dtype=dt)
+        ds = IndexedDataset(str(tmp_path / str(np.dtype(dt)) / "corpus"))
+        assert ds.dtype == np.dtype(dt)
+
+
+def test_analyzer_sharded_map_reduce(tmp_path):
+    seqs = _build(tmp_path, n=30)
+    ds = IndexedDataset(str(tmp_path / "corpus"))
+    samples = [{"input_ids": ds[i]} for i in range(len(ds))]
+    out_dir = str(tmp_path / "analysis")
+    # 3 workers map disjoint shards, then one reduce
+    for w in range(3):
+        DataAnalyzer(samples, out_dir, num_workers=3, worker_id=w).run_map()
+    DataAnalyzer(samples, out_dir, num_workers=3).run_reduce()
+    vals = load_metric(out_dir, "seqlen")
+    np.testing.assert_array_equal(vals, [len(s) for s in seqs])
+    order = np.load(f"{out_dir}/seqlen_index_sorted.npy")
+    assert (np.diff(vals[order]) >= 0).all()
+
+
+def test_analyzer_feeds_curriculum_sampler(tmp_path):
+    _build(tmp_path, n=32)
+    ds = IndexedDataset(str(tmp_path / "corpus"))
+    samples = [{"input_ids": ds[i]} for i in range(len(ds))]
+    out_dir = str(tmp_path / "analysis")
+    DataAnalyzer(samples, out_dir).run_map()
+    DataAnalyzer(samples, out_dir).run_reduce()
+    diffs = load_metric(out_dir)
+    cs = CurriculumScheduler({
+        "min_difficulty": 15, "max_difficulty": 40,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 5}})
+    sampler = DeepSpeedDataSampler(len(samples), batch_size=2,
+                                   difficulties=diffs, curriculum=cs, seed=1)
+    first = next(iter(sampler))
+    assert all(diffs[i] <= 15 for i in first)
+
+
+def test_analyzer_missing_shard_raises(tmp_path):
+    _build(tmp_path, n=10)
+    ds = IndexedDataset(str(tmp_path / "corpus"))
+    samples = [{"input_ids": ds[i]} for i in range(len(ds))]
+    out_dir = str(tmp_path / "analysis")
+    DataAnalyzer(samples, out_dir, num_workers=2, worker_id=0).run_map()
+    with pytest.raises(RuntimeError):  # worker 1 never mapped
+        DataAnalyzer(samples, out_dir, num_workers=2).run_reduce()
