@@ -68,6 +68,14 @@ class TransformerConfig:
     # gradient-accumulation micro-step (0 → pp size); must divide the
     # per-call batch dim
     pipeline_microbatches: int = 0
+    # random-LTD (ref data_routing/basic_layer.py): a band of middle layers
+    # [ltd_start, ltd_end) runs on ltd_kept random tokens; 0 = disabled.
+    # ltd_kept is static per compile — the engine re-jits when the
+    # schedule raises it (same recompile cadence as the reference's
+    # shape changes).
+    ltd_kept: int = 0
+    ltd_start: int = 1
+    ltd_end: Optional[int] = None
     # numerics
     dtype: Any = jnp.bfloat16  # compute dtype
     param_dtype: Any = jnp.float32  # master dtype
@@ -383,8 +391,9 @@ def _maybe_remat(fn, cfg: TransformerConfig):
 
 
 def forward(params: Params, input_ids, cfg: TransformerConfig,
-            positions=None) -> jnp.ndarray:
-    """Token ids [B, S] → logits [B, S, V]. lax.scan over stacked layers."""
+            positions=None, pld_theta=None) -> jnp.ndarray:
+    """Token ids [B, S] → logits [B, S, V]. lax.scan over stacked layers.
+    ``pld_theta``: progressive-layer-drop keep prob (traced scalar or None)."""
     b, s = input_ids.shape
     dt = cfg.dtype
     if positions is None:
@@ -420,21 +429,66 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
         x = spmd_pipeline(stage_fn, params["layers"], x, topo=topo,
                           n_micro=n_micro, extras=positions)
     else:
-        def body(carry, scanned):
-            h, aux_acc = carry
-            layer_params, layer_idx = scanned
-            if cfg.is_moe:
-                is_moe_layer = (layer_idx % moe_every) == (moe_every - 1)
-            else:
-                is_moe_layer = False
-            h2, aux = transformer_layer(h, layer_params, positions, cfg,
-                                        layer_is_moe=is_moe_layer)
-            return (h2, aux_acc + aux), None
+        def scan_segment(x, pos, layers_slice, idx0, n_layers):
+            """Scan a contiguous slice of the stacked layers."""
+            def body(carry, scanned):
+                h, aux_acc = carry
+                layer_params, layer_idx = scanned
+                if cfg.is_moe:
+                    is_moe_layer = (layer_idx % moe_every) == (moe_every - 1)
+                else:
+                    is_moe_layer = False
+                h2, aux = transformer_layer(h, layer_params, pos, cfg,
+                                            layer_is_moe=is_moe_layer)
+                if pld_theta is not None:
+                    # progressive layer drop (ref progressive_layer_drop.py
+                    # + stochastic depth): deeper layers drop more; batch
+                    # content seeds the per-step coin so the step stays a
+                    # single compile.
+                    key = jax.random.fold_in(
+                        jax.random.PRNGKey(17),
+                        (jnp.sum(input_ids) % 100003).astype(jnp.int32)
+                        * 1000 + layer_idx)
+                    depth_frac = (layer_idx + 1) / cfg.num_layers
+                    p_keep = 1.0 - (1.0 - pld_theta) * depth_frac
+                    coin = jax.random.bernoulli(key, p_keep)
+                    h2 = jnp.where(coin, h2, h)
+                return (h2, aux_acc + aux), None
 
-        body = _maybe_remat(body, cfg)
-        layer_indices = jnp.arange(cfg.num_layers)
-        (x, moe_aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
-                                   (params["layers"], layer_indices))
+            body = _maybe_remat(body, cfg)
+            idxs = jnp.arange(idx0, idx0 + n_layers)
+            (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                                   (layers_slice, idxs))
+            return x, aux
+
+        def layer_slice(a, b_):
+            return jax.tree.map(lambda p: p[a:b_], params["layers"])
+
+        ltd_on = 0 < cfg.ltd_kept < s
+        if ltd_on:
+            # random-LTD: middle band runs on a random token subset
+            # (ref RandomLayerTokenDrop; gather/scatter = csrc/random_ltd)
+            from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+                random_ltd_drop, random_ltd_indices, random_ltd_restore)
+
+            a = max(0, min(cfg.ltd_start, cfg.num_layers))
+            z = cfg.ltd_end if cfg.ltd_end is not None else cfg.num_layers - 1
+            z = max(a, min(z, cfg.num_layers))
+            x, aux0 = scan_segment(x, positions, layer_slice(0, a), 0, a)
+            key = jax.random.fold_in(jax.random.PRNGKey(23),
+                                     jnp.sum(input_ids[:, :1]).astype(jnp.int32))
+            idx = random_ltd_indices(key, s, cfg.ltd_kept, b)
+            x_kept = random_ltd_drop(x, idx)
+            pos_kept = jnp.take_along_axis(positions, idx, axis=1)
+            x_kept, aux1 = scan_segment(x_kept, pos_kept, layer_slice(a, z),
+                                        a, z - a)
+            x = random_ltd_restore(x, x_kept, idx)
+            x, aux2 = scan_segment(x, positions, layer_slice(z, cfg.num_layers),
+                                   z, cfg.num_layers - z)
+            moe_aux = aux0 + aux1 + aux2
+        else:
+            x, moe_aux = scan_segment(x, positions, params["layers"], 0,
+                                      cfg.num_layers)
 
     x = _norm(x, params["final_norm"], cfg)
     if cfg.tie_embeddings:
@@ -449,8 +503,11 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
 
 def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfig):
     """Causal LM cross-entropy. ``batch``: input_ids [B,S], labels [B,S]
-    (-100 = ignore, HF convention), optional loss_mask."""
-    out = forward(params, batch["input_ids"], cfg)
+    (-100 = ignore, HF convention), optional loss_mask, optional pld_theta
+    (progressive layer drop keep prob, passed through the batch so the
+    schedule never forces a recompile)."""
+    out = forward(params, batch["input_ids"], cfg,
+                  pld_theta=batch.get("pld_theta"))
     moe_aux = jnp.zeros((), jnp.float32)
     if isinstance(out, tuple):
         logits, moe_aux = out

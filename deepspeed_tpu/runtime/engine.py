@@ -301,6 +301,37 @@ class DeepSpeedEngine:
             self.curriculum_scheduler = CurriculumScheduler(cl_cfg)
             self._curriculum_type = cl_cfg.get("curriculum_type", "seqlen")
 
+        # -- random-LTD: kept-seqlen schedule → model re-jit per value ----
+        self.random_ltd_scheduler = None
+        rl_cfg = cfg.data_efficiency.random_ltd_config \
+            if cfg.data_efficiency.enabled else None
+        if rl_cfg and self.model_config is not None:
+            from deepspeed_tpu.runtime.data_pipeline import RandomLTDScheduler
+
+            sched = rl_cfg.get("random_ltd_schedule", rl_cfg)
+            sc = sched.get("schedule_config", {})
+            self.random_ltd_scheduler = RandomLTDScheduler(
+                min_value=int(sched.get("min_value", 128)),
+                max_value=int(sched.get("max_value",
+                                        self.model_config.max_seq_len)),
+                total_steps=int(sc.get("require_steps",
+                                       sched.get("total_steps", 1000))),
+                step_size=int(sc.get("seq_per_step",
+                                     sched.get("step_size", 16))))
+            self._ltd_band = (int(rl_cfg.get("ltd_start", 1)),
+                              rl_cfg.get("ltd_end"))
+
+        # -- progressive layer drop (theta rides the batch; no recompile) --
+        self.progressive_layer_drop = None
+        pld_dict = (cfg.to_dict().get("progressive_layer_drop", {})
+                    if hasattr(cfg, "to_dict") else {})
+        if pld_dict.get("enabled"):
+            from deepspeed_tpu.runtime.model_features import ProgressiveLayerDrop
+
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=float(pld_dict.get("theta", 0.5)),
+                gamma=float(pld_dict.get("gamma", 0.001)))
+
         # -- flops profiler (XLA cost analysis at profile_step) ----------
         self._flops_profiler = None
         self._last_flops_profile = None
@@ -574,6 +605,39 @@ class DeepSpeedEngine:
             return type(data)(trunc(b) if isinstance(b, dict) else b for b in data)
         return data
 
+    def _maybe_update_random_ltd(self) -> None:
+        """Raise the model's kept-token count per the LTD schedule; a value
+        change swaps the model config and re-jits the step (the bounded
+        recompile the reference pays as a reshape)."""
+        if self.random_ltd_scheduler is None:
+            return
+        kept = self.random_ltd_scheduler.update(self.global_steps)
+        # reaching the schedule's max means full-sequence training resumes
+        effective = 0 if kept >= self.random_ltd_scheduler.max_value else kept
+        if effective == self.model_config.ltd_kept:
+            return
+        from functools import partial as _partial
+
+        from deepspeed_tpu.models import transformer as tf_model
+
+        start, end = self._ltd_band
+        self.model_config = self.model_config.replace(
+            ltd_kept=effective, ltd_start=start, ltd_end=end)
+        self._loss_fn = _partial(tf_model.loss_fn, cfg=self.model_config)
+        self._compile_steps()
+        log_dist(f"random-ltd: kept seqlen → "
+                 f"{effective if effective else 'full'}")
+
+    def _maybe_add_pld(self, batch_stack):
+        """Attach the PLD keep-prob to the stacked batch (traced scalar —
+        the theta schedule never forces a recompile)."""
+        if self.progressive_layer_drop is None:
+            return batch_stack
+        theta = self.progressive_layer_drop.update_state(self.global_steps)
+        gas = next(iter(batch_stack.values())).shape[0]
+        batch_stack["pld_theta"] = np.full((gas,), theta, np.float32)
+        return batch_stack
+
     # ------------------------------------------------------------------
     # Public API (DeepSpeed parity)
     # ------------------------------------------------------------------
@@ -583,9 +647,11 @@ class DeepSpeedEngine:
         if self._onebit is not None:
             return self._train_batch_onebit(data)
         data = self._apply_curriculum(data)
+        self._maybe_update_random_ltd()
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         batch_stack = self._stack_micro_batches(data)
+        batch_stack = self._maybe_add_pld(batch_stack)
         batch_stack = self._put_batch(batch_stack, stacked=True)
         lr = jnp.float32(self.lr_scheduler(self.global_steps))
         opt_state = self._swap_in_opt_state()

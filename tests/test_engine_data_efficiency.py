@@ -1,0 +1,100 @@
+"""Engine integration: random-LTD schedule and progressive layer drop
+driven from the JSON config (ref tests/unit/runtime data-efficiency +
+PLD coverage)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import get_model_config, init_params
+from deepspeed_tpu.models import transformer as tf
+
+
+def _reset_topo():
+    from deepspeed_tpu.parallel import topology
+
+    topology._GLOBAL_TOPOLOGY = None
+
+
+def _batch(model, n=4, s=33, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, model.vocab_size, size=(n, s), dtype=np.int32)
+    return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+
+def test_forward_ltd_band_matches_shape_and_differs():
+    cfg = get_model_config("gpt2-tiny").replace(
+        dtype=jnp.float32, num_layers=4, ltd_kept=8, ltd_start=1, ltd_end=3)
+    import jax
+
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(2, 16)), jnp.int32)
+    out = tf.forward(params, ids, cfg)
+    assert out.shape == (2, 16, cfg.vocab_size)
+    full = tf.forward(params, ids, cfg.replace(ltd_kept=0))
+    # dropping tokens in the band must change the result
+    assert np.abs(np.asarray(out) - np.asarray(full)).max() > 1e-5
+
+
+def test_engine_random_ltd_schedule_rejits():
+    model = get_model_config("gpt2-tiny").replace(num_layers=4)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "mesh": {"data": 1},
+        "data_efficiency": {
+            "enabled": True,
+            "data_routing": {"random_ltd": {
+                "enabled": True, "ltd_start": 1, "ltd_end": 3,
+                "random_ltd_schedule": {
+                    "min_value": 16, "max_value": 32,
+                    "schedule_config": {"require_steps": 2,
+                                        "seq_per_step": 16}}}}},
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=cfg)
+    batch = _batch(model)
+    losses = []
+    for _ in range(4):
+        losses.append(float(np.asarray(engine.train_batch(batch))))
+    assert all(np.isfinite(losses))
+    # step 0-1: kept=16 < seq 32 → LTD active; by step 2 kept=32 ≥ seq → off
+    assert engine.model_config.ltd_kept == 0
+    _reset_topo()
+
+
+def test_engine_pld_theta_rides_batch():
+    model = get_model_config("gpt2-tiny").replace(num_layers=4)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "mesh": {"data": 1},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                   "gamma": 0.01},
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=cfg)
+    assert engine.progressive_layer_drop is not None
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, model.vocab_size, size=(8, 33), dtype=np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+    l0 = float(np.asarray(engine.train_batch(batch)))
+    for _ in range(3):
+        l1 = float(np.asarray(engine.train_batch(batch)))
+    assert np.isfinite(l0) and np.isfinite(l1)
+    # theta decayed from 1.0 toward 0.5
+    assert engine.progressive_layer_drop.current_theta < 1.0
+    _reset_topo()
+
+
+def test_pld_theta_one_is_identity():
+    import jax
+
+    cfg = get_model_config("gpt2-tiny").replace(dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(2, 8)), jnp.int32)
+    base = tf.forward(params, ids, cfg)
+    pld1 = tf.forward(params, ids, cfg, pld_theta=jnp.float32(1.0))
+    np.testing.assert_allclose(np.asarray(base), np.asarray(pld1), atol=1e-6)
