@@ -1,0 +1,79 @@
+"""MoQ scheduled quantization (ref runtime/quantize.py + eigenvalue gate)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.quantize import MoQQuantizer, MoQScheduler
+
+
+def test_scheduler_halves_bits_with_doubling_period():
+    s = MoQScheduler(start_bits=16, target_bits=4, quantize_period=10)
+    assert s.update(0) == 16
+    assert s.update(9) == 16
+    assert s.update(10) == 8   # first transition
+    assert s.update(29) == 8   # period doubled → next at 10+20=30
+    assert s.update(30) == 4
+    assert s.update(1000) == 4  # clamped at target
+
+
+def test_scheduler_deferred_transition():
+    s = MoQScheduler(start_bits=16, target_bits=8, quantize_period=10)
+    assert s.update(10, allow_transition=False) == 16  # gated
+    assert s.update(15) == 16  # re-check scheduled at 20
+    assert s.update(20) == 8
+
+
+def test_moq_quantizer_applies_bits():
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.standard_normal((32, 64)), jnp.float32),
+              "b": jnp.asarray(rng.standard_normal((64,)), jnp.float32)}
+    q = MoQQuantizer({"quantize_training": {
+        "enabled": True,
+        "quantize_bits": {"start_bits": 16, "target_bits": 4},
+        "schedule": {"quantize_period": 5},
+        "quantize_groups": 32}})
+    p0 = q.quantize(params, step=0)
+    np.testing.assert_allclose(np.asarray(p0["w"]), np.asarray(params["w"]))
+    p8 = q.quantize(params, step=5)   # 8-bit now
+    err8 = float(jnp.abs(p8["w"] - params["w"]).max())
+    assert 0 < err8 < 0.05
+    p4 = q.quantize(params, step=15)  # 4-bit
+    err4 = float(jnp.abs(p4["w"] - params["w"]).max())
+    assert err4 > err8  # coarser quantization
+    # vectors untouched
+    np.testing.assert_allclose(np.asarray(p4["b"]), np.asarray(params["b"]))
+
+
+def test_moq_eigenvalue_gate_defers():
+    # sharply curved loss → eigenvalue above threshold → bits stay high
+    A = jnp.diag(jnp.asarray([50.0, 1.0], jnp.float32))
+
+    def loss(p):
+        return 0.5 * p["x"] @ A @ p["x"]
+
+    params = {"x": jnp.ones((2,), jnp.float32)}
+    q = MoQQuantizer({"quantize_training": {
+        "enabled": True,
+        "quantize_bits": {"start_bits": 16, "target_bits": 8},
+        "schedule": {"quantize_period": 2},
+        "eigenvalue": {"enabled": True, "threshold": 10.0, "max_iter": 30}}})
+    bits = q.current_bits(2, loss_fn=loss, params=params,
+                          key=jax.random.PRNGKey(0))
+    assert bits == 16  # deferred: eigenvalue ~50 > 10
+    assert q._last_eig == pytest.approx(50.0, rel=0.05)
+    # flat loss → transition allowed at the re-check step
+    flat = lambda p: 0.01 * (p["x"] ** 2).sum()  # noqa: E731
+    bits = q.current_bits(4, loss_fn=flat, params=params,
+                          key=jax.random.PRNGKey(0))
+    assert bits == 8
+
+
+def test_moq_state_roundtrip():
+    s = MoQScheduler(16, 4, 10)
+    s.update(10)
+    sd = s.state_dict()
+    s2 = MoQScheduler(16, 4, 10)
+    s2.load_state_dict(sd)
+    assert s2.update(30) == s.update(30) == 4
