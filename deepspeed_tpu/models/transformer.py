@@ -377,6 +377,11 @@ _REMAT_POLICIES = {
     "nothing_saveable": "nothing_saveable",
     "dots_saveable": "dots_saveable",
     "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+    # CPU activation checkpointing (ref checkpointing.py:474): matmul
+    # outputs are saved to pinned host memory instead of rematerialised —
+    # trades PCIe/DMA bandwidth for recompute, like the reference's
+    # cpu_checkpointing flag.
+    "offload_dots": "offload_dot_with_no_batch_dims",
 }
 
 
@@ -385,7 +390,11 @@ def _maybe_remat(fn, cfg: TransformerConfig):
         return fn
     policy = None
     name = _REMAT_POLICIES.get(cfg.remat_policy)
-    if name:
+    if name == "offload_dot_with_no_batch_dims":
+        # factory: activations saved to pinned host instead of recomputed
+        policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
+    elif name:
         policy = getattr(jax.checkpoint_policies, name)
     return jax.checkpoint(fn, policy=policy, prevent_cse=False)
 

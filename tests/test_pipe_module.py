@@ -1,0 +1,102 @@
+"""PipelineModule/LayerSpec partitioning API (ref runtime/pipe/module.py +
+partition helpers in runtime/utils.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel.pipe_module import (LayerSpec, PipelineModule,
+                                                TiedLayerSpec,
+                                                partition_balanced,
+                                                partition_uniform)
+
+
+def test_partition_uniform():
+    assert partition_uniform(8, 4) == [0, 2, 4, 6, 8]
+    assert partition_uniform(7, 3) == [0, 3, 5, 7]  # remainder up front
+
+
+def test_partition_balanced_bottleneck():
+    # one huge layer should sit alone in its stage
+    weights = [1, 1, 100, 1, 1, 1]
+    parts = partition_balanced(weights, 3)
+    assert parts[0] == 0 and parts[-1] == 6
+    stage_sums = [sum(weights[parts[i]:parts[i + 1]]) for i in range(3)]
+    assert max(stage_sums) == 100  # optimal bottleneck
+    # monotone boundaries
+    assert all(a <= b for a, b in zip(parts, parts[1:]))
+
+
+def test_partition_balanced_uniform_case():
+    parts = partition_balanced([1] * 8, 4)
+    assert parts == [0, 2, 4, 6, 8]
+
+
+def _linear_init(key, n_in, n_out):
+    return {"w": jax.random.normal(key, (n_in, n_out)) * 0.1}
+
+
+def _linear_apply(p, x):
+    return jnp.tanh(x @ p["w"])
+
+
+def test_pipeline_module_parameters_partition():
+    specs = [LayerSpec(_linear_apply, _linear_init, 8, 8) for _ in range(4)]
+    specs += [LayerSpec(_linear_apply, _linear_init, 8, 64)]  # heavy
+    pm = PipelineModule(specs, num_stages=2, partition_method="parameters")
+    assert pm.parts[0] == 0 and pm.parts[-1] == 5
+    # the heavy layer's stage should not also hold all light layers
+    heavy_stage = pm.stage_of(4)
+    assert len(pm.stage_layers(heavy_stage)) < 5
+    x = jnp.ones((2, 8))
+    out = pm(pm.params, x)
+    assert out.shape == (2, 8) or out.shape == (2, 64)
+    # forward_stage composition == full forward
+    y = x
+    for s in range(pm.num_stages):
+        y = pm.forward_stage(pm.params, y, s)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(out), atol=1e-6)
+
+
+def test_pipeline_module_type_partition_and_errors():
+    def embed_apply(p, x):
+        return x
+
+    specs = [LayerSpec(embed_apply, _linear_init, 4, 4),
+             LayerSpec(_linear_apply, _linear_init, 4, 4),
+             LayerSpec(_linear_apply, _linear_init, 4, 4)]
+    pm = PipelineModule(specs, num_stages=2,
+                        partition_method="type:linear_apply")
+    assert pm.parts[-1] == 3
+    with pytest.raises(ValueError):
+        PipelineModule(specs, num_stages=2, partition_method="type:nomatch")
+    with pytest.raises(ValueError):
+        PipelineModule(specs, num_stages=2, partition_method="bogus")
+
+
+def test_tied_layer_spec_shares_params():
+    specs = [TiedLayerSpec("embed", _linear_apply, _linear_init, 4, 4),
+             LayerSpec(_linear_apply, _linear_init, 4, 4),
+             TiedLayerSpec("embed", _linear_apply, _linear_init, 4, 4)]
+    pm = PipelineModule(specs, num_stages=1, partition_method="uniform")
+    assert "embed" in pm.params and len(pm.tied_comms["embed"]) == 2
+    # exactly one param entry for the tied pair + one untied layer
+    assert len(pm.params) == 2
+
+
+def test_offload_dots_remat_policy():
+    from deepspeed_tpu.models import get_model_config, init_params
+    from deepspeed_tpu.models import transformer as tf
+
+    cfg = get_model_config("gpt2-tiny").replace(dtype=jnp.float32,
+                                                remat_policy="offload_dots")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(2, 8)), jnp.int32)
+    # forward+grad under the offload policy compiles and is finite
+    g = jax.grad(lambda p: tf.loss_fn(
+        p, {"input_ids": ids, "labels": ids}, cfg))(params)
+    gn = float(jnp.sqrt(sum((x.astype(jnp.float32) ** 2).sum()
+                            for x in jax.tree.leaves(g))))
+    assert np.isfinite(gn) and gn > 0
