@@ -76,6 +76,11 @@ class TransformerConfig:
     ltd_kept: int = 0
     ltd_start: int = 1
     ltd_end: Optional[int] = None
+    # sequence-tiled logits+loss (ALST, sequence/alst.py): never
+    # materialises [B, S, V]; 0 = full logits
+    loss_tiles: int = 0
+    # layer-scan unroll factor (XLA overlaps across unrolled iterations)
+    scan_unroll: int = 1
     # numerics
     dtype: Any = jnp.bfloat16  # compute dtype
     param_dtype: Any = jnp.float32  # master dtype
@@ -295,7 +300,9 @@ def _attn_block(x, p, positions, cfg: TransformerConfig):
 
     q, k, v = ulysses_qkv_constraint(q, k, v)
 
-    if cfg.attn_impl == "pallas_flash" and not cfg.sliding_window:
+    if cfg.attn_impl in ("pallas_flash", "auto") and not cfg.sliding_window:
+        # flash_attention dispatches: Pallas kernel on TPU (tiled online
+        # softmax, no [S,S] materialisation), equivalent XLA math elsewhere.
         from deepspeed_tpu.ops.flash_attention import flash_attention
 
         out = flash_attention(q, k, v, causal=True)
@@ -400,9 +407,12 @@ def _maybe_remat(fn, cfg: TransformerConfig):
 
 
 def forward(params: Params, input_ids, cfg: TransformerConfig,
-            positions=None, pld_theta=None) -> jnp.ndarray:
+            positions=None, pld_theta=None,
+            return_hidden: bool = False) -> jnp.ndarray:
     """Token ids [B, S] → logits [B, S, V]. lax.scan over stacked layers.
-    ``pld_theta``: progressive-layer-drop keep prob (traced scalar or None)."""
+    ``pld_theta``: progressive-layer-drop keep prob (traced scalar or None).
+    ``return_hidden``: final-norm hidden states instead of logits (tiled
+    loss path)."""
     b, s = input_ids.shape
     dt = cfg.dtype
     if positions is None:
@@ -466,8 +476,10 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
 
             body = _maybe_remat(body, cfg)
             idxs = jnp.arange(idx0, idx0 + n_layers)
+            unroll = cfg.scan_unroll if n_layers % max(1, cfg.scan_unroll) == 0 \
+                else 1
             (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
-                                   (layers_slice, idxs))
+                                   (layers_slice, idxs), unroll=unroll)
             return x, aux
 
         def layer_slice(a, b_):
@@ -500,6 +512,8 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
                                       cfg.num_layers)
 
     x = _norm(x, params["final_norm"], cfg)
+    if return_hidden:
+        return (x, moe_aux) if cfg.is_moe else x
     if cfg.tie_embeddings:
         logits = x @ params["embed"]["tokens"].astype(dt).T
     else:
@@ -510,11 +524,41 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
     return logits
 
 
+def _tiled_loss(params: Params, batch, cfg: TransformerConfig):
+    """Sequence-tiled cross-entropy (ALST tiled logits, sequence/alst.py):
+    the [B, S, V] logits tensor is never materialised — one tile's logits →
+    logsumexp → gold pick at a time, halving peak HBM on wide vocabs."""
+    from deepspeed_tpu.sequence.alst import tiled_logits_loss
+
+    out = forward(params, batch["input_ids"], cfg,
+                  pld_theta=batch.get("pld_theta"), return_hidden=True)
+    moe_aux = jnp.zeros((), jnp.float32)
+    if isinstance(out, tuple):
+        hidden, moe_aux = out
+    else:
+        hidden = out
+    labels = batch["labels"]
+    mask = (labels != -100)
+    if "loss_mask" in batch:
+        mask = mask & (batch["loss_mask"] > 0)
+    labels = jnp.where(mask, labels, -100)
+    w = params["embed"]["tokens"] if cfg.tie_embeddings \
+        else params["lm_head"].T
+    loss, _ = tiled_logits_loss(hidden, w.astype(cfg.dtype), labels,
+                                cfg.loss_tiles)
+    if cfg.is_moe:
+        loss = loss + 0.01 * moe_aux
+    return loss
+
+
 def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfig):
     """Causal LM cross-entropy. ``batch``: input_ids [B,S], labels [B,S]
     (-100 = ignore, HF convention), optional loss_mask, optional pld_theta
     (progressive layer drop keep prob, passed through the batch so the
     schedule never forces a recompile)."""
+    s = batch["input_ids"].shape[1]
+    if cfg.loss_tiles and s % cfg.loss_tiles == 0:
+        return _tiled_loss(params, batch, cfg)
     out = forward(params, batch["input_ids"], cfg,
                   pld_theta=batch.get("pld_theta"))
     moe_aux = jnp.zeros((), jnp.float32)

@@ -68,8 +68,10 @@ def tiled_logits_loss(hidden, w_embed, labels, num_tiles: int,
     lt = jnp.moveaxis(labels.reshape(b, num_tiles, tile), 1, 0)
 
     def tile_loss(h_i, y_i):
-        logits = jnp.einsum("bte,ve->btv", h_i.astype(jnp.float32),
-                            w_embed.astype(jnp.float32))
+        # matmul in the input dtype (bf16 on TPU → MXU) with fp32
+        # accumulation; fp32 inputs are unchanged
+        logits = jnp.einsum("bte,ve->btv", h_i, w_embed,
+                            preferred_element_type=jnp.float32)
         if logit_cap is not None:
             logits = logit_cap * jnp.tanh(logits / logit_cap)
         lse = jax.nn.logsumexp(logits, axis=-1)
