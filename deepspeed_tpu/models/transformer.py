@@ -476,8 +476,9 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
 
             body = _maybe_remat(body, cfg)
             idxs = jnp.arange(idx0, idx0 + n_layers)
-            unroll = cfg.scan_unroll if n_layers % max(1, cfg.scan_unroll) == 0 \
-                else 1
+            unroll = max(1, cfg.scan_unroll)
+            if n_layers % unroll != 0:
+                unroll = 1
             (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
                                    (layers_slice, idxs), unroll=unroll)
             return x, aux
@@ -524,59 +525,48 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
     return logits
 
 
-def _tiled_loss(params: Params, batch, cfg: TransformerConfig):
-    """Sequence-tiled cross-entropy (ALST tiled logits, sequence/alst.py):
-    the [B, S, V] logits tensor is never materialised — one tile's logits →
-    logsumexp → gold pick at a time, halving peak HBM on wide vocabs."""
-    from deepspeed_tpu.sequence.alst import tiled_logits_loss
-
-    out = forward(params, batch["input_ids"], cfg,
-                  pld_theta=batch.get("pld_theta"), return_hidden=True)
-    moe_aux = jnp.zeros((), jnp.float32)
-    if isinstance(out, tuple):
-        hidden, moe_aux = out
-    else:
-        hidden = out
-    labels = batch["labels"]
-    mask = (labels != -100)
-    if "loss_mask" in batch:
-        mask = mask & (batch["loss_mask"] > 0)
-    labels = jnp.where(mask, labels, -100)
-    w = params["embed"]["tokens"] if cfg.tie_embeddings \
-        else params["lm_head"].T
-    loss, _ = tiled_logits_loss(hidden, w.astype(cfg.dtype), labels,
-                                cfg.loss_tiles)
-    if cfg.is_moe:
-        loss = loss + 0.01 * moe_aux
-    return loss
+MOE_AUX_COEF = 0.01
 
 
 def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfig):
     """Causal LM cross-entropy. ``batch``: input_ids [B,S], labels [B,S]
     (-100 = ignore, HF convention), optional loss_mask, optional pld_theta
     (progressive layer drop keep prob, passed through the batch so the
-    schedule never forces a recompile)."""
-    s = batch["input_ids"].shape[1]
-    if cfg.loss_tiles and s % cfg.loss_tiles == 0:
-        return _tiled_loss(params, batch, cfg)
-    out = forward(params, batch["input_ids"], cfg,
-                  pld_theta=batch.get("pld_theta"))
-    moe_aux = jnp.zeros((), jnp.float32)
-    if isinstance(out, tuple):
-        logits, moe_aux = out
-    else:
-        logits = out
+    schedule never forces a recompile).
+
+    With ``cfg.loss_tiles`` set (and dividing S), the loss is computed in
+    sequence tiles (ALST, sequence/alst.py) so [B, S, V] logits are never
+    materialised.
+    """
     labels = batch["labels"]
     mask = (labels != -100)
     if "loss_mask" in batch:
         mask = mask & (batch["loss_mask"] > 0)
-    safe_labels = jnp.where(mask, labels, 0)
-    logits32 = logits.astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits32, axis=-1)
-    gold = jnp.take_along_axis(logits32, safe_labels[..., None], axis=-1)[..., 0]
-    nll = (logz - gold) * mask
-    denom = jnp.maximum(mask.sum(), 1)
-    loss = nll.sum() / denom
+
+    s = batch["input_ids"].shape[1]
+    tiled = cfg.loss_tiles and s % cfg.loss_tiles == 0
+    out = forward(params, batch["input_ids"], cfg,
+                  pld_theta=batch.get("pld_theta"), return_hidden=bool(tiled))
+    moe_aux = jnp.zeros((), jnp.float32)
+    if isinstance(out, tuple):
+        out, moe_aux = out
+
+    if tiled:
+        from deepspeed_tpu.sequence.alst import tiled_logits_loss
+
+        w = params["embed"]["tokens"] if cfg.tie_embeddings \
+            else params["lm_head"].T
+        loss, _ = tiled_logits_loss(out, w.astype(cfg.dtype),
+                                    jnp.where(mask, labels, -100),
+                                    cfg.loss_tiles)
+    else:
+        safe_labels = jnp.where(mask, labels, 0)
+        logits32 = out.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits32, axis=-1)
+        gold = jnp.take_along_axis(logits32, safe_labels[..., None],
+                                   axis=-1)[..., 0]
+        nll = (logz - gold) * mask
+        loss = nll.sum() / jnp.maximum(mask.sum(), 1)
     if cfg.is_moe:
-        loss = loss + 0.01 * moe_aux
+        loss = loss + MOE_AUX_COEF * moe_aux
     return loss

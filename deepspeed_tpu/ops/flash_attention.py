@@ -30,6 +30,15 @@ def _xla_attention(q, k, v, causal: bool, sm_scale: float):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _block_for(s: int, max_block: int = 512) -> int | None:
+    """Largest block ≤ max_block that divides ``s`` and is a multiple of
+    the 128-lane register width; None if the kernel can't tile ``s``."""
+    for blk in range(min(max_block, s), 127, -128):
+        if blk % 128 == 0 and s % blk == 0:
+            return blk
+    return None
+
+
 def _on_tpu() -> bool:
     try:
         return jax.devices()[0].platform in ("tpu", "axon")
@@ -53,7 +62,10 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None,
         v = jnp.repeat(v, nh // nkv, axis=2)
 
     use_pallas = impl == "pallas" or (impl == "auto" and _on_tpu())
-    if not use_pallas:
+    # the TPU kernel needs the block size to divide the sequence; pick the
+    # largest lane-aligned divisor ≤ 512, else fall back to the XLA path
+    blk = _block_for(q.shape[1]) if use_pallas else None
+    if not use_pallas or blk is None:
         return _xla_attention(q, k, v, causal, sm_scale)
 
     from jax.experimental.pallas.ops.tpu.flash_attention import (
@@ -62,8 +74,6 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None,
     qt = q.swapaxes(1, 2)  # [B, H, S, D]
     kt = k.swapaxes(1, 2)
     vt = v.swapaxes(1, 2)
-    s = qt.shape[2]
-    blk = min(512, s)
     sizes = BlockSizes(
         block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
         block_q_major_dkv=blk, block_k_major_dkv=blk, block_k_dkv=blk,
