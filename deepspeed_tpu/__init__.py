@@ -51,6 +51,12 @@ def initialize(args=None,
     if args is not None and config is None:
         config = getattr(args, "deepspeed_config", None)
 
+    if mpu is not None and get_topology() is None:
+        # Megatron-style caller: derive the mesh from the mpu's sizes
+        # (ref engine._configure_distributed_model mpu path)
+        from deepspeed_tpu.utils.mpu_adapter import topology_from_mpu
+
+        set_topology(topology_from_mpu(mpu))
     init_distributed()
     engine = DeepSpeedEngine(model=model,
                              config=config,
@@ -120,3 +126,8 @@ def tp_model_init(model=None, tp_size: int = 1, dtype=None, config=None,
 # subpackage conveniences
 from deepspeed_tpu.models import registry as models  # noqa: E402
 from deepspeed_tpu.models.registry import get_model_config  # noqa: E402
+from deepspeed_tpu import zero  # noqa: E402
+from deepspeed_tpu import checkpointing  # noqa: E402
+from deepspeed_tpu.utils.init_on_device import OnDevice  # noqa: E402
+from deepspeed_tpu.utils.mpu_adapter import MpuAdapter  # noqa: E402
+from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine  # noqa: E402

@@ -1,0 +1,110 @@
+"""Top-level API parity: zero.Init/GatheredParameters, checkpointing,
+OnDevice, mpu adapter (ref deepspeed.zero / deepspeed.checkpointing /
+utils/init_on_device / Megatron mpu consumption)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import get_model_config, init_params
+
+
+def _reset_topo():
+    from deepspeed_tpu.parallel import topology
+
+    topology._GLOBAL_TOPOLOGY = None
+
+
+def test_zero_init_materializes_sharded():
+    from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+
+    set_topology(MeshTopology({"data": 8}))
+    cfg = get_model_config("gpt2-tiny").replace(dtype=jnp.float32)
+    try:
+        with ds.zero.Init(zero_stage=3) as zinit:
+            params = zinit.materialize(
+                lambda k: init_params(cfg, k), jax.random.PRNGKey(0))
+        leaves = jax.tree.leaves(params)
+        assert leaves
+        # at least the big matrices must be sharded (not fully replicated)
+        sharded = [l for l in leaves
+                   if not l.sharding.is_fully_replicated and l.ndim >= 2]
+        assert sharded, "zero.Init produced only replicated params"
+    finally:
+        _reset_topo()
+
+
+def test_zero_init_needs_context():
+    z = ds.zero.Init()
+    with pytest.raises(RuntimeError):
+        z.materialize(lambda k: {"w": jnp.ones(4)}, jax.random.PRNGKey(0))
+
+
+def test_gathered_parameters_roundtrip():
+    params = {"w": jnp.ones((4, 4), jnp.float32)}
+    ctx = ds.zero.GatheredParameters(params)
+    with ctx as host:
+        host["w"][0, 0] = 5.0
+    assert float(ctx.updated["w"][0, 0]) == 5.0
+    assert float(params["w"][0, 0]) == 1.0  # original untouched (functional)
+    out = ds.zero.gathered_update(
+        params, lambda t: {"w": t["w"] * 2})
+    np.testing.assert_allclose(np.asarray(out["w"]), 2.0)
+
+
+def test_zero_memory_estimators():
+    g3, h3 = ds.zero.estimate_zero3_model_states_mem_needs(
+        10**9, num_gpus_per_node=8, cpu_offload=False)
+    g2, h2 = ds.zero.estimate_zero2_model_states_mem_needs(
+        10**9, num_gpus_per_node=8, cpu_offload=False)
+    assert g3 < g2  # stage 3 shards params too
+    assert h3 == 0 or h3 > 0  # smoke
+
+
+def test_checkpointing_api():
+    ds.checkpointing.configure(partition_activations=True,
+                               checkpoint_in_cpu=False)
+    w = jnp.ones((8, 8), jnp.float32)
+    out = ds.checkpointing.checkpoint(lambda x: jnp.tanh(x @ w), w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(jnp.tanh(w @ w)),
+                               atol=1e-6)
+    # grad flows through the remat wrapper
+    g = jax.grad(lambda x: ds.checkpointing.CheckpointFunction.apply(
+        lambda y: (y @ w).sum(), x))(w)
+    assert np.isfinite(np.asarray(g)).all()
+    cfgd = ds.checkpointing.get_config()
+    assert cfgd["partition_activations"] is True
+    ds.checkpointing.reset()
+    assert ds.checkpointing.get_config()["partition_activations"] is False
+
+
+def test_on_device_meta_and_real():
+    cfg = get_model_config("gpt2-tiny")
+    with ds.OnDevice(dtype=jnp.bfloat16, device="meta") as ctx:
+        shapes = ctx.init(lambda k: init_params(cfg, k), jax.random.PRNGKey(0))
+    leaf = jax.tree.leaves(shapes)[0]
+    assert isinstance(leaf, jax.ShapeDtypeStruct)
+    assert leaf.dtype == jnp.bfloat16
+    with ds.OnDevice(dtype=jnp.float32) as ctx:
+        params = ctx.init(lambda k: {"w": jnp.ones((2, 2), jnp.bfloat16)},
+                          jax.random.PRNGKey(0))
+    assert params["w"].dtype == jnp.float32
+
+
+def test_mpu_adapter_and_initialize():
+    from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+
+    set_topology(MeshTopology({"data": 2, "tensor": 2, "pipe": 2}))
+    try:
+        mpu = ds.MpuAdapter()
+        assert mpu.get_tensor_model_parallel_world_size() == 2
+        assert mpu.get_data_parallel_world_size() == 2
+        assert mpu.get_pipeline_model_parallel_world_size() == 2
+        from deepspeed_tpu.utils.mpu_adapter import topology_from_mpu
+
+        topo = topology_from_mpu(mpu)
+        assert topo.tp_size == 2 and topo.pp_size == 2
+    finally:
+        _reset_topo()
