@@ -87,6 +87,29 @@ class WandbMonitor(Monitor):
             self._wandb.log({tag: value}, step=step)
 
 
+class CometMonitor(Monitor):
+    """Comet backend (ref monitor/comet.py); gated on the comet_ml SDK."""
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self._exp = None
+        if self.enabled and jax.process_index() == 0:
+            try:
+                import comet_ml
+
+                self._exp = comet_ml.Experiment(
+                    project_name=getattr(cfg, "project", None))
+            except Exception as e:
+                logger.warning(f"comet unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self._exp is None:
+            return
+        for tag, value, step in event_list:
+            self._exp.log_metric(tag, value, step=step)
+
+
 class MonitorMaster(Monitor):
     """Fans events out to every enabled backend (ref monitor.py:30)."""
 
@@ -94,7 +117,8 @@ class MonitorMaster(Monitor):
         self.monitors: List[Monitor] = []
         for cfg, cls in ((ds_config.tensorboard, TensorBoardMonitor),
                          (ds_config.wandb, WandbMonitor),
-                         (ds_config.csv_monitor, CSVMonitor)):
+                         (ds_config.csv_monitor, CSVMonitor),
+                         (getattr(ds_config, "comet", None), CometMonitor)):
             if getattr(cfg, "enabled", False):
                 self.monitors.append(cls(cfg))
         self.enabled = any(m.enabled for m in self.monitors)
