@@ -87,7 +87,11 @@ class TransformerConfig:
     layernorm_eps: float = 1e-5
     # remat policy name: none|full|nothing_saveable|dots_saveable|dots_with_no_batch_dims_saveable
     remat_policy: str = "nothing_saveable"
-    attn_impl: str = "auto"  # "auto" | "xla" | "pallas_flash"
+    attn_impl: str = "auto"  # "auto" | "xla" | "pallas_flash" | "sparse"
+    # block-sparse attention config (ref ops/sparse_attention sparsity
+    # configs): {"mode": "fixed"|"bigbird"|"bslongformer"|"variable",
+    # "block": 16, ...mode kwargs}; selected when attn_impl == "sparse"
+    sparse_attention: Optional[Any] = None
 
     @property
     def kv_heads(self) -> int:
@@ -276,6 +280,26 @@ def _attention_scores(q, k, v, cfg: TransformerConfig, segment_pos=None):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _sparse_attn(q, k, v, cfg: TransformerConfig):
+    """Block-sparse attention path (ref ops/sparse_attention configs);
+    causal composes with the layout."""
+    from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                    BSLongformerSparsityConfig,
+                                                    DenseSparsityConfig,
+                                                    FixedSparsityConfig,
+                                                    VariableSparsityConfig,
+                                                    sparse_attention)
+
+    sc = dict(cfg.sparse_attention or {})
+    mode = sc.pop("mode", "fixed")
+    cls = {"fixed": FixedSparsityConfig, "bigbird": BigBirdSparsityConfig,
+           "bslongformer": BSLongformerSparsityConfig,
+           "variable": VariableSparsityConfig,
+           "dense": DenseSparsityConfig}[mode]
+    sparsity = cls(num_heads=q.shape[2], **sc)
+    return sparse_attention(q, k, v, sparsity, causal=True)
+
+
 def _attn_block(x, p, positions, cfg: TransformerConfig):
     b, s, h = x.shape
     nh, nkv, d = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
@@ -300,7 +324,9 @@ def _attn_block(x, p, positions, cfg: TransformerConfig):
 
     q, k, v = ulysses_qkv_constraint(q, k, v)
 
-    if cfg.attn_impl in ("pallas_flash", "auto") and not cfg.sliding_window:
+    if cfg.attn_impl == "sparse":
+        out = _sparse_attn(q, k, v, cfg)
+    elif cfg.attn_impl in ("pallas_flash", "auto") and not cfg.sliding_window:
         # flash_attention dispatches: Pallas kernel on TPU (tiled online
         # softmax, no [S,S] materialisation), equivalent XLA math elsewhere.
         from deepspeed_tpu.ops.flash_attention import flash_attention
