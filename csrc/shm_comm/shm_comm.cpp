@@ -1,0 +1,133 @@
+// Shared-memory host collectives for co-located processes.
+//
+// TPU-native analog of the reference's SHM collectives
+// (csrc/cpu/comm/shm.cpp, shm_interface.cpp): when several launcher
+// processes share one host, small host-side reductions (grad-norm
+// agreement, elastic heartbeats, compressed-collective server phases)
+// should ride shared memory, not the network. POSIX shm + a process-shared
+// barrier; each rank publishes into its slot, then every rank reduces all
+// slots locally (the reference's naive all-reduce path; its tiled
+// distributed reduce is an optimization for large payloads that host
+// coordination traffic doesn't need).
+//
+// Plain C ABI for ctypes (no pybind11 in this image).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Header {
+    std::atomic<int> init_done;
+    pthread_barrier_t barrier;
+};
+
+struct Handle {
+    Header* header;
+    char* slots;       // world * slot_bytes payload area
+    int rank;
+    int world;
+    int64_t slot_bytes;
+    char name[128];
+    size_t total_bytes;
+};
+
+inline char* slot(Handle* h, int r) { return h->slots + r * h->slot_bytes; }
+
+}  // namespace
+
+extern "C" {
+
+void* ds_shm_create(const char* name, int rank, int world,
+                    int64_t slot_bytes) {
+    size_t total = sizeof(Header) + (size_t)world * slot_bytes;
+    int fd = shm_open(name, O_CREAT | O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    if (ftruncate(fd, (off_t)total) != 0) { close(fd); return nullptr; }
+    void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);
+    if (mem == MAP_FAILED) return nullptr;
+
+    Handle* h = new Handle();
+    h->header = (Header*)mem;
+    h->slots = (char*)mem + sizeof(Header);
+    h->rank = rank;
+    h->world = world;
+    h->slot_bytes = slot_bytes;
+    h->total_bytes = total;
+    snprintf(h->name, sizeof(h->name), "%s", name);
+
+    if (rank == 0) {
+        pthread_barrierattr_t attr;
+        pthread_barrierattr_init(&attr);
+        pthread_barrierattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+        pthread_barrier_init(&h->header->barrier, &attr, world);
+        pthread_barrierattr_destroy(&attr);
+        h->header->init_done.store(1, std::memory_order_release);
+    } else {
+        while (h->header->init_done.load(std::memory_order_acquire) != 1) {
+            usleep(100);
+        }
+    }
+    return h;
+}
+
+static void barrier(Handle* h) { pthread_barrier_wait(&h->header->barrier); }
+
+void ds_shm_barrier(void* hv) { barrier((Handle*)hv); }
+
+// Sum-allreduce of n floats, in place.
+int ds_shm_allreduce(void* hv, float* data, int64_t n) {
+    Handle* h = (Handle*)hv;
+    if ((int64_t)(n * sizeof(float)) > h->slot_bytes) return -1;
+    memcpy(slot(h, h->rank), data, n * sizeof(float));
+    barrier(h);
+    // every rank reduces all slots into its private buffer
+    for (int r = 0; r < h->world; ++r) {
+        if (r == h->rank) continue;
+        const float* other = (const float*)slot(h, r);
+        for (int64_t i = 0; i < n; ++i) data[i] += other[i];
+    }
+    barrier(h);  // no one overwrites slots until all have read
+    return 0;
+}
+
+int ds_shm_broadcast(void* hv, float* data, int64_t n, int root) {
+    Handle* h = (Handle*)hv;
+    if ((int64_t)(n * sizeof(float)) > h->slot_bytes) return -1;
+    if (h->rank == root) memcpy(slot(h, root), data, n * sizeof(float));
+    barrier(h);
+    if (h->rank != root) memcpy(data, slot(h, root), n * sizeof(float));
+    barrier(h);
+    return 0;
+}
+
+// out must hold world * n floats, laid out rank-major.
+int ds_shm_allgather(void* hv, const float* in, int64_t n, float* out) {
+    Handle* h = (Handle*)hv;
+    if ((int64_t)(n * sizeof(float)) > h->slot_bytes) return -1;
+    memcpy(slot(h, h->rank), in, n * sizeof(float));
+    barrier(h);
+    for (int r = 0; r < h->world; ++r) {
+        memcpy(out + r * n, slot(h, r), n * sizeof(float));
+    }
+    barrier(h);
+    return 0;
+}
+
+void ds_shm_destroy(void* hv, int unlink_region) {
+    Handle* h = (Handle*)hv;
+    if (unlink_region) shm_unlink(h->name);
+    munmap((void*)h->header, h->total_bytes);
+    delete h;
+}
+
+}  // extern "C"
