@@ -123,11 +123,30 @@ class InferenceEngineV2:
             return {}
         rb = build_ragged_batch(schedule, self.state_manager,
                                 self.scheduler.token_budget)
+        # Bucket the step's shapes (power-of-two token count and context
+        # width) so decode-heavy steps don't pay the full prefill budget:
+        # a 16-seq decode step runs [16, ctx] work, not [budget, max_ctx].
+        # A handful of bucket shapes → a handful of cached compilations
+        # (the shape discipline the reference gets from its CUDA kernels'
+        # ragged launch geometry).
+        t_bucket = 16
+        while t_bucket < rb.n_tokens:
+            t_bucket *= 2
+        t_bucket = min(t_bucket, self.scheduler.token_budget)
+        bs = self.cfg.block_size
+        nb_real = max(1, -(-int(rb.ctx_lens.max()) // bs))
+        nb_bucket = 1
+        while nb_bucket < nb_real:
+            nb_bucket *= 2
+        nb_bucket = min(nb_bucket, self.state_manager.max_blocks_per_seq)
         logits, self.cache_k, self.cache_v = self._step(
             self.params, self.cache_k, self.cache_v,
-            jnp.asarray(rb.token_ids), jnp.asarray(rb.token_slot),
-            jnp.asarray(rb.token_pos), jnp.asarray(rb.token_dest),
-            jnp.asarray(rb.block_tables), jnp.asarray(rb.ctx_lens),
+            jnp.asarray(rb.token_ids[:t_bucket]),
+            jnp.asarray(rb.token_slot[:t_bucket]),
+            jnp.asarray(rb.token_pos[:t_bucket]),
+            jnp.asarray(rb.token_dest[:t_bucket]),
+            jnp.asarray(rb.block_tables[:, :nb_bucket]),
+            jnp.asarray(rb.ctx_lens),
             jnp.asarray(rb.logits_idx))
         logits_np = np.asarray(logits)
         return {uid: logits_np[slot] for slot, uid in rb.uids_by_slot.items()}

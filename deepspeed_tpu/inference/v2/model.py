@@ -182,3 +182,49 @@ def ragged_forward(params, cache_k, cache_v, token_ids, token_slot, token_pos,
     else:
         logits = last @ params["lm_head"].astype(dt)
     return logits.astype(jnp.float32), cache_k, cache_v
+
+
+def ragged_decode_loop(params, cache_k, cache_v, tokens0, ctx_lens0,
+                       active, block_tables, key, temperature,
+                       cfg: TransformerConfig, block_size: int,
+                       n_steps: int, greedy: bool
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                                  jnp.ndarray]:
+    """Fused multi-step decode: ``lax.scan`` over ``n_steps`` single-token
+    steps with on-device sampling — ONE dispatch for the whole decode
+    phase, so per-step host/driver latency (the dominant cost on remote
+    TPU relays) is paid once instead of per token.
+
+    tokens0 [S]: each slot's current last token; ctx_lens0 [S]: tokens
+    already in cache; active [S] bool; block_tables [S, NB] preallocated
+    for the full horizon.  Returns (sampled [n_steps, S], ctx_lens',
+    cache_k', cache_v').  Slot s's row in ``sampled`` is garbage where
+    ``active[s]`` is False.
+    """
+    s_rows = block_tables.shape[0]
+    slots = jnp.arange(s_rows, dtype=jnp.int32)
+    act_i = active.astype(jnp.int32)
+
+    def step(carry, step_key):
+        tokens, ctx_lens, ck, cv = carry
+        pos = ctx_lens  # 0-based position of the incoming token
+        dest = block_tables[slots, pos // block_size] * block_size \
+            + pos % block_size
+        dest = jnp.where(active, dest, 0)  # inactive → garbage page 0
+        ctx_after = ctx_lens + act_i
+        logits, ck, cv = ragged_forward(
+            params, ck, cv, tokens, slots, pos, dest, block_tables,
+            ctx_after, slots, cfg=cfg, block_size=block_size)
+        if greedy:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            nxt = jax.random.categorical(
+                step_key, logits / jnp.maximum(temperature, 1e-6),
+                axis=-1).astype(jnp.int32)
+        nxt = jnp.where(active, nxt, 0)
+        return (nxt, ctx_after, ck, cv), nxt
+
+    keys = jax.random.split(key, n_steps)
+    (tokens, ctx_lens, cache_k, cache_v), sampled = lax.scan(
+        step, (tokens0, ctx_lens0, cache_k, cache_v), keys)
+    return sampled, ctx_lens, cache_k, cache_v
