@@ -84,6 +84,10 @@ def config_from_hf(hf_config) -> TransformerConfig:
             nkv = 1
         else:
             nkv = hf_config.num_attention_heads
+        new_arch = bool(getattr(hf_config, "new_decoder_architecture", False))
+        n_ln = getattr(hf_config, "num_ln_in_parallel_attn", None)
+        if n_ln is None and new_arch:
+            n_ln = 2  # HF FalconDecoderLayer default for the new arch
         return TransformerConfig(
             vocab_size=hf_config.vocab_size,
             hidden_size=hf_config.hidden_size,
@@ -94,6 +98,7 @@ def config_from_hf(hf_config) -> TransformerConfig:
             arch="falcon", norm="layernorm", activation="gelu",
             use_rope=getattr(hf_config, "rotary", True),
             parallel_block=bool(getattr(hf_config, "parallel_attn", True)),
+            parallel_norms=(new_arch and n_ln == 2),
             use_bias=bool(getattr(hf_config, "bias", False)),
             tie_embeddings=True,
             layernorm_eps=getattr(hf_config, "layer_norm_epsilon", 1e-5))
@@ -238,29 +243,43 @@ def _convert_opt(sd, cfg):
 
 def _convert_falcon(sd, cfg):
     nh, nkv, d = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
+    ln_attn = "transformer.h.0.ln_attn.weight" in sd
+    if ln_attn:
+        ln2_key = "ln_mlp"
+    elif "transformer.h.0.post_attention_layernorm.weight" in sd:
+        ln2_key = "post_attention_layernorm"  # parallel_attn=False layout
+    else:
+        ln2_key = "input_layernorm"
     layers = []
     for i in range(cfg.num_layers):
         p = f"transformer.h.{i}."
         qkv = sd[p + "self_attention.query_key_value.weight"].T  # [h, (nh+2nkv)d]
-        if nkv == nh:  # fused interleaved per-head [q,k,v] groups
-            qkv = qkv.reshape(qkv.shape[0], nh, 3, d)
-            wq = qkv[:, :, 0].reshape(qkv.shape[0], nh * d)
-            wk = qkv[:, :, 1].reshape(qkv.shape[0], nh * d)
-            wv = qkv[:, :, 2].reshape(qkv.shape[0], nh * d)
-        else:  # MQA layout: nh query heads then nkv k + nkv v
-            wq = qkv[:, :nh * d]
-            wk = qkv[:, nh * d:(nh + nkv) * d]
-            wv = qkv[:, (nh + nkv) * d:]
+        # HF Falcon's fused layout is per-KV-group in every variant:
+        # nkv groups of (nh/nkv query heads, one k, one v).  nkv==nh reduces
+        # to per-head [q,k,v] interleave (Falcon-RW), nkv==1 to [all-q, k, v]
+        # (7B multi-query), and 1<nkv<nh is the new_decoder_architecture
+        # interleave (40B/180B — the reference handles it via
+        # GQAMegatronQKVParameter, module_inject/layers.py).
+        hdim = qkv.shape[0]
+        qkv = qkv.reshape(hdim, nkv, nh // nkv + 2, d)
+        wq = qkv[:, :, :-2, :].reshape(hdim, nh * d)
+        wk = qkv[:, :, -2, :].reshape(hdim, nkv * d)
+        wv = qkv[:, :, -1, :].reshape(hdim, nkv * d)
         layers.append({
             "attn": {"wq": wq, "wk": wk, "wv": wv,
                      "wo": sd[p + "self_attention.dense.weight"].T},
             "mlp": {"wi": sd[p + "mlp.dense_h_to_4h.weight"].T,
                     "wo": sd[p + "mlp.dense_4h_to_h.weight"].T},
-            "ln1": {"scale": sd[p + "input_layernorm.weight"],
-                    "bias": sd[p + "input_layernorm.bias"]},
-            # parallel block: ln2 unused but the tree keeps the slot
-            "ln2": {"scale": sd[p + "input_layernorm.weight"],
-                    "bias": sd[p + "input_layernorm.bias"]},
+            # new_decoder_architecture: separate ln_attn/ln_mlp parallel
+            # norms; legacy sequential (parallel_attn=False): ln2 is the
+            # post-attention norm; legacy parallel: one shared input norm
+            # (ln2 mirrors it so the tree keeps the slot).
+            "ln1": {"scale": sd[p + ("ln_attn.weight" if ln_attn
+                                     else "input_layernorm.weight")],
+                    "bias": sd[p + ("ln_attn.bias" if ln_attn
+                                    else "input_layernorm.bias")]},
+            "ln2": {"scale": sd[p + ln2_key + ".weight"],
+                    "bias": sd[p + ln2_key + ".bias"]},
         })
     return {
         "embed": {"tokens": sd["transformer.word_embeddings.weight"]},

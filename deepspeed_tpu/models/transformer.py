@@ -59,6 +59,10 @@ class TransformerConfig:
     qkv_bias: bool = False  # qkv-only bias (Qwen2)
     sliding_window: Optional[int] = None  # Mistral
     parallel_block: bool = False  # Falcon/Phi: x + attn(n) + mlp(n)
+    # Falcon new_decoder_architecture (40B/180B, num_ln_in_parallel_attn=2):
+    # the parallel block gets separate input norms — attn uses ln1 (HF
+    # ln_attn) and the MLP uses ln2 (HF ln_mlp) on the same residual input.
+    parallel_norms: bool = False
     # MoE (0 ⇒ dense; ref deepspeed/moe)
     num_experts: int = 0
     top_k: int = 2
@@ -377,14 +381,16 @@ def transformer_layer(x, layer_params, positions, cfg: TransformerConfig,
     uniform scan-over-layers body.
     """
     if cfg.parallel_block:
-        # Falcon/Phi residual form: one shared input norm feeds attention
-        # and MLP in parallel (ref falcon/phi v2 containers).
+        # Falcon/Phi residual form: shared (or, with parallel_norms, per-
+        # branch) input norms feed attention and MLP in parallel (ref
+        # falcon/phi v2 containers).
         n = _norm(x, layer_params["ln1"], cfg)
+        n_mlp = _norm(x, layer_params["ln2"], cfg) if cfg.parallel_norms else n
         attn_out = _attn_block(n, layer_params["attn"], positions, cfg)
         if "moe" not in layer_params:
-            return (x + attn_out + _mlp_block(n, layer_params["mlp"], cfg),
+            return (x + attn_out + _mlp_block(n_mlp, layer_params["mlp"], cfg),
                     jnp.zeros((), jnp.float32))
-        y, aux = _moe_block(n, layer_params["moe"], cfg)
+        y, aux = _moe_block(n_mlp, layer_params["moe"], cfg)
         return x + attn_out + y, aux
     x = x + _attn_block(_norm(x, layer_params["ln1"], cfg), layer_params["attn"], positions, cfg)
     h = _norm(x, layer_params["ln2"], cfg)
@@ -409,6 +415,9 @@ _REMAT_POLICIES = {
     "full": None,
     "nothing_saveable": "nothing_saveable",
     "dots_saveable": "dots_saveable",
+    # dots + the repo flash kernel's named residuals (flash_out/flash_lse):
+    # the backward then never re-runs the attention forward kernel.
+    "dots_flash_saveable": "dots_flash_saveable",
     "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
     # CPU activation checkpointing (ref checkpointing.py:474): matmul
     # outputs are saved to pinned host memory instead of rematerialised —
@@ -427,6 +436,11 @@ def _maybe_remat(fn, cfg: TransformerConfig):
         # factory: activations saved to pinned host instead of recomputed
         policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
             "device", "pinned_host")
+    elif name == "dots_flash_saveable":
+        policy = jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_saveable,
+            jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"))
     elif name:
         policy = getattr(jax.checkpoint_policies, name)
     return jax.checkpoint(fn, policy=policy, prevent_cse=False)

@@ -1,13 +1,16 @@
 """Flash attention for TPU.
 
 Replaces the reference's fused attention CUDA kernels
-(``csrc/transformer``/FlashAttention paths) with the Pallas TPU flash
-attention kernel (tiled online-softmax over VMEM blocks, custom VJP).  On
-non-TPU backends (the 8-device CPU test mesh) it falls back to a numerically
-equivalent XLA implementation so the same model code runs everywhere.
+(``csrc/transformer``/FlashAttention paths). The default TPU path is the
+**repo-owned** Pallas kernel (`deepspeed_tpu.ops.pallas.flash_mha`):
+GQA-native (KV never repeated), any sequence length (tail-pad + in-kernel
+mask — no silent O(S²) fallback), saved-residual backward. The upstream
+jax library kernel remains available as ``impl="pallas_lib"``; non-TPU
+backends (the 8-device CPU test mesh) use a numerically equivalent XLA
+implementation so the same model code runs everywhere.
 
 Layout contract: q, k, v are ``[batch, seq, heads, head_dim]`` (the model's
-natural layout); the kernel operates in ``[batch, heads, seq, head_dim]``.
+natural layout); the kernels operate in ``[batch, heads, seq, head_dim]``.
 """
 
 from __future__ import annotations
@@ -18,9 +21,24 @@ import math
 import jax
 import jax.numpy as jnp
 
+from deepspeed_tpu.utils.logging import logger
+
+_warned_fallback = False
+
+
+def _repeat_kv(q, k, v):
+    """Repeat KV heads up to the query head count (GQA -> MHA) for the
+    paths whose kernels are not GQA-native."""
+    nh, nkv = q.shape[2], k.shape[2]
+    if nkv != nh:
+        k = jnp.repeat(k, nh // nkv, axis=2)
+        v = jnp.repeat(v, nh // nkv, axis=2)
+    return k, v
+
 
 def _xla_attention(q, k, v, causal: bool, sm_scale: float):
     b, s_q, h, d = q.shape
+    k, v = _repeat_kv(q, k, v)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
     if causal:
         s_k = k.shape[1]
@@ -32,7 +50,8 @@ def _xla_attention(q, k, v, causal: bool, sm_scale: float):
 
 def _block_for(s: int, max_block: int = 512) -> int | None:
     """Largest block ≤ max_block that divides ``s`` and is a multiple of
-    the 128-lane register width; None if the kernel can't tile ``s``."""
+    the 128-lane register width; None if the library kernel can't tile
+    ``s``."""
     for blk in range(min(max_block, s), 127, -128):
         if blk % 128 == 0 and s % blk == 0:
             return blk
@@ -46,28 +65,9 @@ def _on_tpu() -> bool:
         return False
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "impl"))
-def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None,
-                    impl: str = "auto"):
-    """Multi-head attention over [B, S, H, D] tensors.
-
-    ``impl``: "auto" (pallas on TPU, XLA elsewhere) | "pallas" | "xla".
-    GQA is handled by repeating KV heads before the kernel.
-    """
-    if sm_scale is None:
-        sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    nh, nkv = q.shape[2], k.shape[2]
-    if nkv != nh:
-        k = jnp.repeat(k, nh // nkv, axis=2)
-        v = jnp.repeat(v, nh // nkv, axis=2)
-
-    use_pallas = impl == "pallas" or (impl == "auto" and _on_tpu())
-    # the TPU kernel needs the block size to divide the sequence; pick the
-    # largest lane-aligned divisor ≤ 512, else fall back to the XLA path
-    blk = _block_for(q.shape[1]) if use_pallas else None
-    if not use_pallas or blk is None:
-        return _xla_attention(q, k, v, causal, sm_scale)
-
+def _lib_flash(q, k, v, causal, sm_scale, blk):
+    """Upstream jax.experimental Pallas kernel (KV repeated to MHA)."""
+    k, v = _repeat_kv(q, k, v)
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes, flash_attention as pallas_flash)
 
@@ -80,4 +80,52 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None,
         block_q_dkv=blk, block_k_major_dq=blk, block_k_dq=blk, block_q_dq=blk)
     out = pallas_flash(qt, kt, vt, causal=causal, sm_scale=sm_scale,
                        block_sizes=sizes)
+    return out.swapaxes(1, 2)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "impl"))
+def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None,
+                    impl: str = "auto"):
+    """Multi-head attention over [B, S, H, D] tensors.
+
+    ``impl``: "auto" (repo Pallas kernel on TPU, XLA elsewhere) | "pallas"
+    (repo kernel) | "pallas_lib" (upstream library kernel) | "xla".
+    """
+    global _warned_fallback
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+
+    if impl == "xla" or not (impl in ("auto", "pallas", "pallas_lib")
+                             and _on_tpu()):
+        return _xla_attention(q, k, v, causal, sm_scale)
+
+    if impl == "pallas_lib":
+        blk = _block_for(q.shape[1])
+        if blk is None:
+            if not _warned_fallback:
+                logger.warning(
+                    "flash_attention: seq %d has no 128-aligned divisor; "
+                    "library kernel unavailable, using XLA attention",
+                    q.shape[1])
+                _warned_fallback = True
+            return _xla_attention(q, k, v, causal, sm_scale)
+        return _lib_flash(q, k, v, causal, sm_scale, blk)
+
+    from deepspeed_tpu.ops.pallas import flash_mha
+    from deepspeed_tpu.ops.pallas.flash_mha import supports
+
+    if not supports(q.shape[1], q.shape[-1]):
+        # beyond the VMEM-resident budget; try the library kernel, else XLA
+        blk = _block_for(q.shape[1])
+        if blk is not None:
+            return _lib_flash(q, k, v, causal, sm_scale, blk)
+        if not _warned_fallback:
+            logger.warning(
+                "flash_attention: seq %d (head_dim %d) exceeds kernel "
+                "budgets; using XLA attention", q.shape[1], q.shape[-1])
+            _warned_fallback = True
+        return _xla_attention(q, k, v, causal, sm_scale)
+
+    out = flash_mha(q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2),
+                    causal, sm_scale)
     return out.swapaxes(1, 2)

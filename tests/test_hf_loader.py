@@ -102,6 +102,35 @@ def test_falcon_parity():
     _compare(m)
 
 
+def test_falcon_sequential_parity():
+    """parallel_attn=False (Falcon-RW sequential residual): ln2 must load
+    from post_attention_layernorm, not input_layernorm."""
+    from transformers import FalconConfig, FalconForCausalLM
+
+    torch.manual_seed(0)
+    m = FalconForCausalLM(FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=False,
+        new_decoder_architecture=False, parallel_attn=False, bias=False,
+        alibi=False))
+    _compare(m)
+
+
+def test_falcon_gqa_new_arch_parity():
+    """Falcon-40B/180B layout: new_decoder_architecture with 1 < nkv < nh
+    interleaves the fused QKV per KV group and uses ln_attn/ln_mlp parallel
+    norms (ref GQAMegatronQKVParameter, module_inject/layers.py)."""
+    from transformers import FalconConfig, FalconForCausalLM
+
+    torch.manual_seed(0)
+    m = FalconForCausalLM(FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_kv_heads=2, multi_query=False,
+        new_decoder_architecture=True, parallel_attn=True, bias=False,
+        alibi=False))
+    _compare(m)
+
+
 def test_phi_parity():
     from transformers import PhiConfig, PhiForCausalLM
 

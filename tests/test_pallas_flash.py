@@ -1,0 +1,118 @@
+"""Parity tests for the repo-owned Pallas flash attention kernel
+(deepspeed_tpu/ops/pallas/flash_mha.py) run through the Pallas interpreter
+on the CPU mesh. Ref test model: tests/unit/ops/transformer/inference
+attention parity in the reference suite."""
+
+import importlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+# the package re-exports the flash_mha *function* under the same name as the
+# submodule; import the module itself for INTERPRET toggling
+fm = importlib.import_module("deepspeed_tpu.ops.pallas.flash_mha")
+
+
+@pytest.fixture(autouse=True)
+def _interpret_mode():
+    old = fm.INTERPRET
+    fm.INTERPRET = True
+    yield
+    fm.INTERPRET = old
+
+
+def _ref_attn(q, k, v, causal, scale):
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    hq, hkv = q.shape[1], k.shape[1]
+    if hq != hkv:
+        kf = jnp.repeat(kf, hq // hkv, axis=1)
+        vf = jnp.repeat(vf, hq // hkv, axis=1)
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if causal:
+        S = q.shape[2]
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+
+
+CASES = [
+    # b, hq, hkv, s, d, causal
+    (1, 2, 2, 256, 64, True),     # MHA
+    (1, 4, 2, 256, 64, True),     # GQA 2x
+    (1, 4, 1, 128, 64, True),     # MQA
+    (1, 2, 2, 200, 64, True),     # odd length (pad + mask path)
+    (1, 2, 2, 256, 64, False),    # non-causal
+]
+
+
+@pytest.mark.parametrize("b,hq,hkv,s,d,causal", CASES)
+def test_forward_parity(b, hq, hkv, s, d, causal):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (b, hq, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, hkv, s, d), jnp.bfloat16)
+    out = fm.flash_mha(q, k, v, causal)
+    ref = _ref_attn(q, k, v, causal, 1.0 / np.sqrt(d))
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+    assert err < 0.05, err
+
+
+@pytest.mark.parametrize("b,hq,hkv,s,d,causal", [CASES[1], CASES[3]])
+def test_grad_parity(b, hq, hkv, s, d, causal):
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (b, hq, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, hkv, s, d), jnp.bfloat16)
+    w = jnp.linspace(0.0, 1.0, d)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v).astype(jnp.float32) * w).sum()
+
+    scale = 1.0 / np.sqrt(d)
+    g1 = jax.grad(loss(lambda q, k, v: fm.flash_mha(q, k, v, causal)),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(lambda q, k, v: _ref_attn(q, k, v, causal, scale)),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        a32 = a.astype(jnp.float32)
+        b32 = b_.astype(jnp.float32)
+        rel = float(jnp.linalg.norm((a32 - b32).ravel())
+                    / (jnp.linalg.norm(b32.ravel()) + 1e-9))
+        assert rel < 0.02, rel
+
+
+def test_supports_budget():
+    assert fm.supports(1024, 64)
+    assert fm.supports(8192, 128)
+    assert not fm.supports(65536, 128)
+
+
+def test_any_length_no_fallback(monkeypatch):
+    """flash_attention dispatches s % 128 != 0 through the repo kernel
+    (pad+mask), not the O(S²) XLA path — verified by pretending to be on
+    TPU (interpret mode) and asserting the repo kernel actually ran."""
+    from deepspeed_tpu.ops import flash_attention as fa
+
+    monkeypatch.setattr(fa, "_on_tpu", lambda: True)
+    calls = {"n": 0}
+    real = fm._fwd
+
+    def counting_fwd(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(fm, "_fwd", counting_fwd)
+
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q = jax.random.normal(ks[0], (1, 200, 4, 64), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (1, 200, 2, 64), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (1, 200, 2, 64), jnp.bfloat16)
+    out = fa.flash_attention.__wrapped__(q, k, v, causal=True, sm_scale=None,
+                                         impl="auto")
+    assert calls["n"] == 1, "repo kernel was not used for s % 128 != 0"
+    ref = _ref_attn(q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2),
+                    True, 1.0 / np.sqrt(64)).swapaxes(1, 2)
+    assert float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref))) < 0.05
