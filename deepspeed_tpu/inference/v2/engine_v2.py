@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.inference.v2.model import ragged_forward
+from deepspeed_tpu.inference.v2.model import ragged_decode_loop, ragged_forward
 from deepspeed_tpu.inference.v2.ragged import DSStateManager, build_ragged_batch
 from deepspeed_tpu.inference.v2.scheduler import SplitFuseScheduler
 from deepspeed_tpu.models import transformer as tf_model
@@ -83,13 +83,18 @@ class InferenceEngineV2:
                                             token_budget=self.cfg.max_ragged_batch_size)
 
         pages = self.cfg.num_blocks * self.cfg.block_size
-        kv_shape = (mc.num_layers, pages, mc.kv_heads, mc.dim_per_head)
+        # [L, nkv, P, d]: kv-head-major so the paged-attention kernel's page
+        # blocks have (rows, head_dim) as their minor dims (lane-aligned).
+        kv_shape = (mc.num_layers, mc.kv_heads, pages, mc.dim_per_head)
         self.cache_k = jnp.zeros(kv_shape, dtype=dt)
         self.cache_v = jnp.zeros(kv_shape, dtype=dt)
 
         self._step = jax.jit(
             partial(ragged_forward, cfg=mc, block_size=self.cfg.block_size),
             donate_argnums=(1, 2))
+        self._decode_loop = jax.jit(
+            partial(ragged_decode_loop, cfg=mc, block_size=self.cfg.block_size),
+            static_argnames=("n_steps", "greedy"), donate_argnums=(1, 2))
         log_dist(f"InferenceEngineV2: budget={self.cfg.max_ragged_batch_size} "
                  f"blocks={self.cfg.num_blocks}×{self.cfg.block_size} "
                  f"max_seqs={self.cfg.max_tracked_sequences} tp={self.cfg.tp_size}")
@@ -178,7 +183,20 @@ class InferenceEngineV2:
         total_blocks = self.cfg.num_blocks - 1  # block 0 reserved
         bs = self.cfg.block_size
         max_per_seq = self.state_manager.max_blocks_per_seq
+        decode_key = jax.random.PRNGKey(seed ^ 0x5EED)
         while pending or any(u in self.state_manager for u in uids):
+            # Pure-decode phase: every live sequence is waiting on exactly
+            # its one pending sampled token -> run a fused multi-step decode
+            # on device (one dispatch + one [chunk, S] int32 fetch instead
+            # of a full-logits transfer per token).
+            active_uids = [u for u in uids if u in self.state_manager]
+            if (not pending and active_uids
+                    and all(self.state_manager.get(u).uncached == 1
+                            for u in active_uids)):
+                decode_key, sub = jax.random.split(decode_key)
+                self._fused_decode(active_uids, remaining, outputs,
+                                   temperature, sub, eos_token_id)
+                continue
             admit_uids, admit_toks = [], []
             # Active sequences will still claim pages as they decode: reserve
             # their remaining future blocks so admission never overcommits.
@@ -225,6 +243,57 @@ class InferenceEngineV2:
                 else:
                     self.extend(uid, nxt)
         return [outputs[u] for u in uids]
+
+    # ------------------------------------------------------------------
+    def _fused_decode(self, uids: List[int], remaining: Dict[int, int],
+                      outputs: Dict[int, List[int]], temperature: float,
+                      key, eos_token_id: Optional[int]) -> None:
+        """One fused on-device decode chunk for all live sequences
+        (ragged_decode_loop): chunk sizes are power-of-two bucketed so a
+        generation run compiles at most a handful of loop lengths."""
+        mgr = self.state_manager
+        chunk = min(min(remaining[u] for u in uids), 32)
+        if chunk > 1:  # round down to a power of two (compile-cache bound)
+            chunk = 1 << (chunk.bit_length() - 1)
+        s_rows = mgr.max_seqs
+        tokens0 = np.zeros((s_rows,), np.int32)
+        ctx0 = np.zeros((s_rows,), np.int32)
+        active = np.zeros((s_rows,), bool)
+        nb_needed = 1
+        for u in uids:
+            seq = mgr.get(u)
+            mgr.ensure_capacity(seq, seq.num_cached + chunk)
+            tokens0[seq.slot] = seq.tokens[-1]
+            ctx0[seq.slot] = seq.num_cached
+            active[seq.slot] = True
+            nb_needed = max(nb_needed, len(seq.blocks))
+        nb_bucket = 1
+        while nb_bucket < nb_needed:
+            nb_bucket *= 2
+        nb_bucket = min(nb_bucket, mgr.max_blocks_per_seq)
+        tables = np.zeros((s_rows, nb_bucket), np.int32)
+        for u in uids:
+            seq = mgr.get(u)
+            tables[seq.slot, :len(seq.blocks)] = seq.blocks
+
+        sampled, _, self.cache_k, self.cache_v = self._decode_loop(
+            self.params, self.cache_k, self.cache_v,
+            jnp.asarray(tokens0), jnp.asarray(ctx0), jnp.asarray(active),
+            jnp.asarray(tables), key, jnp.float32(max(temperature, 1e-6)),
+            n_steps=chunk, greedy=(temperature <= 0))
+        sampled = np.asarray(sampled)  # [chunk, s_rows]
+        for u in uids:
+            seq = mgr.get(u)
+            toks = [int(x) for x in sampled[:, seq.slot]]
+            cut = chunk
+            if eos_token_id is not None and eos_token_id in toks:
+                cut = toks.index(eos_token_id) + 1
+            seq.tokens.extend(toks)
+            seq.num_cached += chunk
+            outputs[u].extend(toks[:cut])
+            remaining[u] -= cut
+            if cut < chunk or remaining[u] <= 0:
+                self.flush(u)
 
 
 def build_engine(model: TransformerConfig, engine_config: Optional[Dict] = None,

@@ -49,40 +49,72 @@ def _rope_tok(x, positions, cfg: TransformerConfig):
     return out.astype(x.dtype)
 
 
-def _paged_attention(q, k_pages, v_pages, gather_idx, token_pos, token_ctx_len,
-                     cfg: TransformerConfig):
-    """Attention of T query tokens against their sequences' KV pages.
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
 
-    q: [T, nh, d]; k_pages/v_pages: [P, nkv, d] (already contain this step's
-    scattered KV); gather_idx: [T, C] flat page indices of each token's
-    context; token_pos: [T]; token_ctx_len: [T] context length of the token's
-    sequence. Ref kernel: ragged_ops/blocked_flash.
+
+def _paged_attention_xla(q, k_pages, v_pages, gather_idx, token_pos,
+                         token_ctx_len, cfg: TransformerConfig):
+    """Gather-based fallback (non-TPU backends / oversize shapes).
+
+    q: [T, nh, d]; k_pages/v_pages: [nkv, P, d]; gather_idx: [T, C] flat
+    page-row indices of each token's context. GQA-native: queries are
+    grouped by KV head instead of repeating KV.
     """
-    nh = q.shape[1]
-    nkv = k_pages.shape[1]
-    k_ctx = k_pages[gather_idx]  # [T, C, nkv, d]
-    v_ctx = v_pages[gather_idx]
-    if nkv != nh:
-        rep = nh // nkv
-        k_ctx = jnp.repeat(k_ctx, rep, axis=2)
-        v_ctx = jnp.repeat(v_ctx, rep, axis=2)
+    t, nh, d = q.shape
+    nkv = k_pages.shape[0]
+    g = nh // nkv
+    k_ctx = k_pages[:, gather_idx]  # [nkv, T, C, d]
+    v_ctx = v_pages[:, gather_idx]
+    qg = q.reshape(t, nkv, g, d)
     scale = 1.0 / math.sqrt(cfg.dim_per_head)
-    scores = jnp.einsum("thd,tchd->thc", q, k_ctx) * scale  # [T, nh, C]
+    scores = jnp.einsum("tkgd,ktcd->tkgc", qg, k_ctx) * scale
     c_pos = jnp.arange(scores.shape[-1], dtype=jnp.int32)
     valid = (c_pos[None, :] <= token_pos[:, None]) & \
             (c_pos[None, :] < token_ctx_len[:, None])       # [T, C]
     if cfg.sliding_window:
         valid = valid & (token_pos[:, None] - c_pos[None, :]
                          < cfg.sliding_window)
-    scores = jnp.where(valid[:, None, :], scores.astype(jnp.float32), -1e30)
+    scores = jnp.where(valid[:, None, None, :], scores.astype(jnp.float32),
+                       -1e30)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("thc,tchd->thd", probs, v_ctx)
+    out = jnp.einsum("tkgc,ktcd->tkgd", probs, v_ctx)
+    return out.reshape(t, nh, d)
+
+
+def _paged_attention(q, k_pages, v_pages, gather_idx, token_pos, token_ctx_len,
+                     cfg: TransformerConfig, block_tables=None, token_slot=None,
+                     block_size: int = 0):
+    """Attention of T query tokens against their sequences' KV pages.
+
+    On TPU this dispatches to the repo-owned Pallas kernel
+    (ops/pallas/paged_attention.py: block-table walk with online softmax —
+    no [T, C, ...] gather materialisation); elsewhere the XLA gather path.
+    Ref kernel: inference/v2/kernels/ragged_ops/blocked_flash.
+    """
+    if (block_tables is not None and _on_tpu()
+            and cfg.sliding_window is None):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention, supports as paged_supports)
+
+        if paged_supports(block_size, cfg.dim_per_head):
+            pages = block_tables[token_slot]  # [T, NB]
+            scale = 1.0 / math.sqrt(cfg.dim_per_head)
+            return paged_decode_attention(
+                q, k_pages, v_pages, pages, token_pos, token_ctx_len,
+                block_size, scale)
+    return _paged_attention_xla(q, k_pages, v_pages, gather_idx, token_pos,
+                                token_ctx_len, cfg)
 
 
 def _ragged_layer(x, lp, k_pages, v_pages, meta, cfg: TransformerConfig,
                   layer_is_moe=False):
     """One block over flat tokens [T, H]; scatters KV, attends via pages."""
-    token_pos, token_dest, gather_idx, token_ctx_len = meta
+    (token_pos, token_dest, gather_idx, token_ctx_len, token_slot,
+     block_tables, block_size) = meta
     t = x.shape[0]
     nh, nkv, d = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
     dt = x.dtype
@@ -101,12 +133,16 @@ def _ragged_layer(x, lp, k_pages, v_pages, meta, cfg: TransformerConfig,
         k = _rope_tok(k, token_pos, cfg)
 
     # Write this step's KV to its pages (padding tokens target page 0 =
-    # garbage, so no mask needed; ref: linear_blocked_kv_copy).
-    k_pages = k_pages.at[token_dest].set(k.astype(k_pages.dtype))
-    v_pages = v_pages.at[token_dest].set(v.astype(v_pages.dtype))
+    # garbage, so no mask needed; ref: linear_blocked_kv_copy). Cache layout
+    # is [nkv, P, d] (kv-head-major for the Pallas kernel's page blocks).
+    k_pages = k_pages.at[:, token_dest].set(
+        k.swapaxes(0, 1).astype(k_pages.dtype))
+    v_pages = v_pages.at[:, token_dest].set(
+        v.swapaxes(0, 1).astype(v_pages.dtype))
 
     attn = _paged_attention(q, k_pages, v_pages, gather_idx, token_pos,
-                            token_ctx_len, cfg)
+                            token_ctx_len, cfg, block_tables=block_tables,
+                            token_slot=token_slot, block_size=block_size)
     attn = attn.reshape(t, nh * d) @ lp["attn"]["wo"].astype(dt)
     if lp["attn"].get("bo") is not None:
         attn = attn + lp["attn"]["bo"].astype(dt)
@@ -157,7 +193,8 @@ def ragged_forward(params, cache_k, cache_v, token_ids, token_slot, token_pos,
     ctx_idx = block_tables[:, c // block_size] * block_size + c % block_size  # [S+1, C]
     gather_idx = ctx_idx[token_slot]          # [T, C]
     token_ctx_len = ctx_lens[token_slot]      # [T]
-    meta = (token_pos, token_dest, gather_idx, token_ctx_len)
+    meta = (token_pos, token_dest, gather_idx, token_ctx_len, token_slot,
+            block_tables, block_size)
 
     moe_every = max(1, cfg.moe_layer_freq)
 
