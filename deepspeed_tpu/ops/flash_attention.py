@@ -115,7 +115,9 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None,
     from deepspeed_tpu.ops.pallas.flash_mha import supports
 
     if not supports(q.shape[1], q.shape[-1]):
-        # beyond the VMEM-resident budget; try the library kernel, else XLA
+        # beyond even the KV-blocked path's ceiling (S·D > 2^25) — shard
+        # the sequence (Ulysses/FPDT) at such lengths. Last resorts: the
+        # library kernel (repeats KV), then XLA.
         blk = _block_for(q.shape[1])
         if blk is not None:
             return _lib_flash(q, k, v, causal, sm_scale, blk)

@@ -63,16 +63,18 @@ def chunked_attention(q, k, v, chunk_size: int, causal: bool = True,
     """Streaming attention over sequence chunks (ref FPDT_Attention,
     fpdt_layer.py:971).
 
-    q/k/v: [B, S, H, D] (KV heads may divide query heads — GQA is expanded).
+    q/k/v: [B, S, H, D] (KV heads may divide query heads — GQA-native: the
+    score einsum groups query heads per KV head instead of repeating KV,
+    so a GQA model streams 1/group the KV bytes per chunk fetch).
     Returns [B, S, H, D].  Numerics match full softmax attention: the inner
     scan carries the usual (max, sum, weighted-acc) online-softmax state.
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
-    if k.shape[2] != q.shape[2]:
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    nh, nkv = q.shape[2], k.shape[2]
+    if nh % nkv != 0:
+        raise ValueError(f"query heads {nh} not a multiple of kv heads {nkv}")
+    grp = nh // nkv
 
     orig_dtype = q.dtype
     qc = _split_chunks(q, chunk_size, axis=1)          # [Nq, B, Cq, H, D]
@@ -90,9 +92,10 @@ def chunked_attention(q, k, v, chunk_size: int, causal: bool = True,
         q_i, i = qi_and_idx
         q_i = q_i.astype(jnp.float32) * sm_scale
         b, cq, h, d = q_i.shape
-        m0 = jnp.full((b, h, cq), neg_inf, jnp.float32)
-        l0 = jnp.zeros((b, h, cq), jnp.float32)
-        a0 = jnp.zeros((b, h, cq, d), jnp.float32)
+        q_i = q_i.reshape(b, cq, nkv, grp, d)
+        m0 = jnp.full((b, nkv, grp, cq), neg_inf, jnp.float32)
+        l0 = jnp.zeros((b, nkv, grp, cq), jnp.float32)
+        a0 = jnp.zeros((b, nkv, grp, cq, d), jnp.float32)
 
         def kv_step(carry, kv_and_idx):
             m, l, acc = carry
@@ -101,8 +104,8 @@ def chunked_attention(q, k, v, chunk_size: int, causal: bool = True,
                 k_j, v_j = _fetch_from_host(k_j), _fetch_from_host(v_j)
             k_j = k_j.astype(jnp.float32)
             v_j = v_j.astype(jnp.float32)
-            # [B, H, Cq, Ck]
-            s = jnp.einsum("bqhd,bkhd->bhqk", q_i, k_j)
+            # [B, nkv, grp, Cq, Ck]
+            s = jnp.einsum("bqhgd,bkhd->bhgqk", q_i, k_j)
             if causal:
                 qpos = i * chunk_size + lax.broadcasted_iota(jnp.int32, (cq, k_j.shape[1]), 0)
                 kpos = j * chunk_size + lax.broadcasted_iota(jnp.int32, (cq, k_j.shape[1]), 1)
@@ -113,14 +116,14 @@ def chunked_attention(q, k, v, chunk_size: int, causal: bool = True,
             p = jnp.where(jnp.isfinite(m_new)[..., None], p, 0.0)
             alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_new), 0.0)
             l = l * alpha + p.sum(axis=-1)
-            acc = acc * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, v_j)
+            acc = acc * alpha[..., None] + jnp.einsum("bhgqk,bkhd->bhgqd", p, v_j)
             return (m_new, l, acc), None
 
         (m, l, acc), _ = lax.scan(
             kv_step, (m0, l0, a0),
             (kc, vc, jnp.arange(kc.shape[0], dtype=jnp.int32)))
-        out = acc / jnp.maximum(l, 1e-30)[..., None]       # [B, H, Cq, D]
-        return None, jnp.transpose(out, (0, 2, 1, 3))       # [B, Cq, H, D]
+        out = acc / jnp.maximum(l, 1e-30)[..., None]   # [B, nkv, grp, Cq, D]
+        return None, jnp.transpose(out, (0, 3, 1, 2, 4)).reshape(b, cq, h, d)
 
     _, out = lax.scan(q_step, None, (qc, jnp.arange(nq, dtype=jnp.int32)))
     return _merge_chunks(out, axis=1).astype(orig_dtype)

@@ -87,7 +87,83 @@ def test_grad_parity(b, hq, hkv, s, d, causal):
 def test_supports_budget():
     assert fm.supports(1024, 64)
     assert fm.supports(8192, 128)
-    assert not fm.supports(65536, 128)
+    assert fm.supports(65536, 128)          # KV-blocked long-context path
+    assert fm.supports(262144, 128)
+    assert not fm.supports(1 << 20, 128)
+    assert fm._supports_resident(8192, 64)
+    assert not fm._supports_resident(16384, 128)
+
+
+BLOCKED_CASES = [
+    # b, hq, hkv, s, d, causal
+    (1, 4, 2, 1024, 64, True),    # GQA, 2x2 blocks
+    (1, 2, 2, 1280, 64, True),    # pad path (s_pad = 1536, ragged tail)
+    (1, 4, 1, 1024, 64, False),   # MQA, non-causal
+]
+
+
+@pytest.fixture
+def _force_blocked(monkeypatch):
+    monkeypatch.setattr(fm, "_supports_resident", lambda s, d: False)
+
+
+@pytest.mark.parametrize("b,hq,hkv,s,d,causal", BLOCKED_CASES)
+def test_blocked_forward_parity(b, hq, hkv, s, d, causal, _force_blocked):
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (b, hq, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, hkv, s, d), jnp.bfloat16)
+    out = fm.flash_mha(q, k, v, causal)
+    ref = _ref_attn(q, k, v, causal, 1.0 / np.sqrt(d))
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+    assert err < 0.05, err
+
+
+@pytest.mark.parametrize("b,hq,hkv,s,d,causal",
+                         [BLOCKED_CASES[0], BLOCKED_CASES[1]])
+def test_blocked_grad_parity(b, hq, hkv, s, d, causal, _force_blocked):
+    ks = jax.random.split(jax.random.PRNGKey(4), 3)
+    q = jax.random.normal(ks[0], (b, hq, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, hkv, s, d), jnp.bfloat16)
+    w = jnp.linspace(0.0, 1.0, d)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v).astype(jnp.float32) * w).sum()
+
+    scale = 1.0 / np.sqrt(d)
+    g1 = jax.grad(loss(lambda q, k, v: fm.flash_mha(q, k, v, causal)),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(lambda q, k, v: _ref_attn(q, k, v, causal, scale)),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        a32 = a.astype(jnp.float32)
+        b32 = b_.astype(jnp.float32)
+        rel = float(jnp.linalg.norm((a32 - b32).ravel())
+                    / (jnp.linalg.norm(b32.ravel()) + 1e-9))
+        assert rel < 0.02, rel
+
+
+def test_long_context_16k_forward():
+    """S=16K naturally routes to the KV-blocked path (resident budget is
+    8K at d=128 / 128·s_pad score cap); oracle is the independently-written
+    FPDT chunked online-softmax attention (O(chunk) memory — a full [S,S]
+    reference would need multi-GB scores on the CPU runner)."""
+    from deepspeed_tpu.sequence.fpdt import chunked_attention
+
+    s, d = 16384, 64
+    assert not fm._supports_resident(s, d)
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (1, 2, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (1, 1, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (1, 1, s, d), jnp.bfloat16)
+    out = fm.flash_mha(q, k, v, True)
+    ref = chunked_attention(q.swapaxes(1, 2), k.swapaxes(1, 2),
+                            v.swapaxes(1, 2), chunk_size=2048,
+                            causal=True).swapaxes(1, 2)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
+                                - ref.astype(jnp.float32))))
+    assert err < 0.05, err
 
 
 def test_any_length_no_fallback(monkeypatch):
