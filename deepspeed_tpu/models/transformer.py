@@ -67,6 +67,9 @@ class TransformerConfig:
     num_experts: int = 0
     top_k: int = 2
     capacity_factor: float = 1.25
+    # "auto" | "einsum" | "sorted": [T,E,C] one-hot einsum dispatch vs
+    # argsort-by-expert gather dispatch (auto switches on one-hot size)
+    moe_dispatch: str = "auto"
     moe_layer_freq: int = 2  # every Nth layer is MoE, matching ref PR-MoE style
     # pipeline parallelism: microbatches per forward call, i.e. per
     # gradient-accumulation micro-step (0 → pp size); must divide the
@@ -362,13 +365,46 @@ def _mlp_block(x, p, cfg: TransformerConfig):
     return y
 
 
-def _moe_block(x, p, cfg: TransformerConfig):
-    """Dense-dispatch MoE block used inside the scan (einsum dispatch).
-    The expert-parallel all-to-all version lives in deepspeed_tpu/moe."""
-    from deepspeed_tpu.moe.sharded_moe import moe_forward
+def _moe_block(x, p, cfg: TransformerConfig, allow_ep: bool = True):
+    """MoE block used inside the scan.  With an expert mesh axis of size
+    > 1 the explicit shard_map + all_to_all expert-parallel path runs
+    (deepspeed_tpu/moe/sharded_moe.moe_forward_ep — the reference's
+    `_AllToAll` dispatch on ICI); otherwise the single-group path.
 
-    out, aux = moe_forward(x, p, cfg)
-    return out, aux
+    ``allow_ep=False`` is passed from ``lax.cond`` call sites: a shard_map
+    collective inside a cond branch crashes XLA's backward pass, so traced
+    MoE-vs-dense selection keeps the auto-partitioned formulation (the
+    grouped scan in :func:`forward` makes the selection static precisely
+    so the EP path applies on aligned configs)."""
+    from deepspeed_tpu.moe.sharded_moe import moe_forward, moe_forward_ep
+    from deepspeed_tpu.parallel.topology import get_topology
+
+    topo = get_topology()
+    if allow_ep and topo is not None and topo.ep_size > 1:
+        return moe_forward_ep(x, p, cfg, topo)
+    return moe_forward(x, p, cfg)
+
+
+def _select_ffn(h, layer_params, cfg: TransformerConfig, layer_is_moe):
+    """MoE-vs-dense FFN selection on normed input ``h`` → (y, aux).
+
+    A static ``layer_is_moe`` keeps the choice out of the compiled graph
+    (and lets the expert-parallel shard_map path apply); a traced one
+    lowers to ``lax.cond`` with the auto-partitioned MoE (a shard_map
+    collective under cond crashes XLA backward)."""
+    def dense_branch(h):
+        return _mlp_block(h, layer_params["mlp"], cfg), jnp.zeros((), jnp.float32)
+
+    if "moe" not in layer_params:
+        return dense_branch(h)
+    if isinstance(layer_is_moe, bool):
+        return (_moe_block(h, layer_params["moe"], cfg) if layer_is_moe
+                else dense_branch(h))
+
+    def moe_branch(h):
+        return _moe_block(h, layer_params["moe"], cfg, allow_ep=False)
+
+    return lax.cond(layer_is_moe, moe_branch, dense_branch, h)
 
 
 def transformer_layer(x, layer_params, positions, cfg: TransformerConfig,
@@ -387,26 +423,11 @@ def transformer_layer(x, layer_params, positions, cfg: TransformerConfig,
         n = _norm(x, layer_params["ln1"], cfg)
         n_mlp = _norm(x, layer_params["ln2"], cfg) if cfg.parallel_norms else n
         attn_out = _attn_block(n, layer_params["attn"], positions, cfg)
-        if "moe" not in layer_params:
-            return (x + attn_out + _mlp_block(n_mlp, layer_params["mlp"], cfg),
-                    jnp.zeros((), jnp.float32))
-        y, aux = _moe_block(n_mlp, layer_params["moe"], cfg)
+        y, aux = _select_ffn(n_mlp, layer_params, cfg, layer_is_moe)
         return x + attn_out + y, aux
     x = x + _attn_block(_norm(x, layer_params["ln1"], cfg), layer_params["attn"], positions, cfg)
     h = _norm(x, layer_params["ln2"], cfg)
-    if "moe" not in layer_params:
-        return x + _mlp_block(h, layer_params["mlp"], cfg), jnp.zeros((), jnp.float32)
-
-    def moe_branch(h):
-        return _moe_block(h, layer_params["moe"], cfg)
-
-    def dense_branch(h):
-        return _mlp_block(h, layer_params["mlp"], cfg), jnp.zeros((), jnp.float32)
-
-    if isinstance(layer_is_moe, bool):
-        y, aux = moe_branch(h) if layer_is_moe else dense_branch(h)
-    else:
-        y, aux = lax.cond(layer_is_moe, moe_branch, dense_branch, h)
+    y, aux = _select_ffn(h, layer_params, cfg, layer_is_moe)
     return x + y, aux
 
 
@@ -489,15 +510,23 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
                           n_micro=n_micro, extras=positions)
     else:
         def scan_segment(x, pos, layers_slice, idx0, n_layers):
-            """Scan a contiguous slice of the stacked layers."""
-            def body(carry, scanned):
-                h, aux_acc = carry
-                layer_params, layer_idx = scanned
-                if cfg.is_moe:
-                    is_moe_layer = (layer_idx % moe_every) == (moe_every - 1)
-                else:
-                    is_moe_layer = False
-                h2, aux = transformer_layer(h, layer_params, pos, cfg,
+            """Scan a contiguous slice of the stacked layers.
+
+            MoE placement is kept **static** so the expert-parallel
+            shard_map path applies: with moe_layer_freq f, the f-aligned
+            middle of the segment scans *groups* of f layers whose last
+            member is statically MoE (no lax.cond in the scan body — a
+            shard_map collective under a traced cond crashes XLA
+            backward), and the unaligned head/tail layers (e.g. where a
+            random-LTD band cuts through a group) run unrolled with their
+            static global indices.
+            """
+            f = moe_every if cfg.is_moe else 1
+            if n_layers == 0:
+                return x, jnp.zeros((), jnp.float32)
+
+            def apply_layer(h, aux_acc, lp, layer_idx, is_moe_layer):
+                h2, aux = transformer_layer(h, lp, pos, cfg,
                                             layer_is_moe=is_moe_layer)
                 if pld_theta is not None:
                     # progressive layer drop (ref progressive_layer_drop.py
@@ -512,16 +541,63 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
                     p_keep = 1.0 - (1.0 - pld_theta) * depth_frac
                     coin = jax.random.bernoulli(key, p_keep)
                     h2 = jnp.where(coin, h2, h)
-                return (h2, aux_acc + aux), None
+                return h2, aux_acc + aux
 
-            body = _maybe_remat(body, cfg)
-            idxs = jnp.arange(idx0, idx0 + n_layers)
-            unroll = max(1, cfg.scan_unroll)
-            if n_layers % unroll != 0:
-                unroll = 1
-            (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
-                                   (layers_slice, idxs), unroll=unroll)
-            return x, aux
+            aux0 = jnp.zeros((), jnp.float32)
+            head = min((-idx0) % f, n_layers)
+            mid = (n_layers - head) // f * f
+            # head/tail: static global indices → static MoE placement
+            def run_unrolled(x, aux, lo, hi):
+                for j in range(lo, hi):
+                    lp = jax.tree.map(lambda p, j=j: p[j], layers_slice)
+                    is_moe = cfg.is_moe and ((idx0 + j) % f == f - 1)
+                    step = _maybe_remat(
+                        lambda h, a, lp, j=j, m=is_moe:
+                        apply_layer(h, a, lp, idx0 + j, m), cfg)
+                    x, aux = step(x, aux, lp)
+                return x, aux
+
+            x, aux0 = run_unrolled(x, aux0, 0, head)
+            if mid > 0:
+                grouped = f > 1
+
+                def body(carry, scanned):
+                    h, aux_acc = carry
+                    layer_params, i = scanned
+                    if grouped:
+                        for j in range(f):
+                            lp = jax.tree.map(lambda p, j=j: p[j],
+                                              layer_params)
+                            h, aux_acc = apply_layer(h, aux_acc, lp,
+                                                     i * f + j, j == f - 1)
+                    else:
+                        h, aux_acc = apply_layer(h, aux_acc, layer_params, i,
+                                                 cfg.is_moe and f == 1)
+                    return (h, aux_acc), None
+
+                body = _maybe_remat(body, cfg)
+                mid_slice = jax.tree.map(lambda p: p[head:head + mid],
+                                         layers_slice)
+                if grouped:
+                    steps = mid // f
+                    layers_scan = jax.tree.map(
+                        lambda p: p.reshape((steps, f) + p.shape[1:]),
+                        mid_slice)
+                    idxs = jnp.arange((idx0 + head) // f,
+                                      (idx0 + head) // f + steps)
+                else:
+                    steps = mid
+                    layers_scan = mid_slice
+                    idxs = jnp.arange(idx0 + head, idx0 + head + mid)
+                unroll = max(1, cfg.scan_unroll)
+                if steps % unroll != 0:
+                    unroll = 1
+                (x, aux_mid), _ = lax.scan(
+                    body, (x, jnp.zeros((), jnp.float32)),
+                    (layers_scan, idxs), unroll=unroll)
+                aux0 = aux0 + aux_mid
+            x, aux0 = run_unrolled(x, aux0, head + mid, n_layers)
+            return x, aux0
 
         def layer_slice(a, b_):
             return jax.tree.map(lambda p: p[a:b_], params["layers"])
