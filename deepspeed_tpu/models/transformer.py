@@ -467,6 +467,61 @@ def _maybe_remat(fn, cfg: TransformerConfig):
     return jax.checkpoint(fn, policy=policy, prevent_cse=False)
 
 
+def make_pipeline_stage_fn(cfg: TransformerConfig, topo):
+    """Per-stage layer applier for the SPMD pipeline: scans this stage's
+    ``L/pp`` stacked layers, returns ``(h, aux)``.
+
+    MoE placement must be static inside the pipe shard_map (the stage
+    index is a traced ``axis_index``, so a global-layer-index predicate
+    would put the MoE collective under a traced cond — see
+    :func:`_select_ffn`): with ``layers_per_stage % moe_layer_freq == 0``
+    every stage has the same local pattern — groups of f layers whose last
+    member is MoE.  Ref: MoE+PP composition, utils/groups.py:384.
+    """
+    pp = topo.pp_size
+    if cfg.num_layers % pp:
+        raise ValueError(f"num_layers={cfg.num_layers} not divisible by "
+                         f"pipeline stages ({pp})")
+    lp_count = cfg.num_layers // pp
+    f = max(1, cfg.moe_layer_freq) if cfg.is_moe else 1
+    if cfg.is_moe and lp_count % f != 0:
+        raise NotImplementedError(
+            f"MoE + pipeline requires layers_per_stage ({lp_count}) "
+            f"divisible by moe_layer_freq ({f}) so expert placement is "
+            "static per stage")
+
+    def stage_fn(stage_params, h, pos_mb):
+        zero = jnp.zeros((), jnp.float32)
+        if f > 1:
+            steps = lp_count // f
+
+            def body(carry, glp):
+                h, aux_acc = carry
+                for j in range(f):
+                    lp = jax.tree.map(lambda p, j=j: p[j], glp)
+                    h, aux = transformer_layer(h, lp, pos_mb, cfg,
+                                               layer_is_moe=(j == f - 1))
+                    aux_acc = aux_acc + aux
+                return (h, aux_acc), None
+
+            body = _maybe_remat(body, cfg)
+            grouped = jax.tree.map(
+                lambda p: p.reshape((steps, f) + p.shape[1:]), stage_params)
+            (h, aux), _ = lax.scan(body, (h, zero), grouped)
+        else:
+            def body(carry, lp):
+                h, aux_acc = carry
+                h, aux = transformer_layer(h, lp, pos_mb, cfg,
+                                           layer_is_moe=cfg.is_moe)
+                return (h, aux_acc + aux), None
+
+            body = _maybe_remat(body, cfg)
+            (h, aux), _ = lax.scan(body, (h, zero), stage_params)
+        return h, aux
+
+    return stage_fn
+
+
 def forward(params: Params, input_ids, cfg: TransformerConfig,
             positions=None, pld_theta=None,
             return_hidden: bool = False) -> jnp.ndarray:
@@ -492,22 +547,18 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
     if topo is not None and topo.pp_size > 1:
         # Pipeline path: layers circulate microbatches over the "pipe" axis
         # (ref runtime/pipe/engine.py TrainSchedule → spmd_pipeline here).
-        if cfg.is_moe:
-            raise NotImplementedError("MoE + pipeline parallelism not yet supported")
+        if pld_theta is not None:
+            raise NotImplementedError(
+                "progressive layer drop + pipeline parallelism not supported")
+        if 0 < cfg.ltd_kept < s:
+            raise NotImplementedError(
+                "random-LTD + pipeline parallelism not supported")
         from deepspeed_tpu.parallel.pipeline import spmd_pipeline
 
-        def stage_fn(stage_params, h, pos_mb):
-            def body(h_, lp):
-                h2, _ = transformer_layer(h_, lp, pos_mb, cfg, layer_is_moe=False)
-                return h2, None
-
-            body = _maybe_remat(body, cfg)
-            h, _ = lax.scan(body, h, stage_params)
-            return h
-
+        stage_fn = make_pipeline_stage_fn(cfg, topo)
         n_micro = cfg.pipeline_microbatches or topo.pp_size
-        x = spmd_pipeline(stage_fn, params["layers"], x, topo=topo,
-                          n_micro=n_micro, extras=positions)
+        x, moe_aux = spmd_pipeline(stage_fn, params["layers"], x, topo=topo,
+                                   n_micro=n_micro, extras=positions)
     else:
         def scan_segment(x, pos, layers_slice, idx0, n_layers):
             """Scan a contiguous slice of the stacked layers.

@@ -276,8 +276,13 @@ def moe_forward_ep(x: jnp.ndarray, p: Dict[str, jnp.ndarray], cfg,
     # the router is replicated
     p_specs = {key: P(EXPERT_AXIS) if key != "router" else P()
                for key in p}
+    # inside another shard_map (e.g. the pipeline's manual "pipe" axis) the
+    # inner shard_map must be built on the *context* mesh, whose outer axes
+    # are already marked Manual — passing the raw device mesh is rejected
+    ctx = jax.sharding.get_abstract_mesh()
+    mesh = topo.mesh if ctx.empty else ctx
     mapped = jax.shard_map(
-        body, mesh=topo.mesh, axis_names={EXPERT_AXIS},
+        body, mesh=mesh, axis_names={EXPERT_AXIS},
         in_specs=(P(EXPERT_AXIS), p_specs),
         out_specs=(P(EXPERT_AXIS), P()))
     return mapped(x, p)

@@ -12,10 +12,12 @@ between stage processes, the whole pipeline is ONE ``shard_map`` over the
 * microbatches circulate between stages with ``lax.ppermute`` (ICI
   neighbour exchange), the analog of SendActivation/RecvActivation
   (ref engine.py:1016/:1108);
-* the schedule is the classic GPipe fill-drain: ``n_micro + pp - 1`` ticks,
-  expressed as a differentiable ``lax.scan`` — backward reuses the same
-  rotation in reverse (the transpose of ppermute), replacing
-  SendGrad/RecvGrad (ref engine.py:1052/:1151).
+* :func:`spmd_pipeline` is the forward schedule (GPipe fill-drain as a
+  differentiable ``lax.scan``); finished microbatches **ring-drain**
+  through a single-slot transit buffer to a home stage (``o % pp``), so
+  each stage stores ``ceil(n_micro/pp)`` microbatches, drain traffic is
+  one microbatch per tick, and a single all-gather at the end replaces
+  the old full-buffer psum broadcast.
 
 Other mesh axes (data/tensor/seq/expert) stay in GSPMD "auto" mode inside
 the shard_map (jax 0.9 ``axis_names``), so pipeline composes with ZeRO/DP/TP
@@ -29,9 +31,42 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from deepspeed_tpu.parallel.topology import PIPE_AXIS, MeshTopology
+
+
+def _drain_schedule(n_micro: int, pp: int):
+    """Static capture schedule for the transit-slot ring drain.
+
+    Finished microbatch ``o`` (emitted by the last stage at tick
+    ``o + pp - 1``) travels the ring one hop per tick in a single-slot
+    transit buffer until it reaches its home stage ``o % pp``, which
+    captures it into row ``o // pp`` of its local (never-permuted) store.
+    Emissions are one per tick and every trip is < pp hops, so at most one
+    item occupies any stage's transit slot at a time — inter-stage drain
+    traffic is one microbatch per tick (the old full-buffer rotation moved
+    ceil(n_micro/pp) of them every tick).
+
+    Returns ``(cap_do [T, pp], cap_row [T, pp], T)`` where tick ``t``'s
+    entries say whether stage ``s`` captures its incoming transit item
+    this tick and into which row; ``T`` includes the post-compute drain
+    ticks that flush the last items home.
+    """
+    compute_ticks = n_micro + pp - 1
+    T = compute_ticks + pp - 1
+    cap_do = np.zeros((T, pp), np.bool_)
+    cap_row = np.zeros((T, pp), np.int32)
+    for o in range(n_micro):
+        home = o % pp
+        hops = (home - (pp - 1)) % pp
+        if hops == 0:
+            continue  # captured directly at emission on the last stage
+        t_arrive = (o + pp - 1) + hops
+        cap_do[t_arrive, home] = True
+        cap_row[t_arrive, home] = o // pp
+    return cap_do, cap_row, T
 
 
 def spmd_pipeline(layer_fn: Callable,
@@ -43,22 +78,19 @@ def spmd_pipeline(layer_fn: Callable,
                   extras=None):
     """Run stacked layers over the "pipe" axis in pipelined fashion.
 
-    ``layer_fn(stage_local_params, h, extras_mb) -> h`` must apply this
-    stage's layers to a microbatch of activations ``[mb, S, H]`` (typically
-    a scan over the local ``L/pp`` stacked layers).  ``stage_params`` leaves
-    have a leading layer axis sharded over "pipe".  ``x``: ``[B, S, H]``
-    activations after the (replicated) embedding; ``B % n_micro == 0``.
-    ``extras`` is an optional pytree of per-example side inputs (leading dim
-    B, e.g. RoPE positions); each stage receives the slice belonging to the
-    microbatch it is currently processing (microbatch ``t - stage_idx``).
+    ``layer_fn(stage_local_params, h, extras_mb) -> (h, aux)`` must apply
+    this stage's layers to a microbatch of activations ``[mb, S, H]``
+    (typically a scan over the local ``L/pp`` stacked layers) and return an
+    auxiliary scalar (e.g. the MoE load-balancing loss; 0 for dense).
+    ``stage_params`` leaves have a leading layer axis sharded over "pipe".
+    ``x``: ``[B, S, H]`` activations after the (replicated) embedding;
+    ``B % n_micro == 0``.  ``extras`` is an optional pytree of per-example
+    side inputs (leading dim B, e.g. RoPE positions); each stage receives
+    the slice belonging to the microbatch it is currently processing.
 
-    Returns ``[B, S, H]`` activations after all L layers, replicated over
-    the pipe axis.
-
-    NOTE: every stage carries the full outputs accumulator through the scan
-    (only the last stage writes it) and the final psum broadcasts it across
-    the pipe axis — simple and correct; a ring-drain collection would save
-    (pp-1)/pp of that buffer and is a planned optimisation.
+    Returns ``([B, S, H], aux)`` with activations after all L layers,
+    replicated over the pipe axis, and the auxiliary scalar averaged over
+    microbatches and summed over stages.
     """
     pp = topo.pp_size
     b = x.shape[0]
@@ -68,17 +100,50 @@ def spmd_pipeline(layer_fn: Callable,
     if pp == 1:
         return layer_fn(stage_params, x, extras)
 
+    rows = -(-n_micro // pp)
+    cap_do_np, cap_row_np, total_ticks = _drain_schedule(n_micro, pp)
+    compute_ticks = n_micro + pp - 1
+
+    dtype = x.dtype
+
     def per_stage(stage_local_params, x_local, extras_local):
         idx = lax.axis_index(PIPE_AXIS)
+        x_local = x_local.astype(dtype)
         micro = x_local.reshape((n_micro, mb) + x_local.shape[1:])
         micro_extras = jax.tree.map(
             lambda e: e.reshape((n_micro, mb) + e.shape[1:]), extras_local)
         state = jnp.zeros_like(micro[0])
-        outputs = jnp.zeros_like(micro)
+        # local store of finished microbatches (never permuted) + the
+        # single-slot transit buffer carrying one finished microbatch per
+        # tick toward its home stage o % pp
+        store = jnp.zeros((rows,) + micro.shape[1:], micro.dtype)
+        transit = jnp.zeros_like(micro[0])
+        cap_do = jnp.asarray(cap_do_np)
+        cap_row = jnp.asarray(cap_row_np)
         perm = [(i, (i + 1) % pp) for i in range(pp)]
 
+        def drain_step(store, transit, out, t):
+            """Move the transit slot one hop, capture at home stages, and
+            emit this tick's finished microbatch (``out`` on the last
+            stage; it goes straight to the store when home == pp-1)."""
+            transit = lax.ppermute(transit, PIPE_AXIS, perm)
+            o = t - (pp - 1)
+            emit = (idx == pp - 1) & (o >= 0) & (o < n_micro)
+            direct = emit & (o % pp == pp - 1)
+            do_cap = cap_do[t, idx] | direct
+            row = jnp.clip(jnp.where(direct, o // pp, cap_row[t, idx]),
+                           0, rows - 1)
+            val = jnp.where(direct, out.astype(store.dtype), transit)
+            cur = lax.dynamic_index_in_dim(store, row, axis=0, keepdims=False)
+            store = lax.dynamic_update_index_in_dim(
+                store, jnp.where(do_cap, val, cur), row, axis=0)
+            # non-home emissions enter the transit slot
+            transit = jnp.where(emit & ~direct, out.astype(transit.dtype),
+                                transit)
+            return store, transit
+
         def tick(carry, t):
-            state, outputs = carry
+            state, store, transit, aux_acc = carry
             # Stage 0 ingests microbatch t (while t < n_micro); other stages
             # use what arrived from the previous stage.
             inp = micro[jnp.minimum(t, n_micro - 1)]
@@ -87,34 +152,53 @@ def spmd_pipeline(layer_fn: Callable,
             # This stage is processing microbatch t - idx right now.
             cur_mb = jnp.clip(t - idx, 0, n_micro - 1)
             extras_mb = jax.tree.map(lambda e: e[cur_mb], micro_extras)
-            out = layer_fn(stage_local_params, h, extras_mb)
-            # Last stage emits microbatch t-(pp-1): masked dynamic update so
-            # non-emitting ticks/stages leave the slot untouched.
-            out_t = t - (pp - 1)
-            emit = (idx == pp - 1) & (out_t >= 0)
-            safe_t = jnp.maximum(out_t, 0)
-            cur = lax.dynamic_index_in_dim(outputs, safe_t, axis=0, keepdims=False)
-            upd = jnp.where(emit, out.astype(outputs.dtype), cur)
-            outputs = lax.dynamic_update_index_in_dim(outputs, upd, safe_t, axis=0)
+            out, aux = layer_fn(stage_local_params, h, extras_mb)
+            # fill/drain ticks recycle garbage state: only count aux from
+            # ticks where this stage held a real microbatch
+            useful = (t >= idx) & (t - idx < n_micro)
+            aux_acc = aux_acc + jnp.where(useful, aux, 0.0)
+            store, transit = drain_step(store, transit, out, t)
             state = lax.ppermute(out, PIPE_AXIS, perm)
-            return (state, outputs), None
+            return (state, store, transit, aux_acc), None
 
-        (state, outputs), _ = lax.scan(tick, (state, outputs),
-                                       jnp.arange(n_micro + pp - 1))
-        # outputs are valid only on the last stage → broadcast via psum.
-        mask = (idx == pp - 1).astype(outputs.dtype)
-        outputs = lax.psum(outputs * mask, PIPE_AXIS)
-        return outputs.reshape(x_local.shape)
+        def flush_tick(carry, t):
+            store, transit = carry
+            store, transit = drain_step(store, transit,
+                                        jnp.zeros_like(transit), t)
+            return (store, transit), None
+
+        (state, store, transit, aux_acc), _ = lax.scan(
+            tick, (state, store, transit, jnp.zeros((), jnp.float32)),
+            jnp.arange(compute_ticks))
+        # post-compute ticks flush the last in-flight items home
+        (store, transit), _ = lax.scan(
+            flush_tick, (store, transit),
+            jnp.arange(compute_ticks, total_ticks))
+        # gather every stage's store and restore batch order: microbatch o
+        # lives at (stage o % pp, row o // pp). fp32 across the collective —
+        # its VJP is a reduce-scatter, and a bf16 one aborts XLA CPU's
+        # AllReducePromotion pass.
+        gathered = lax.all_gather(store.astype(jnp.float32), PIPE_AXIS,
+                                  axis=0)                    # [pp, rows, ...]
+        o = np.arange(n_micro)
+        outputs = gathered[o % pp, o // pp].astype(store.dtype)
+        aux = lax.psum(aux_acc, PIPE_AXIS) / n_micro
+        return outputs.reshape(x_local.shape), aux
 
     from jax.sharding import PartitionSpec as P
 
     param_specs = jax.tree.map(lambda _: P(PIPE_AXIS), stage_params)
     extras_specs = jax.tree.map(lambda _: P(), extras)
-    return jax.shard_map(
+    out, aux = jax.shard_map(
         per_stage,
         mesh=topo.mesh,
         in_specs=(param_specs, P(), extras_specs),
-        out_specs=P(),
+        out_specs=(P(), P()),
         axis_names={PIPE_AXIS},
         check_vma=False,
-    )(stage_params, x, extras)
+        # the replicated activation boundary crosses in fp32: the VJP of a
+        # replicated bf16 input is a bf16 psum, which XLA CPU's
+        # AllReducePromotion pass aborts on (and fp32 boundary grads are
+        # what the embedding wants anyway)
+    )(stage_params, x.astype(jnp.float32), extras)
+    return out.astype(dtype), aux
