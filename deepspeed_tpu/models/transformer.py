@@ -70,6 +70,10 @@ class TransformerConfig:
     # "auto" | "einsum" | "sorted": [T,E,C] one-hot einsum dispatch vs
     # argsort-by-expert gather dispatch (auto switches on one-hot size)
     moe_dispatch: str = "auto"
+    # "1f1b" (training loss runs the interleaved schedule with O(pp) live
+    # microbatches, ref runtime/pipe/schedule.py:189) | "gpipe" (fill-drain
+    # forward scan differentiated by AD)
+    pipeline_schedule: str = "1f1b"
     moe_layer_freq: int = 2  # every Nth layer is MoE, matching ref PR-MoE style
     # pipeline parallelism: microbatches per forward call, i.e. per
     # gradient-accumulation micro-step (0 → pp size); must divide the
@@ -534,9 +538,7 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
 
-    x = params["embed"]["tokens"].astype(dt)[input_ids]
-    if cfg.has_learned_positions:
-        x = x + params["embed"]["positions"].astype(dt)[positions]
+    x = _embed(params, input_ids, positions, cfg)
 
     moe_every = max(1, cfg.moe_layer_freq)
 
@@ -695,6 +697,54 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
 MOE_AUX_COEF = 0.01
 
 
+def _nll_sum(logits32, labels_mb):
+    """Summed token NLL with -100 = ignore (HF convention)."""
+    m = labels_mb != -100
+    safe = jnp.where(m, labels_mb, 0)
+    logz = jax.nn.logsumexp(logits32, axis=-1)
+    gold = jnp.take_along_axis(logits32, safe[..., None], axis=-1)[..., 0]
+    return jnp.sum((logz - gold) * m)
+
+
+def _embed(params: Params, input_ids, positions, cfg: TransformerConfig):
+    """Embedding prologue shared by forward() and the 1F1B loss path."""
+    x = params["embed"]["tokens"].astype(cfg.dtype)[input_ids]
+    if cfg.has_learned_positions:
+        x = x + params["embed"]["positions"].astype(cfg.dtype)[positions]
+    return x
+
+
+def _pipeline_1f1b_loss(params, batch, cfg: TransformerConfig, topo,
+                        labels_eff, denom):
+    """Training loss through the 1F1B pipeline schedule (the head + NLL run
+    per microbatch on the last stage, ref runtime/pipe/engine.py:337)."""
+    from deepspeed_tpu.parallel.pipeline import make_pipeline_train_loss
+
+    input_ids = batch["input_ids"]
+    b, s = input_ids.shape
+    dt = cfg.dtype
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :],
+                                 (b, s))
+    x = _embed(params, input_ids, positions, cfg)
+
+    def tail_fn(tp, h, labels_mb):
+        h = _norm(h, tp["final_norm"], cfg)
+        w = tp["w"].astype(dt)
+        logits = h @ (w.T if cfg.tie_embeddings else w)
+        return _nll_sum(logits.astype(jnp.float32), labels_mb)
+
+    tail_params = {"final_norm": params["final_norm"],
+                   "w": params["embed"]["tokens"] if cfg.tie_embeddings
+                   else params["lm_head"]}
+    stage_fn = make_pipeline_stage_fn(cfg, topo)
+    n_micro = cfg.pipeline_microbatches or topo.pp_size
+    f = make_pipeline_train_loss(
+        stage_fn, tail_fn, topo, n_micro,
+        aux_coef=MOE_AUX_COEF if cfg.is_moe else 0.0)
+    return f(params["layers"], tail_params, x, labels_eff, positions,
+             denom)
+
+
 def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfig):
     """Causal LM cross-entropy. ``batch``: input_ids [B,S], labels [B,S]
     (-100 = ignore, HF convention), optional loss_mask, optional pld_theta
@@ -712,6 +762,23 @@ def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfi
 
     s = batch["input_ids"].shape[1]
     tiled = cfg.loss_tiles and s % cfg.loss_tiles == 0
+
+    from deepspeed_tpu.parallel.topology import get_topology
+
+    topo = get_topology()
+    if (topo is not None and topo.pp_size > 1
+            and cfg.pipeline_schedule == "1f1b" and not tiled
+            and batch.get("pld_theta") is None
+            and not (0 < cfg.ltd_kept < s)      # forward() raises for pp+LTD
+            # fp16 needs the dynamic loss scale inside the backward, but the
+            # 1F1B custom VJP computes grads in its forward before the scale
+            # cotangent exists — fp16 stays on the AD-differentiated GPipe
+            # path (bf16 shares f32's exponent range; no scaling needed)
+            and cfg.dtype != jnp.float16):
+        labels_eff = jnp.where(mask, labels, -100)
+        denom = jnp.maximum(mask.sum(), 1).astype(jnp.float32)
+        return _pipeline_1f1b_loss(params, batch, cfg, topo, labels_eff,
+                                   denom)
     out = forward(params, batch["input_ids"], cfg,
                   pld_theta=batch.get("pld_theta"), return_hidden=bool(tiled))
     moe_aux = jnp.zeros((), jnp.float32)
@@ -727,13 +794,9 @@ def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfi
                                     jnp.where(mask, labels, -100),
                                     cfg.loss_tiles)
     else:
-        safe_labels = jnp.where(mask, labels, 0)
-        logits32 = out.astype(jnp.float32)
-        logz = jax.nn.logsumexp(logits32, axis=-1)
-        gold = jnp.take_along_axis(logits32, safe_labels[..., None],
-                                   axis=-1)[..., 0]
-        nll = (logz - gold) * mask
-        loss = nll.sum() / jnp.maximum(mask.sum(), 1)
+        loss = _nll_sum(out.astype(jnp.float32),
+                        jnp.where(mask, labels, -100)) \
+            / jnp.maximum(mask.sum(), 1)
     if cfg.is_moe:
         loss = loss + MOE_AUX_COEF * moe_aux
     return loss

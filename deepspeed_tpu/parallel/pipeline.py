@@ -18,6 +18,14 @@ between stage processes, the whole pipeline is ONE ``shard_map`` over the
   each stage stores ``ceil(n_micro/pp)`` microbatches, drain traffic is
   one microbatch per tick, and a single all-gather at the end replaces
   the old full-buffer psum broadcast.
+* :func:`make_pipeline_train_loss` is the **1F1B** training schedule
+  (ref TrainSchedule, schedule.py:189): a custom-VJP loss whose forward
+  runs a host-precomputed interleaved F/B tick table and produces the
+  gradients itself (each backward tick re-linearizes its stage with
+  ``jax.vjp`` from an O(pp) input stash), so live activations are
+  bounded by pp microbatches per stage instead of n_micro — the defining
+  property of 1F1B — and the outer ``jax.grad`` merely rescales the
+  stashed grads.
 
 Other mesh axes (data/tensor/seq/expert) stay in GSPMD "auto" mode inside
 the shard_map (jax 0.9 ``axis_names``), so pipeline composes with ZeRO/DP/TP
@@ -202,3 +210,227 @@ def spmd_pipeline(layer_fn: Callable,
         # what the embedding wants anyway)
     )(stage_params, x.astype(jnp.float32), extras)
     return out.astype(dtype), aux
+
+
+# ----------------------------------------------------------------------
+# 1F1B training schedule
+# ----------------------------------------------------------------------
+def _make_1f1b_schedule(pp: int, m: int):
+    """Greedy B-priority 1F1B tick table (ref TrainSchedule,
+    runtime/pipe/schedule.py:189).
+
+    Each tick every stage does one unit of work: a Forward for its next
+    microbatch (if its predecessor's activation has arrived and fewer than
+    pp microbatches are in flight — the 1F1B stash bound) or, preferably, a
+    Backward (if the successor's cotangent has arrived; the last stage
+    needs only its own forward).  Returns ``(wt, wm)`` int32 ``[T, pp]``:
+    work type (0 idle / 1 fwd / 2 bwd) and microbatch index.
+    """
+    next_f = [0] * pp
+    next_b = [0] * pp
+    f_tick = [[-1] * m for _ in range(pp)]
+    b_tick = [[-1] * m for _ in range(pp)]
+    wt_rows, wm_rows = [], []
+    t = 0
+    while min(next_b) < m:
+        wt, wm = [0] * pp, [0] * pp
+        for s in range(pp):
+            ob, of = next_b[s], next_f[s]
+            can_b = ob < m and (
+                (s == pp - 1 and 0 <= f_tick[s][ob] < t)
+                or (s < pp - 1 and 0 <= b_tick[s + 1][ob] < t))
+            can_f = of < m and (of - next_b[s]) < pp and (
+                s == 0 or 0 <= f_tick[s - 1][of] < t)
+            if can_b:
+                wt[s], wm[s] = 2, ob
+                b_tick[s][ob] = t
+                next_b[s] += 1
+            elif can_f:
+                wt[s], wm[s] = 1, of
+                f_tick[s][of] = t
+                next_f[s] += 1
+        wt_rows.append(wt)
+        wm_rows.append(wm)
+        t += 1
+        if t > 4 * (m + pp) + 8:
+            raise RuntimeError("1F1B schedule did not converge")
+    return np.asarray(wt_rows, np.int32), np.asarray(wm_rows, np.int32)
+
+
+def make_pipeline_train_loss(stage_fn: Callable, tail_fn: Callable,
+                             topo: MeshTopology, n_micro: int,
+                             aux_coef: float = 0.0):
+    """Build the 1F1B pipelined training loss.
+
+    ``stage_fn(stage_params, h, extras_mb) -> (h, aux)`` applies one
+    stage's layers; ``tail_fn(tail_params, h, labels_mb) -> nll_sum``
+    computes the summed token NLL of one microbatch on the last stage's
+    output.  The returned callable
+
+        ``loss = f(stage_params, tail_params, x, labels, extras, denom)``
+
+    computes ``sum(nll)/denom + aux_coef * mean_micro(sum_stage(aux))``
+    with a custom VJP: its *forward* runs the interleaved 1F1B tick table
+    (so each stage keeps at most pp stashed microbatch inputs — O(pp)
+    live activations, vs the GPipe scan's O(n_micro) residuals) and
+    already produces the parameter/input gradients; the backward pass
+    just scales them by the incoming cotangent.  ``denom`` is the global
+    valid-token count (computable from labels before any compute).
+    """
+    pp = topo.pp_size
+    wt_np, wm_np = _make_1f1b_schedule(pp, n_micro)
+    ticks = wt_np.shape[0]
+    from jax.sharding import PartitionSpec as P
+
+    def _run(stage_params, tail_params, x, labels, extras, denom):
+        b = x.shape[0]
+        assert b % n_micro == 0
+        mb = b // n_micro
+        dtype = x.dtype
+
+        def per_stage(sp, tp, x_local, labels_local, extras_local):
+            idx = lax.axis_index(PIPE_AXIS)
+            micro = x_local.reshape((n_micro, mb) + x_local.shape[1:])
+            lab_micro = labels_local.reshape((n_micro, mb)
+                                             + labels_local.shape[1:])
+            ex_micro = jax.tree.map(
+                lambda e: e.reshape((n_micro, mb) + e.shape[1:]),
+                extras_local)
+            wt = jnp.asarray(wt_np)
+            wm = jnp.asarray(wm_np)
+            hshape = (mb,) + x_local.shape[1:]
+            fperm = [(i, (i + 1) % pp) for i in range(pp)]
+            bperm = [(i, (i - 1) % pp) for i in range(pp)]
+
+            carry = dict(
+                arr_f=jnp.zeros((pp,) + hshape, dtype),   # arrived activations
+                arr_b=jnp.zeros((pp,) + hshape, dtype),   # arrived cotangents
+                a_in=jnp.zeros((pp,) + hshape, dtype),    # 1F1B input stash
+                state_f=jnp.zeros(hshape, dtype),
+                state_b=jnp.zeros(hshape, dtype),
+                g_sp=jax.tree.map(jnp.zeros_like, sp),
+                g_tp=jax.tree.map(jnp.zeros_like, tp),
+                dx=jnp.zeros((n_micro,) + hshape, jnp.float32),
+                nll=jnp.zeros((), jnp.float32),
+                aux=jnp.zeros((), jnp.float32),
+            )
+
+            def tick(c, t):
+                # deliver last tick's ring arrivals per the schedule
+                left = jnp.clip(idx - 1, 0, pp - 1)
+                right = jnp.clip(idx + 1, 0, pp - 1)
+                tm1 = jnp.maximum(t - 1, 0)
+                got_f = (t > 0) & (idx > 0) & (wt[tm1, left] == 1)
+                got_b = (t > 0) & (idx < pp - 1) & (wt[tm1, right] == 2)
+                sf = wm[tm1, left] % pp
+                sb = wm[tm1, right] % pp
+                arr_f = c["arr_f"].at[sf].set(
+                    jnp.where(got_f, c["state_f"], c["arr_f"][sf]))
+                arr_b = c["arr_b"].at[sb].set(
+                    jnp.where(got_b, c["state_b"], c["arr_b"][sb]))
+
+                my_wt = wt[t, idx]
+                my_m = wm[t, idx]
+                slot = my_m % pp
+                x_mb = micro[my_m]
+                lab_mb = lab_micro[my_m]
+                ex_mb = jax.tree.map(lambda e: e[my_m], ex_micro)
+                h_f_in = jnp.where(idx == 0, x_mb, arr_f[slot])
+
+                def idle(op):
+                    a_in, g_sp, g_tp, dx, nll, aux = op
+                    return (jnp.zeros(hshape, dtype), jnp.zeros(hshape, dtype),
+                            a_in, g_sp, g_tp, dx, nll, aux)
+
+                def fwd_work(op):
+                    a_in, g_sp, g_tp, dx, nll, aux = op
+                    a_in = a_in.at[slot].set(h_f_in)
+                    h_out, _ = stage_fn(sp, h_f_in, ex_mb)
+                    return (h_out.astype(dtype), jnp.zeros(hshape, dtype),
+                            a_in, g_sp, g_tp, dx, nll, aux)
+
+                def bwd_work(op):
+                    a_in, g_sp, g_tp, dx, nll, aux = op
+                    h_in = a_in[slot]
+                    last_stage = idx == pp - 1
+
+                    def stage_plus(sp_, tp_, h_):
+                        h_out, aux_ = stage_fn(sp_, h_, ex_mb)
+                        # the [mb,S,V] head projection + NLL only exists on
+                        # the last stage; other stages skip it entirely
+                        # (no collectives inside, so cond is safe here)
+                        nll_ = lax.cond(
+                            last_stage,
+                            lambda h: tail_fn(tp_, h, lab_mb),
+                            lambda h: jnp.zeros((), jnp.float32),
+                            h_out)
+                        return h_out, aux_, nll_
+
+                    (h_out, aux_v, nll_v), pull = jax.vjp(
+                        stage_plus, sp, tp, h_in)
+                    last = idx == pp - 1
+                    d_h = jnp.where(last, jnp.zeros_like(h_out),
+                                    arr_b[slot].astype(h_out.dtype))
+                    d_aux = jnp.asarray(aux_coef / n_micro, aux_v.dtype)
+                    d_nll = jnp.where(last, 1.0 / denom,
+                                      0.0).astype(nll_v.dtype)
+                    d_sp, d_tp, d_hin = pull((d_h, d_aux, d_nll))
+                    g_sp = jax.tree.map(jnp.add, g_sp, d_sp)
+                    g_tp = jax.tree.map(jnp.add, g_tp, d_tp)
+                    dx = dx.at[my_m].set(
+                        jnp.where(idx == 0, d_hin.astype(jnp.float32),
+                                  dx[my_m]))
+                    nll = nll + jnp.where(last, nll_v.astype(jnp.float32), 0.0)
+                    aux = aux + aux_v.astype(jnp.float32)
+                    return (jnp.zeros(hshape, dtype), d_hin.astype(dtype),
+                            a_in, g_sp, g_tp, dx, nll, aux)
+
+                op = (c["a_in"], c["g_sp"], c["g_tp"], c["dx"], c["nll"],
+                      c["aux"])
+                send_f, send_b, a_in, g_sp, g_tp, dx, nll, aux = lax.switch(
+                    my_wt, [idle, fwd_work, bwd_work], op)
+                return dict(
+                    arr_f=arr_f, arr_b=arr_b, a_in=a_in,
+                    state_f=lax.ppermute(send_f, PIPE_AXIS, fperm),
+                    state_b=lax.ppermute(send_b, PIPE_AXIS, bperm),
+                    g_sp=g_sp, g_tp=g_tp, dx=dx, nll=nll, aux=aux), None
+
+            c, _ = lax.scan(tick, carry, jnp.arange(ticks))
+            nll = lax.psum(c["nll"], PIPE_AXIS)          # last stage only
+            aux = lax.psum(c["aux"], PIPE_AXIS) / n_micro
+            loss = nll / denom + aux_coef * aux
+            g_tp = jax.tree.map(lambda a: lax.psum(a, PIPE_AXIS), c["g_tp"])
+            dx = lax.psum(c["dx"], PIPE_AXIS)            # stage 0 only
+            return loss, c["g_sp"], g_tp, dx.reshape(x_local.shape)
+
+        sp_specs = jax.tree.map(lambda _: P(PIPE_AXIS), stage_params)
+        tp_specs = jax.tree.map(lambda _: P(), tail_params)
+        ex_specs = jax.tree.map(lambda _: P(), extras)
+        return jax.shard_map(
+            per_stage,
+            mesh=topo.mesh,
+            in_specs=(sp_specs, tp_specs, P(), P(), ex_specs),
+            out_specs=(P(), sp_specs, tp_specs, P()),
+            axis_names={PIPE_AXIS},
+            check_vma=False,
+        )(stage_params, tail_params, x, labels, extras)
+
+    @jax.custom_vjp
+    def f(stage_params, tail_params, x, labels, extras, denom):
+        return _run(stage_params, tail_params, x, labels, extras, denom)[0]
+
+    def f_fwd(stage_params, tail_params, x, labels, extras, denom):
+        loss, g_sp, g_tp, dx = _run(stage_params, tail_params, x, labels,
+                                    extras, denom)
+        return loss, (g_sp, g_tp, dx.astype(x.dtype))
+
+    def f_bwd(res, g):
+        g_sp, g_tp, dx = res
+
+        def scale(tree):
+            return jax.tree.map(lambda a: (a * g).astype(a.dtype), tree)
+
+        return (scale(g_sp), scale(g_tp), scale(dx), None, None, None)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f
