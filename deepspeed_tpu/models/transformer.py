@@ -74,6 +74,10 @@ class TransformerConfig:
     # microbatches, ref runtime/pipe/schedule.py:189) | "gpipe" (fill-drain
     # forward scan differentiated by AD)
     pipeline_schedule: str = "1f1b"
+    # ZeRO-Infinity: stacked layer params live in pinned host memory and
+    # stream one layer at a time through the scan, fwd and bwd
+    # (runtime/infinity.py; set by the engine from offload_param config)
+    param_stream: bool = False
     moe_layer_freq: int = 2  # every Nth layer is MoE, matching ref PR-MoE style
     # pipeline parallelism: microbatches per forward call, i.e. per
     # gradient-accumulation micro-step (0 → pp size); must divide the
@@ -555,6 +559,10 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
         if 0 < cfg.ltd_kept < s:
             raise NotImplementedError(
                 "random-LTD + pipeline parallelism not supported")
+        if cfg.param_stream:
+            raise NotImplementedError(
+                "param streaming + pipeline parallelism not supported "
+                "(the pipe axis already partitions layers pp-ways)")
         from deepspeed_tpu.parallel.pipeline import spmd_pipeline
 
         stage_fn = make_pipeline_stage_fn(cfg, topo)
@@ -599,6 +607,46 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
             aux0 = jnp.zeros((), jnp.float32)
             head = min((-idx0) % f, n_layers)
             mid = (n_layers - head) // f * f
+
+            if cfg.param_stream:
+                # ZeRO-Infinity: layer slices stream host→device inside the
+                # scan; the custom VJP (runtime/infinity.streamed_scan)
+                # parks each layer's gradient back to a host accumulator so
+                # neither params nor their grads are ever device-resident in
+                # full. Placement must be static end to end.
+                if head or mid != n_layers:
+                    raise NotImplementedError(
+                        "param streaming requires moe_layer_freq-aligned "
+                        "segments (no random-LTD bands)")
+                if pld_theta is not None:
+                    raise NotImplementedError(
+                        "param streaming + progressive layer drop "
+                        "not supported")
+                from deepspeed_tpu.runtime.infinity import streamed_scan
+
+                if f > 1:
+                    steps = n_layers // f
+                    stacked = jax.tree.map(
+                        lambda p: p.reshape((steps, f) + p.shape[1:]),
+                        layers_slice)
+                else:
+                    stacked = layers_slice
+
+                def step_fn(lp, h, pos_, i):
+                    aux_acc = jnp.zeros((), jnp.float32)
+                    if f > 1:
+                        for j in range(f):
+                            sub = jax.tree.map(lambda p, j=j: p[j], lp)
+                            h, aux = transformer_layer(
+                                h, sub, pos_, cfg, layer_is_moe=(j == f - 1))
+                            aux_acc = aux_acc + aux
+                    else:
+                        h, aux = transformer_layer(
+                            h, lp, pos_, cfg, layer_is_moe=cfg.is_moe)
+                        aux_acc = aux_acc + aux
+                    return h, aux_acc
+
+                return streamed_scan(step_fn, stacked, x, extras=pos)
             # head/tail: static global indices → static MoE placement
             def run_unrolled(x, aux, lo, hi):
                 for j in range(lo, hi):
@@ -768,6 +816,7 @@ def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfi
     topo = get_topology()
     if (topo is not None and topo.pp_size > 1
             and cfg.pipeline_schedule == "1f1b" and not tiled
+            and not cfg.param_stream   # forward() raises for pp+streaming
             and batch.get("pld_theta") is None
             and not (0 < cfg.ltd_kept < s)      # forward() raises for pp+LTD
             # fp16 needs the dynamic loss scale inside the backward, but the

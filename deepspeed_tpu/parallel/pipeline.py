@@ -417,7 +417,16 @@ def make_pipeline_train_loss(stage_fn: Callable, tail_fn: Callable,
 
     @jax.custom_vjp
     def f(stage_params, tail_params, x, labels, extras, denom):
-        return _run(stage_params, tail_params, x, labels, extras, denom)[0]
+        # loss-only (non-differentiated) calls — e.g. eval_batch — take the
+        # plain GPipe forward instead of paying the full fwd+bwd tick table;
+        # mathematically identical: tail NLL is per-token additive, and
+        # spmd_pipeline's aux is the same psum/n_micro statistic
+        def wrap(sp, h, ex):
+            return stage_fn(sp, h, ex)
+
+        h, aux = spmd_pipeline(wrap, stage_params, x, topo=topo,
+                               n_micro=n_micro, extras=extras)
+        return tail_fn(tail_params, h, labels) / denom + aux_coef * aux
 
     def f_fwd(stage_params, tail_params, x, labels, extras, denom):
         loss, g_sp, g_tp, dx = _run(stage_params, tail_params, x, labels,

@@ -138,6 +138,17 @@ class DeepSpeedEngine:
         self.train_batch_size_value = cfg.train_batch_size
         self.seed = seed if seed is not None else cfg.seed
 
+        # -- ZeRO-Infinity param streaming (decided before the model config
+        # freezes: the loss fn must compile the streamed layer scan) -------
+        off_param = cfg.zero_config.offload_param
+        self._param_stream = bool(
+            off_param and off_param.device in ("cpu", "nvme")
+            and isinstance(model, TransformerConfig))
+        if off_param and off_param.device in ("cpu", "nvme") \
+                and not isinstance(model, TransformerConfig):
+            logger.warning("offload_param requires the built-in transformer "
+                           "model; params stay in device memory")
+
         # -- model ------------------------------------------------------
         self.model_config: Optional[TransformerConfig] = None
         if isinstance(model, TransformerConfig):
@@ -154,6 +165,8 @@ class DeepSpeedEngine:
                             else mc.remat_policy)
             if cfg.pipeline.num_microbatches:
                 mc = mc.replace(pipeline_microbatches=cfg.pipeline.num_microbatches)
+            if self._param_stream:
+                mc = mc.replace(param_stream=True)
             self.model_config = mc
             self._init_fn = partial(tf_model.init_params, mc)
             self._loss_fn = partial(tf_model.loss_fn, cfg=mc)
@@ -193,9 +206,47 @@ class DeepSpeedEngine:
 
         params_treedef = jax.tree_util.tree_structure(params_shape)
         opt_param_shardings = self.rules.optimizer_shardings(params_shape)
-        opt_state_shape = jax.eval_shape(self.optimizer.init, params_shape)
-        self.opt_shardings = _match_state_shardings(
-            opt_state_shape, params_treedef, opt_param_shardings, self._replicated)
+        if self._param_stream:
+            # split the optimizer: the streamed layer partition's state
+            # lives host-resident and is stepped one layer-slice at a time
+            # (runtime/infinity.streamed_update); the small resident part
+            # (embed/norm/head) keeps the normal device update.  On
+            # backends without memory kinds (the CPU test mesh) the
+            # streaming code path still runs; placement is a no-op.
+            from deepspeed_tpu.runtime.offload import (host_offload_supported,
+                                                       with_memory_kind)
+
+            self._host_kinds = host_offload_supported(topology)
+
+            def hostify(sh):
+                return with_memory_kind(sh, "pinned_host") \
+                    if self._host_kinds else sh
+
+            res_shape = {k: v for k, v in params_shape.items()
+                         if k != "layers"}
+            res_treedef = jax.tree_util.tree_structure(res_shape)
+            res_param_sh = {k: v for k, v in opt_param_shardings.items()
+                            if k != "layers"}
+            res_state_shape = jax.eval_shape(self.optimizer.init, res_shape)
+            layers_treedef = jax.tree_util.tree_structure(
+                params_shape["layers"])
+            layers_state_shape = jax.eval_shape(self.optimizer.init,
+                                                params_shape["layers"])
+            self.opt_shardings = {
+                "resident": _match_state_shardings(
+                    res_state_shape, res_treedef, res_param_sh,
+                    self._replicated),
+                "stream": hostify(_match_state_shardings(
+                    layers_state_shape, layers_treedef,
+                    opt_param_shardings["layers"], self._replicated)),
+            }
+            opt_state_shape = {"resident": res_state_shape,
+                               "stream": layers_state_shape}
+        else:
+            opt_state_shape = jax.eval_shape(self.optimizer.init, params_shape)
+            self.opt_shardings = _match_state_shardings(
+                opt_state_shape, params_treedef, opt_param_shardings,
+                self._replicated)
 
         # -- ZeRO-Offload / -Infinity tiering --------------------------
         # Two realisations (runtime/offload.py): streaming mode keeps opt
@@ -206,7 +257,12 @@ class DeepSpeedEngine:
         self._opt_stream_offload = False
         self._opt_device_shardings = self.opt_shardings
         off_opt = cfg.zero_config.offload_optimizer
-        if off_opt and off_opt.device == "cpu":
+        if off_opt and off_opt.device == "cpu" and self._param_stream:
+            # the streamed layer partition's opt state is already
+            # host-resident and slice-stepped; nothing extra to offload
+            log_dist("ZeRO-Offload: opt state host placement subsumed by "
+                     "param streaming")
+        elif off_opt and off_opt.device == "cpu":
             from deepspeed_tpu.runtime.offload import (HostOptimizerStore,
                                                        host_offload_supported,
                                                        partial_offload_shardings)
@@ -220,11 +276,12 @@ class DeepSpeedEngine:
             else:
                 self._opt_store = HostOptimizerStore()
                 log_dist("ZeRO-Offload: opt state → host-store (numpy) mode")
-        off_param = cfg.zero_config.offload_param
-        if off_param and off_param.device == "nvme":
-            logger.warning("offload_param.device='nvme' is not yet supported on TPU; "
-                           "params stay in HBM (use offload_optimizer nvme instead)")
-        if off_param and off_param.device == "cpu":
+        self._param_store = None
+        if off_param and off_param.device in ("cpu", "nvme") \
+                and not self._param_stream:
+            # custom (non-TransformerConfig) models can't stream the layer
+            # scan; keep the coarse whole-tree host placement (XLA bulk-
+            # transfers params into the step)
             from deepspeed_tpu.runtime.offload import (host_offload_supported,
                                                        with_memory_kind)
 
@@ -232,13 +289,45 @@ class DeepSpeedEngine:
                 self.param_shardings = with_memory_kind(self.param_shardings,
                                                         "pinned_host")
                 self.params = jax.device_put(self.params, self.param_shardings)
-                log_dist("ZeRO-Infinity: params → host RAM")
-            else:
-                log_dist("ZeRO-Infinity: param host offload unsupported on this "
-                         "backend; params stay on device")
+                log_dist("ZeRO-Infinity: params → host RAM (whole-tree)")
+        if self._param_stream:
+            # ZeRO-Infinity: the stacked layer weights live in pinned host
+            # memory and are streamed one layer at a time through the
+            # compiled step (models/transformer.py streamed scan_segment +
+            # runtime/infinity.py; ref partitioned_param_swapper.py:37)
+            layer_sh = hostify(self.param_shardings["layers"])
+            self.param_shardings = {**self.param_shardings,
+                                    "layers": layer_sh}
+            self.params = {**self.params,
+                           "layers": jax.device_put(self.params["layers"],
+                                                    layer_sh)}
+            log_dist("ZeRO-Infinity: layer params → host RAM, streamed "
+                     "layer-by-layer through the step")
+            if off_param.device == "nvme":
+                from deepspeed_tpu.runtime.offload import NVMeOptimizerSwapper
 
-        opt_init_jit = jax.jit(self.optimizer.init, out_shardings=self.opt_shardings)
-        self.opt_state = opt_init_jit(self.params)
+                swap_dir = off_param.nvme_path or os.path.join(
+                    os.environ.get("TMPDIR", "/tmp"), "dstpu_param_swap")
+                # the swapper is a generic AIO-backed tree store; between
+                # steps the layer weights live on NVMe, around each step
+                # they are staged through host RAM only
+                self._param_store = NVMeOptimizerSwapper(swap_dir,
+                                                         cfg.aio_config)
+                log_dist(f"ZeRO-Infinity: layer params → NVMe at {swap_dir}")
+
+        if self._param_stream:
+            res_params = {k: v for k, v in self.params.items()
+                          if k != "layers"}
+            opt_init_jit = jax.jit(
+                lambda lp, rp: {"stream": self.optimizer.init(lp),
+                                "resident": self.optimizer.init(rp)},
+                out_shardings={"stream": self.opt_shardings["stream"],
+                               "resident": self.opt_shardings["resident"]})
+            self.opt_state = opt_init_jit(self.params["layers"], res_params)
+        else:
+            opt_init_jit = jax.jit(self.optimizer.init,
+                                   out_shardings=self.opt_shardings)
+            self.opt_state = opt_init_jit(self.params)
 
         if off_opt and off_opt.device == "nvme":
             from deepspeed_tpu.runtime.offload import NVMeOptimizerSwapper
@@ -250,8 +339,15 @@ class DeepSpeedEngine:
         if self._opt_store is not None:
             self._opt_store.swap_out(self.opt_state)
             self.opt_state = None  # store is authoritative between steps
+        if self._param_store is not None:
+            self._param_store.swap_out(self.params["layers"])
+            self.params = {**self.params, "layers": None}
 
         self.grad_shardings = self.rules.grad_accum_shardings(params_shape)
+        if self._param_stream:
+            self.grad_shardings = {
+                **self.grad_shardings,
+                "layers": hostify(self.grad_shardings["layers"])}
 
         # -- precision / loss scaling ----------------------------------
         self.fp16_enabled = cfg.fp16.enabled
@@ -420,6 +516,20 @@ class DeepSpeedEngine:
         stream_offload = self._opt_stream_offload
         opt_device_shardings = self._opt_device_shardings
 
+        def ls_advance(finite, ls_state):
+            scale = ls_state["scale"]
+            skipped = ls_state["skipped"] + jnp.where(finite, 0, 1).astype(jnp.int32)
+            if ls_dynamic:
+                good = jnp.where(finite, ls_state["good_steps"] + 1, 0)
+                grow = good >= ls_window
+                new_scale = jnp.where(
+                    finite,
+                    jnp.where(grow, scale * 2.0, scale),
+                    jnp.maximum(scale * 0.5, ls_min))
+                good = jnp.where(grow, 0, good)
+                return {"scale": new_scale, "good_steps": good, "skipped": skipped}
+            return {**ls_state, "skipped": skipped}
+
         def apply_update(params, opt_state, grads, lr, ls_state):
             if stream_offload:
                 # ZeRO-Offload streaming: state arrives in host memory; move
@@ -445,19 +555,49 @@ class DeepSpeedEngine:
             new_opt = jax.tree.map(
                 lambda n, o: jnp.where(finite, n.astype(o.dtype), o), new_opt, opt_state)
 
-            skipped = ls_state["skipped"] + jnp.where(finite, 0, 1).astype(jnp.int32)
-            if ls_dynamic:
-                good = jnp.where(finite, ls_state["good_steps"] + 1, 0)
-                grow = good >= ls_window
-                new_scale = jnp.where(
-                    finite,
-                    jnp.where(grow, scale * 2.0, scale),
-                    jnp.maximum(scale * 0.5, ls_min))
-                good = jnp.where(grow, 0, good)
-                new_ls = {"scale": new_scale, "good_steps": good, "skipped": skipped}
-            else:
-                new_ls = {**ls_state, "skipped": skipped}
-            return new_params, new_opt, new_ls, grad_norm, finite
+            return new_params, new_opt, ls_advance(finite, ls_state), grad_norm, finite
+
+        def split_layers(tree):
+            return tree["layers"], {k: v for k, v in tree.items()
+                                    if k != "layers"}
+
+        def stream_apply_update(params, opt_state, g_layers, g_res, lr,
+                                ls_state):
+            """ZeRO-Infinity update: layer partition stepped slice-wise
+            against host-resident grads/params/opt-state; the small
+            resident partition (embed/norms/head) updated normally."""
+            from deepspeed_tpu.runtime.infinity import (streamed_sq_norm,
+                                                        streamed_update)
+
+            p_layers, p_res = split_layers(params)
+            scale = ls_state["scale"]
+            inv = 1.0 / (scale * gas)
+            g_res = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, g_res)
+            sq = streamed_sq_norm(g_layers) * inv * inv
+            sq = sq + sum(jnp.sum(g ** 2) for g in jax.tree.leaves(g_res))
+            grad_norm = jnp.sqrt(sq)
+            coef = jnp.float32(1.0)
+            if clip and clip > 0:
+                coef = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+                g_res = jax.tree.map(lambda g: g * coef, g_res)
+            finite = jnp.isfinite(grad_norm) if fp16 else jnp.bool_(True)
+
+            new_res, new_opt_res = opt.update(g_res, opt_state["resident"],
+                                              p_res, lr)
+            new_res = jax.tree.map(lambda n, o: jnp.where(finite, n, o),
+                                   new_res, p_res)
+            new_opt_res = jax.tree.map(
+                lambda n, o: jnp.where(finite, n.astype(o.dtype), o),
+                new_opt_res, opt_state["resident"])
+
+            new_layers, new_opt_stream = streamed_update(
+                opt.update, g_layers, opt_state["stream"], p_layers, lr,
+                scale=inv * coef, gate=finite)
+
+            new_params = {**new_res, "layers": new_layers}
+            new_opt = {"resident": new_opt_res, "stream": new_opt_stream}
+            return (new_params, new_opt, ls_advance(finite, ls_state),
+                    grad_norm, finite)
 
         def train_step(params, opt_state, ls_state, batch_stack, lr):
             """One full train batch: scan over gas micro-batches + update.
@@ -482,6 +622,36 @@ class DeepSpeedEngine:
                        "skipped": jnp.logical_not(finite)}
             return new_params, new_opt, new_ls, metrics
 
+        def stream_train_step(params, opt_state, ls_state, batch_stack, lr):
+            """ZeRO-Infinity train batch: the gas loop unrolls (static) so
+            layer gradients accumulate host-resident via slice-wise adds —
+            no full-size device gradient buffer ever exists."""
+            from deepspeed_tpu.runtime.infinity import streamed_tree_add
+
+            g_layers = None
+            g_res = None
+            loss_sum = jnp.float32(0.0)
+            for k in range(gas):
+                mb = jax.tree.map(lambda x, k=k: x[k], batch_stack)
+                loss, grads = micro_grads(params, mb, ls_state["scale"])
+                gl, gr = split_layers(grads)
+                gr = jax.tree.map(lambda g: g.astype(jnp.float32), gr)
+                g_layers = gl if g_layers is None \
+                    else streamed_tree_add(g_layers, gl)
+                g_res = gr if g_res is None \
+                    else jax.tree.map(jnp.add, g_res, gr)
+                loss_sum = loss_sum + loss
+            new_params, new_opt, new_ls, grad_norm, finite = \
+                stream_apply_update(params, opt_state, g_layers, g_res, lr,
+                                    ls_state)
+            metrics = {"loss": loss_sum / gas, "grad_norm": grad_norm,
+                       "loss_scale": ls_state["scale"],
+                       "skipped": jnp.logical_not(finite)}
+            return new_params, new_opt, new_ls, metrics
+
+        if self._param_stream:
+            train_step = stream_train_step
+
         state_out = (self.param_shardings, self.opt_shardings, self._replicated,
                      jax.tree.map(lambda _: self._replicated,
                                   {"loss": 0, "grad_norm": 0, "loss_scale": 0, "skipped": 0}))
@@ -492,6 +662,14 @@ class DeepSpeedEngine:
 
         def micro_step(params, grad_acc, batch, scale):
             loss, grads = micro_grads(params, batch, scale)
+            if self._param_stream:
+                from deepspeed_tpu.runtime.infinity import streamed_tree_add
+
+                gl, gr = split_layers(grads)
+                al, ar = split_layers(grad_acc)
+                ar = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                                  ar, gr)
+                return loss, {**ar, "layers": streamed_tree_add(al, gl)}
             grad_acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32), grad_acc, grads)
             grad_acc = lax.with_sharding_constraint(grad_acc, grad_shardings)
             return loss, grad_acc
@@ -501,8 +679,14 @@ class DeepSpeedEngine:
             out_shardings=(self._replicated, self.grad_shardings))
 
         def apply_step(params, opt_state, ls_state, grads, lr):
-            new_params, new_opt, new_ls, grad_norm, finite = apply_update(
-                params, opt_state, grads, lr, ls_state)
+            if self._param_stream:
+                gl, gr = split_layers(grads)
+                new_params, new_opt, new_ls, grad_norm, finite = \
+                    stream_apply_update(params, opt_state, gl, gr, lr,
+                                        ls_state)
+            else:
+                new_params, new_opt, new_ls, grad_norm, finite = apply_update(
+                    params, opt_state, grads, lr, ls_state)
             metrics = {"grad_norm": grad_norm, "loss_scale": ls_state["scale"],
                        "skipped": jnp.logical_not(finite)}
             return new_params, new_opt, new_ls, metrics
@@ -532,6 +716,22 @@ class DeepSpeedEngine:
             return
         self._opt_store.swap_out(opt_state)
         self.opt_state = None
+
+    def _swap_in_params(self) -> None:
+        """NVMe param tier (ZeRO-Infinity): stage the layer weights
+        NVMe → host pinned RAM for this step (ref
+        partitioned_param_swapper.py:37)."""
+        if self._param_store is None or self.params.get("layers") is not None:
+            return
+        layers = jax.device_put(self._param_store.swap_in(),
+                                self.param_shardings["layers"])
+        self.params = {**self.params, "layers": layers}
+
+    def _swap_out_params(self) -> None:
+        if self._param_store is None:
+            return
+        self._param_store.swap_out(self.params["layers"])
+        self.params = {**self.params, "layers": None}
 
     def offload_states(self, include=None) -> None:
         """Move params/optimizer state to host RAM (ref offload_states.py:90)."""
@@ -655,6 +855,7 @@ class DeepSpeedEngine:
         batch_stack = self._put_batch(batch_stack, stacked=True)
         lr = jnp.float32(self.lr_scheduler(self.global_steps))
         opt_state = self._swap_in_opt_state()
+        self._swap_in_params()
         if (self._flops_profiler is not None
                 and not self._flops_profiler.profile_done
                 and self.global_steps + 1 >= self.config.flops_profiler.profile_step):
@@ -665,6 +866,7 @@ class DeepSpeedEngine:
         self.params, opt_state, self.loss_scale_state, metrics = self._train_step_jit(
             self.params, opt_state, self.loss_scale_state, batch_stack, lr)
         self._swap_out_opt_state(opt_state)
+        self._swap_out_params()
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps_value
         self.lr_scheduler.step()
@@ -707,6 +909,7 @@ class DeepSpeedEngine:
         With XLA there is no separate autograd tape, so forward+backward fuse;
         ``backward`` is then bookkeeping only — same user-visible contract."""
         self.timers(FORWARD_GLOBAL_TIMER).start()
+        self._swap_in_params()
         if self._grad_buffer is None:
             zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32), self.params)
             self._grad_buffer = jax.device_put(zeros, self.grad_shardings)
@@ -736,9 +939,11 @@ class DeepSpeedEngine:
             return
         lr = jnp.float32(self.lr_scheduler(self.global_steps))
         opt_state = self._swap_in_opt_state()
+        self._swap_in_params()
         self.params, opt_state, self.loss_scale_state, metrics = self._apply_step_jit(
             self.params, opt_state, self.loss_scale_state, self._grad_buffer, lr)
         self._swap_out_opt_state(opt_state)
+        self._swap_out_params()
         self._grad_buffer = None
         self._micro_in_step = 0
         self.global_steps += 1
@@ -747,6 +952,7 @@ class DeepSpeedEngine:
         self.timers(STEP_GLOBAL_TIMER).stop()
 
     def eval_batch(self, batch: Batch) -> jnp.ndarray:
+        self._swap_in_params()
         batch = self._put_batch(batch, stacked=False)
         return self._eval_step_jit(self.params, batch)
 
@@ -820,6 +1026,7 @@ class DeepSpeedEngine:
 
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict] = None) -> None:
+        self._swap_in_params()  # NVMe param tier: stage layers for the save
         ce = self.checkpoint_engine
         if ce != "pickle":
             ce.save(self, save_dir, tag or f"global_step{self.global_steps}",

@@ -39,8 +39,15 @@ _HOST_OFFLOAD_PROBE: Dict[str, bool] = {}
 
 def host_offload_supported(topo) -> bool:
     """Compile-probe whether this backend supports pinned_host placement of
-    sharded arrays under SPMD (real TPUs: yes; the CPU test backend with
-    >1 device: no — XLA UNIMPLEMENTED). Cached per mesh shape."""
+    sharded arrays under SPMD (real TPUs: yes; the CPU test backend: no —
+    and behavioral probes are unreliable there, small programs fold the
+    placement annotations away while large ones abort at runtime, so the
+    platform gate in runtime/infinity.memory_kinds_supported decides
+    first). Cached per mesh shape."""
+    from deepspeed_tpu.runtime.infinity import memory_kinds_supported
+
+    if not memory_kinds_supported():
+        return False
     key = str(sorted(topo.sizes.items())) + str(jax.devices()[0].platform)
     if key in _HOST_OFFLOAD_PROBE:
         return _HOST_OFFLOAD_PROBE[key]
