@@ -146,8 +146,12 @@ class DeepSpeedEngine:
             and isinstance(model, TransformerConfig))
         if off_param and off_param.device in ("cpu", "nvme") \
                 and not isinstance(model, TransformerConfig):
-            logger.warning("offload_param requires the built-in transformer "
-                           "model; params stay in device memory")
+            logger.warning(
+                "layer-streamed offload_param requires the built-in "
+                "transformer model; falling back to whole-tree host "
+                "placement where supported (no NVMe store%s)"
+                % (" — device='nvme' degrades to host RAM"
+                   if off_param.device == "nvme" else ""))
 
         # -- model ------------------------------------------------------
         self.model_config: Optional[TransformerConfig] = None
@@ -312,7 +316,8 @@ class DeepSpeedEngine:
                 # steps the layer weights live on NVMe, around each step
                 # they are staged through host RAM only
                 self._param_store = NVMeOptimizerSwapper(swap_dir,
-                                                         cfg.aio_config)
+                                                         cfg.aio_config,
+                                                         prefix="param")
                 log_dist(f"ZeRO-Infinity: layer params → NVMe at {swap_dir}")
 
         if self._param_stream:
@@ -445,7 +450,8 @@ class DeepSpeedEngine:
         self._onebit = None
         self._onebit_state = None
         _dp_only = (self.topology.dp_size > 1 and self.topology.tp_size == 1
-                    and self.topology.pp_size == 1 and self.topology.sp_size == 1)
+                    and self.topology.pp_size == 1 and self.topology.sp_size == 1
+                    and not self._param_stream)
         if (cfg.optimizer is not None and _dp_only
                 and cfg.optimizer.type in ("onebitadam", "onebitlamb",
                                            "zerooneadam", "0/1adam")):
@@ -623,24 +629,29 @@ class DeepSpeedEngine:
             return new_params, new_opt, new_ls, metrics
 
         def stream_train_step(params, opt_state, ls_state, batch_stack, lr):
-            """ZeRO-Infinity train batch: the gas loop unrolls (static) so
-            layer gradients accumulate host-resident via slice-wise adds —
-            no full-size device gradient buffer ever exists."""
-            from deepspeed_tpu.runtime.infinity import streamed_tree_add
+            """ZeRO-Infinity train batch: layer gradients accumulate
+            host-resident via slice-wise adds — no full-size device
+            gradient buffer ever exists.  The gas loop is a lax.scan so the
+            compiled program stays O(1) in gradient_accumulation_steps."""
+            from deepspeed_tpu.runtime.infinity import streamed_tree_add, to_host
 
-            g_layers = None
-            g_res = None
-            loss_sum = jnp.float32(0.0)
-            for k in range(gas):
-                mb = jax.tree.map(lambda x, k=k: x[k], batch_stack)
+            p_layers, p_res = split_layers(params)
+            zeros_l = to_host(jax.tree.map(
+                lambda x: jnp.zeros(x.shape, jnp.float32), p_layers))
+            zeros_r = jax.tree.map(
+                lambda x: jnp.zeros(x.shape, jnp.float32), p_res)
+
+            def body(carry, mb):
+                g_layers, g_res, loss_acc = carry
                 loss, grads = micro_grads(params, mb, ls_state["scale"])
                 gl, gr = split_layers(grads)
-                gr = jax.tree.map(lambda g: g.astype(jnp.float32), gr)
-                g_layers = gl if g_layers is None \
-                    else streamed_tree_add(g_layers, gl)
-                g_res = gr if g_res is None \
-                    else jax.tree.map(jnp.add, g_res, gr)
-                loss_sum = loss_sum + loss
+                g_res = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
+                                     g_res, gr)
+                g_layers = streamed_tree_add(g_layers, gl)
+                return (g_layers, g_res, loss_acc + loss), None
+
+            (g_layers, g_res, loss_sum), _ = lax.scan(
+                body, (zeros_l, zeros_r, jnp.float32(0.0)), batch_stack)
             new_params, new_opt, new_ls, grad_norm, finite = \
                 stream_apply_update(params, opt_state, g_layers, g_res, lr,
                                     ls_state)

@@ -69,6 +69,11 @@ def _put(x, space):
     return jax.device_put(x, space) if memory_kinds_supported() else x
 
 
+def split_layers(tree):
+    """Split an engine param-style dict into (layers, resident) partitions."""
+    return tree["layers"], {k: v for k, v in tree.items() if k != "layers"}
+
+
 def to_host(tree):
     """Place a pytree in host memory (inside or outside jit)."""
     return jax.tree.map(lambda x: _put(x, HOST), tree)
@@ -155,6 +160,13 @@ def streamed_scan(step_fn: Callable, stacked_host, h0, extras=()):
 
         (dh0, gacc), _ = lax.scan(body, (dh_out, gacc),
                                   jnp.arange(steps - 1, -1, -1))
+        # accumulation runs in fp32; the cotangent handed back to JAX must
+        # match the primal dtype (custom_vjp checks avals), so cast at the
+        # boundary for non-fp32 parameter trees
+        gacc = jax.tree.map(
+            lambda g, p: g if g.dtype == p.dtype else _put(
+                g.astype(p.dtype), HOST),
+            gacc, stacked_host)
         return gacc, dh0, None
 
     run.defvjp(run_fwd, run_bwd)

@@ -126,11 +126,14 @@ class NVMeOptimizerSwapper:
     step while the write-back of the previous step drains (double buffer).
     """
 
-    def __init__(self, swap_dir: str, aio_config=None):
+    def __init__(self, swap_dir: str, aio_config=None, prefix: str = "opt"):
         from deepspeed_tpu.ops.aio import AsyncIOHandle
 
         os.makedirs(swap_dir, exist_ok=True)
         self.swap_dir = swap_dir
+        # distinct prefixes let the param tier and the optimizer tier share
+        # one NVMe mount (the canonical setup) without clobbering files
+        self.prefix = prefix
         cfg = aio_config
         self.handle = AsyncIOHandle(
             block_size=getattr(cfg, "block_size", 1 << 20),
@@ -140,7 +143,7 @@ class NVMeOptimizerSwapper:
         self._treedef = None
 
     def _leaf_path(self, idx: int) -> str:
-        return os.path.join(self.swap_dir, f"opt_leaf_{idx}.bin")
+        return os.path.join(self.swap_dir, f"{self.prefix}_leaf_{idx}.bin")
 
     def swap_out(self, opt_state) -> None:
         """Write opt state to NVMe (async) and record templates."""
@@ -182,8 +185,19 @@ def offload_states(engine, include: Optional[list] = None) -> None:
                                                        engine.opt_shardings, 1.0)
             engine.opt_state = jax.device_put(engine.opt_state, host_shardings)
     if "params" in include:
-        engine.params = jax.device_put(
-            engine.params, with_memory_kind(engine.param_shardings, "pinned_host"))
+        if getattr(engine, "_param_store", None) is not None \
+                and engine.params.get("layers") is None:
+            # NVMe param tier between steps: layers already off-device, but
+            # the resident partition (embed/norms/head) still needs the move
+            from deepspeed_tpu.runtime.infinity import split_layers
+
+            _, res = split_layers(engine.params)
+            _, res_sh = split_layers(engine.param_shardings)
+            res = jax.device_put(res, with_memory_kind(res_sh, "pinned_host"))
+            engine.params = {**res, "layers": None}
+        else:
+            engine.params = jax.device_put(
+                engine.params, with_memory_kind(engine.param_shardings, "pinned_host"))
     log_dist(f"offloaded states to host: {include}")
 
 
@@ -195,5 +209,8 @@ def reload_states(engine, include: Optional[list] = None) -> None:
         else:
             engine.opt_state = jax.device_put(engine.opt_state, engine.opt_shardings)
     if "params" in include:
+        if getattr(engine, "_param_store", None) is not None \
+                and engine.params.get("layers") is None:
+            engine._swap_in_params()  # NVMe → host staging at param_shardings
         engine.params = jax.device_put(engine.params, engine.param_shardings)
     log_dist(f"reloaded states to device: {include}")

@@ -159,6 +159,72 @@ def test_nvme_tier_micro_api_and_eval(tmp_path):
         topology._GLOBAL_TOPOLOGY = None
 
 
+def test_nvme_shared_mount_param_and_opt(tmp_path):
+    """Param tier and optimizer tier sharing ONE nvme_path (the canonical
+    DeepSpeed setup) must not clobber each other's files: the stores use
+    distinct file prefixes."""
+    model = get_model_config("gpt2-tiny")
+    rng = np.random.default_rng(7)
+    batches = [make_lm_batch(rng, 4, 32, model.vocab_size)] * 3
+    shared = str(tmp_path / "mount")
+    losses, eng = _train(model, _cfg(zero_optimization={
+        "stage": 0,
+        "offload_param": {"device": "nvme", "nvme_path": shared},
+        "offload_optimizer": {"device": "nvme", "nvme_path": shared}}),
+        batches)
+    assert eng._param_store is not None and eng._opt_store is not None
+    assert eng._param_store.prefix != eng._opt_store.prefix
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+    ref, _ = _train(model, _cfg(), batches)
+    np.testing.assert_allclose(ref, losses, rtol=2e-4, atol=2e-4)
+
+
+def test_offload_reload_states_with_nvme_param_tier(tmp_path):
+    """engine.offload_states()/reload_states() must not crash when the NVMe
+    param tier has parked the layers off-device (params['layers'] is None
+    between steps)."""
+    model = get_model_config("gpt2-tiny")
+    rng = np.random.default_rng(8)
+    batch = make_lm_batch(rng, 4, 32, model.vocab_size)
+    engine, _, _, _ = ds.initialize(model=model, config=_cfg(
+        zero_optimization={"stage": 0,
+                           "offload_param": {"device": "nvme",
+                                             "nvme_path": str(tmp_path)}}),
+        seed=9)
+    try:
+        assert engine.params["layers"] is None
+        engine.offload_states()          # must not raise on the None leaf
+        engine.reload_states()           # stages NVMe layers back in
+        assert engine.params["layers"] is not None
+        loss = float(np.asarray(engine.train_batch(batch)))
+        assert np.isfinite(loss)
+    finally:
+        from deepspeed_tpu.parallel import topology
+
+        topology._GLOBAL_TOPOLOGY = None
+
+
+def test_streamed_scan_bf16_params():
+    """Non-fp32 parameter trees: the custom VJP must hand back cotangents
+    in the primal dtype (accumulation still runs in fp32 internally)."""
+    L, H = 3, 16
+    key = jax.random.PRNGKey(5)
+    params = {"w": (jax.random.normal(key, (L, H, H), jnp.float32) * 0.1
+                    ).astype(jnp.bfloat16)}
+    x = jax.random.normal(key, (4, H), jnp.float32).astype(jnp.bfloat16)
+
+    def step_fn(lp, h, extras, i):
+        return jnp.tanh(h @ lp["w"]), jnp.zeros((), jnp.float32)
+
+    def loss_s(ph, x):
+        h, _ = inf.streamed_scan(step_fn, ph, x, extras=())
+        return jnp.mean(h.astype(jnp.float32) ** 2)
+
+    g = jax.jit(jax.grad(loss_s))(inf.to_host(params), x)
+    assert g["w"].dtype == jnp.bfloat16
+    assert np.all(np.isfinite(np.asarray(g["w"], dtype=np.float32)))
+
+
 def test_param_stream_plus_pipeline_raises():
     """offload_param + pipeline parallelism is an explicit
     NotImplementedError, on the 1F1B path too (it must not silently bypass
