@@ -29,7 +29,8 @@ def _lib():
             lib = load_op("ds_shm_comm", ["shm_comm/shm_comm.cpp"])
             lib.ds_shm_create.restype = ctypes.c_void_p
             lib.ds_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
-                                          ctypes.c_int, ctypes.c_int64]
+                                          ctypes.c_int, ctypes.c_int64,
+                                          ctypes.c_uint64, ctypes.c_int64]
             f32 = ctypes.POINTER(ctypes.c_float)
             lib.ds_shm_allreduce.restype = ctypes.c_int
             lib.ds_shm_allreduce.argtypes = [ctypes.c_void_p, f32,
@@ -61,7 +62,8 @@ class ShmComm:
     """Process group over POSIX shared memory (same-host ranks)."""
 
     def __init__(self, name: str, rank: int, world: int,
-                 max_elems: int = 1 << 20):
+                 max_elems: int = 1 << 20, nonce: Optional[int] = None,
+                 timeout_s: float = 60.0):
         lib = _lib()
         if lib is None:
             raise RuntimeError("shm comm native op unavailable")
@@ -70,10 +72,42 @@ class ShmComm:
         self.world = world
         # namespace per user+name so stale regions don't collide
         shm_name = f"/dstpu_{os.environ.get('USER', 'u')}_{name}"
+        # all ranks of one run must agree on the nonce, and it must differ
+        # from a crashed previous run's: the launcher exports one per job.
+        # Fallback for co-spawned workers: parent pid mixed with the
+        # parent's start time (stable across ranks, differs when the parent
+        # pid is recycled).  Caveat: a supervisor that respawns an
+        # identical job keeps the same parent — such setups must provide
+        # DSTPU_SHM_NONCE (or nonce=) for full stale-region safety.
+        if nonce is None:
+            env = os.environ.get("DSTPU_SHM_NONCE")
+            if env is not None:
+                nonce = int(env)
+            else:
+                nonce = os.getppid()
+                try:
+                    with open(f"/proc/{nonce}/stat", "rb") as f:
+                        starttime = int(f.read().rsplit(b") ", 1)[1].split()[19])
+                    nonce = (starttime << 22) | nonce
+                except (OSError, IndexError, ValueError):
+                    pass
+        self.nonce = nonce & 0xFFFFFFFFFFFFFFFF
+        if self.nonce == 0:
+            self.nonce = 1  # 0 is the in-progress-init sentinel
         self._h = lib.ds_shm_create(shm_name.encode(), rank, world,
-                                    max_elems * 4)
+                                    max_elems * 4, self.nonce,
+                                    int(timeout_s * 1e6))
         if not self._h:
-            raise RuntimeError(f"shm_open failed for {shm_name}")
+            if rank == 0:
+                raise RuntimeError(
+                    f"shm init failed for {shm_name}: could not create/map "
+                    f"the shared-memory region (is /dev/shm writable and "
+                    f"large enough?)")
+            raise RuntimeError(
+                f"shm init failed for {shm_name} (rank {rank}/{world}): "
+                f"rank 0 never published nonce {self.nonce} — if ranks are "
+                f"spawned from different parents, set DSTPU_SHM_NONCE to a "
+                f"shared per-job value")
 
     def allreduce(self, arr: np.ndarray) -> np.ndarray:
         arr = np.ascontiguousarray(arr, np.float32)

@@ -67,6 +67,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         rank_base, slots, world_size = 0, list(range(n)), n
 
     coord = f"{args.coordinator_addr}:{args.coordinator_port}"
+    # one shm nonce per job: distinguishes this run's shared-memory regions
+    # from a crashed predecessor's (comm/shm.py waits on it)
+    shm_nonce = str((os.getpid() << 20) | (int(time.time()) & 0xFFFFF))
     procs: List[subprocess.Popen] = []
     for local_rank, slot in enumerate(slots):
         rank = rank_base + local_rank
@@ -75,6 +78,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "DSTPU_COORDINATOR": coord,
             "DSTPU_NUM_PROCS": str(world_size),
             "DSTPU_PROC_ID": str(rank),
+            "DSTPU_SHM_NONCE": shm_nonce,
             # reference-compatible names (launch.py:182 area)
             "MASTER_ADDR": args.coordinator_addr,
             "MASTER_PORT": str(args.coordinator_port),

@@ -563,9 +563,7 @@ class DeepSpeedEngine:
 
             return new_params, new_opt, ls_advance(finite, ls_state), grad_norm, finite
 
-        def split_layers(tree):
-            return tree["layers"], {k: v for k, v in tree.items()
-                                    if k != "layers"}
+        from deepspeed_tpu.runtime.infinity import split_layers
 
         def stream_apply_update(params, opt_state, g_layers, g_res, lr,
                                 ls_state):

@@ -198,6 +198,9 @@ def offload_states(engine, include: Optional[list] = None) -> None:
         else:
             engine.params = jax.device_put(
                 engine.params, with_memory_kind(engine.param_shardings, "pinned_host"))
+            if getattr(engine, "_param_store", None) is not None:
+                # restore the between-steps invariant: NVMe is authoritative
+                engine._swap_out_params()
     log_dist(f"offloaded states to host: {include}")
 
 

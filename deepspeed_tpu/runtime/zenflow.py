@@ -191,6 +191,43 @@ class ZenFlowOptimizer:
         return params
 
     def state_dict(self) -> Dict[str, Any]:
+        """Complete optimizer state: host AND device moments, the partial
+        cold accumulator, and any un-landed pending delta — so a
+        save/resume continues the exact trajectory (hot-column Adam state
+        and in-flight cold work included)."""
         self.wait()
+        none_leaf = lambda x: x is None  # noqa: E731
+        to_np = lambda t: jax.tree.map(  # noqa: E731
+            lambda x: None if x is None else np.asarray(jax.device_get(x)),
+            t, is_leaf=none_leaf)
+        # host state is mutated IN PLACE by _cold_update — snapshot copies
+        # so later steps can't corrupt a saved checkpoint
+        copy_np = lambda t: jax.tree.map(np.copy, t)  # noqa: E731
         return {"step": self.step_count, "cold_updates": self.cold_updates,
-                "host_m": self._host_m, "host_v": self._host_v}
+                "cold_steps": self._cold_steps,
+                "host_m": copy_np(self._host_m),
+                "host_v": copy_np(self._host_v),
+                "cold_acc": copy_np(self._cold_acc),
+                "dev_m": to_np(self._dev_m), "dev_v": to_np(self._dev_v),
+                "pending_delta": None if self._pending_delta is None
+                else copy_np(self._pending_delta)}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.wait()
+        self.step_count = int(state["step"])
+        self.cold_updates = int(state["cold_updates"])
+        self._cold_steps = int(state.get("cold_steps", 0))
+        copy_np = lambda t: jax.tree.map(np.copy, t)  # noqa: E731
+        self._host_m = copy_np(state["host_m"])
+        self._host_v = copy_np(state["host_v"])
+        if "cold_acc" in state:
+            self._cold_acc = copy_np(state["cold_acc"])
+        none_leaf = lambda x: x is None  # noqa: E731
+        if "dev_m" in state:
+            to_dev = lambda t: jax.tree.map(  # noqa: E731
+                lambda x: None if x is None else jnp.asarray(x),
+                t, is_leaf=none_leaf)
+            self._dev_m = to_dev(state["dev_m"])
+            self._dev_v = to_dev(state["dev_v"])
+        pend = state.get("pending_delta")
+        self._pending_delta = None if pend is None else copy_np(pend)

@@ -105,6 +105,48 @@ def test_zenflow_overlap_thread_lands():
     assert (np.abs(w) > 0).mean() > 0.9  # cold columns landed too
 
 
+def test_zenflow_save_resume_trajectory_parity():
+    """state_dict/load_state_dict mid-run (including mid-interval partial
+    cold accumulator and device hot moments) must reproduce the
+    uninterrupted trajectory exactly (advisor finding: state_dict dropped
+    _dev_m/_dev_v and _cold_acc)."""
+    params, target, vg = _quadratic_problem()
+    kw = dict(lr=0.05, topk_ratio=0.25, update_interval=4, overlap=False)
+
+    # uninterrupted reference run
+    opt_ref = ZenFlowOptimizer(params, **kw)
+    p_ref = params
+    for _ in range(10):
+        _, g = vg(p_ref)
+        p_ref = opt_ref.step(p_ref, g)
+
+    # interrupted at step 6: mid-interval (6 % 4 != 0) so _cold_acc is
+    # partially filled and the device moments carry hot-column state
+    opt_a = ZenFlowOptimizer(params, **kw)
+    p = params
+    for _ in range(6):
+        _, g = vg(p)
+        p = opt_a.step(p, g)
+    sd = opt_a.state_dict()
+    assert sd["cold_steps"] == 2  # genuinely mid-interval
+    assert any(np.abs(x).sum() > 0 for x in
+               jax.tree_util.tree_leaves(sd["cold_acc"]))
+    # keep training opt_a past the snapshot: state_dict must be a deep
+    # copy, so these steps must NOT leak into sd
+    pa = p
+    for _ in range(2):
+        _, g = vg(pa)
+        pa = opt_a.step(pa, g)
+
+    opt_b = ZenFlowOptimizer(params, **kw)
+    opt_b.load_state_dict(sd)
+    for _ in range(4):
+        _, g = vg(p)
+        p = opt_b.step(p, g)
+    np.testing.assert_array_equal(np.asarray(p["w"]), np.asarray(p_ref["w"]))
+    np.testing.assert_array_equal(np.asarray(p["b"]), np.asarray(p_ref["b"]))
+
+
 def test_superoffload_matches_plain_adam():
     rng = np.random.default_rng(0)
     params = {"a": jnp.asarray(rng.standard_normal((8, 8)), jnp.float32),

@@ -51,6 +51,83 @@ def test_shm_collectives_across_processes(world):
         assert bc == 42.0
 
 
+def _stale_worker(rank, world, name, start_delay, q):
+    """Second-run worker: the shm region already holds a crashed previous
+    run's header (old nonce, world=1 barrier). Ranks must wait for THIS
+    run's nonce instead of racing into the stale barrier."""
+    import time
+
+    try:
+        time.sleep(start_delay)
+        comm = ShmComm(name, rank, world, max_elems=64, nonce=0xBEEF)
+        red = comm.allreduce(np.full(4, float(rank + 1), np.float32))
+        comm.close(unlink=(rank == 0))
+        q.put((rank, float(red[0])))
+    except Exception as e:
+        q.put((rank, f"ERR {e}"))
+
+
+def test_stale_region_relaunch():
+    """A crashed run leaves an initialized header behind; a relaunch with a
+    new nonce must re-initialize instead of racing into the stale barrier
+    (advisor finding: stale init_done race)."""
+    name = f"stale{os.getpid()}"
+    # "previous run": world=1, initializes the region, exits WITHOUT unlink
+    prev = ShmComm(name, 0, 1, max_elems=64, nonce=0xDEAD)
+    prev.allreduce(np.ones(4, np.float32))
+    prev.close(unlink=False)  # simulate crash: region persists, nonce=0xDEAD
+
+    world = 2
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    # non-root starts FIRST: with the old init_done flag it would have run
+    # straight into the stale world=1 barrier; with the nonce it waits
+    procs = [ctx.Process(target=_stale_worker,
+                         args=(r, world, name, 0.0 if r else 0.5, q))
+             for r in range(world)]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=60) for _ in range(world)]
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+    for rank, red in results:
+        assert red == 3.0, results  # 1 + 2
+
+
+def _bitwise_worker(rank, world, name, q):
+    try:
+        comm = ShmComm(name, rank, world, max_elems=64)
+        # values whose FP sum is order-sensitive: catastrophic cancellation
+        vals = np.array([1e8, 1.0, -1e8, 1e-8], np.float32) * (rank + 1)
+        red = comm.allreduce(vals.copy())
+        comm.close(unlink=(rank == 0))
+        q.put((rank, red.tobytes().hex()))
+    except Exception as e:
+        q.put((rank, f"ERR {e}"))
+
+
+def test_allreduce_bitwise_identical_across_ranks():
+    """All ranks must produce bitwise-identical allreduce results (fixed
+    summation order) — the grad-norm-agreement use case (advisor finding:
+    per-rank FP order divergence)."""
+    world = 4
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    name = f"bw{os.getpid()}"
+    procs = [ctx.Process(target=_bitwise_worker, args=(r, world, name, q))
+             for r in range(world)]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=60) for _ in range(world)]
+    for p in procs:
+        p.join(timeout=30)
+        assert p.exitcode == 0
+    hexes = {h for _, h in results}
+    assert not any(str(h).startswith("ERR") for h in hexes), results
+    assert len(hexes) == 1, f"rank results differ bitwise: {results}"
+
+
 def test_payload_too_large():
     comm = ShmComm(f"big{os.getpid()}", 0, 1, max_elems=8)
     comm.allreduce(np.ones(8, np.float32))  # fits
