@@ -25,22 +25,118 @@ from deepspeed_tpu.io.fast_file_writer import (FastFileWriter,
 from deepspeed_tpu.utils.logging import log_dist, logger
 
 
-def _flatten(tree, prefix: str) -> Dict[str, np.ndarray]:
-    out = {}
+def _leaf_name(prefix: str, path) -> str:
+    return prefix + "/" + "/".join(
+        str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
+def _shard_bounds(index, shape):
+    """Concrete [start, stop) bounds per dim from a shard's index slices."""
+    bounds = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        bounds.append([start, stop])
+    return bounds
+
+
+def _flatten(tree, prefix: str):
+    """Flatten a pytree into (entries, shard_index) writing only THIS
+    process's addressable data.  Multi-host rule: each process writes its
+    replica-0 addressable shards with their global bounding boxes; arrays
+    with no device shards (host numpy) are written whole by process 0.
+    Single-process, this degenerates to one full entry per leaf."""
+    entries: Dict[str, np.ndarray] = {}
+    index: Dict[str, Dict] = {}
+    proc = jax.process_index()
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        name = prefix + "/" + "/".join(
-            str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        out[name] = np.asarray(jax.device_get(leaf))
-    return out
+        name = _leaf_name(prefix, path)
+        if isinstance(leaf, jax.Array):
+            shape = leaf.shape
+            full = None
+            for k, sh in enumerate(leaf.addressable_shards):
+                if sh.replica_id != 0:
+                    continue
+                data = np.asarray(sh.data)
+                if data.shape == tuple(shape):
+                    full = data  # replicated / single-shard: one full entry
+                    break
+                ename = f"{name}@p{proc}s{k}"
+                entries[ename] = data
+                index[ename] = {"leaf": name, "shape": list(shape),
+                                "slices": _shard_bounds(sh.index, shape)}
+            if full is not None:
+                entries[name] = full
+        elif proc == 0:
+            entries[name] = np.asarray(leaf)
+    return entries, index
 
 
-def _unflatten_into(template, flat: Dict[str, np.ndarray], prefix: str):
+class _CheckpointReader:
+    """Lazy view over every process's tensor file + shard index in a
+    checkpoint dir: only the small JSON indices are read up front; entry
+    bytes are fetched on demand so a host never materializes more than one
+    leaf beyond what it keeps."""
+
+    def __init__(self, d: str):
+        import glob
+
+        from deepspeed_tpu.io.fast_file_writer import read_tensor_index
+
+        bins = sorted(glob.glob(os.path.join(d, "model_states*.bin")))
+        if not bins:
+            raise FileNotFoundError(f"no model_states*.bin under {d}")
+        self.entry_file: Dict[str, str] = {}
+        for b in bins:
+            for name in read_tensor_index(b):
+                self.entry_file[name] = b
+        self.shard_index: Dict[str, Dict] = {}
+        for j in sorted(glob.glob(os.path.join(d, "shard_index*.json"))):
+            with open(j) as f:
+                self.shard_index.update(json.load(f))
+        self.by_leaf: Dict[str, list] = {}
+        for ename, info in self.shard_index.items():
+            self.by_leaf.setdefault(info["leaf"], []).append((ename, info))
+
+    def has_prefix(self, prefix: str) -> bool:
+        p = prefix + "/"
+        return any(n.startswith(p) for n in self.entry_file) or any(
+            i["leaf"].startswith(p) for i in self.shard_index.values())
+
+    def _fetch(self, ename: str) -> np.ndarray:
+        return read_tensor_file(self.entry_file[ename], names={ename})[ename]
+
+    def read_leaf(self, name: str) -> np.ndarray:
+        if name in self.entry_file and name not in self.shard_index:
+            return self._fetch(name)
+        if name in self.by_leaf:
+            pieces = self.by_leaf[name]
+            shape = tuple(pieces[0][1]["shape"])
+            first = self._fetch(pieces[0][0])
+            arr = np.empty(shape, first.dtype)
+            covered = 0
+            for k, (ename, info) in enumerate(pieces):
+                data = first if k == 0 else self._fetch(ename)
+                sl = tuple(slice(a, b) for a, b in info["slices"])
+                arr[sl] = data
+                covered += data.size
+            if covered < arr.size:
+                raise ValueError(f"incomplete shards for '{name}': "
+                                 f"{covered}/{arr.size} elements")
+            return arr
+        raise KeyError(f"checkpoint missing entry '{name}'")
+
+
+def _load_tree(template, shardings, reader: _CheckpointReader, prefix: str):
+    """Rebuild ``template``'s structure, device_put-ting one leaf at a time
+    (host residency stays O(largest leaf), not O(model))."""
     paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    sh_leaves = jax.tree_util.tree_flatten(shardings)[0]
     leaves = []
-    for path, leaf in paths:
-        name = prefix + "/" + "/".join(
-            str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
-        leaves.append(flat[name].astype(leaf.dtype).reshape(np.shape(leaf)))
+    for (path, leaf), sh in zip(paths, sh_leaves):
+        arr = reader.read_leaf(_leaf_name(prefix, path))
+        arr = arr.astype(leaf.dtype).reshape(np.shape(leaf))
+        leaves.append(jax.device_put(arr, sh))
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
@@ -56,28 +152,61 @@ class FastCheckpointEngine:
 
     def _paths(self, save_dir: str, tag: str):
         d = os.path.join(save_dir, str(tag))
-        return d, os.path.join(d, "model_states.bin"), os.path.join(d, "meta.json")
+        # per-process files: multi-host processes on a shared FS must not
+        # clobber each other (only 'latest' and meta.json are rank-gated)
+        proc, nproc = jax.process_index(), jax.process_count()
+        stem = "model_states" if nproc == 1 else f"model_states_p{proc:03d}"
+        return (d, os.path.join(d, stem + ".bin"),
+                os.path.join(d, "meta.json"),
+                os.path.join(d, "shard_index.json" if nproc == 1
+                             else f"shard_index_p{proc:03d}.json"))
 
     def save(self, engine, save_dir: str, tag: str,
              client_state: Optional[Dict[str, Any]] = None) -> str:
-        d, bin_path, meta_path = self._paths(save_dir, tag)
+        import glob
+
+        d, bin_path, meta_path, idx_path = self._paths(save_dir, tag)
         os.makedirs(d, exist_ok=True)
+        # clear a previous save of this tag (possibly from a DIFFERENT
+        # process count — stale per-process files would otherwise be merged
+        # back in on load); process 0 cleans, everyone else waits
+        if jax.process_index() == 0:
+            for stale in (glob.glob(os.path.join(d, "model_states*.bin"))
+                          + glob.glob(os.path.join(d, "shard_index*.json"))):
+                os.unlink(stale)
+        if jax.process_count() > 1:
+            from deepspeed_tpu.comm import comm
+
+            comm.barrier()
         opt_tree = (engine.opt_state if getattr(engine, "_opt_store", None) is None
                     else engine._opt_store.swap_in())
-        tensors = _flatten(engine.params, "module")
+        tensors, shard_idx = _flatten(engine.params, "module")
         if opt_tree is not None:
-            tensors.update(_flatten(opt_tree, "optimizer"))
-        tensors.update(_flatten(engine.loss_scale_state, "loss_scale"))
+            t, i = _flatten(opt_tree, "optimizer")
+            tensors.update(t)
+            shard_idx.update(i)
+        t, i = _flatten(engine.loss_scale_state, "loss_scale")
+        tensors.update(t)
+        shard_idx.update(i)
         stats = write_tensor_file(bin_path, tensors, FastFileWriter,
                                   buffer_bytes=self.buffer_bytes)
-        meta = {"global_steps": engine.global_steps,
-                "micro_steps": engine.micro_steps,
-                "lr_scheduler": engine.lr_scheduler.state_dict(),
-                "client_state": client_state or {},
-                "mesh_sizes": dict(engine.topology.sizes),
-                "io_stats": stats}
-        with open(meta_path, "w") as f:
-            json.dump(meta, f)
+        if shard_idx or jax.process_count() > 1:
+            with open(idx_path, "w") as f:
+                json.dump(shard_idx, f)
+        if jax.process_index() == 0:
+            meta = {"global_steps": engine.global_steps,
+                    "micro_steps": engine.micro_steps,
+                    "lr_scheduler": engine.lr_scheduler.state_dict(),
+                    "client_state": client_state or {},
+                    "mesh_sizes": dict(engine.topology.sizes),
+                    "process_count": jax.process_count(),
+                    "io_stats": stats}
+            with open(meta_path, "w") as f:
+                json.dump(meta, f)
+        if jax.process_count() > 1:
+            from deepspeed_tpu.comm import comm
+
+            comm.barrier()  # every process's file must land before commit
         if jax.process_index() == 0:
             with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
                 f.write(str(tag))
@@ -94,23 +223,22 @@ class FastCheckpointEngine:
                 logger.warning(f"no {LATEST_FILE} in {load_dir}")
                 return None, {}
             tag = open(latest).read().strip()
-        d, bin_path, meta_path = self._paths(load_dir, tag)
-        flat = read_tensor_file(bin_path)
-        engine.params = jax.device_put(
-            _unflatten_into(engine.params, flat, "module"),
-            engine.param_shardings)
-        if load_optimizer_states and engine.opt_state is not None and any(
-                k.startswith("optimizer/") for k in flat):
-            engine.opt_state = jax.device_put(
-                _unflatten_into(engine.opt_state, flat, "optimizer"),
-                engine.opt_shardings)
+        d, bin_path, meta_path, _ = self._paths(load_dir, tag)
+        reader = _CheckpointReader(d)
+        engine.params = _load_tree(engine.params, engine.param_shardings,
+                                   reader, "module")
+        if load_optimizer_states and engine.opt_state is not None \
+                and reader.has_prefix("optimizer"):
+            engine.opt_state = _load_tree(engine.opt_state,
+                                          engine.opt_shardings, reader,
+                                          "optimizer")
         with open(meta_path) as f:
             meta = json.load(f)
         engine.global_steps = int(meta["global_steps"])
         engine.micro_steps = int(meta["micro_steps"])
         if load_lr_scheduler_states and meta.get("lr_scheduler"):
             engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
-        log_dist(f"fast checkpoint loaded: {bin_path}")
+        log_dist(f"fast checkpoint loaded: {d}")
         return bin_path, meta.get("client_state", {})
 
     def wait(self) -> None:  # synchronous engine
@@ -132,6 +260,14 @@ class DecoupledCheckpointEngine:
     def save(self, engine, save_dir: str, tag: str,
              client_state: Optional[Dict[str, Any]] = None) -> str:
         self.wait()
+        if jax.process_count() > 1:
+            # multi-host: the inner save runs collectives (cleanup barrier,
+            # commit barrier) that must not execute on a side thread racing
+            # the training stream, and the numpy snapshot below cannot hold
+            # non-addressable arrays — save synchronously instead
+            logger.warning("decoupled checkpointing is single-host only; "
+                           "falling back to a synchronous save")
+            return self.inner.save(engine, save_dir, tag, client_state)
 
         # Snapshot NOW (host copies) so training can mutate params while
         # the write is in flight — the decoupled contract.

@@ -33,6 +33,14 @@ def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
         from deepspeed_tpu.parallel.sharding import path_str
 
+        if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+            # ds_to_universal runs on process 0 only, so a cross-process
+            # gather here would hang — the converter's inputs must already
+            # be host-complete (the pickle engine allgathers at save time)
+            raise ValueError(
+                "universal converter got a non-fully-addressable array; "
+                "convert from a saved checkpoint (engine.save_checkpoint), "
+                "not from live multi-host state")
         flat[path_str(path)] = np.asarray(leaf)
     return flat
 
@@ -60,10 +68,19 @@ def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
     if tag is None:
         with open(os.path.join(ckpt_dir, LATEST_FILE)) as f:
             tag = f.read().strip()
+
+    out = output_dir or os.path.join(ckpt_dir, str(tag), "universal")
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        # each process's pickle holds the full (allgathered) state; one
+        # writer suffices on a shared FS — wait for process 0 to finish
+        from deepspeed_tpu.comm import comm
+
+        comm.barrier()
+        return out
+
     with open(_ckpt_path(ckpt_dir, tag), "rb") as f:
         state = pickle.load(f)
 
-    out = output_dir or os.path.join(ckpt_dir, str(tag), "universal")
     os.makedirs(os.path.join(out, "params"), exist_ok=True)
     os.makedirs(os.path.join(out, "optimizer"), exist_ok=True)
 
@@ -85,6 +102,10 @@ def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
     }
     with open(os.path.join(out, "meta.json"), "w") as f:
         json.dump(meta, f, indent=2)
+    if jax.process_count() > 1:
+        from deepspeed_tpu.comm import comm
+
+        comm.barrier()  # release the non-writer processes
     log_dist(f"universal checkpoint written: {out}")
     return out
 

@@ -176,13 +176,24 @@ def write_tensor_file(path: str, tensors: Dict[str, np.ndarray],
     return w.close()
 
 
-def read_tensor_file(path: str) -> Dict[str, np.ndarray]:
+def read_tensor_index(path: str) -> Dict[str, Any]:
+    """Read only the JSON index header of a tensor file."""
+    with open(path, "rb") as f:
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        return json.loads(f.read(hlen).decode())
+
+
+def read_tensor_file(path: str, names=None) -> Dict[str, np.ndarray]:
+    """Read a tensor file; with ``names`` given, read only those entries
+    (the index header + targeted seeks, not the whole file)."""
     with open(path, "rb") as f:
         (hlen,) = struct.unpack("<Q", f.read(8))
         index = json.loads(f.read(hlen).decode())
         base = 8 + hlen
         out = {}
         for name, meta in index.items():
+            if names is not None and name not in names:
+                continue
             f.seek(base + meta["offset"])
             raw = f.read(meta["nbytes"])
             out[name] = np.frombuffer(raw, dtype=np.dtype(meta["dtype"])
