@@ -80,16 +80,24 @@ class _CheckpointReader:
 
     def __init__(self, d: str):
         import glob
+        import struct
 
         from deepspeed_tpu.io.fast_file_writer import read_tensor_index
 
         bins = sorted(glob.glob(os.path.join(d, "model_states*.bin")))
         if not bins:
             raise FileNotFoundError(f"no model_states*.bin under {d}")
-        self.entry_file: Dict[str, str] = {}
+        # entry → (file, absolute offset, nbytes, dtype, shape); headers are
+        # parsed ONCE here, fetches are direct seeks
+        self.entry_meta: Dict[str, tuple] = {}
         for b in bins:
-            for name in read_tensor_index(b):
-                self.entry_file[name] = b
+            index = read_tensor_index(b)
+            with open(b, "rb") as f:
+                (hlen,) = struct.unpack("<Q", f.read(8))
+            base = 8 + hlen
+            for name, m in index.items():
+                self.entry_meta[name] = (b, base + m["offset"], m["nbytes"],
+                                         m["dtype"], m["shape"])
         self.shard_index: Dict[str, Dict] = {}
         for j in sorted(glob.glob(os.path.join(d, "shard_index*.json"))):
             with open(j) as f:
@@ -100,14 +108,18 @@ class _CheckpointReader:
 
     def has_prefix(self, prefix: str) -> bool:
         p = prefix + "/"
-        return any(n.startswith(p) for n in self.entry_file) or any(
+        return any(n.startswith(p) for n in self.entry_meta) or any(
             i["leaf"].startswith(p) for i in self.shard_index.values())
 
     def _fetch(self, ename: str) -> np.ndarray:
-        return read_tensor_file(self.entry_file[ename], names={ename})[ename]
+        path, off, nbytes, dtype, shape = self.entry_meta[ename]
+        with open(path, "rb") as f:
+            f.seek(off)
+            raw = f.read(nbytes)
+        return np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape).copy()
 
     def read_leaf(self, name: str) -> np.ndarray:
-        if name in self.entry_file and name not in self.shard_index:
+        if name in self.entry_meta and name not in self.shard_index:
             return self._fetch(name)
         if name in self.by_leaf:
             pieces = self.by_leaf[name]
@@ -180,33 +192,38 @@ class FastCheckpointEngine:
             comm.barrier()
         opt_tree = (engine.opt_state if getattr(engine, "_opt_store", None) is None
                     else engine._opt_store.swap_in())
-        tensors, shard_idx = _flatten(engine.params, "module")
-        if opt_tree is not None:
-            t, i = _flatten(opt_tree, "optimizer")
+        try:
+            tensors, shard_idx = _flatten(engine.params, "module")
+            if opt_tree is not None:
+                t, i = _flatten(opt_tree, "optimizer")
+                tensors.update(t)
+                shard_idx.update(i)
+            t, i = _flatten(engine.loss_scale_state, "loss_scale")
             tensors.update(t)
             shard_idx.update(i)
-        t, i = _flatten(engine.loss_scale_state, "loss_scale")
-        tensors.update(t)
-        shard_idx.update(i)
-        stats = write_tensor_file(bin_path, tensors, FastFileWriter,
-                                  buffer_bytes=self.buffer_bytes)
-        if shard_idx or jax.process_count() > 1:
-            with open(idx_path, "w") as f:
-                json.dump(shard_idx, f)
-        if jax.process_index() == 0:
-            meta = {"global_steps": engine.global_steps,
-                    "micro_steps": engine.micro_steps,
-                    "lr_scheduler": engine.lr_scheduler.state_dict(),
-                    "client_state": client_state or {},
-                    "mesh_sizes": dict(engine.topology.sizes),
-                    "process_count": jax.process_count(),
-                    "io_stats": stats}
-            with open(meta_path, "w") as f:
-                json.dump(meta, f)
-        if jax.process_count() > 1:
-            from deepspeed_tpu.comm import comm
+            stats = write_tensor_file(bin_path, tensors, FastFileWriter,
+                                      buffer_bytes=self.buffer_bytes)
+            if shard_idx or jax.process_count() > 1:
+                with open(idx_path, "w") as f:
+                    json.dump(shard_idx, f)
+            if jax.process_index() == 0:
+                meta = {"global_steps": engine.global_steps,
+                        "micro_steps": engine.micro_steps,
+                        "lr_scheduler": engine.lr_scheduler.state_dict(),
+                        "client_state": client_state or {},
+                        "mesh_sizes": dict(engine.topology.sizes),
+                        "process_count": jax.process_count(),
+                        "io_stats": stats}
+                with open(meta_path, "w") as f:
+                    json.dump(meta, f)
+        finally:
+            if jax.process_count() > 1:
+                # every process's file must land before the commit — and the
+                # barrier must be reached even if THIS process's write threw,
+                # or the healthy processes hang forever
+                from deepspeed_tpu.comm import comm
 
-            comm.barrier()  # every process's file must land before commit
+                comm.barrier()
         if jax.process_index() == 0:
             with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
                 f.write(str(tag))
@@ -239,7 +256,9 @@ class FastCheckpointEngine:
         if load_lr_scheduler_states and meta.get("lr_scheduler"):
             engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
         log_dist(f"fast checkpoint loaded: {d}")
-        return bin_path, meta.get("client_state", {})
+        # return the tag DIRECTORY: per-process bin names depend on the
+        # process count at save time, which may differ from now
+        return d, meta.get("client_state", {})
 
     def wait(self) -> None:  # synchronous engine
         pass

@@ -78,34 +78,40 @@ def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
         comm.barrier()
         return out
 
-    with open(_ckpt_path(ckpt_dir, tag), "rb") as f:
-        state = pickle.load(f)
+    try:
+        with open(_ckpt_path(ckpt_dir, tag), "rb") as f:
+            state = pickle.load(f)
 
-    os.makedirs(os.path.join(out, "params"), exist_ok=True)
-    os.makedirs(os.path.join(out, "optimizer"), exist_ok=True)
+        os.makedirs(os.path.join(out, "params"), exist_ok=True)
+        os.makedirs(os.path.join(out, "optimizer"), exist_ok=True)
 
-    params_flat = _flatten_with_paths(state["module"])
-    _save_flat(params_flat, os.path.join(out, "params"))
-    opt_flat = _flatten_with_paths(state["optimizer"])
-    _save_flat(opt_flat, os.path.join(out, "optimizer"))
+        params_flat = _flatten_with_paths(state["module"])
+        _save_flat(params_flat, os.path.join(out, "params"))
+        opt_flat = _flatten_with_paths(state["optimizer"])
+        _save_flat(opt_flat, os.path.join(out, "optimizer"))
 
-    meta = {
-        "global_steps": state.get("global_steps", 0),
-        "micro_steps": state.get("micro_steps", 0),
-        "lr_scheduler": state.get("lr_scheduler"),
-        "loss_scale_state": {k: float(np.asarray(v))
-                             for k, v in state.get("loss_scale_state", {}).items()},
-        "param_manifest": {k: list(v.shape) for k, v in params_flat.items()},
-        "opt_treedef_leaves": len(opt_flat),
-        "ds_config": state.get("ds_config", {}),
-        "source_mesh": state.get("mesh_sizes", {}),
-    }
-    with open(os.path.join(out, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=2)
-    if jax.process_count() > 1:
-        from deepspeed_tpu.comm import comm
+        meta = {
+            "global_steps": state.get("global_steps", 0),
+            "micro_steps": state.get("micro_steps", 0),
+            "lr_scheduler": state.get("lr_scheduler"),
+            "loss_scale_state": {k: float(np.asarray(v))
+                                 for k, v in state.get("loss_scale_state",
+                                                       {}).items()},
+            "param_manifest": {k: list(v.shape)
+                               for k, v in params_flat.items()},
+            "opt_treedef_leaves": len(opt_flat),
+            "ds_config": state.get("ds_config", {}),
+            "source_mesh": state.get("mesh_sizes", {}),
+        }
+        with open(os.path.join(out, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+    finally:
+        if jax.process_count() > 1:
+            # ALWAYS release the non-writer processes — a writer exception
+            # must raise on process 0, not hang processes 1..N in a barrier
+            from deepspeed_tpu.comm import comm
 
-        comm.barrier()  # release the non-writer processes
+            comm.barrier()
     log_dist(f"universal checkpoint written: {out}")
     return out
 

@@ -19,7 +19,8 @@ from deepspeed_tpu.ops.op_builder import load_op
 def _lib():
     lib = load_op("ds_aio", ["aio/ds_aio.cpp"])
     lib.ds_aio_create.restype = ctypes.c_void_p
-    lib.ds_aio_create.argtypes = [ctypes.c_long, ctypes.c_int, ctypes.c_int]
+    lib.ds_aio_create.argtypes = [ctypes.c_long, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int]
     lib.ds_aio_destroy.argtypes = [ctypes.c_void_p]
     lib.ds_aio_pread.restype = ctypes.c_int
     lib.ds_aio_pread.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_long,
@@ -31,6 +32,8 @@ def _lib():
     lib.ds_aio_wait.argtypes = [ctypes.c_void_p]
     lib.ds_aio_pending.restype = ctypes.c_long
     lib.ds_aio_pending.argtypes = [ctypes.c_void_p]
+    lib.ds_aio_direct_fallbacks.restype = ctypes.c_long
+    lib.ds_aio_direct_fallbacks.argtypes = [ctypes.c_void_p]
     return lib
 
 
@@ -38,12 +41,14 @@ class AsyncIOHandle:
     """Async pread/pwrite of numpy arrays through the native thread pool."""
 
     def __init__(self, block_size: int = 1 << 20, queue_depth: int = 8,
-                 thread_count: int = 4):
+                 thread_count: int = 4, use_direct: bool = False):
         self._lib = _lib()
-        self._h = self._lib.ds_aio_create(block_size, queue_depth, thread_count)
+        self._h = self._lib.ds_aio_create(block_size, queue_depth, thread_count,
+                                          1 if use_direct else 0)
         self.block_size = block_size
         self.queue_depth = queue_depth
         self.thread_count = thread_count
+        self.use_direct = use_direct
         # keep buffers alive while IO is in flight
         self._inflight_bufs = []
 
@@ -70,6 +75,11 @@ class AsyncIOHandle:
 
     def pending(self) -> int:
         return int(self._lib.ds_aio_pending(self._h))
+
+    def direct_fallbacks(self) -> int:
+        """O_DIRECT chunks that fell back to buffered I/O since last call
+        (non-zero means 'direct' timings measured the page cache)."""
+        return int(self._lib.ds_aio_direct_fallbacks(self._h))
 
     # sync conveniences (ref: aio_handle.read/write)
     def pwrite(self, array: np.ndarray, path: str, offset: int = 0) -> None:

@@ -351,6 +351,7 @@ class AIOConfig:
     single_submit: bool = False
     overlap_events: bool = True
     use_gds: bool = False
+    use_direct: bool = False  # O_DIRECT data path (bypass the page cache)
 
 
 class DeepSpeedConfig:

@@ -138,7 +138,8 @@ class NVMeOptimizerSwapper:
         self.handle = AsyncIOHandle(
             block_size=getattr(cfg, "block_size", 1 << 20),
             queue_depth=getattr(cfg, "queue_depth", 8),
-            thread_count=getattr(cfg, "thread_count", 4))
+            thread_count=getattr(cfg, "thread_count", 4),
+            use_direct=getattr(cfg, "use_direct", False))
         self._templates = None  # list of (path, shape, dtype)
         self._treedef = None
 
