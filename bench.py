@@ -1,40 +1,86 @@
 """Benchmark harness — runs on the real TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints one JSON line per row, with the PRIMARY row last (the driver
+records the last line; it carries the full row table under "rows").
 
-Benchmarks the ZeRO training engine end-to-end (train_batch: fwd+bwd+update
-in one compiled step) on a GPT-2-class model sized for a single v5e chip and
-reports model FLOPs throughput (MFU-style tokens/sec).  ``vs_baseline``
-compares against an A100 eager-torch reference rate for the same model class
-(the north star in BASELINE.md is tokens/sec/chip parity with A100+NCCL).
+Rows (BASELINE.json milestone configs scaled to one chip):
+  1. gpt2_350m_zero1   — end-to-end train_batch tokens/s (primary; the
+     north star is tokens/sec/chip parity with A100+NCCL ≈ 35k)
+  2. llama8b_class_zero3 — Llama-3-8B-geometry layers (full hidden 4096 /
+     GQA 32:8 / swiglu 14336) under ZeRO-3 specs, depth scaled to fit one
+     chip; tokens/s + MFU
+  3. peak_params_zero0 — largest GPT-class model trained (fwd+bwd+adam)
+     on one chip with full remat; metric = parameter count
+  4. v2_decode — inference v2 fused decode loop tokens/s (paged KV), vs
+     the reference FastGen's A100 llama-13B ~52 tok/s/seq class figure
+
+Pass --smoke for a tiny-shape CPU plumbing check (no numbers of record).
 """
 
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
 
+SMOKE = "--smoke" in sys.argv
 
-def main() -> None:
-    import jax
 
+def _sync(x) -> float:
+    # float() is a hard host sync — block_until_ready returns early under
+    # the axon relay, so sync via value fetch.
+    return float(np.asarray(x))
+
+
+def _reset_topology():
+    from deepspeed_tpu.parallel import topology
+
+    topology._GLOBAL_TOPOLOGY = None
+
+
+def _time_train(engine, batch, steps, warmup=3):
+    for _ in range(warmup):
+        loss = engine.train_batch(batch)
+    _sync(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch)
+    _sync(loss)
+    return time.perf_counter() - t0
+
+
+def _fwd_flops_per_tok(model, seq):
+    """Model fwd FLOPs/token: qkvo (GQA-aware) + ffn + lm_head + attn."""
+    h, L, V = model.hidden_size, model.num_layers, model.vocab_size
+    ffn = getattr(model, "intermediate_size", 4 * h)
+    act = 3 if getattr(model, "activation", "gelu") == "swiglu" else 2
+    heads = getattr(model, "num_heads", 1)
+    kv_heads = getattr(model, "num_kv_heads", None) or heads
+    qkvo = 2 * h * h + 2 * h * (h * kv_heads // heads)  # q,o + k,v (GQA)
+    matmul = L * (qkvo + act * h * ffn)
+    return 2 * matmul + 2 * h * V + 2 * seq * h * L
+
+
+def _mfu(tokens_per_sec, model, seq):
+    # ×3 for fwd+bwd, against the v5e bf16 peak of 197 TFLOP/s.
+    return tokens_per_sec * 3 * _fwd_flops_per_tok(model, seq) / 197e12
+
+
+def row_gpt2_350m():
+    """Primary row — unchanged config from rounds 1-2 for comparability."""
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import get_model_config
 
-    # GPT-2 350M-class, bf16, ZeRO-1, seq 1024 — fits one v5e chip.
-    # Tuned on-chip: repo-owned Pallas flash attention (ops/pallas/flash_mha,
-    # default) + dots_flash_saveable remat (save matmul outputs AND the
-    # flash kernel's o/lse residuals so the backward never re-runs the
-    # attention forward) + gas=8 to amortise the optimizer step.
-    # Measured ladder: 24.5k (xla attn, full remat) → 31.1k (library flash)
-    # → 34.5k (dots_saveable+gas8) → 38.1k (repo kernel) → ~39.9k
-    # (dots_flash_saveable).
-    model = get_model_config("gpt2-350m", max_seq_len=1024)
-    batch_size = 8
-    gas = 8
-    seq = 1024
+    if SMOKE:
+        model = get_model_config("gpt2-tiny")
+        batch_size, gas, seq, steps = 2, 2, 64, 2
+    else:
+        # Tuned on-chip: repo Pallas flash attention + dots_flash_saveable
+        # remat + gas=8. Ladder: 24.5k → 31.1k → 34.5k → 38.1k → ~40.8k.
+        model = get_model_config("gpt2-350m", max_seq_len=1024)
+        batch_size, gas, seq, steps = 8, 8, 1024, 8
     config = {
         "train_micro_batch_size_per_gpu": batch_size,
         "gradient_accumulation_steps": gas,
@@ -46,42 +92,187 @@ def main() -> None:
         "activation_checkpointing": {"remat_policy": "dots_flash_saveable"},
     }
     engine, _, _, _ = ds.initialize(model=model, config=config)
-
     rows = batch_size * gas
     rng = np.random.default_rng(0)
     ids = rng.integers(0, model.vocab_size, size=(rows, seq + 1), dtype=np.int32)
     batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
-
-    # warmup (compile); float() is a hard host sync — block_until_ready
-    # returns early under the axon relay, so sync via value fetch.
-    for _ in range(3):
-        loss = engine.train_batch(batch)
-    float(np.asarray(loss))
-
-    steps = 8
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = engine.train_batch(batch)
-    float(np.asarray(loss))
-    dt = time.perf_counter() - t0
-
-    tokens_per_sec = steps * rows * seq / dt
-    # Baseline: GPT-2 350M-class training on one A100 with eager
-    # torch+DeepSpeed ZeRO-1 sustains roughly 35k tokens/s (bf16, seq 1024)
-    # — derived from A100 312 TFLOPs peak at ~40% MFU over 6*N*T flops/token.
-    baseline_tokens_per_sec = 35_000.0
-    # Model FLOPs per token (fwd [2·params-matmuls + lm_head + causal attn]
-    # ×3 for fwd+bwd), against the v5e bf16 peak of 197 TFLOP/s.
-    h, L, V = model.hidden_size, model.num_layers, model.vocab_size
-    fwd_flops_per_tok = 2 * (12 * h * h * L) + 2 * h * V + 2 * seq * h * L
-    mfu = tokens_per_sec * 3 * fwd_flops_per_tok / 197e12
-    print(json.dumps({
+    dt = _time_train(engine, batch, steps)
+    tps = steps * rows * seq / dt
+    _reset_topology()
+    # Baseline: GPT-2 350M-class on one A100, eager torch+DeepSpeed ZeRO-1,
+    # ≈35k tokens/s (bf16, seq 1024): A100 312 TFLOPs at ~40% MFU.
+    return {
         "metric": "gpt2_350m_zero1_train_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(tokens_per_sec / baseline_tokens_per_sec, 3),
-        "mfu": round(mfu, 3),
-    }))
+        "value": round(tps, 1), "unit": "tokens/s",
+        "vs_baseline": round(tps / 35_000.0, 3),
+        "mfu": round(_mfu(tps, model, seq), 3),
+    }
+
+
+def row_llama8b_class_zero3():
+    """Llama-3-8B geometry (hidden 4096, GQA 32:8, swiglu 14336) with depth
+    scaled to one chip, ZeRO-3 sharding specs active (single-device: specs
+    are trivial but the code path — fsdp param style + streamed update —
+    is the 8B-on-v5e-8 configuration of BASELINE.json)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import get_model_config
+
+    if SMOKE:
+        model = get_model_config("llama-tiny")
+        batch_size, gas, seq, steps, layers = 2, 1, 64, 2, 2
+    else:
+        layers = 4  # 8B is 32 layers; 4 fit one v5e with remat
+        batch_size, gas, seq, steps = 4, 4, 1024, 4
+        model = get_model_config("llama3-8b", num_layers=layers,
+                                 max_seq_len=seq)
+    config = {
+        "train_micro_batch_size_per_gpu": batch_size,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 3},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10_000,
+        "activation_checkpointing": {"remat_policy": "dots_flash_saveable"},
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+    rows = batch_size * gas
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, model.vocab_size, size=(rows, seq + 1), dtype=np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
+    seq_eff = min(seq, model.max_seq_len)
+    dt = _time_train(engine, batch, steps)
+    tps = steps * rows * seq_eff / dt
+    _reset_topology()
+    # A100 80G, Llama-class layers, ZeRO-3 bf16: ~55% MFU published for
+    # well-tuned stacks ⇒ per-chip token rate for THIS depth:
+    a100_tps = 0.55 * 312e12 / (3 * _fwd_flops_per_tok(model, seq_eff))
+    return {
+        "metric": f"llama3_8b_class_{layers}L_zero3_tokens_per_sec_per_chip",
+        "value": round(tps, 1), "unit": "tokens/s",
+        "vs_baseline": round(tps / a100_tps, 3),
+        "mfu": round(_mfu(tps, model, seq_eff), 3),
+    }
+
+
+def row_peak_params_zero0():
+    """Largest model trained end-to-end (fwd+bwd+fused-adam) on one chip
+    under full remat — the 'train bigger than you think' metric.  Ladder of
+    geometries, largest that completes wins."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import get_model_config
+
+    if SMOKE:
+        ladder = [("gpt2-tiny", "gpt2-tiny", {})]
+        seq = 64
+    else:
+        seq = 512
+        ladder = [
+            ("gpt2-1.3b", "gpt2-350m",
+             dict(hidden_size=2048, num_layers=24, num_heads=16,
+                  vocab_size=50257, max_seq_len=seq)),
+            ("gpt2-774m", "gpt2-350m",
+             dict(hidden_size=1600, num_layers=24, num_heads=20,
+                  vocab_size=50257, max_seq_len=seq)),
+            ("gpt2-350m", "gpt2-350m", dict(max_seq_len=seq)),
+        ]
+    best = None
+    for name, base, over in ladder:
+        try:
+            model = get_model_config(base, **over)
+            config = {
+                "train_micro_batch_size_per_gpu": 1,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "bf16": {"enabled": True},
+                "zero_optimization": {"stage": 0},
+                "steps_per_print": 10_000,
+                "activation_checkpointing": {"remat_policy": "nothing_saveable"},
+            }
+            engine, _, _, _ = ds.initialize(model=model, config=config)
+            rng = np.random.default_rng(2)
+            ids = rng.integers(0, model.vocab_size, size=(1, seq + 1),
+                               dtype=np.int32)
+            batch = {"input_ids": ids[:, :-1],
+                     "labels": ids[:, 1:].astype(np.int32)}
+            loss = engine.train_batch(batch)
+            if not np.isfinite(_sync(loss)):
+                raise RuntimeError("non-finite")
+            import jax
+
+            n_params = sum(int(np.prod(x.shape))
+                           for x in jax.tree_util.tree_leaves(engine.params))
+            best = {"name": name, "params_m": round(n_params / 1e6, 1)}
+            _reset_topology()
+            break
+        except Exception:
+            _reset_topology()
+            continue
+    if best is None:
+        raise RuntimeError("no ladder entry fit")
+    # A100-80G fits ~1.3B params trained in fp32-master Adam without
+    # offload (16 bytes/param ≈ 21GB + activations); v5e has 16GB.
+    return {
+        "metric": "peak_params_trained_one_chip_zero0",
+        "value": best["params_m"], "unit": "Mparams",
+        "vs_baseline": round(best["params_m"] / 1300.0, 3),
+        "model": best["name"],
+    }
+
+
+def row_v2_decode():
+    """Inference v2 fused decode loop (paged KV cache): steady-state decode
+    tokens/s on one chip."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import get_model_config
+
+    if SMOKE:
+        model = get_model_config("llama-tiny")
+        n_seqs, gen_tokens = 2, 8
+    else:
+        model = get_model_config("llama3-8b", num_layers=4, max_seq_len=2048)
+        n_seqs, gen_tokens = 8, 64
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+
+    eng = InferenceEngineV2(model)
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, model.vocab_size, size=(32,)).tolist()
+               for _ in range(n_seqs)]
+    # warmup (compile prefill + decode)
+    eng.generate(prompts, max_new_tokens=4)
+    t0 = time.perf_counter()
+    eng.generate(prompts, max_new_tokens=gen_tokens)
+    dt = time.perf_counter() - t0
+    tps = n_seqs * gen_tokens / dt
+    # FastGen blog: Llama-2-13B on A100 ≈ dozens of tok/s/seq; use a
+    # 50 tok/s/seq-class figure for this depth-scaled model as the bar.
+    return {
+        "metric": "v2_decode_tokens_per_sec",
+        "value": round(tps, 1), "unit": "tokens/s",
+        "vs_baseline": round(tps / (50.0 * n_seqs), 3),
+    }
+
+
+def main() -> None:
+    rows = []
+    for fn in (row_llama8b_class_zero3, row_peak_params_zero0,
+               row_v2_decode):
+        try:
+            r = fn()
+        except Exception as e:  # a failing row must not kill the report
+            r = {"metric": fn.__name__, "error": str(e)[:200]}
+        rows.append(r)
+        print(json.dumps(r), flush=True)
+    try:
+        primary = row_gpt2_350m()
+    except Exception as e:
+        # the LAST line is what the driver records — it must be the primary
+        # metric (or its explicit failure), never a stray secondary row
+        primary = {"metric": "gpt2_350m_zero1_train_tokens_per_sec_per_chip",
+                   "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+                   "error": str(e)[:200]}
+    primary["rows"] = rows
+    print(json.dumps(primary), flush=True)
 
 
 if __name__ == "__main__":

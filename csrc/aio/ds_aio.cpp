@@ -18,6 +18,7 @@
 #include <atomic>
 #include <condition_variable>
 #include <cstdlib>
+#include <cstdint>
 #include <cstring>
 #include <deque>
 #include <fcntl.h>
@@ -97,29 +98,34 @@ struct Handle {
         int fd = ::open(t.path.c_str(), flags, 0644);
         if (fd < 0) return false;
         long body = t.nbytes & ~(A - 1);
+        char* user = t.buf + t.buf_offset;
+        // large numpy buffers are typically page-aligned: skip the bounce
+        // copy and do O_DIRECT straight on the user buffer when possible
+        bool aligned = ((uintptr_t)user % A) == 0;
         void* bounce = nullptr;
-        if (body > 0 && posix_memalign(&bounce, A, body) != 0) {
+        if (body > 0 && !aligned && posix_memalign(&bounce, A, body) != 0) {
             ::close(fd);
             return false;
         }
+        char* io_buf = aligned ? user : (char*)bounce;
         bool ok = true;
         long done = 0;
         if (t.write && body > 0) {
-            memcpy(bounce, t.buf + t.buf_offset, body);
+            if (!aligned) memcpy(io_buf, user, body);
             while (done < body) {
-                ssize_t r = ::pwrite(fd, (char*)bounce + done, body - done,
+                ssize_t r = ::pwrite(fd, io_buf + done, body - done,
                                      t.file_offset + done);
                 if (r <= 0) { ok = false; break; }
                 done += r;
             }
         } else if (body > 0) {
             while (done < body) {
-                ssize_t r = ::pread(fd, (char*)bounce + done, body - done,
+                ssize_t r = ::pread(fd, io_buf + done, body - done,
                                     t.file_offset + done);
                 if (r <= 0) { ok = false; break; }
                 done += r;
             }
-            if (ok) memcpy(t.buf + t.buf_offset, bounce, body);
+            if (ok && !aligned) memcpy(user, io_buf, body);
         }
         free(bounce);
         ::close(fd);
@@ -133,9 +139,9 @@ struct Handle {
             long td = 0;
             while (td < tail) {
                 ssize_t r = t.write
-                    ? ::pwrite(tf, t.buf + t.buf_offset + body + td, tail - td,
+                    ? ::pwrite(tf, user + body + td, tail - td,
                                t.file_offset + body + td)
-                    : ::pread(tf, t.buf + t.buf_offset + body + td, tail - td,
+                    : ::pread(tf, user + body + td, tail - td,
                               t.file_offset + body + td);
                 if (r <= 0) { ++errors; break; }
                 td += r;

@@ -80,24 +80,19 @@ class _CheckpointReader:
 
     def __init__(self, d: str):
         import glob
-        import struct
 
         from deepspeed_tpu.io.fast_file_writer import read_tensor_index
 
         bins = sorted(glob.glob(os.path.join(d, "model_states*.bin")))
         if not bins:
             raise FileNotFoundError(f"no model_states*.bin under {d}")
-        # entry → (file, absolute offset, nbytes, dtype, shape); headers are
-        # parsed ONCE here, fetches are direct seeks
+        # entry → (file, base offset, index record); headers are parsed
+        # ONCE here, fetches are targeted seeks via read_tensor_entry
         self.entry_meta: Dict[str, tuple] = {}
         for b in bins:
-            index = read_tensor_index(b)
-            with open(b, "rb") as f:
-                (hlen,) = struct.unpack("<Q", f.read(8))
-            base = 8 + hlen
+            index, base = read_tensor_index(b)
             for name, m in index.items():
-                self.entry_meta[name] = (b, base + m["offset"], m["nbytes"],
-                                         m["dtype"], m["shape"])
+                self.entry_meta[name] = (b, base, m)
         self.shard_index: Dict[str, Dict] = {}
         for j in sorted(glob.glob(os.path.join(d, "shard_index*.json"))):
             with open(j) as f:
@@ -112,11 +107,10 @@ class _CheckpointReader:
             i["leaf"].startswith(p) for i in self.shard_index.values())
 
     def _fetch(self, ename: str) -> np.ndarray:
-        path, off, nbytes, dtype, shape = self.entry_meta[ename]
-        with open(path, "rb") as f:
-            f.seek(off)
-            raw = f.read(nbytes)
-        return np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape).copy()
+        from deepspeed_tpu.io.fast_file_writer import read_tensor_entry
+
+        path, base, meta = self.entry_meta[ename]
+        return read_tensor_entry(path, base, meta)
 
     def read_leaf(self, name: str) -> np.ndarray:
         if name in self.entry_meta and name not in self.shard_index:
@@ -192,6 +186,8 @@ class FastCheckpointEngine:
             comm.barrier()
         opt_tree = (engine.opt_state if getattr(engine, "_opt_store", None) is None
                     else engine._opt_store.swap_in())
+        ok = False
+        all_ok = True
         try:
             tensors, shard_idx = _flatten(engine.params, "module")
             if opt_tree is not None:
@@ -216,14 +212,23 @@ class FastCheckpointEngine:
                         "io_stats": stats}
                 with open(meta_path, "w") as f:
                     json.dump(meta, f)
+            ok = True
         finally:
             if jax.process_count() > 1:
-                # every process's file must land before the commit — and the
-                # barrier must be reached even if THIS process's write threw,
-                # or the healthy processes hang forever
-                from deepspeed_tpu.comm import comm
+                # every process's file must land before the commit — the
+                # rendezvous must be reached even if THIS process's write
+                # threw (or the healthy processes hang forever), and it
+                # carries a success flag so 'latest' is only advanced when
+                # EVERY process's shard landed
+                from jax.experimental import multihost_utils
 
-                comm.barrier()
+                flags = multihost_utils.process_allgather(
+                    np.array([1 if ok else 0], np.int32))
+                all_ok = bool(flags.min())
+        if not all_ok:
+            raise RuntimeError(
+                f"fast checkpoint save of tag '{tag}' failed on a peer "
+                f"process; 'latest' not advanced")
         if jax.process_index() == 0:
             with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
                 f.write(str(tag))

@@ -72,12 +72,16 @@ def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
     out = output_dir or os.path.join(ckpt_dir, str(tag), "universal")
     if jax.process_count() > 1 and jax.process_index() != 0:
         # each process's pickle holds the full (allgathered) state; one
-        # writer suffices on a shared FS — wait for process 0 to finish
-        from deepspeed_tpu.comm import comm
+        # writer suffices on a shared FS — wait for process 0 to finish,
+        # and surface its failure instead of returning a broken dir
+        from jax.experimental import multihost_utils
 
-        comm.barrier()
+        flags = multihost_utils.process_allgather(np.array([1], np.int32))
+        if not bool(flags.min()):
+            raise RuntimeError("universal conversion failed on process 0")
         return out
 
+    ok = False
     try:
         with open(_ckpt_path(ckpt_dir, tag), "rb") as f:
             state = pickle.load(f)
@@ -105,13 +109,16 @@ def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
         }
         with open(os.path.join(out, "meta.json"), "w") as f:
             json.dump(meta, f, indent=2)
+        ok = True
     finally:
         if jax.process_count() > 1:
             # ALWAYS release the non-writer processes — a writer exception
-            # must raise on process 0, not hang processes 1..N in a barrier
-            from deepspeed_tpu.comm import comm
+            # must raise on process 0, not hang processes 1..N — and tell
+            # them whether the conversion actually succeeded
+            from jax.experimental import multihost_utils
 
-            comm.barrier()
+            multihost_utils.process_allgather(
+                np.array([1 if ok else 0], np.int32))
     log_dist(f"universal checkpoint written: {out}")
     return out
 

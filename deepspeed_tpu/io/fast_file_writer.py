@@ -17,7 +17,7 @@ import json
 import os
 import struct
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -176,11 +176,20 @@ def write_tensor_file(path: str, tensors: Dict[str, np.ndarray],
     return w.close()
 
 
-def read_tensor_index(path: str) -> Dict[str, Any]:
-    """Read only the JSON index header of a tensor file."""
+def read_tensor_index(path: str) -> "Tuple[Dict[str, Any], int]":
+    """→ (JSON index, data base offset) without reading any tensor bytes."""
     with open(path, "rb") as f:
         (hlen,) = struct.unpack("<Q", f.read(8))
-        return json.loads(f.read(hlen).decode())
+        return json.loads(f.read(hlen).decode()), 8 + hlen
+
+
+def read_tensor_entry(path: str, base_offset: int, meta: Dict[str, Any]) -> np.ndarray:
+    """Read ONE entry given its index record (targeted seek, no parsing)."""
+    with open(path, "rb") as f:
+        f.seek(base_offset + meta["offset"])
+        raw = f.read(meta["nbytes"])
+    return np.frombuffer(raw, dtype=np.dtype(meta["dtype"])
+                         ).reshape(meta["shape"]).copy()
 
 
 def read_tensor_file(path: str, names=None) -> Dict[str, np.ndarray]:

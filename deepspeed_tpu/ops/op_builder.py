@@ -17,8 +17,21 @@ from typing import List, Optional
 
 from deepspeed_tpu.utils.logging import logger
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-CSRC_DIR = os.path.join(_REPO_ROOT, "csrc")
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PKG_ROOT)
+
+
+def _find_csrc() -> str:
+    """Source tree location: repo root (dev/editable install) or inside the
+    installed package (wheels ship deepspeed_tpu/csrc — see pyproject)."""
+    for cand in (os.path.join(_REPO_ROOT, "csrc"),
+                 os.path.join(_PKG_ROOT, "csrc")):
+        if os.path.isdir(cand):
+            return cand
+    return os.path.join(_REPO_ROOT, "csrc")  # best-effort for error messages
+
+
+CSRC_DIR = _find_csrc()
 CACHE_DIR = os.environ.get("DSTPU_OPS_CACHE",
                            os.path.expanduser("~/.cache/deepspeed_tpu/ops"))
 
