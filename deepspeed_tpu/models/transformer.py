@@ -532,7 +532,7 @@ def make_pipeline_stage_fn(cfg: TransformerConfig, topo):
 
 def forward(params: Params, input_ids, cfg: TransformerConfig,
             positions=None, pld_theta=None,
-            return_hidden: bool = False) -> jnp.ndarray:
+            return_hidden: bool = False, token_embeds=None) -> jnp.ndarray:
     """Token ids [B, S] → logits [B, S, V]. lax.scan over stacked layers.
     ``pld_theta``: progressive-layer-drop keep prob (traced scalar or None).
     ``return_hidden``: final-norm hidden states instead of logits (tiled
@@ -542,7 +542,7 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
 
-    x = _embed(params, input_ids, positions, cfg)
+    x = _embed(params, input_ids, positions, cfg, token_embeds)
 
     moe_every = max(1, cfg.moe_layer_freq)
 
@@ -754,9 +754,14 @@ def _nll_sum(logits32, labels_mb):
     return jnp.sum((logz - gold) * m)
 
 
-def _embed(params: Params, input_ids, positions, cfg: TransformerConfig):
-    """Embedding prologue shared by forward() and the 1F1B loss path."""
-    x = params["embed"]["tokens"].astype(cfg.dtype)[input_ids]
+def _embed(params: Params, input_ids, positions, cfg: TransformerConfig,
+           token_embeds=None):
+    """Embedding prologue shared by forward() and the 1F1B loss path.
+    ``token_embeds``: precomputed table rows [B,S,H] — the sparse-gradient
+    path (runtime/sparse.py) hoists the lookup out of the differentiated
+    function so the table cotangent stays (ids, values)-sparse."""
+    x = (params["embed"]["tokens"].astype(cfg.dtype)[input_ids]
+         if token_embeds is None else token_embeds.astype(cfg.dtype))
     if cfg.has_learned_positions:
         x = x + params["embed"]["positions"].astype(cfg.dtype)[positions]
     return x
@@ -793,7 +798,8 @@ def _pipeline_1f1b_loss(params, batch, cfg: TransformerConfig, topo,
              denom)
 
 
-def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfig):
+def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfig,
+            token_embeds=None):
     """Causal LM cross-entropy. ``batch``: input_ids [B,S], labels [B,S]
     (-100 = ignore, HF convention), optional loss_mask, optional pld_theta
     (progressive layer drop keep prob, passed through the batch so the
@@ -829,7 +835,8 @@ def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfi
         return _pipeline_1f1b_loss(params, batch, cfg, topo, labels_eff,
                                    denom)
     out = forward(params, batch["input_ids"], cfg,
-                  pld_theta=batch.get("pld_theta"), return_hidden=bool(tiled))
+                  pld_theta=batch.get("pld_theta"), return_hidden=bool(tiled),
+                  token_embeds=token_embeds)
     moe_aux = jnp.zeros((), jnp.float32)
     if isinstance(out, tuple):
         out, moe_aux = out

@@ -507,7 +507,24 @@ class DeepSpeedEngine:
         qwz = (cfg.zero_config.zero_quantized_weights and self.zero_stage >= 3)
         rules = self.rules
 
-        def micro_grads(params, batch, scale):
+        # -- sparse gradients (ref runtime/sparse_tensor.py + the sparse
+        # allreduce bucket of engine.py:145): hoist the token-embedding
+        # lookup out of AD so the table cotangent is (ids, values)-COO and
+        # the dp reduction is an all_gather of O(tokens·H) bytes, not a
+        # dense [V,H] scatter+psum. See runtime/sparse.py.
+        mc = self.model_config
+        sparse_grads = (cfg.sparse_gradients_enabled and mc is not None
+                        and not mc.tie_embeddings
+                        and self.topology.pp_size == 1
+                        and not self._param_stream and not qwz)
+        if cfg.sparse_gradients_enabled and not sparse_grads:
+            logger.warning(
+                "sparse_gradients: unsupported with this configuration "
+                "(tied embeddings, pipeline, param streaming, or qwZ) — "
+                "falling back to dense gradients")
+        topo = self.topology
+
+        def micro_grads_dense(params, batch, scale):
             def scaled_loss(p):
                 if qwz:
                     from deepspeed_tpu.parallel.zeropp import qwz_weight_gather
@@ -518,6 +535,27 @@ class DeepSpeedEngine:
 
             sloss, grads = jax.value_and_grad(scaled_loss)(params)
             return sloss / scale, grads
+
+        def micro_grads_sparse(params, batch, scale):
+            from deepspeed_tpu.runtime.sparse import sparse_embedding_grad
+
+            ids = batch["input_ids"]
+            table = params["embed"]["tokens"]
+            emb = jnp.take(table, ids, axis=0)
+
+            def scaled_loss(p, emb_):
+                loss = loss_fn(p, batch, token_embeds=emb_)
+                return loss * scale.astype(loss.dtype)
+
+            sloss, (g_params, g_emb) = jax.value_and_grad(
+                scaled_loss, argnums=(0, 1))(params, emb)
+            st = sparse_embedding_grad(g_emb, ids, table.shape, topo)
+            g_table = st.add_into(g_params["embed"]["tokens"])
+            g_params = {**g_params,
+                        "embed": {**g_params["embed"], "tokens": g_table}}
+            return sloss / scale, g_params
+
+        micro_grads = micro_grads_sparse if sparse_grads else micro_grads_dense
 
         stream_offload = self._opt_stream_offload
         opt_device_shardings = self._opt_device_shardings
