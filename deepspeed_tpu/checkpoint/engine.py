@@ -46,8 +46,12 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
                     client_state: Optional[Dict[str, Any]] = None) -> None:
     tag = tag if tag is not None else f"global_step{engine.global_steps}"
     os.makedirs(os.path.join(save_dir, str(tag)), exist_ok=True)
-    opt_tree = (engine.opt_state if getattr(engine, "_opt_store", None) is None
-                else engine._opt_store.swap_in())
+    if getattr(engine, "_super_opt", None) is not None:
+        # SuperOffload: masters/moments live in the host optimizer
+        opt_tree = {"superoffload": engine._super_opt.state_dict()}
+    else:
+        opt_tree = (engine.opt_state if getattr(engine, "_opt_store", None) is None
+                    else engine._opt_store.swap_in())
     state = {
         "module": _to_host(engine.params),
         "optimizer": _to_host(opt_tree),
@@ -87,8 +91,24 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         state = pickle.load(f)
 
     engine.params = jax.device_put(state["module"], engine.param_shardings)
-    if load_optimizer_states and "optimizer" in state:
-        engine.opt_state = jax.device_put(state["optimizer"], engine.opt_shardings)
+    opt = state.get("optimizer")
+    opt_is_super = isinstance(opt, dict) and "superoffload" in opt
+    engine_is_super = getattr(engine, "_super_opt", None) is not None
+    if load_optimizer_states and opt is not None \
+            and opt_is_super != engine_is_super:
+        raise ValueError(
+            "checkpoint optimizer mode mismatch: the checkpoint was saved "
+            + ("with" if opt_is_super else "without")
+            + " SuperOffload but this engine is configured "
+            + ("without" if opt_is_super else "with")
+            + " it — match offload_optimizer.super_offload, or pass "
+            "load_optimizer_states=False to resume weights only")
+    if load_optimizer_states and opt_is_super and engine_is_super:
+        engine._super_opt.load_state_dict(opt["superoffload"])
+    elif load_optimizer_states and opt is not None:
+        # store-mode engines rely on this device placement too:
+        # _sync_store_after_load pushes it into the host/NVMe store
+        engine.opt_state = jax.device_put(opt, engine.opt_shardings)
     if "loss_scale_state" in state:
         engine.loss_scale_state = jax.device_put(state["loss_scale_state"],
                                                  engine._replicated)

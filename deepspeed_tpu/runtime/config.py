@@ -116,6 +116,11 @@ class OffloadOptimizerConfig:
     pipeline_write: bool = False
     fast_init: bool = False
     ratio: float = 1.0  # TwinFlow/Offload++ partial offload fraction
+    # SuperOffload (ref engine.py:935 super_offload +
+    # superoffload_stage3.py): pipelined host Adam with speculative step +
+    # rollback-on-overflow
+    super_offload: bool = False
+    cpuadam_cores_perc: float = 0.8
 
 
 @dataclass

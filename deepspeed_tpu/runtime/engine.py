@@ -38,7 +38,7 @@ from deepspeed_tpu.models.transformer import TransformerConfig
 from deepspeed_tpu.parallel.sharding import ShardingRules
 from deepspeed_tpu.parallel.topology import (BATCH_AXES, SEQ_AXIS, MeshTopology, get_topology,
                                              set_topology)
-from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
 from deepspeed_tpu.runtime.lr_schedules import LRSchedule, build_lr_schedule, constant_lr
 from deepspeed_tpu.runtime.optimizers import Optimizer, build_optimizer
 from deepspeed_tpu.utils.logging import log_dist, logger
@@ -51,6 +51,24 @@ Batch = Dict[str, Any]
 
 def _tree_zeros_like(tree, dtype=None):
     return jax.tree.map(lambda x: jnp.zeros(x.shape, dtype or x.dtype), tree)
+
+
+def _advance_loss_scale(scale, good, skipped, finite, dynamic: bool,
+                        window: int, ls_min: float, xp):
+    """Dynamic-loss-scale policy (grow after `window` good steps, halve on
+    overflow, floor at `ls_min`).  One implementation for both dialects:
+    ``xp=jnp`` inside the jitted step, ``xp=np`` on host step paths
+    (SuperOffload) — so the two can never drift."""
+    skipped = skipped + xp.where(finite, 0, 1)
+    if not dynamic:
+        return scale, good, skipped
+    good = xp.where(finite, good + 1, 0)
+    grow = good >= window
+    scale = xp.where(finite,
+                     xp.where(grow, scale * 2.0, scale),
+                     xp.maximum(scale * 0.5, ls_min))
+    good = xp.where(grow, 0, good)
+    return scale, good, skipped
 
 
 def _global_norm(tree) -> jnp.ndarray:
@@ -260,8 +278,17 @@ class DeepSpeedEngine:
         self._opt_store = None
         self._opt_stream_offload = False
         self._opt_device_shardings = self.opt_shardings
+        self._super_opt = None
         off_opt = cfg.zero_config.offload_optimizer
-        if off_opt and off_opt.device == "cpu" and self._param_stream:
+        if off_opt and off_opt.device == "cpu" and off_opt.super_offload \
+                and not self._param_stream:
+            # SuperOffload (ref engine.py:935 + superoffload_stage3.py):
+            # the full fp32 master + moments live on the host; the step is
+            # a pipelined bucketed host Adam (device keeps working params
+            # only). Created after params exist, below.
+            log_dist("SuperOffload: host-resident pipelined Adam with "
+                     "rollback")
+        elif off_opt and off_opt.device == "cpu" and self._param_stream:
             # the streamed layer partition's opt state is already
             # host-resident and slice-stepped; nothing extra to offload
             log_dist("ZeRO-Offload: opt state host placement subsumed by "
@@ -320,7 +347,27 @@ class DeepSpeedEngine:
                                                          prefix="param")
                 log_dist(f"ZeRO-Infinity: layer params → NVMe at {swap_dir}")
 
-        if self._param_stream:
+        if off_opt and off_opt.device == "cpu" and off_opt.super_offload \
+                and not self._param_stream:
+            from deepspeed_tpu.runtime.superoffload import SuperOffloadOptimizer
+
+            opt_type = (cfg.optimizer.type if cfg.optimizer else "adamw").lower()
+            if opt_type not in ("adam", "adamw", "fusedadam"):
+                raise DeepSpeedConfigError(
+                    f"super_offload supports Adam/AdamW only, got "
+                    f"optimizer.type={opt_type!r}")
+            op = (cfg.optimizer.params if cfg.optimizer else {})
+            workers = max(1, int((os.cpu_count() or 4)
+                                 * off_opt.cpuadam_cores_perc))
+            self._super_opt = SuperOffloadOptimizer(
+                self.params, lr=self.base_lr,
+                betas=tuple(op.get("betas", (0.9, 0.999))),
+                eps=float(op.get("eps", 1e-8)),
+                weight_decay=float(op.get("weight_decay", 0.0)),
+                max_workers=workers,
+                adamw=opt_type in ("adamw", "fusedadam"))
+            self.opt_state = None  # host masters/moments are authoritative
+        elif self._param_stream:
             res_params = {k: v for k, v in self.params.items()
                           if k != "layers"}
             opt_init_jit = jax.jit(
@@ -561,18 +608,12 @@ class DeepSpeedEngine:
         opt_device_shardings = self._opt_device_shardings
 
         def ls_advance(finite, ls_state):
-            scale = ls_state["scale"]
-            skipped = ls_state["skipped"] + jnp.where(finite, 0, 1).astype(jnp.int32)
-            if ls_dynamic:
-                good = jnp.where(finite, ls_state["good_steps"] + 1, 0)
-                grow = good >= ls_window
-                new_scale = jnp.where(
-                    finite,
-                    jnp.where(grow, scale * 2.0, scale),
-                    jnp.maximum(scale * 0.5, ls_min))
-                good = jnp.where(grow, 0, good)
-                return {"scale": new_scale, "good_steps": good, "skipped": skipped}
-            return {**ls_state, "skipped": skipped}
+            scale, good, skipped = _advance_loss_scale(
+                ls_state["scale"], ls_state["good_steps"],
+                ls_state["skipped"], finite, ls_dynamic, ls_window, ls_min,
+                jnp)
+            return {"scale": scale, "good_steps": good.astype(jnp.int32),
+                    "skipped": skipped.astype(jnp.int32)}
 
         def apply_update(params, opt_state, grads, lr, ls_state):
             if stream_offload:
@@ -698,6 +739,38 @@ class DeepSpeedEngine:
 
         if self._param_stream:
             train_step = stream_train_step
+
+        if self._super_opt is not None:
+            # SuperOffload path: device computes grads + norm + finite in
+            # one jit; the optimizer step runs on the host (pipelined
+            # bucketed Adam), so no fused device update is compiled.
+            def grads_batch(params, batch_stack, scale):
+                zeros = jax.tree.map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), params)
+                zeros = lax.with_sharding_constraint(zeros, grad_shardings)
+
+                def body(carry, mb):
+                    g_acc, loss_acc = carry
+                    loss, g = micro_grads(params, mb, scale)
+                    g_acc = jax.tree.map(
+                        lambda a, x: a + x.astype(jnp.float32), g_acc, g)
+                    g_acc = lax.with_sharding_constraint(g_acc, grad_shardings)
+                    return (g_acc, loss_acc + loss), None
+
+                (grads, loss_sum), _ = lax.scan(
+                    body, (zeros, jnp.float32(0.0)), batch_stack)
+                gn = _global_norm(grads)
+                # match apply_update's semantics: only fp16 runs skip on
+                # overflow — fp32/bf16 NaNs must land in params and be
+                # visible, not silently stall training by skipping forever
+                finite = (_all_finite(grads) & jnp.isfinite(gn)) if fp16 \
+                    else jnp.bool_(True)
+                return loss_sum / gas, grads, gn, finite
+
+            self._grads_batch_jit = jax.jit(
+                grads_batch,
+                out_shardings=(self._replicated, self.grad_shardings,
+                               self._replicated, self._replicated))
 
         state_out = (self.param_shardings, self.opt_shardings, self._replicated,
                      jax.tree.map(lambda _: self._replicated,
@@ -893,6 +966,8 @@ class DeepSpeedEngine:
         Ref: PipelineEngine.train_batch / engine forward+backward+step."""
         if self._onebit is not None:
             return self._train_batch_onebit(data)
+        if self._super_opt is not None:
+            return self._train_batch_super(data)
         data = self._apply_curriculum(data)
         self._maybe_update_random_ltd()
         self.tput_timer.start()
@@ -921,6 +996,76 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).stop(ready=metrics["loss"])
         self.tput_timer.stop()
         return metrics["loss"]
+
+    def _train_batch_super(self, data) -> jnp.ndarray:
+        """SuperOffload train batch (ref superoffload_stage3.py): grads are
+        computed in one compiled step; the optimizer runs on the host as a
+        pipelined bucketed Adam (overflow skips the step; the rollback
+        window additionally allows post-hoc recovery via engine.rollback)."""
+        data = self._apply_curriculum(data)
+        self._maybe_update_random_ltd()
+        self.tput_timer.start()
+        self.timers(TRAIN_BATCH_TIMER).start()
+        batch_stack = self._stack_micro_batches(data)
+        batch_stack = self._maybe_add_pld(batch_stack)
+        batch_stack = self._put_batch(batch_stack, stacked=True)
+        lr = float(self.lr_scheduler(self.global_steps))
+        gas = self.gradient_accumulation_steps_value
+        scale = self.loss_scale_state["scale"]
+        loss, grads, gn, finite = self._grads_batch_jit(
+            self.params, batch_stack, scale)
+        scale_v = float(np.asarray(scale))
+        finite_v = bool(np.asarray(finite))
+        inv = 1.0 / (scale_v * gas)
+        gnorm = float(np.asarray(gn)) * inv
+        clip = self.config.gradient_clipping
+        coef = inv * (min(1.0, clip / (gnorm + 1e-6))
+                      if clip and clip > 0 else 1.0)
+        if finite_v:
+            self._super_opt.lr = lr
+            self.params = self._super_opt.step(self.params, grads,
+                                               grad_scale=coef)
+        self._super_last_skipped = not finite_v
+        self._advance_loss_scale_host(finite_v)
+        self.global_steps += 1
+        self.micro_steps += gas
+        self.lr_scheduler.step()
+        metrics = {"loss": loss, "grad_norm": gnorm, "loss_scale": scale_v,
+                   "skipped": not finite_v}
+        self._after_step(metrics)
+        self.timers(TRAIN_BATCH_TIMER).stop(ready=loss)
+        self.tput_timer.stop()
+        return loss
+
+    def rollback(self) -> None:
+        """Undo the last SuperOffload optimizer step (host masters, moments,
+        step counter) and restore the device params from the rolled-back
+        masters — post-hoc overflow/divergence recovery (ref
+        superoffload_stage3 rollback optimizer)."""
+        if self._super_opt is None:
+            raise RuntimeError("rollback requires SuperOffload mode "
+                               "(offload_optimizer.super_offload)")
+        if getattr(self, "_super_last_skipped", False):
+            raise RuntimeError(
+                "last train_batch was overflow-skipped (no optimizer step "
+                "ran); the rollback snapshot belongs to an earlier step")
+        self._super_opt.rollback()
+        self.params = self._super_opt.push_params(self.params)
+        self.global_steps = max(0, self.global_steps - 1)
+
+    def _advance_loss_scale_host(self, finite: bool) -> None:
+        """Host-side entry to the SAME loss-scale policy the jitted step
+        uses (_advance_loss_scale with xp=np) for step paths that decide on
+        the host (SuperOffload)."""
+        ls = {k: np.asarray(v) for k, v in self.loss_scale_state.items()}
+        scale, good, skipped = _advance_loss_scale(
+            ls["scale"], ls["good_steps"], ls["skipped"], np.bool_(finite),
+            self._ls_dynamic, self._ls_window, self._ls_min, np)
+        self.loss_scale_state = jax.device_put(
+            {"scale": jnp.float32(float(scale)),
+             "good_steps": jnp.int32(int(good)),
+             "skipped": jnp.int32(int(skipped))},
+            self._replicated)
 
     def _train_batch_onebit(self, data) -> jnp.ndarray:
         """Compressed-DP train batch: explicit shard_map step with 1-bit

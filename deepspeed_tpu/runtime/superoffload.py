@@ -35,11 +35,12 @@ class SuperOffloadOptimizer:
     def __init__(self, params: Any, lr: float = 1e-3, betas=(0.9, 0.999),
                  eps: float = 1e-8, weight_decay: float = 0.0,
                  bucket_bytes: int = 64 << 20, max_workers: int = 4,
-                 rollback_window: int = 1):
+                 rollback_window: int = 1, adamw: bool = False):
         self.lr = lr
         self.beta1, self.beta2 = betas
         self.eps = eps
         self.weight_decay = weight_decay
+        self.adamw = adamw  # decoupled (AdamW) vs coupled (Adam) decay
         self.step_count = 0
         self.rollback_window = rollback_window
         leaves, self._treedef = jax.tree_util.tree_flatten(params)
@@ -83,27 +84,32 @@ class SuperOffloadOptimizer:
         self._prev = None
 
     def _bucket_step(self, bucket: List[int], grads: List[np.ndarray],
-                     step: int) -> None:
+                     step: int, grad_scale: float = 1.0) -> None:
         from deepspeed_tpu.ops.cpu_optimizer import _lib, _ptr, adam_step_numpy
 
         lib = _lib()
         b1, b2 = self.beta1, self.beta2
         for j, i in enumerate(bucket):
             g = np.ascontiguousarray(grads[j], np.float32)
+            if grad_scale != 1.0:
+                g = g * grad_scale  # loss-scale/gas normalisation + clip coef
             p, m, v = self._master[i], self._m[i], self._v[i]
             if lib is not None:
-                # vectorized fused step (csrc/cpu_optimizer) — classic Adam
-                # with coupled weight decay, matching the numpy fallback
+                # vectorized fused step (csrc/cpu_optimizer); the last arg
+                # selects decoupled (AdamW) vs coupled (Adam) weight decay
                 lib.ds_adam_step(_ptr(p), _ptr(g), _ptr(m), _ptr(v), p.size,
                                  self.lr, b1, b2, self.eps,
-                                 self.weight_decay, step, 0)
+                                 self.weight_decay, step,
+                                 1 if self.adamw else 0)
             else:
                 adam_step_numpy(p, g, m, v, self.lr, b1, b2, self.eps,
-                                self.weight_decay, step, adamw=False)
+                                self.weight_decay, step, adamw=self.adamw)
 
-    def step(self, params: Any, grads: Any) -> Any:
+    def step(self, params: Any, grads: Any, grad_scale: float = 1.0) -> Any:
         """grads (device tree) → updated device params.  Transfers and host
-        Adam are pipelined per bucket."""
+        Adam are pipelined per bucket.  ``grad_scale`` multiplies gradients
+        on the host (loss-scale/grad-accum normalisation + clip coef,
+        computed on device by the engine)."""
         self._snapshot()
         self.step_count += 1
         step = self.step_count
@@ -115,12 +121,17 @@ class SuperOffloadOptimizer:
             host_g = [np.asarray(jax.device_get(flat_g[i]), np.float32)
                       for i in bucket]
             futures.append(self._pool.submit(self._bucket_step, bucket,
-                                             host_g, step))
+                                             host_g, step, grad_scale))
         for f in futures:
             f.result()
+        return self.push_params(params)
+
+    def push_params(self, params_like: Any) -> Any:
+        """Host masters → device tree matching ``params_like``'s dtypes and
+        shardings (used by step() and by engine rollback)."""
+        flat_p = jax.tree_util.tree_flatten(params_like)[0]
         new_leaves = [jnp.asarray(x, dt) for x, dt in
                       zip(self._master, self._dtypes)]
-        flat_p = jax.tree_util.tree_flatten(params)[0]
         new_leaves = [jax.device_put(x, l.sharding) if hasattr(l, "sharding")
                       else x for x, l in zip(new_leaves, flat_p)]
         return jax.tree_util.tree_unflatten(self._treedef, new_leaves)
