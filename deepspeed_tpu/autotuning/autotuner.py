@@ -39,15 +39,20 @@ class ModelInfo:
 def estimate_memory_per_device(model_info: ModelInfo, zero_stage: int,
                                dp_size: int, micro_batch: int, seq_len: int,
                                dtype: str = "bf16",
-                               optimizer_factor: int = 12) -> int:
+                               optimizer_factor: int = 12,
+                               tp_size: int = 1, pp_size: int = 1,
+                               sp_size: int = 1) -> int:
     """Bytes per device for params+grads+optimizer+activations.
 
     Ref get_instantiation_memory_required_per_gpu (autotuner.py:278):
     optimizer_factor=12 ≈ fp32 master + two Adam moments + fp16 param/grad
     bookkeeping, partitioned by stage:
       stage 0: all replicated; 1: optimizer/dp; 2: +grads/dp; 3: +params/dp.
+    Model-parallel axes shard everything multiplicatively: tensor/pipe split
+    params+grads+optimizer; pipe splits resident layers (activations too);
+    seq splits the activation sequence dim.
     """
-    p = model_info.num_params
+    p = model_info.num_params // max(1, tp_size * pp_size)
     b = BYTES_PER_PARAM.get(dtype, 2)
     params_mem = p * b
     grads_mem = p * b
@@ -58,28 +63,93 @@ def estimate_memory_per_device(model_info: ModelInfo, zero_stage: int,
         grads_mem //= dp_size
     if zero_stage >= 3:
         params_mem //= dp_size
-    # activation estimate: ~ layers * micro_batch * seq * hidden * c bytes
+    # activation estimate: ~ layers * micro_batch * seq * hidden * c bytes.
+    # NOT divided by pp: the 1F1B schedule keeps O(pp) microbatches in
+    # flight, cancelling the layers/pp split per stage.
     act = (model_info.num_layers * micro_batch * seq_len
-           * max(1, model_info.hidden_size) * 2 * 16)
+           * max(1, model_info.hidden_size) * 2 * 16
+           // max(1, sp_size * tp_size))
     return int(params_mem + grads_mem + opt_mem + act)
+
+
+def enumerate_meshes(n_devices: int, model_cfg) -> "List[Dict[str, int]]":
+    """All valid mesh factorizations of ``n_devices`` over
+    data×tensor×pipe×seq(×expert for MoE), pruned by model divisibility
+    (heads % tp, kv_heads % tp, heads % sp, layers % pp, experts % ep) —
+    the tp/pp/sp/ep sweep dimension of the reference autotuner's space.
+    """
+    heads = getattr(model_cfg, "num_heads", 1) or 1
+    kv_heads = getattr(model_cfg, "num_kv_heads", None) or heads
+    layers = getattr(model_cfg, "num_layers", 1) or 1
+    experts = getattr(model_cfg, "num_experts", 0) or 0
+    is_moe = experts > 1
+
+    def divisors(n):
+        return [d for d in range(1, n + 1) if n % d == 0]
+
+    meshes = []
+    for tp in divisors(n_devices):
+        if heads % tp or kv_heads % tp:
+            continue
+        for pp in divisors(n_devices // tp):
+            if layers % pp:
+                continue
+            for sp in divisors(n_devices // (tp * pp)):
+                if heads % sp or kv_heads % sp:
+                    continue
+                rem = n_devices // (tp * pp * sp)
+                for ep in (divisors(rem) if is_moe else [1]):
+                    if is_moe and ep > 1 and experts % ep:
+                        continue
+                    mesh = {"data": rem // ep}
+                    if tp > 1:
+                        mesh["tensor"] = tp
+                    if pp > 1:
+                        mesh["pipe"] = pp
+                    if sp > 1:
+                        mesh["seq"] = sp
+                    if ep > 1:
+                        mesh["expert"] = ep
+                    if mesh not in meshes:
+                        meshes.append(mesh)
+    return meshes
 
 
 def generate_tuning_space(model_info: ModelInfo, dp_size: int, seq_len: int,
                           hbm_bytes: int, dtype: str = "bf16",
                           stages=(0, 1, 2, 3),
-                          max_micro_batch: int = 64) -> List[Dict[str, Any]]:
-    """Candidate (zero_stage, micro_batch) configs that fit the memory
-    budget (ref tuning-space templates, autotuning/config_templates/)."""
+                          max_micro_batch: int = 64,
+                          meshes: Optional[List[Dict[str, int]]] = None
+                          ) -> List[Dict[str, Any]]:
+    """Candidate (mesh, zero_stage, micro_batch) configs that fit the
+    memory budget (ref tuning-space templates + the mesh sweep)."""
     space = []
-    for stage in stages:
-        mb = 1
-        while mb <= max_micro_batch:
-            need = estimate_memory_per_device(model_info, stage, dp_size, mb,
-                                              seq_len, dtype)
-            if need <= hbm_bytes:
-                space.append({"zero_stage": stage, "micro_batch": mb,
-                              "est_bytes": need})
-            mb *= 2
+    # mesh=None = "not sweeping": candidates carry no mesh key, so the
+    # caller's base_config mesh passes through trials untouched
+    for mesh in (meshes if meshes else [None]):
+        if mesh is None:
+            dp, tp, pp, sp = dp_size, 1, 1, 1
+        else:
+            dp = mesh.get("data", 1) * mesh.get("expert", 1)
+            tp, pp, sp = (mesh.get("tensor", 1), mesh.get("pipe", 1),
+                          mesh.get("seq", 1))
+        if sp > 1 and seq_len % sp:
+            continue
+        for stage in stages:
+            if pp > 1 and stage >= 2:
+                continue  # engine: pipeline composes with ZeRO-0/1 specs
+            mb = 1
+            while mb <= max_micro_batch:
+                need = estimate_memory_per_device(
+                    model_info, stage, max(1, dp), mb, seq_len, dtype,
+                    tp_size=tp, pp_size=pp, sp_size=sp)
+                if need <= hbm_bytes:
+                    cand = {"zero_stage": stage, "micro_batch": mb,
+                            "est_bytes": need}
+                    if mesh is not None:
+                        cand["mesh"] = mesh
+                    space.append(cand)
+                mb *= 2
     return space
 
 
@@ -103,7 +173,8 @@ class Autotuner:
     def __init__(self, model_cfg, base_config: Dict[str, Any],
                  seq_len: int = 64, mode: str = "model_based",
                  max_trials: int = 8, steps_per_trial: int = 3,
-                 hbm_bytes: Optional[int] = None, seed: int = 0):
+                 hbm_bytes: Optional[int] = None, seed: int = 0,
+                 tune_mesh: bool = False, n_devices: Optional[int] = None):
         self.model_cfg = model_cfg
         self.base_config = base_config
         self.seq_len = seq_len
@@ -112,6 +183,8 @@ class Autotuner:
         self.steps_per_trial = steps_per_trial
         self.hbm_bytes = hbm_bytes or (16 << 30)
         self.seed = seed
+        self.tune_mesh = tune_mesh
+        self.n_devices = n_devices
         self.results: List[TrialResult] = []
 
     # ------------------------------------------------------------------
@@ -127,8 +200,15 @@ class Autotuner:
     def _space(self) -> List[Dict[str, Any]]:
         mesh = self.base_config.get("mesh") or {}
         dp = int(mesh.get("data", 1)) * int(mesh.get("expert", 1))
+        meshes = None
+        if self.tune_mesh:
+            import jax
+
+            n = self.n_devices or len(jax.devices())
+            meshes = enumerate_meshes(n, self.model_cfg)
         space = generate_tuning_space(self.model_info(), max(1, dp),
-                                      self.seq_len, self.hbm_bytes)
+                                      self.seq_len, self.hbm_bytes,
+                                      meshes=meshes)
         if self.mode == "random":
             rng = np.random.default_rng(self.seed)
             rng.shuffle(space)
@@ -144,6 +224,8 @@ class Autotuner:
         cfg.setdefault("gradient_accumulation_steps", 1)
         cfg.pop("train_batch_size", None)
         cfg.setdefault("zero_optimization", {})["stage"] = cand["zero_stage"]
+        if cand.get("mesh"):
+            cfg["mesh"] = dict(cand["mesh"])
         return cfg
 
     def run_trial(self, cand: Dict[str, Any]) -> TrialResult:

@@ -280,6 +280,12 @@ class DeepSpeedEngine:
         self._opt_device_shardings = self.opt_shardings
         self._super_opt = None
         off_opt = cfg.zero_config.offload_optimizer
+        if off_opt and getattr(off_opt, "super_offload", False) \
+                and self._param_stream:
+            raise DeepSpeedConfigError(
+                "offload_optimizer.super_offload cannot combine with "
+                "offload_param streaming (ZeRO-Infinity already steps the "
+                "streamed partition host-side); drop one of the two")
         if off_opt and off_opt.device == "cpu" and off_opt.super_offload \
                 and not self._param_stream:
             # SuperOffload (ref engine.py:935 + superoffload_stage3.py):
@@ -682,22 +688,30 @@ class DeepSpeedEngine:
             return (new_params, new_opt, ls_advance(finite, ls_state),
                     grad_norm, finite)
 
-        def train_step(params, opt_state, ls_state, batch_stack, lr):
-            """One full train batch: scan over gas micro-batches + update.
-            micro_grads returns grads of scale·loss; apply_update divides the
-            accumulated sum by scale·gas."""
+        def accum_grads(params, batch_stack, scale):
+            """Scan gas micro-batches, accumulating fp32 grads under the
+            grad shardings (shared by train_step and the SuperOffload
+            grads_batch so the accumulation semantics cannot drift)."""
             zeros = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), params)
             zeros = lax.with_sharding_constraint(zeros, grad_shardings)
 
             def body(carry, mb):
                 grad_acc, loss_acc = carry
-                loss, grads = micro_grads(params, mb, ls_state["scale"])
+                loss, grads = micro_grads(params, mb, scale)
                 grad_acc = jax.tree.map(lambda a, g: a + g.astype(jnp.float32),
                                         grad_acc, grads)
                 grad_acc = lax.with_sharding_constraint(grad_acc, grad_shardings)
                 return (grad_acc, loss_acc + loss), None
 
-            (grads, loss_sum), _ = lax.scan(body, (zeros, jnp.float32(0.0)), batch_stack)
+            (grads, loss_sum), _ = lax.scan(
+                body, (zeros, jnp.float32(0.0)), batch_stack)
+            return grads, loss_sum
+
+        def train_step(params, opt_state, ls_state, batch_stack, lr):
+            """One full train batch: scan over gas micro-batches + update.
+            micro_grads returns grads of scale·loss; apply_update divides the
+            accumulated sum by scale·gas."""
+            grads, loss_sum = accum_grads(params, batch_stack, ls_state["scale"])
             new_params, new_opt, new_ls, grad_norm, finite = apply_update(
                 params, opt_state, grads, lr, ls_state)
             metrics = {"loss": loss_sum / gas, "grad_norm": grad_norm,
@@ -745,20 +759,7 @@ class DeepSpeedEngine:
             # one jit; the optimizer step runs on the host (pipelined
             # bucketed Adam), so no fused device update is compiled.
             def grads_batch(params, batch_stack, scale):
-                zeros = jax.tree.map(
-                    lambda x: jnp.zeros(x.shape, jnp.float32), params)
-                zeros = lax.with_sharding_constraint(zeros, grad_shardings)
-
-                def body(carry, mb):
-                    g_acc, loss_acc = carry
-                    loss, g = micro_grads(params, mb, scale)
-                    g_acc = jax.tree.map(
-                        lambda a, x: a + x.astype(jnp.float32), g_acc, g)
-                    g_acc = lax.with_sharding_constraint(g_acc, grad_shardings)
-                    return (g_acc, loss_acc + loss), None
-
-                (grads, loss_sum), _ = lax.scan(
-                    body, (zeros, jnp.float32(0.0)), batch_stack)
+                grads, loss_sum = accum_grads(params, batch_stack, scale)
                 gn = _global_norm(grads)
                 # match apply_update's semantics: only fp16 runs skip on
                 # overflow — fp32/bf16 NaNs must land in params and be
@@ -1012,6 +1013,15 @@ class DeepSpeedEngine:
         lr = float(self.lr_scheduler(self.global_steps))
         gas = self.gradient_accumulation_steps_value
         scale = self.loss_scale_state["scale"]
+        # bookkeeping snapshot so rollback() can restore EVERYTHING the
+        # step mutates (scheduler counter, loss scale, step counts), not
+        # just the optimizer masters
+        self._super_prev_bookkeeping = {
+            "sched": self.lr_scheduler.state_dict(),
+            "ls": self.loss_scale_state,
+            "global_steps": self.global_steps,
+            "micro_steps": self.micro_steps,
+        }
         loss, grads, gn, finite = self._grads_batch_jit(
             self.params, batch_stack, scale)
         scale_v = float(np.asarray(scale))
@@ -1051,7 +1061,15 @@ class DeepSpeedEngine:
                 "ran); the rollback snapshot belongs to an earlier step")
         self._super_opt.rollback()
         self.params = self._super_opt.push_params(self.params)
-        self.global_steps = max(0, self.global_steps - 1)
+        bk = getattr(self, "_super_prev_bookkeeping", None)
+        if bk is not None:
+            self.lr_scheduler.load_state_dict(bk["sched"])
+            self.loss_scale_state = bk["ls"]
+            self.global_steps = bk["global_steps"]
+            self.micro_steps = bk["micro_steps"]
+            self._super_prev_bookkeeping = None
+        else:
+            self.global_steps = max(0, self.global_steps - 1)
 
     def _advance_loss_scale_host(self, finite: bool) -> None:
         """Host-side entry to the SAME loss-scale policy the jitted step

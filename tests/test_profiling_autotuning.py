@@ -96,3 +96,50 @@ def test_autotuner_end_to_end():
     assert results and any(r.throughput > 0 for r in results)
     assert "train_micro_batch_size_per_gpu" in best
     assert best["zero_optimization"]["stage"] in (0, 1, 2, 3)
+
+
+def test_enumerate_meshes_validity():
+    """Mesh sweep candidates must respect model divisibility (ref
+    autotuner.py:278 tuning-space generation extended with tp/pp/sp/ep)."""
+    from deepspeed_tpu.autotuning.autotuner import enumerate_meshes
+    from deepspeed_tpu.models import get_model_config
+
+    model = get_model_config("llama-tiny")  # 4 heads, 2 kv, 2 layers
+    meshes = enumerate_meshes(8, model)
+    assert {"data": 8} in meshes
+    for m in meshes:
+        n = 1
+        for v in m.values():
+            n *= v
+        assert n == 8
+        assert model.num_heads % m.get("tensor", 1) == 0
+        assert model.num_kv_heads % m.get("tensor", 1) == 0
+        assert model.num_layers % m.get("pipe", 1) == 0
+        assert model.num_heads % m.get("seq", 1) == 0
+        assert "expert" not in m  # dense model: no expert axis
+    # tp=2 and pipe=2 variants must be present (divisible), tp=8 absent
+    assert any(m.get("tensor") == 2 for m in meshes)
+    assert any(m.get("pipe") == 2 for m in meshes)
+    assert not any(m.get("tensor", 1) == 8 for m in meshes)
+    # MoE model gets expert factorizations
+    moe = get_model_config("mixtral-tiny")  # 4 experts
+    assert any(m.get("expert", 1) > 1 for m in enumerate_meshes(8, moe))
+
+
+@pytest.mark.slow
+def test_autotuner_mesh_sweep_runs_trials():
+    """tune_mesh=True sweeps mesh shapes (the highest-leverage TPU knobs)
+    and lands on a runnable config; non-data axes appear in the space."""
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+    from deepspeed_tpu.models import get_model_config
+
+    model = get_model_config("llama-tiny")
+    base = {"optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "steps_per_print": 1000}
+    tuner = Autotuner(model, base, seq_len=32, mode="random", max_trials=3,
+                      steps_per_trial=1, tune_mesh=True, n_devices=8, seed=3)
+    space = tuner._space()
+    assert any(c["mesh"] != {"data": 8} for c in space)
+    best_cfg, results = tuner.tune()
+    assert any(r.throughput > 0 for r in results)
+    assert "mesh" in best_cfg and "zero_optimization" in best_cfg
