@@ -236,10 +236,17 @@ def row_v2_decode():
 
     eng = InferenceEngineV2(model)
     rng = np.random.default_rng(3)
-    prompts = [rng.integers(0, model.vocab_size, size=(32,)).tolist()
+    prompt_len = 32
+    prompts = [rng.integers(0, model.vocab_size, size=(prompt_len,)).tolist()
                for _ in range(n_seqs)]
     # warmup (compile prefill + decode)
     eng.generate(prompts, max_new_tokens=4)
+    # prefill throughput: admit + first token for all prompts (SplitFuse
+    # mixed steps with on-device sampling)
+    t0 = time.perf_counter()
+    eng.generate(prompts, max_new_tokens=1)
+    prefill_dt = time.perf_counter() - t0
+    prefill_tps = n_seqs * prompt_len / prefill_dt
     t0 = time.perf_counter()
     eng.generate(prompts, max_new_tokens=gen_tokens)
     dt = time.perf_counter() - t0
@@ -254,6 +261,7 @@ def row_v2_decode():
         "metric": "v2_decode_tokens_per_sec",
         "value": round(tps, 1), "unit": "tokens/s",
         "vs_baseline": round(tps / (bar_per_seq * n_seqs), 3),
+        "prefill_tokens_per_sec": round(prefill_tps, 1),
     }
 
 

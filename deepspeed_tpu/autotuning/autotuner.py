@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import copy
 import itertools
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -95,7 +96,14 @@ def enumerate_meshes(n_devices: int, model_cfg) -> "List[Dict[str, int]]":
             if layers % pp:
                 continue
             for sp in divisors(n_devices // (tp * pp)):
-                if heads % sp or kv_heads % sp:
+                # only query heads constrain sp: the Ulysses layer expands
+                # KV for GQA when kv_heads < sp (sequence/layer.py:43)
+                if heads % sp:
+                    continue
+                if tp > 1 and sp > 1:
+                    # tensor×seq combined is not supported: the flash
+                    # kernel's head sharding conflicts with the Ulysses
+                    # all-to-all layout (XLA SPMD partitioner aborts)
                     continue
                 rem = n_devices // (tp * pp * sp)
                 for ep in (divisors(rem) if is_moe else [1]):
@@ -110,8 +118,7 @@ def enumerate_meshes(n_devices: int, model_cfg) -> "List[Dict[str, int]]":
                         mesh["seq"] = sp
                     if ep > 1:
                         mesh["expert"] = ep
-                    if mesh not in meshes:
-                        meshes.append(mesh)
+                    meshes.append(mesh)  # every (tp,pp,sp,ep) is distinct
     return meshes
 
 
@@ -174,7 +181,8 @@ class Autotuner:
                  seq_len: int = 64, mode: str = "model_based",
                  max_trials: int = 8, steps_per_trial: int = 3,
                  hbm_bytes: Optional[int] = None, seed: int = 0,
-                 tune_mesh: bool = False, n_devices: Optional[int] = None):
+                 tune_mesh: bool = False, n_devices: Optional[int] = None,
+                 isolate_trials: bool = True):
         self.model_cfg = model_cfg
         self.base_config = base_config
         self.seq_len = seq_len
@@ -185,6 +193,9 @@ class Autotuner:
         self.seed = seed
         self.tune_mesh = tune_mesh
         self.n_devices = n_devices
+        # subprocess isolation (ref: experiments run as separate jobs) —
+        # an aborting/OOMing candidate must not kill the tuner itself
+        self.isolate_trials = isolate_trials
         self.results: List[TrialResult] = []
 
     # ------------------------------------------------------------------
@@ -229,6 +240,76 @@ class Autotuner:
         return cfg
 
     def run_trial(self, cand: Dict[str, Any]) -> TrialResult:
+        if self.isolate_trials:
+            return self._run_trial_subprocess(cand)
+        return self._run_trial_inprocess(cand)
+
+    def _run_trial_subprocess(self, cand: Dict[str, Any]) -> TrialResult:
+        """Run one trial in a fresh subprocess (the reference launches whole
+        experiment jobs, autotuner.py:404): an OOM, compile failure, or a
+        hard XLA abort kills only the trial, never the tuner."""
+        import json
+        import pickle
+        import subprocess
+        import sys
+        import tempfile
+
+        payload = {"model_cfg": self.model_cfg,
+                   "config": self._trial_config(cand),
+                   "seq_len": self.seq_len,
+                   "steps": self.steps_per_trial}
+        with tempfile.NamedTemporaryFile(suffix=".pkl", delete=False) as f:
+            pickle.dump(payload, f)
+            path = f.name
+        import deepspeed_tpu
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(deepspeed_tpu.__file__)))
+        code = (
+            "import os, sys, pickle, time, json\n"
+            f"sys.path.insert(0, {repo!r})\n"
+            "import jax\n"
+            "if os.environ.get('JAX_PLATFORMS'):\n"
+            "    jax.config.update('jax_platforms',"
+            " os.environ['JAX_PLATFORMS'])\n"
+            "import numpy as np\n"
+            "import deepspeed_tpu as ds\n"
+            f"p = pickle.load(open({path!r}, 'rb'))\n"
+            "eng, _, _, _ = ds.initialize(model=p['model_cfg'],"
+            " config=p['config'])\n"
+            "rng = np.random.default_rng(0)\n"
+            "rows = eng.train_batch_size_value\n"
+            "ids = rng.integers(0, p['model_cfg'].vocab_size,"
+            " size=(rows, p['seq_len'] + 1), dtype=np.int32)\n"
+            "b = {'input_ids': ids[:, :-1], 'labels': ids[:, 1:]}\n"
+            "loss = eng.train_batch(b)\n"
+            "float(np.asarray(loss))\n"
+            "t0 = time.perf_counter()\n"
+            "for _ in range(p['steps']):\n"
+            "    loss = eng.train_batch(b)\n"
+            "float(np.asarray(loss))\n"
+            "dt = (time.perf_counter() - t0) / p['steps']\n"
+            "print('DSTPU_TRIAL ' + json.dumps("
+            "{'step_seconds': dt, 'throughput': rows / dt}))\n")
+        try:
+            out = subprocess.run([sys.executable, "-c", code],
+                                 capture_output=True, timeout=600)
+            for line in out.stdout.decode(errors="replace").splitlines():
+                if line.startswith("DSTPU_TRIAL "):
+                    r = json.loads(line[len("DSTPU_TRIAL "):])
+                    return TrialResult(cand, throughput=r["throughput"],
+                                       step_seconds=r["step_seconds"])
+            err = out.stderr.decode(errors="replace")[-300:]
+            logger.warning(f"autotuner trial {cand} failed (rc={out.returncode})")
+            return TrialResult(cand, throughput=0.0,
+                               step_seconds=float("inf"), error=err)
+        except subprocess.TimeoutExpired:
+            return TrialResult(cand, throughput=0.0,
+                               step_seconds=float("inf"), error="timeout")
+        finally:
+            os.unlink(path)
+
+    def _run_trial_inprocess(self, cand: Dict[str, Any]) -> TrialResult:
         import deepspeed_tpu as ds
         from deepspeed_tpu.parallel import topology
 

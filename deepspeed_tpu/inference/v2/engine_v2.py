@@ -22,7 +22,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.inference.v2.model import ragged_decode_loop, ragged_forward
+from deepspeed_tpu.inference.v2.model import (ragged_decode_loop,
+                                              ragged_forward,
+                                              ragged_forward_sampled)
 from deepspeed_tpu.inference.v2.ragged import DSStateManager, build_ragged_batch
 from deepspeed_tpu.inference.v2.scheduler import SplitFuseScheduler
 from deepspeed_tpu.models import transformer as tf_model
@@ -92,6 +94,13 @@ class InferenceEngineV2:
         self._step = jax.jit(
             partial(ragged_forward, cfg=mc, block_size=self.cfg.block_size),
             donate_argnums=(1, 2))
+        # sampled variant: mixed prefill/decode steps fetch [max_seqs] int32
+        # tokens instead of full [max_seqs, V] logits (ref Weak: v2 prefill
+        # loop host-bound — sampling now happens on device for BOTH phases)
+        self._step_sampled = jax.jit(
+            partial(ragged_forward_sampled, cfg=mc,
+                    block_size=self.cfg.block_size),
+            static_argnames=("greedy",), donate_argnums=(1, 2))
         self._decode_loop = jax.jit(
             partial(ragged_decode_loop, cfg=mc, block_size=self.cfg.block_size),
             static_argnames=("n_steps", "greedy"), donate_argnums=(1, 2))
@@ -100,14 +109,12 @@ class InferenceEngineV2:
                  f"max_seqs={self.cfg.max_tracked_sequences} tp={self.cfg.tp_size}")
 
     # ------------------------------------------------------------------
-    def put(self, batch_uids: Sequence[int],
-            batch_tokens: Sequence[Sequence[int]]) -> Dict[int, np.ndarray]:
-        """Admit prompts and run ONE ragged step (ref engine_v2.py:30 put).
-
-        Returns {uid: next-token logits} for sequences whose full prompt (or
-        pending decode token) was processed this step; uids mid-prefill
-        return nothing yet — call put([], []) again to continue.
-        """
+    def _ragged_step(self, batch_uids: Sequence[int],
+                     batch_tokens: Sequence[Sequence[int]],
+                     sample: Optional[Dict[str, Any]] = None):
+        """Admit prompts and run ONE ragged step; returns (rb, result) where
+        result is the full logits array (sample=None) or on-device-sampled
+        tokens [max_seqs] (sample={'key','temperature'})."""
         # Validate the whole batch before touching any state, so a bad entry
         # cannot leave earlier prompts half-admitted.
         if len(batch_uids) != len(batch_tokens):
@@ -125,7 +132,7 @@ class InferenceEngineV2:
             self.scheduler.add(uid)
         schedule = self.scheduler.next_schedule()
         if not schedule:
-            return {}
+            return None, None
         rb = build_ragged_batch(schedule, self.state_manager,
                                 self.scheduler.token_budget)
         # Bucket the step's shapes (power-of-two token count and context
@@ -144,15 +151,34 @@ class InferenceEngineV2:
         while nb_bucket < nb_real:
             nb_bucket *= 2
         nb_bucket = min(nb_bucket, self.state_manager.max_blocks_per_seq)
-        logits, self.cache_k, self.cache_v = self._step(
-            self.params, self.cache_k, self.cache_v,
-            jnp.asarray(rb.token_ids[:t_bucket]),
-            jnp.asarray(rb.token_slot[:t_bucket]),
-            jnp.asarray(rb.token_pos[:t_bucket]),
-            jnp.asarray(rb.token_dest[:t_bucket]),
-            jnp.asarray(rb.block_tables[:, :nb_bucket]),
-            jnp.asarray(rb.ctx_lens),
-            jnp.asarray(rb.logits_idx))
+        args = (self.params, self.cache_k, self.cache_v,
+                jnp.asarray(rb.token_ids[:t_bucket]),
+                jnp.asarray(rb.token_slot[:t_bucket]),
+                jnp.asarray(rb.token_pos[:t_bucket]),
+                jnp.asarray(rb.token_dest[:t_bucket]),
+                jnp.asarray(rb.block_tables[:, :nb_bucket]),
+                jnp.asarray(rb.ctx_lens),
+                jnp.asarray(rb.logits_idx))
+        if sample is None:
+            logits, self.cache_k, self.cache_v = self._step(*args)
+            return rb, logits
+        toks, self.cache_k, self.cache_v = self._step_sampled(
+            *args, key=sample["key"],
+            temperature=jnp.float32(max(sample["temperature"], 1e-6)),
+            greedy=(sample["temperature"] <= 0))
+        return rb, toks
+
+    def put(self, batch_uids: Sequence[int],
+            batch_tokens: Sequence[Sequence[int]]) -> Dict[int, np.ndarray]:
+        """Admit prompts and run ONE ragged step (ref engine_v2.py:30 put).
+
+        Returns {uid: next-token logits} for sequences whose full prompt (or
+        pending decode token) was processed this step; uids mid-prefill
+        return nothing yet — call put([], []) again to continue.
+        """
+        rb, logits = self._ragged_step(batch_uids, batch_tokens)
+        if rb is None:
+            return {}
         logits_np = np.asarray(logits)
         return {uid: logits_np[slot] for slot, uid in rb.uids_by_slot.items()}
 
@@ -178,7 +204,7 @@ class InferenceEngineV2:
         remaining = {u: max_new_tokens for u in uids}
         outputs: Dict[int, List[int]] = {u: [] for u in uids}
         pending = list(zip(uids, prompts))
-        rng = np.random.default_rng(seed)
+        step_key = jax.random.PRNGKey(seed)
 
         total_blocks = self.cfg.num_blocks - 1  # block 0 reserved
         bs = self.cfg.block_size
@@ -225,15 +251,18 @@ class InferenceEngineV2:
             if pending and not admit_uids and self.state_manager.n_active == 0:
                 raise RuntimeError("cannot admit any pending prompt: KV cache "
                                    "too fragmented/small for the workload")
-            results = self.put(admit_uids, admit_toks)
-            for uid, logits in results.items():
-                if temperature > 0:
-                    z = logits / temperature
-                    z = z - z.max()
-                    p = np.exp(z) / np.exp(z).sum()
-                    nxt = int(rng.choice(len(p), p=p))
-                else:
-                    nxt = int(np.argmax(logits))
+            # mixed prefill/decode step with ON-DEVICE sampling: only
+            # [max_seqs] int32 tokens cross to the host, not [seqs, V]
+            # logits (the decode-phase discipline applied to prefill too)
+            step_key, sub = jax.random.split(step_key)
+            rb, toks = self._ragged_step(
+                admit_uids, admit_toks,
+                sample={"key": sub, "temperature": temperature})
+            toks_np = np.asarray(toks) if rb is not None else None
+            results = ({} if rb is None
+                       else {uid: int(toks_np[slot])
+                             for slot, uid in rb.uids_by_slot.items()})
+            for uid, nxt in results.items():
                 outputs[uid].append(nxt)
                 remaining[uid] -= 1
                 done = remaining[uid] <= 0 or (eos_token_id is not None

@@ -221,6 +221,30 @@ def ragged_forward(params, cache_k, cache_v, token_ids, token_slot, token_pos,
     return logits.astype(jnp.float32), cache_k, cache_v
 
 
+def ragged_forward_sampled(params, cache_k, cache_v, token_ids, token_slot,
+                           token_pos, token_dest, block_tables, ctx_lens,
+                           logits_idx, key, temperature,
+                           cfg: TransformerConfig, block_size: int,
+                           greedy: bool
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Ragged step + ON-DEVICE sampling: the host receives [S+1] int32
+    tokens instead of [S+1, V] logits.  Same sampling semantics as the
+    fused decode loop (greedy argmax / temperature categorical), so a
+    generation that alternates prefill and decode phases stays consistent.
+    """
+    logits, cache_k, cache_v = ragged_forward(
+        params, cache_k, cache_v, token_ids, token_slot, token_pos,
+        token_dest, block_tables, ctx_lens, logits_idx, cfg=cfg,
+        block_size=block_size)
+    if greedy:
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    else:
+        nxt = jax.random.categorical(
+            key, logits / jnp.maximum(temperature, 1e-6),
+            axis=-1).astype(jnp.int32)
+    return nxt, cache_k, cache_v
+
+
 def ragged_decode_loop(params, cache_k, cache_v, tokens0, ctx_lens0,
                        active, block_tables, key, temperature,
                        cfg: TransformerConfig, block_size: int,
