@@ -182,7 +182,8 @@ class Autotuner:
                  max_trials: int = 8, steps_per_trial: int = 3,
                  hbm_bytes: Optional[int] = None, seed: int = 0,
                  tune_mesh: bool = False, n_devices: Optional[int] = None,
-                 isolate_trials: bool = True):
+                 isolate_trials: bool = True,
+                 trial_timeout: Optional[float] = None):
         self.model_cfg = model_cfg
         self.base_config = base_config
         self.seq_len = seq_len
@@ -196,6 +197,8 @@ class Autotuner:
         # subprocess isolation (ref: experiments run as separate jobs) —
         # an aborting/OOMing candidate must not kill the tuner itself
         self.isolate_trials = isolate_trials
+        # generous default: engine build + XLA compile + timed steps
+        self.trial_timeout = trial_timeout or (600.0 + 30.0 * steps_per_trial)
         self.results: List[TrialResult] = []
 
     # ------------------------------------------------------------------
@@ -247,12 +250,17 @@ class Autotuner:
     def _run_trial_subprocess(self, cand: Dict[str, Any]) -> TrialResult:
         """Run one trial in a fresh subprocess (the reference launches whole
         experiment jobs, autotuner.py:404): an OOM, compile failure, or a
-        hard XLA abort kills only the trial, never the tuner."""
+        hard XLA abort kills only the trial, never the tuner.  The trial
+        body is deepspeed_tpu.autotuning.trial_runner (shared with the
+        in-process path)."""
         import json
         import pickle
+        import re as _re
         import subprocess
         import sys
         import tempfile
+
+        from deepspeed_tpu.autotuning.trial_runner import RESULT_PREFIX
 
         payload = {"model_cfg": self.model_cfg,
                    "config": self._trial_config(cand),
@@ -265,38 +273,28 @@ class Autotuner:
 
         repo = os.path.dirname(os.path.dirname(
             os.path.abspath(deepspeed_tpu.__file__)))
-        code = (
-            "import os, sys, pickle, time, json\n"
-            f"sys.path.insert(0, {repo!r})\n"
-            "import jax\n"
-            "if os.environ.get('JAX_PLATFORMS'):\n"
-            "    jax.config.update('jax_platforms',"
-            " os.environ['JAX_PLATFORMS'])\n"
-            "import numpy as np\n"
-            "import deepspeed_tpu as ds\n"
-            f"p = pickle.load(open({path!r}, 'rb'))\n"
-            "eng, _, _, _ = ds.initialize(model=p['model_cfg'],"
-            " config=p['config'])\n"
-            "rng = np.random.default_rng(0)\n"
-            "rows = eng.train_batch_size_value\n"
-            "ids = rng.integers(0, p['model_cfg'].vocab_size,"
-            " size=(rows, p['seq_len'] + 1), dtype=np.int32)\n"
-            "b = {'input_ids': ids[:, :-1], 'labels': ids[:, 1:]}\n"
-            "loss = eng.train_batch(b)\n"
-            "float(np.asarray(loss))\n"
-            "t0 = time.perf_counter()\n"
-            "for _ in range(p['steps']):\n"
-            "    loss = eng.train_batch(b)\n"
-            "float(np.asarray(loss))\n"
-            "dt = (time.perf_counter() - t0) / p['steps']\n"
-            "print('DSTPU_TRIAL ' + json.dumps("
-            "{'step_seconds': dt, 'throughput': rows / dt}))\n")
+        # propagate the parent's LIVE jax setup — it is often configured
+        # programmatically (jax.config.update), which env vars alone would
+        # not reproduce in the child
+        import jax
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        if jax.default_backend() == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            ndev = self.n_devices or len(jax.devices())
+            flags = _re.sub(r"--xla_force_host_platform_device_count=\d+",
+                            "", env.get("XLA_FLAGS", ""))
+            env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_"
+                                f"count={ndev}").strip()
         try:
-            out = subprocess.run([sys.executable, "-c", code],
-                                 capture_output=True, timeout=600)
+            out = subprocess.run(
+                [sys.executable, "-m",
+                 "deepspeed_tpu.autotuning.trial_runner", path],
+                capture_output=True, timeout=self.trial_timeout, env=env)
             for line in out.stdout.decode(errors="replace").splitlines():
-                if line.startswith("DSTPU_TRIAL "):
-                    r = json.loads(line[len("DSTPU_TRIAL "):])
+                if line.startswith(RESULT_PREFIX):
+                    r = json.loads(line[len(RESULT_PREFIX):])
                     return TrialResult(cand, throughput=r["throughput"],
                                        step_seconds=r["step_seconds"])
             err = out.stderr.decode(errors="replace")[-300:]
@@ -304,33 +302,23 @@ class Autotuner:
             return TrialResult(cand, throughput=0.0,
                                step_seconds=float("inf"), error=err)
         except subprocess.TimeoutExpired:
+            logger.warning(f"autotuner trial {cand} timed out after "
+                           f"{self.trial_timeout:.0f}s")
             return TrialResult(cand, throughput=0.0,
                                step_seconds=float("inf"), error="timeout")
         finally:
             os.unlink(path)
 
     def _run_trial_inprocess(self, cand: Dict[str, Any]) -> TrialResult:
-        import deepspeed_tpu as ds
+        from deepspeed_tpu.autotuning.trial_runner import run_timed_trial
         from deepspeed_tpu.parallel import topology
 
         cfg = self._trial_config(cand)
         try:
-            engine, _, _, _ = ds.initialize(model=self.model_cfg, config=cfg)
-            rng = np.random.default_rng(0)
-            rows = (engine.train_batch_size_value
-                    * 1)
-            ids = rng.integers(0, self.model_cfg.vocab_size,
-                               size=(rows, self.seq_len + 1), dtype=np.int32)
-            batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
-            loss = engine.train_batch(batch)  # compile step (excluded)
-            float(np.asarray(loss))
-            t0 = time.perf_counter()
-            for _ in range(self.steps_per_trial):
-                loss = engine.train_batch(batch)
-            float(np.asarray(loss))  # sync
-            dt = (time.perf_counter() - t0) / self.steps_per_trial
-            tput = engine.train_batch_size_value / dt
-            return TrialResult(cand, throughput=tput, step_seconds=dt)
+            r = run_timed_trial(self.model_cfg, cfg, self.seq_len,
+                                self.steps_per_trial)
+            return TrialResult(cand, throughput=r["throughput"],
+                               step_seconds=r["step_seconds"])
         except Exception as e:  # OOM / compile failure → score 0
             logger.warning(f"autotuner trial {cand} failed: {e}")
             return TrialResult(cand, throughput=0.0, step_seconds=float("inf"),

@@ -1,0 +1,117 @@
+"""TiledLinear — split big linears into tiles (ref runtime/zero/tiling.py).
+
+The reference's ``TiledLinear`` decomposes one huge ``nn.Linear`` into an
+``in_splits × out_splits`` grid of small Linears so ZeRO-3 can
+gather/release one tile's weights at a time instead of the whole matrix.
+The TPU realisation keeps the same capability with compiled control flow:
+the weight lives as a stacked ``[in_splits * out_splits, in_tile,
+out_tile]`` array scanned tile-by-tile under ``jax.checkpoint``, so at most
+one tile's activation product is live during the backward — the
+sequence-tiled analog in ``sequence/alst.py:tiled_mlp`` tiles the TOKEN
+dim; this module tiles the FEATURE dims.
+
+Functional API (no module system): ``init`` → params, ``apply`` → output.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class TiledLinear:
+    """``y = x @ W + b`` computed as an in_splits×out_splits tile grid.
+
+    ``in_features`` must divide by ``in_splits`` and ``out_features`` by
+    ``out_splits``.  ``remat`` wraps each tile's product in
+    ``jax.checkpoint`` so the backward recomputes per-tile (O(tile)
+    activation residency, the point of the reference module).
+    """
+
+    def __init__(self, in_features: int, out_features: int,
+                 in_splits: int = 1, out_splits: int = 1, bias: bool = True,
+                 remat: bool = True, dtype=jnp.float32):
+        if in_features % in_splits or out_features % out_splits:
+            raise ValueError(
+                f"splits must divide features: {in_features}/{in_splits}, "
+                f"{out_features}/{out_splits}")
+        self.in_features = in_features
+        self.out_features = out_features
+        self.in_splits = in_splits
+        self.out_splits = out_splits
+        self.in_tile = in_features // in_splits
+        self.out_tile = out_features // out_splits
+        self.use_bias = bias
+        self.remat = remat
+        self.dtype = dtype
+
+    def init(self, key, scale: Optional[float] = None):
+        """Stacked tile weights [in_splits*out_splits, in_tile, out_tile]
+        (+ bias [out_features]); tile (i, o) is row ``i * out_splits + o``.
+        """
+        scale = scale if scale is not None else self.in_features ** -0.5
+        wkey, _ = jax.random.split(key)
+        w = jax.random.normal(
+            wkey, (self.in_splits * self.out_splits, self.in_tile,
+                   self.out_tile), self.dtype) * scale
+        params = {"w_tiles": w}
+        if self.use_bias:
+            params["b"] = jnp.zeros((self.out_features,), self.dtype)
+        return params
+
+    def from_dense(self, w, b=None):
+        """Pack a dense [in, out] weight into the tiled layout."""
+        w = jnp.asarray(w, self.dtype)
+        if w.shape != (self.in_features, self.out_features):
+            raise ValueError(f"weight shape {w.shape} != "
+                             f"({self.in_features}, {self.out_features})")
+        tiles = w.reshape(self.in_splits, self.in_tile,
+                          self.out_splits, self.out_tile)
+        tiles = tiles.transpose(0, 2, 1, 3).reshape(
+            self.in_splits * self.out_splits, self.in_tile, self.out_tile)
+        params = {"w_tiles": tiles}
+        if self.use_bias:
+            params["b"] = (jnp.zeros((self.out_features,), self.dtype)
+                           if b is None else jnp.asarray(b, self.dtype))
+        return params
+
+    def to_dense(self, params):
+        """Tiled layout → dense [in, out] weight (checkpoint export)."""
+        t = params["w_tiles"].reshape(self.in_splits, self.out_splits,
+                                      self.in_tile, self.out_tile)
+        return t.transpose(0, 2, 1, 3).reshape(self.in_features,
+                                               self.out_features)
+
+    def apply(self, params, x):
+        """x [..., in_features] → [..., out_features], scanning the tile
+        grid; each (in, out) product is rematerialized in the backward."""
+        lead = x.shape[:-1]
+        xs = x.reshape(-1, self.in_splits, self.in_tile)  # [N, IS, it]
+
+        def tile_product(w_row, x_in):
+            return x_in @ w_row
+
+        if self.remat:
+            tile_product = jax.checkpoint(tile_product)
+
+        def out_block(o):
+            def body(acc, i):
+                w_row = params["w_tiles"][i * self.out_splits + o]
+                return acc + tile_product(w_row, xs[:, i, :]), None
+
+            acc0 = jnp.zeros((xs.shape[0], self.out_tile), x.dtype)
+            acc, _ = lax.scan(body, acc0, jnp.arange(self.in_splits))
+            return acc
+
+        # out blocks are independent → vmap'd scan over the grid
+        blocks = [out_block(o) for o in range(self.out_splits)]
+        y = jnp.concatenate(blocks, axis=-1)
+        if self.use_bias:
+            y = y + params["b"].astype(x.dtype)
+        return y.reshape(*lead, self.out_features)
+
+    def __call__(self, params, x):
+        return self.apply(params, x)

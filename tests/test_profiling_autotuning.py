@@ -87,11 +87,14 @@ def test_tuning_space_respects_budget():
 @pytest.mark.slow
 def test_autotuner_end_to_end():
     model = get_model_config("gpt2-tiny")
+    # in-process trials: subprocess isolation is covered by its own test;
+    # under full-suite load a fresh jax-loading subprocess per trial can
+    # starve on a single-core box and time out spuriously
     tuner = Autotuner(model, {"optimizer": {"type": "AdamW",
                                             "params": {"lr": 1e-3}},
                               "mesh": {"data": 1}},
                       seq_len=16, mode="model_based", max_trials=2,
-                      steps_per_trial=1)
+                      steps_per_trial=1, isolate_trials=False)
     best, results = tuner.tune()
     assert results and any(r.throughput > 0 for r in results)
     assert "train_micro_batch_size_per_gpu" in best
@@ -137,9 +140,32 @@ def test_autotuner_mesh_sweep_runs_trials():
     base = {"optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
             "steps_per_print": 1000}
     tuner = Autotuner(model, base, seq_len=32, mode="random", max_trials=3,
-                      steps_per_trial=1, tune_mesh=True, n_devices=8, seed=3)
+                      steps_per_trial=1, tune_mesh=True, n_devices=8, seed=3,
+                      isolate_trials=False)
     space = tuner._space()
     assert any(c["mesh"] != {"data": 8} for c in space)
     best_cfg, results = tuner.tune()
     assert any(r.throughput > 0 for r in results)
     assert "mesh" in best_cfg and "zero_optimization" in best_cfg
+
+
+def test_autotuner_subprocess_isolation_contains_crash():
+    """A candidate whose trial subprocess dies (here: config error at
+    engine init) must score 0 without killing the tuner — the property
+    that matters for hard XLA aborts (ref: experiments as separate jobs,
+    autotuner.py:404)."""
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+    from deepspeed_tpu.models import get_model_config
+
+    model = get_model_config("gpt2-tiny")
+    # super_offload + a non-Adam optimizer raises DeepSpeedConfigError in
+    # the subprocess before any compilation: a fast, deterministic death
+    base = {"optimizer": {"type": "lamb", "params": {"lr": 1e-3}},
+            "mesh": {"data": 1},
+            "zero_optimization": {"offload_optimizer": {
+                "device": "cpu", "super_offload": True}}}
+    tuner = Autotuner(model, base, seq_len=16, mode="grid", max_trials=1,
+                      steps_per_trial=1, isolate_trials=True)
+    cand = tuner._space()[0]
+    res = tuner.run_trial(cand)
+    assert res.throughput == 0.0 and res.error
