@@ -265,10 +265,11 @@ def row_v2_decode():
     }
 
 
-def _device_reachable(timeout_s: float = 120.0) -> bool:
+def _device_probe_error(timeout_s: float = 120.0):
     """Probe the default JAX backend in a SUBPROCESS with a deadline —
     jax.devices() blocks indefinitely when the TPU tunnel is down, and a
-    hung bench run records nothing at all (worse than an error row)."""
+    hung bench run records nothing at all (worse than an error row).
+    Returns None when reachable, else a diagnostic string."""
     import subprocess
     import sys
 
@@ -277,17 +278,21 @@ def _device_reachable(timeout_s: float = 120.0) -> bool:
             [sys.executable, "-c",
              "import jax; assert len(jax.devices()) >= 1"],
             capture_output=True, timeout=timeout_s)
-        return r.returncode == 0
+        if r.returncode == 0:
+            return None
+        tail = r.stderr.decode(errors="replace").strip()[-200:]
+        return f"device probe exited rc={r.returncode}: {tail}"
     except subprocess.TimeoutExpired:
-        return False
+        return f"device probe timed out after {timeout_s:.0f}s (tunnel down?)"
 
 
 def main() -> None:
-    if not SMOKE and not _device_reachable():
+    probe_err = None if SMOKE else _device_probe_error()
+    if probe_err is not None:
         print(json.dumps({
             "metric": "gpt2_350m_zero1_train_tokens_per_sec_per_chip",
             "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
-            "error": "TPU backend unreachable (device probe timed out)",
+            "error": f"TPU backend unreachable ({probe_err})",
             "rows": []}), flush=True)
         return
     rows = []

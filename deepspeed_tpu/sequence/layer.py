@@ -27,7 +27,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu.parallel.topology import (BATCH_AXES, SEQ_AXIS, MeshTopology,
+from deepspeed_tpu.parallel.topology import (BATCH_AXES, SEQ_AXIS,
+                                             TENSOR_AXIS, MeshTopology,
                                              get_topology)
 
 
@@ -41,25 +42,35 @@ def _constraint(x, spec):
 def ulysses_qkv_constraint(q, k, v):
     """Pin q/k/v [B, S, H, D] to head-sharded over the seq axis (XLA inserts
     the seq→head all-to-all). KV heads may be fewer than sp_size (GQA): then
-    KV stays seq-sharded and XLA all-gathers inside attention instead."""
+    KV stays seq-sharded and XLA all-gathers inside attention instead.
+
+    Composed with tensor parallelism the heads are already tp-sharded, so
+    the target layout shards heads JOINTLY over (tensor, seq) — pinning
+    them to seq alone asks the partitioner for a tensor→seq relayout it
+    cannot express and it hard-aborts. Requires heads % (tp·sp) == 0."""
     topo = get_topology()
     if topo is None or topo.sp_size == 1:
         return q, k, v
-    sp = topo.sp_size
-    head_spec = P(BATCH_AXES, None, SEQ_AXIS, None)
-    q = _constraint(q, head_spec) if q.shape[2] % sp == 0 else q
-    k = _constraint(k, head_spec) if k.shape[2] % sp == 0 else k
-    v = _constraint(v, head_spec) if v.shape[2] % sp == 0 else v
+    sp, tp = topo.sp_size, topo.tp_size
+    grp = sp * tp
+    head_spec = (P(BATCH_AXES, None, (TENSOR_AXIS, SEQ_AXIS), None)
+                 if tp > 1 else P(BATCH_AXES, None, SEQ_AXIS, None))
+    q = _constraint(q, head_spec) if q.shape[2] % grp == 0 else q
+    k = _constraint(k, head_spec) if k.shape[2] % grp == 0 else k
+    v = _constraint(v, head_spec) if v.shape[2] % grp == 0 else v
     return q, k, v
 
 
 def ulysses_output_constraint(out):
     """Pin attention output [B, S, H*D] back to seq-sharded (head→seq
-    all-to-all)."""
+    all-to-all).  Under tp the hidden dim stays TENSOR-sharded — that is
+    the row-parallel wo matmul's natural input layout (its contracting dim
+    is tp-sharded), so no tensor-axis all-gather is forced here."""
     topo = get_topology()
     if topo is None or topo.sp_size == 1:
         return out
-    return _constraint(out, P(BATCH_AXES, SEQ_AXIS, None))
+    hid = TENSOR_AXIS if topo.tp_size > 1 else None
+    return _constraint(out, P(BATCH_AXES, SEQ_AXIS, hid))
 
 
 def single_all_to_all(x, scatter_idx: int, gather_idx: int, axis: str = SEQ_AXIS):

@@ -153,3 +153,17 @@ def test_distributed_attention_wrapper():
     out = dist_attn(q, k, v)
     expected = local_attn(q, k, v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_composes_with_tensor_parallel():
+    """tp×sp composition: heads shard jointly over (tensor, seq)
+    (sequence/layer.py ulysses_qkv_constraint) — must reproduce the pure-DP
+    trajectory, and must not trip the SPMD partitioner."""
+    model = get_model_config("llama-tiny")  # 4 heads = tp2 * sp2
+    batches = _batches(model)
+    dp = _losses(model, _cfg({"data": 8},
+                             train_micro_batch_size_per_gpu=1), batches)
+    mix = _losses(model, _cfg({"data": 2, "tensor": 2, "seq": 2},
+                              train_micro_batch_size_per_gpu=4), batches)
+    np.testing.assert_allclose(dp, mix, rtol=2e-4, atol=2e-4)
+    assert mix[-1] < mix[0]
