@@ -266,24 +266,11 @@ def row_v2_decode():
 
 
 def _device_probe_error(timeout_s: float = 120.0):
-    """Probe the default JAX backend in a SUBPROCESS with a deadline —
-    jax.devices() blocks indefinitely when the TPU tunnel is down, and a
-    hung bench run records nothing at all (worse than an error row).
-    Returns None when reachable, else a diagnostic string."""
-    import subprocess
-    import sys
+    """A hung bench run records nothing at all (worse than an error row) —
+    probe the backend with a deadline before touching it."""
+    from deepspeed_tpu.utils.device_probe import probe_default_backend
 
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; assert len(jax.devices()) >= 1"],
-            capture_output=True, timeout=timeout_s)
-        if r.returncode == 0:
-            return None
-        tail = r.stderr.decode(errors="replace").strip()[-200:]
-        return f"device probe exited rc={r.returncode}: {tail}"
-    except subprocess.TimeoutExpired:
-        return f"device probe timed out after {timeout_s:.0f}s (tunnel down?)"
+    return probe_default_backend(1, timeout_s)
 
 
 def main() -> None:

@@ -1,0 +1,42 @@
+"""Bounded JAX backend probing.
+
+``jax.devices()`` blocks INDEFINITELY when the default platform's runtime
+is unreachable (e.g. a down TPU tunnel), so anything that might touch an
+uninitialized backend probes it in a subprocess with a deadline first.
+Shared by ``bench.py`` and ``__graft_entry__.py``.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+from typing import Optional
+
+
+def backend_is_live() -> bool:
+    """Whether THIS process already initialized a JAX backend (checking a
+    live backend is instant and safe; only first-touch can hang)."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        return False
+
+
+def probe_default_backend(min_devices: int = 1,
+                          timeout_s: float = 120.0) -> Optional[str]:
+    """Probe the default backend in a subprocess.  Returns None when it is
+    reachable with >= min_devices, else a diagnostic string."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             f"import jax; raise SystemExit(0 if len(jax.devices()) >= "
+             f"{int(min_devices)} else 1)"],
+            capture_output=True, timeout=timeout_s)
+        if r.returncode == 0:
+            return None
+        tail = r.stderr.decode(errors="replace").strip()[-200:]
+        return f"device probe exited rc={r.returncode}: {tail}"
+    except subprocess.TimeoutExpired:
+        return f"device probe timed out after {timeout_s:.0f}s (tunnel down?)"

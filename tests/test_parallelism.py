@@ -161,9 +161,7 @@ def test_ulysses_composes_with_tensor_parallel():
     trajectory, and must not trip the SPMD partitioner."""
     model = get_model_config("llama-tiny")  # 4 heads = tp2 * sp2
     batches = _batches(model)
-    dp = _losses(model, _cfg({"data": 8},
-                             train_micro_batch_size_per_gpu=1), batches)
-    mix = _losses(model, _cfg({"data": 2, "tensor": 2, "seq": 2},
-                              train_micro_batch_size_per_gpu=4), batches)
+    dp = _losses(model, _cfg({"data": 8}), batches)
+    mix = _losses(model, _cfg({"data": 2, "tensor": 2, "seq": 2}), batches)
     np.testing.assert_allclose(dp, mix, rtol=2e-4, atol=2e-4)
     assert mix[-1] < mix[0]
