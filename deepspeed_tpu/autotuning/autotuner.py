@@ -15,10 +15,8 @@ stage → prune stages that can't fit → sweep micro-batch sizes (power-of-2
 from __future__ import annotations
 
 import copy
-import itertools
 import os
-import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
 import numpy as np
