@@ -1,0 +1,133 @@
+"""deepspeed_tpu — a TPU-native large-scale training & inference framework
+with the capabilities of DeepSpeed, built on JAX/XLA/Pallas/pjit.
+
+Top-level API mirrors the reference (``deepspeed/__init__.py``):
+
+    import deepspeed_tpu as ds
+    engine, optimizer, dataloader, lr_scheduler = ds.initialize(
+        model=ds.models.get_model_config("gpt2-125m"),
+        config="ds_config.json")
+    loss = engine.train_batch(batch)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+__version__ = "0.1.0"
+__git_branch__ = "main"
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.parallel.topology import MeshTopology, get_topology, set_topology
+from deepspeed_tpu import comm  # noqa: F401
+from deepspeed_tpu.utils.logging import logger, log_dist  # noqa: F401
+
+
+def initialize(args=None,
+               model: Any = None,
+               optimizer: Any = None,
+               model_parameters: Any = None,
+               training_data: Any = None,
+               lr_scheduler: Any = None,
+               distributed_port: Optional[int] = None,
+               mpu: Any = None,
+               dist_init_required: Optional[bool] = None,
+               collate_fn: Any = None,
+               config: Union[str, Dict[str, Any], None] = None,
+               config_params: Union[str, Dict[str, Any], None] = None,
+               mesh_param=None,
+               seed: Optional[int] = None):
+    """Initialize the engine. Ref: ``deepspeed.initialize`` (__init__.py:78).
+
+    Returns the reference's 4-tuple ``(engine, optimizer, dataloader,
+    lr_scheduler)``.  ``model`` is a :class:`TransformerConfig` from the model
+    zoo or any object with ``init(rng)``/``loss(params, batch)``;
+    ``model_parameters`` may carry a pre-built param pytree.
+    """
+    from deepspeed_tpu.comm.comm import init_distributed
+
+    config = config if config is not None else config_params
+    if args is not None and config is None:
+        config = getattr(args, "deepspeed_config", None)
+
+    if mpu is not None and get_topology() is None:
+        # Megatron-style caller: derive the mesh from the mpu's sizes
+        # (ref engine._configure_distributed_model mpu path)
+        from deepspeed_tpu.utils.mpu_adapter import topology_from_mpu
+
+        set_topology(topology_from_mpu(mpu))
+    init_distributed()
+    engine = DeepSpeedEngine(model=model,
+                             config=config,
+                             model_params=model_parameters,
+                             optimizer=optimizer,
+                             lr_scheduler=lr_scheduler,
+                             seed=seed)
+
+    dataloader = None
+    if training_data is not None:
+        from deepspeed_tpu.runtime.dataloader import DeepSpeedDataLoader
+
+        dataloader = DeepSpeedDataLoader(
+            training_data,
+            batch_size=engine.train_batch_size_value,
+            collate_fn=collate_fn,
+            drop_last=engine.config.dataloader_drop_last)
+
+    return engine, engine.optimizer, dataloader, engine.lr_scheduler
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Ref: ``deepspeed.init_inference`` (__init__.py:302)."""
+    from deepspeed_tpu.inference.engine import InferenceEngine
+
+    return InferenceEngine(model=model, config=config, **kwargs)
+
+
+def tp_model_init(model=None, tp_size: int = 1, dtype=None, config=None,
+                  **kwargs):
+    """AutoTP training init: shard a param tree over the "tensor" mesh axis.
+    Ref: ``deepspeed.tp_model_init`` (deepspeed/__init__.py:380).
+
+    ``config`` may carry a ``tensor_parallel.autotp_size`` override (the
+    reference reads the same key). An existing topology with other mesh axes
+    (pipe/expert/seq) is an error if its tp size conflicts — rebuilding the
+    mesh here would silently drop those axes.
+    """
+    from deepspeed_tpu.comm.comm import init_distributed
+    from deepspeed_tpu.module_inject.auto_tp import tp_model_init as _tp_init
+    from deepspeed_tpu.parallel.topology import get_topology
+
+    if config:
+        tp_size = (config.get("tensor_parallel", {}) or {}).get(
+            "autotp_size", tp_size)
+    topo = get_topology()
+    if topo is None:
+        topo = init_distributed(mesh_sizes={"tensor": tp_size} if tp_size > 1
+                                else None)
+    elif tp_size > 1 and topo.tp_size != tp_size:
+        extra = {a: s for a, s in topo.sizes.items()
+                 if a not in ("data", "tensor") and s > 1}
+        if extra:
+            raise ValueError(
+                f"tp_model_init(tp_size={tp_size}) conflicts with existing "
+                f"topology {topo.sizes}; re-run init_distributed with the "
+                f"full mesh instead of rebuilding it here")
+        topo = init_distributed(mesh_sizes={"tensor": tp_size})
+    params = model
+    if dtype is not None:
+        import jax
+
+        params = jax.tree.map(lambda x: x.astype(dtype), params)
+    return _tp_init(params, topo, **kwargs)
+
+
+# subpackage conveniences
+from deepspeed_tpu.models import registry as models  # noqa: E402
+from deepspeed_tpu.models.registry import get_model_config  # noqa: E402
+from deepspeed_tpu import zero  # noqa: E402
+from deepspeed_tpu import checkpointing  # noqa: E402
+from deepspeed_tpu.utils.init_on_device import OnDevice  # noqa: E402
+from deepspeed_tpu.utils.mpu_adapter import MpuAdapter  # noqa: E402
+from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine  # noqa: E402

@@ -1,0 +1,388 @@
+"""Accelerator abstraction — runtime device plug-in interface.
+
+TPU-native analog of the reference's ``DeepSpeedAccelerator``
+(accelerator/abstract_accelerator.py:10).  The reference exposes ~80 abstract
+methods shaped around CUDA semantics (streams, events, caching allocator).
+On JAX/XLA those map to:
+
+* streams/events  → XLA's async dispatch queue; ``synchronize`` is
+  ``jax.block_until_ready`` / ``device.synchronize_all_activity``.
+* memory stats    → PJRT ``device.memory_stats()``.
+* RNG             → functional ``jax.random`` keys (a mutable wrapper is
+  provided for API parity).
+* graph capture   → ``jax.jit`` (everything is a captured graph); the
+  reference's ``create_graph/capture_to_graph/replay_graph`` map to jitted
+  callables.
+* op builder      → ``ops.op_builder`` (C++ host ops via ctypes) and the
+  Pallas kernel registry.
+
+Backends: ``tpu`` (also drives any PJRT device incl. GPU) and ``cpu``
+(the test/fake backend, mirroring the reference's cpu_accelerator role).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    """Abstract accelerator interface (ref abstract_accelerator.py:10)."""
+
+    def __init__(self):
+        self._name: Optional[str] = None
+        self._communication_backend_name: Optional[str] = None
+        self._compile_backend: Optional[str] = None
+
+    # ------------------------------------------------------------------
+    # Identification
+    # ------------------------------------------------------------------
+    @abc.abstractmethod
+    def is_synchronized_device(self) -> bool:
+        ...
+
+    def use_host_timers(self) -> bool:
+        return self.is_synchronized_device()
+
+    def resolves_data_dependency(self) -> bool:
+        # XLA resolves data dependencies inside the compiled program.
+        return True
+
+    def handles_memory_backpressure(self) -> bool:
+        return False
+
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return self._name or "unknown"
+        return f"{self._name}:{device_index}"
+
+    # ------------------------------------------------------------------
+    # Device APIs
+    # ------------------------------------------------------------------
+    @abc.abstractmethod
+    def device(self, device_index: Optional[int] = None):
+        ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def set_device(self, device_index: int) -> None:
+        ...
+
+    @abc.abstractmethod
+    def current_device(self) -> int:
+        ...
+
+    def current_device_name(self) -> str:
+        return self.device_name(self.current_device())
+
+    @abc.abstractmethod
+    def is_available(self) -> bool:
+        ...
+
+    # ------------------------------------------------------------------
+    # RNG APIs (functional on JAX; these mirror the torch-style surface)
+    # ------------------------------------------------------------------
+    @abc.abstractmethod
+    def random(self):
+        ...
+
+    @abc.abstractmethod
+    def set_rng_state(self, new_state, device_index: Optional[int] = None) -> None:
+        ...
+
+    @abc.abstractmethod
+    def get_rng_state(self, device_index: Optional[int] = None):
+        ...
+
+    @abc.abstractmethod
+    def manual_seed(self, seed: int) -> None:
+        ...
+
+    def manual_seed_all(self, seed: int) -> None:
+        self.manual_seed(seed)
+
+    def initial_seed(self) -> int:
+        raise NotImplementedError
+
+    def default_generator(self, device_index: int):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # Streams/Events — XLA async dispatch analogs
+    # ------------------------------------------------------------------
+    def Stream(self, *args, **kwargs):
+        return NullStream()
+
+    def StreamContext(self, stream):
+        return NullContext()
+
+    def stream(self, stream):
+        return NullContext()
+
+    def current_stream(self, device_index: Optional[int] = None):
+        return NullStream()
+
+    def default_stream(self, device_index: Optional[int] = None):
+        return NullStream()
+
+    def Event(self, enable_timing: bool = False, **kwargs):
+        return NullEvent(enable_timing=enable_timing)
+
+    @abc.abstractmethod
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        ...
+
+    # ------------------------------------------------------------------
+    # Memory management
+    # ------------------------------------------------------------------
+    @abc.abstractmethod
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, int]:
+        ...
+
+    def empty_cache(self) -> None:
+        pass
+
+    def memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return self.memory_stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return self.memory_stats(device_index).get("peak_bytes_in_use", 0)
+
+    def reset_max_memory_allocated(self, device_index: Optional[int] = None) -> None:
+        pass
+
+    def memory_cached(self, device_index: Optional[int] = None) -> int:
+        return self.memory_allocated(device_index)
+
+    def max_memory_cached(self, device_index: Optional[int] = None) -> int:
+        return self.max_memory_allocated(device_index)
+
+    def reset_max_memory_cached(self, device_index: Optional[int] = None) -> None:
+        pass
+
+    def memory_reserved(self, device_index: Optional[int] = None) -> int:
+        return self.memory_stats(device_index).get("bytes_reserved", 0) or \
+            self.memory_allocated(device_index)
+
+    def max_memory_reserved(self, device_index: Optional[int] = None) -> int:
+        return self.max_memory_allocated(device_index)
+
+    def reset_peak_memory_stats(self, device_index: Optional[int] = None) -> None:
+        pass
+
+    @abc.abstractmethod
+    def total_memory(self, device_index: Optional[int] = None) -> int:
+        ...
+
+    def available_memory(self, device_index: Optional[int] = None) -> int:
+        return self.total_memory(device_index) - self.memory_allocated(device_index)
+
+    # ------------------------------------------------------------------
+    # Dtype support
+    # ------------------------------------------------------------------
+    @abc.abstractmethod
+    def is_bf16_supported(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self) -> bool:
+        ...
+
+    def supported_dtypes(self) -> List[Any]:
+        import jax.numpy as jnp
+
+        dtypes = [jnp.float32]
+        if self.is_fp16_supported():
+            dtypes.append(jnp.float16)
+        if self.is_bf16_supported():
+            dtypes.append(jnp.bfloat16)
+        return dtypes
+
+    def preferred_dtype(self):
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 if self.is_bf16_supported() else jnp.float32
+
+    def is_triton_supported(self) -> bool:
+        return False  # TPU kernels come from Pallas, not Triton
+
+    # ------------------------------------------------------------------
+    # Misc
+    # ------------------------------------------------------------------
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str:
+        ...
+
+    def communication_backend_version(self) -> str:
+        import jax
+
+        return jax.__version__
+
+    def range_push(self, msg: str) -> None:
+        """Profiler range start (ref abstract_accelerator.py:190, nvtx)."""
+        try:
+            import jax.profiler
+
+            tc = jax.profiler.TraceAnnotation(msg)
+            tc.__enter__()
+            self._ranges().append(tc)
+        except Exception:
+            pass
+
+    def range_pop(self) -> None:
+        try:
+            stack = self._ranges()
+            if stack:
+                stack.pop().__exit__(None, None, None)
+        except Exception:
+            pass
+
+    def _ranges(self):
+        if not hasattr(self, "_range_stack"):
+            self._range_stack = []
+        return self._range_stack
+
+    def lazy_call(self, callback) -> None:
+        callback()
+
+    def communication_backend(self):
+        from deepspeed_tpu import comm
+
+        return comm
+
+    # ------------------------------------------------------------------
+    # Graph capture (ref abstract_accelerator.py graph ops) → jax.jit
+    # ------------------------------------------------------------------
+    def is_graph_capture_supported(self) -> bool:
+        return True
+
+    def create_graph(self):
+        return _JitGraph()
+
+    def capture_to_graph(self, graph, **kwargs):
+        return graph
+
+    def replay_graph(self, graph, *args):
+        return graph.replay(*args)
+
+    # ------------------------------------------------------------------
+    # Tensor constructors / pinning
+    # ------------------------------------------------------------------
+    def pin_memory(self, tensor, align_bytes: int = 1):
+        import numpy as np
+
+        return np.ascontiguousarray(tensor)
+
+    def is_pinned(self, tensor) -> bool:
+        import numpy as np
+
+        return isinstance(tensor, np.ndarray) and tensor.flags["C_CONTIGUOUS"]
+
+    def on_accelerator(self, tensor) -> bool:
+        import jax
+
+        return isinstance(tensor, jax.Array)
+
+    # ------------------------------------------------------------------
+    # Op builder resolution (ref abstract_accelerator.py op-builder-dir)
+    # ------------------------------------------------------------------
+    def op_builder_dir(self) -> str:
+        return "deepspeed_tpu.ops"
+
+    def create_op_builder(self, class_name: str):
+        from deepspeed_tpu.ops import op_builder
+
+        return getattr(op_builder, class_name, None)
+
+    def get_op_builder(self, class_name: str):
+        return self.create_op_builder(class_name)
+
+    def build_extension(self):
+        from deepspeed_tpu.ops import op_builder
+
+        return op_builder
+
+    def export_envs(self) -> List[str]:
+        return ["JAX_", "XLA_", "LIBTPU", "TPU_"]
+
+
+class NullStream:
+    """CUDA-stream stand-in: XLA owns scheduling; stream ops are no-ops."""
+
+    def synchronize(self) -> None:
+        import jax
+
+        jax.effects_barrier()
+
+    def wait_event(self, event) -> None:
+        pass
+
+    def wait_stream(self, stream) -> None:
+        pass
+
+    def record_event(self, event=None):
+        return event or NullEvent()
+
+    def query(self) -> bool:
+        return True
+
+
+class NullContext:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class NullEvent:
+    """CUDA-event stand-in; timing events use host wall clock after a
+    device barrier (XLA has no device-side timers)."""
+
+    def __init__(self, enable_timing: bool = False):
+        self.enable_timing = enable_timing
+        self._t: Optional[float] = None
+
+    def record(self, stream=None) -> None:
+        import time
+
+        if self.enable_timing:
+            import jax
+
+            jax.effects_barrier()
+            self._t = time.time()
+
+    def synchronize(self) -> None:
+        import jax
+
+        jax.effects_barrier()
+
+    def elapsed_time(self, end_event: "NullEvent") -> float:
+        if self._t is None or end_event._t is None:
+            return 0.0
+        return (end_event._t - self._t) * 1000.0
+
+    def query(self) -> bool:
+        return True
+
+
+class _JitGraph:
+    """Graph-capture stand-in: holds a jitted callable (ref CUDA graphs →
+    jax.jit compiled executable replay)."""
+
+    def __init__(self):
+        self.fn = None
+
+    def capture(self, fn):
+        import jax
+
+        self.fn = jax.jit(fn)
+        return self.fn
+
+    def replay(self, *args):
+        if self.fn is None:
+            raise RuntimeError("graph not captured")
+        return self.fn(*args)

@@ -1,0 +1,52 @@
+"""CPU accelerator backend — the test/fake accelerator.
+
+Plays the role of the reference's ``accelerator/cpu_accelerator.py``: lets
+every subsystem run on a chip-less machine (JAX CPU backend, optionally with
+``--xla_force_host_platform_device_count=N`` for virtual multi-device
+meshes), the way the reference's CPU accelerator + gloo enables its CI.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from deepspeed_tpu.accelerator.tpu_accelerator import TPU_Accelerator
+
+
+class CPU_Accelerator(TPU_Accelerator):
+
+    def __init__(self):
+        super().__init__(platform="cpu")
+        self._communication_backend_name = "xla-cpu"
+
+    def is_synchronized_device(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return False
+
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, int]:
+        # PJRT CPU devices report no memory stats; fall back to /proc.
+        try:
+            with open("/proc/self/status") as f:
+                status = f.read()
+            rss_kb = int(status.split("VmRSS:")[1].split()[0])
+            peak_kb = int(status.split("VmHWM:")[1].split()[0])
+            return {"bytes_in_use": rss_kb * 1024, "peak_bytes_in_use": peak_kb * 1024}
+        except Exception:
+            return {}
+
+    def total_memory(self, device_index: Optional[int] = None) -> int:
+        try:
+            pages = os.sysconf("SC_PHYS_PAGES")
+            return pages * os.sysconf("SC_PAGE_SIZE")
+        except (ValueError, OSError):
+            return 0
+
+    def available_memory(self, device_index: Optional[int] = None) -> int:
+        try:
+            pages = os.sysconf("SC_AVPHYS_PAGES")
+            return pages * os.sysconf("SC_PAGE_SIZE")
+        except (ValueError, OSError):
+            return 0

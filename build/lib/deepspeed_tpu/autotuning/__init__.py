@@ -1,0 +1,9 @@
+"""Autotuning (ref deepspeed/autotuning/)."""
+
+from deepspeed_tpu.autotuning.autotuner import (Autotuner, ModelInfo,
+                                                TrialResult,
+                                                estimate_memory_per_device,
+                                                generate_tuning_space)
+
+__all__ = ["Autotuner", "ModelInfo", "TrialResult",
+           "estimate_memory_per_device", "generate_tuning_space"]

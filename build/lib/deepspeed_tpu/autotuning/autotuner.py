@@ -1,0 +1,195 @@
+"""Autotuner — memory-model-driven search over ZeRO stage & micro-batch.
+
+Analog of ``deepspeed/autotuning/autotuner.py`` (``Autotuner`` :42,
+``model_info_profile_run`` :663, ``get_instantiation_memory_required_per_gpu``
+:278) and the grid/random/model-based tuners (``autotuning/tuner/``).  The
+reference launches whole subprocess experiment jobs; on TPU a trial is just
+building an engine and timing a few compiled steps in-process — rendezvous
+and relaunch overhead don't exist under single-controller JAX.
+
+Flow (mirrors Autotuner.tune): estimate per-device memory for each ZeRO
+stage → prune stages that can't fit → sweep micro-batch sizes (power-of-2
+"model-based" ordering) → run short timed trials → pick best throughput.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.utils.logging import logger
+
+BYTES_PER_PARAM = {"bf16": 2, "fp16": 2, "fp32": 4}
+
+
+@dataclass
+class ModelInfo:
+    """Ref model_info_profile_run: num_params + activation footprint."""
+    num_params: int
+    hidden_size: int = 0
+    num_layers: int = 0
+    vocab_size: int = 0
+
+
+def estimate_memory_per_device(model_info: ModelInfo, zero_stage: int,
+                               dp_size: int, micro_batch: int, seq_len: int,
+                               dtype: str = "bf16",
+                               optimizer_factor: int = 12) -> int:
+    """Bytes per device for params+grads+optimizer+activations.
+
+    Ref get_instantiation_memory_required_per_gpu (autotuner.py:278):
+    optimizer_factor=12 ≈ fp32 master + two Adam moments + fp16 param/grad
+    bookkeeping, partitioned by stage:
+      stage 0: all replicated; 1: optimizer/dp; 2: +grads/dp; 3: +params/dp.
+    """
+    p = model_info.num_params
+    b = BYTES_PER_PARAM.get(dtype, 2)
+    params_mem = p * b
+    grads_mem = p * b
+    opt_mem = p * optimizer_factor
+    if zero_stage >= 1:
+        opt_mem //= dp_size
+    if zero_stage >= 2:
+        grads_mem //= dp_size
+    if zero_stage >= 3:
+        params_mem //= dp_size
+    # activation estimate: ~ layers * micro_batch * seq * hidden * c bytes
+    act = (model_info.num_layers * micro_batch * seq_len
+           * max(1, model_info.hidden_size) * 2 * 16)
+    return int(params_mem + grads_mem + opt_mem + act)
+
+
+def generate_tuning_space(model_info: ModelInfo, dp_size: int, seq_len: int,
+                          hbm_bytes: int, dtype: str = "bf16",
+                          stages=(0, 1, 2, 3),
+                          max_micro_batch: int = 64) -> List[Dict[str, Any]]:
+    """Candidate (zero_stage, micro_batch) configs that fit the memory
+    budget (ref tuning-space templates, autotuning/config_templates/)."""
+    space = []
+    for stage in stages:
+        mb = 1
+        while mb <= max_micro_batch:
+            need = estimate_memory_per_device(model_info, stage, dp_size, mb,
+                                              seq_len, dtype)
+            if need <= hbm_bytes:
+                space.append({"zero_stage": stage, "micro_batch": mb,
+                              "est_bytes": need})
+            mb *= 2
+    return space
+
+
+@dataclass
+class TrialResult:
+    config: Dict[str, Any]
+    throughput: float  # samples/sec
+    step_seconds: float
+    error: Optional[str] = None
+
+
+class Autotuner:
+    """Ref Autotuner (autotuning/autotuner.py:42).
+
+    ``tune`` returns (best_ds_config, results).  ``mode``: "grid" tries the
+    whole space; "random" samples ``max_trials``; "model_based" orders by
+    estimated memory headroom (bigger batch first) and early-stops after
+    ``patience`` non-improving trials.
+    """
+
+    def __init__(self, model_cfg, base_config: Dict[str, Any],
+                 seq_len: int = 64, mode: str = "model_based",
+                 max_trials: int = 8, steps_per_trial: int = 3,
+                 hbm_bytes: Optional[int] = None, seed: int = 0):
+        self.model_cfg = model_cfg
+        self.base_config = base_config
+        self.seq_len = seq_len
+        self.mode = mode
+        self.max_trials = max_trials
+        self.steps_per_trial = steps_per_trial
+        self.hbm_bytes = hbm_bytes or (16 << 30)
+        self.seed = seed
+        self.results: List[TrialResult] = []
+
+    # ------------------------------------------------------------------
+    def model_info(self) -> ModelInfo:
+        from deepspeed_tpu.profiling import get_model_profile
+
+        prof = get_model_profile(self.model_cfg, 1, self.seq_len)
+        return ModelInfo(num_params=prof["params"],
+                         hidden_size=self.model_cfg.hidden_size,
+                         num_layers=self.model_cfg.num_layers,
+                         vocab_size=self.model_cfg.vocab_size)
+
+    def _space(self) -> List[Dict[str, Any]]:
+        mesh = self.base_config.get("mesh") or {}
+        dp = int(mesh.get("data", 1)) * int(mesh.get("expert", 1))
+        space = generate_tuning_space(self.model_info(), max(1, dp),
+                                      self.seq_len, self.hbm_bytes)
+        if self.mode == "random":
+            rng = np.random.default_rng(self.seed)
+            rng.shuffle(space)
+            return space[:self.max_trials]
+        if self.mode == "model_based":
+            space.sort(key=lambda c: (-c["micro_batch"], -c["zero_stage"]))
+            return space[:self.max_trials]
+        return space  # grid
+
+    def _trial_config(self, cand: Dict[str, Any]) -> Dict[str, Any]:
+        cfg = copy.deepcopy(self.base_config)
+        cfg["train_micro_batch_size_per_gpu"] = cand["micro_batch"]
+        cfg.setdefault("gradient_accumulation_steps", 1)
+        cfg.pop("train_batch_size", None)
+        cfg.setdefault("zero_optimization", {})["stage"] = cand["zero_stage"]
+        return cfg
+
+    def run_trial(self, cand: Dict[str, Any]) -> TrialResult:
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.parallel import topology
+
+        cfg = self._trial_config(cand)
+        try:
+            engine, _, _, _ = ds.initialize(model=self.model_cfg, config=cfg)
+            rng = np.random.default_rng(0)
+            rows = (engine.train_batch_size_value
+                    * 1)
+            ids = rng.integers(0, self.model_cfg.vocab_size,
+                               size=(rows, self.seq_len + 1), dtype=np.int32)
+            batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+            loss = engine.train_batch(batch)  # compile step (excluded)
+            float(np.asarray(loss))
+            t0 = time.perf_counter()
+            for _ in range(self.steps_per_trial):
+                loss = engine.train_batch(batch)
+            float(np.asarray(loss))  # sync
+            dt = (time.perf_counter() - t0) / self.steps_per_trial
+            tput = engine.train_batch_size_value / dt
+            return TrialResult(cand, throughput=tput, step_seconds=dt)
+        except Exception as e:  # OOM / compile failure → score 0
+            logger.warning(f"autotuner trial {cand} failed: {e}")
+            return TrialResult(cand, throughput=0.0, step_seconds=float("inf"),
+                               error=str(e))
+        finally:
+            topology._GLOBAL_TOPOLOGY = None
+
+    def tune(self, patience: int = 3):
+        """→ (best_config_dict, [TrialResult...])."""
+        best: Optional[TrialResult] = None
+        stale = 0
+        for cand in self._space():
+            res = self.run_trial(cand)
+            self.results.append(res)
+            logger.info(f"autotuner: {cand} → "
+                        f"{res.throughput:.2f} samples/s")
+            if best is None or res.throughput > best.throughput:
+                best, stale = res, 0
+            else:
+                stale += 1
+                if self.mode == "model_based" and stale >= patience:
+                    break
+        if best is None or best.throughput <= 0:
+            raise RuntimeError("autotuning found no runnable config")
+        return self._trial_config(best.config), self.results

@@ -1,0 +1,100 @@
+"""Checkpoint save/load.
+
+Analog of ``runtime/engine.py:3610/3262`` (save_checkpoint/load_checkpoint)
+plus the pluggable CheckpointEngine (ref runtime/checkpoint_engine/).  The
+default format stores each leaf as a ``.npy``-style entry inside one pickle
+per checkpoint tag, with sharded arrays gathered to host (single-controller
+JAX owns all shards in-process, so this is addressable-shard I/O, not a
+network gather).  The universal-checkpoint converter lives in
+``deepspeed_tpu/checkpoint/universal.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+LATEST_FILE = "latest"
+
+
+def _to_host(tree):
+    """Gather arrays to host. Multi-host fully-sharded arrays are gathered
+    via process_allgather so every process can serialize a full copy."""
+    def get(x):
+        if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return np.asarray(jax.device_get(x))
+
+    return jax.tree.map(get, tree)
+
+
+def _ckpt_path(save_dir: str, tag: str) -> str:
+    # one state file per process (multi-host writes its own shard file)
+    return os.path.join(save_dir, str(tag),
+                        f"mp_rank_{jax.process_index():02d}_model_states.pt")
+
+
+def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
+                    client_state: Optional[Dict[str, Any]] = None) -> None:
+    tag = tag if tag is not None else f"global_step{engine.global_steps}"
+    os.makedirs(os.path.join(save_dir, str(tag)), exist_ok=True)
+    opt_tree = (engine.opt_state if getattr(engine, "_opt_store", None) is None
+                else engine._opt_store.swap_in())
+    state = {
+        "module": _to_host(engine.params),
+        "optimizer": _to_host(opt_tree),
+        "loss_scale_state": _to_host(engine.loss_scale_state),
+        "lr_scheduler": engine.lr_scheduler.state_dict(),
+        "global_steps": engine.global_steps,
+        "micro_steps": engine.micro_steps,
+        "skipped_steps": engine.skipped_steps,
+        "client_state": client_state or {},
+        "ds_config": engine.config.to_dict(),
+        "mesh_sizes": dict(engine.topology.sizes),
+    }
+    path = _ckpt_path(save_dir, tag)
+    with open(path, "wb") as f:
+        pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+    if jax.process_index() == 0:
+        with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
+            f.write(str(tag))
+    log_dist(f"saved checkpoint: {path}")
+
+
+def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
+                    load_optimizer_states: bool = True,
+                    load_lr_scheduler_states: bool = True):
+    if tag is None:
+        latest = os.path.join(load_dir, LATEST_FILE)
+        if not os.path.exists(latest):
+            logger.warning(f"no '{LATEST_FILE}' file in {load_dir}; nothing loaded")
+            return None, {}
+        with open(latest) as f:
+            tag = f.read().strip()
+    path = _ckpt_path(load_dir, tag)
+    if not os.path.exists(path):
+        logger.warning(f"checkpoint {path} not found")
+        return None, {}
+    with open(path, "rb") as f:
+        state = pickle.load(f)
+
+    engine.params = jax.device_put(state["module"], engine.param_shardings)
+    if load_optimizer_states and "optimizer" in state:
+        engine.opt_state = jax.device_put(state["optimizer"], engine.opt_shardings)
+    if "loss_scale_state" in state:
+        engine.loss_scale_state = jax.device_put(state["loss_scale_state"],
+                                                 engine._replicated)
+    if load_lr_scheduler_states and state.get("lr_scheduler") is not None:
+        engine.lr_scheduler.load_state_dict(state["lr_scheduler"])
+    engine.global_steps = int(state.get("global_steps", 0))
+    engine.micro_steps = int(state.get("micro_steps", 0))
+    log_dist(f"loaded checkpoint: {path} (step {engine.global_steps})")
+    return path, state.get("client_state", {})

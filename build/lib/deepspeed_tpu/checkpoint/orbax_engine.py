@@ -1,0 +1,110 @@
+"""Orbax/tensorstore checkpoint engine: sharded, async, multi-host.
+
+Analog of the reference's pluggable high-performance checkpoint engines —
+``FastCheckpointEngine`` (double-buffered pinned I/O via
+deepspeed/io/fast_file_writer.py) and ``DecoupledCheckpointEngine`` (async
+commit in a separate process): orbax writes each shard from the process
+that owns it through tensorstore with async commit, which is the
+TPU-native equivalent of both.
+
+Selected via ``"checkpoint": {"writer": {"type": "orbax"}, "async_save": true}``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+class OrbaxCheckpointEngine:
+    def __init__(self, async_save: bool = False):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.async_save = async_save
+        self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler()) \
+            if async_save else ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+        self._pending = None
+
+    def save(self, engine, save_dir: str, tag: str,
+             client_state: Optional[Dict[str, Any]] = None) -> None:
+        path = os.path.abspath(os.path.join(save_dir, str(tag), "orbax"))
+        meta = {
+            "global_steps": engine.global_steps,
+            "micro_steps": engine.micro_steps,
+            "lr_scheduler": engine.lr_scheduler.state_dict(),
+            "client_state": client_state or {},
+            "mesh_sizes": dict(engine.topology.sizes),
+        }
+        tree = {
+            "params": engine.params,
+            # offload-store mode: opt_state lives in the store, not on engine
+            "opt_state": engine._opt_state_template(),
+            "loss_scale_state": engine.loss_scale_state,
+        }
+        self.wait()  # one in-flight save at a time (double buffering)
+        self._ckptr.save(path, tree, force=True)
+        if self.async_save:
+            self._pending = path
+        import json
+
+        if jax.process_index() == 0:
+            os.makedirs(os.path.join(save_dir, str(tag)), exist_ok=True)
+            with open(os.path.join(save_dir, str(tag), "meta.json"), "w") as f:
+                json.dump(meta, f)
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(str(tag))
+        log_dist(f"orbax checkpoint {'queued' if self.async_save else 'saved'}: {path}")
+
+    def wait(self) -> None:
+        """Block until the in-flight async save commits."""
+        if self._pending is not None:
+            self._ckptr.wait_until_finished()
+            self._pending = None
+
+    def load(self, engine, load_dir: str, tag: Optional[str] = None,
+             load_optimizer_states: bool = True,
+             load_lr_scheduler_states: bool = True):
+        import json
+
+        if tag is None:
+            with open(os.path.join(load_dir, "latest")) as f:
+                tag = f.read().strip()
+        path = os.path.abspath(os.path.join(load_dir, str(tag), "orbax"))
+        opt_shardings = (engine._opt_device_shardings if engine._opt_store is not None
+                         else engine.opt_shardings)
+        template = {
+            "params": engine.params,
+            "opt_state": engine._opt_state_template(),
+            "loss_scale_state": engine.loss_scale_state,
+        }
+        shardings = {
+            "params": engine.param_shardings,
+            "opt_state": opt_shardings,
+            "loss_scale_state": jax.tree.map(lambda _: engine._replicated,
+                                             engine.loss_scale_state),
+        }
+        restore_args = jax.tree.map(
+            lambda t, s: self._ocp.ArrayRestoreArgs(sharding=s, dtype=t.dtype),
+            template, shardings)
+        tree = self._ckptr.restore(
+            path, args=self._ocp.args.PyTreeRestore(
+                item=template,
+                restore_args=restore_args))
+        engine.params = tree["params"]
+        if load_optimizer_states:
+            engine.opt_state = tree["opt_state"]
+        engine.loss_scale_state = tree["loss_scale_state"]
+        with open(os.path.join(load_dir, str(tag), "meta.json")) as f:
+            meta = json.load(f)
+        if load_lr_scheduler_states and meta.get("lr_scheduler") is not None:
+            engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+        engine.global_steps = int(meta["global_steps"])
+        engine.micro_steps = int(meta["micro_steps"])
+        log_dist(f"orbax checkpoint loaded: {path}")
+        return path, meta.get("client_state", {})

@@ -1,0 +1,217 @@
+"""Universal checkpoint: per-parameter atomic format + any-topology reload.
+
+Re-design of the reference's UCP (``deepspeed/checkpoint/ds_to_universal.py``
+:112/:152/:232, loader ``universal_checkpoint.py:22``, offline consolidation
+``utils/zero_to_fp32.py``): the reference must merge per-rank ZeRO shards and
+TP slices into atomic per-param files; here global arrays are already
+logical wholes (single-controller JAX), so the converter writes one ``.npy``
+per parameter path and reload simply re-shards onto whatever mesh the new
+engine has — world-size elasticity falls out of the sharding system.
+
+Layout:
+    <dir>/universal/
+        meta.json                 # step counters, config, param manifest
+        params/<path>.npy         # fp32 master weights
+        optimizer/<path>.npy      # flattened optimizer state leaves
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.utils.logging import log_dist, logger
+
+
+def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        from deepspeed_tpu.parallel.sharding import path_str
+
+        if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+            # ds_to_universal runs on process 0 only, so a cross-process
+            # gather here would hang — the converter's inputs must already
+            # be host-complete (the pickle engine allgathers at save time)
+            raise ValueError(
+                "universal converter got a non-fully-addressable array; "
+                "convert from a saved checkpoint (engine.save_checkpoint), "
+                "not from live multi-host state")
+        flat[path_str(path)] = np.asarray(leaf)
+    return flat
+
+
+def _save_flat(flat: Dict[str, np.ndarray], root: str) -> None:
+    for path, arr in flat.items():
+        fname = os.path.join(root, path.replace("/", "__") + ".npy")
+        np.save(fname, arr)
+
+
+def _load_flat(root: str) -> Dict[str, np.ndarray]:
+    out = {}
+    for fname in sorted(os.listdir(root)):
+        if fname.endswith(".npy"):
+            out[fname[:-4].replace("__", "/")] = np.load(os.path.join(root, fname))
+    return out
+
+
+def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
+                    output_dir: Optional[str] = None) -> str:
+    """Convert a saved checkpoint to the universal per-param format.
+    Ref: ds_to_universal.py main flow (extract shards → merge → per-param)."""
+    from deepspeed_tpu.checkpoint.engine import LATEST_FILE, _ckpt_path
+
+    if tag is None:
+        with open(os.path.join(ckpt_dir, LATEST_FILE)) as f:
+            tag = f.read().strip()
+
+    out = output_dir or os.path.join(ckpt_dir, str(tag), "universal")
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        # each process's pickle holds the full (allgathered) state; one
+        # writer suffices on a shared FS — wait for process 0 to finish,
+        # and surface its failure instead of returning a broken dir
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(np.array([1], np.int32))
+        if not bool(flags.min()):
+            raise RuntimeError("universal conversion failed on process 0")
+        return out
+
+    ok = False
+    try:
+        with open(_ckpt_path(ckpt_dir, tag), "rb") as f:
+            state = pickle.load(f)
+
+        os.makedirs(os.path.join(out, "params"), exist_ok=True)
+        os.makedirs(os.path.join(out, "optimizer"), exist_ok=True)
+
+        params_flat = _flatten_with_paths(state["module"])
+        _save_flat(params_flat, os.path.join(out, "params"))
+        opt_flat = _flatten_with_paths(state["optimizer"])
+        _save_flat(opt_flat, os.path.join(out, "optimizer"))
+
+        meta = {
+            "global_steps": state.get("global_steps", 0),
+            "micro_steps": state.get("micro_steps", 0),
+            "lr_scheduler": state.get("lr_scheduler"),
+            "loss_scale_state": {k: float(np.asarray(v))
+                                 for k, v in state.get("loss_scale_state",
+                                                       {}).items()},
+            "param_manifest": {k: list(v.shape)
+                               for k, v in params_flat.items()},
+            "opt_treedef_leaves": len(opt_flat),
+            "ds_config": state.get("ds_config", {}),
+            "source_mesh": state.get("mesh_sizes", {}),
+        }
+        with open(os.path.join(out, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+        ok = True
+    finally:
+        if jax.process_count() > 1:
+            # ALWAYS release the non-writer processes — a writer exception
+            # must raise on process 0, not hang processes 1..N — and tell
+            # them whether the conversion actually succeeded
+            from jax.experimental import multihost_utils
+
+            multihost_utils.process_allgather(
+                np.array([1 if ok else 0], np.int32))
+    log_dist(f"universal checkpoint written: {out}")
+    return out
+
+
+def resolve_universal_dir(load_dir: str, tag: Optional[str] = None) -> str:
+    """Accept either the universal dir itself, a checkpoint root (+tag), or a
+    checkpoint root with a ``latest`` file."""
+    if os.path.exists(os.path.join(load_dir, "meta.json")):
+        return load_dir
+    if tag is None:
+        latest = os.path.join(load_dir, "latest")
+        if os.path.exists(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+    if tag is not None:
+        cand = os.path.join(load_dir, str(tag), "universal")
+        if os.path.exists(os.path.join(cand, "meta.json")):
+            return cand
+    raise FileNotFoundError(f"no universal checkpoint under {load_dir} (tag={tag})")
+
+
+def load_universal(engine, universal_dir: str) -> None:
+    """Load a universal checkpoint into an engine with ANY mesh topology
+    (ref load_hp_checkpoint_state, universal_checkpoint.py:22).  Arrays are
+    device_put with the engine's current shardings, so dp/tp/pp/sp changes
+    between save and load "just work"."""
+    with open(os.path.join(universal_dir, "meta.json")) as f:
+        meta = json.load(f)
+
+    params_flat = _load_flat(os.path.join(universal_dir, "params"))
+    params = _unflatten_like(engine.params, params_flat)
+    engine.params = jax.device_put(params, engine.param_shardings)
+
+    opt_flat = _load_flat(os.path.join(universal_dir, "optimizer"))
+    template = engine._opt_state_template()
+    if opt_flat and template is not None:
+        opt_state = _unflatten_like(template, opt_flat)
+        # store mode: device placement is transient (engine pushes to the
+        # store right after); stream mode: resident (possibly host) shardings
+        target = (engine._opt_device_shardings if engine._opt_store is not None
+                  else engine.opt_shardings)
+        engine.opt_state = jax.device_put(opt_state, target)
+
+    if meta.get("loss_scale_state"):
+        import jax.numpy as jnp
+
+        ls = meta["loss_scale_state"]
+        engine.loss_scale_state = jax.device_put(
+            {"scale": jnp.float32(ls.get("scale", 1.0)),
+             "good_steps": jnp.int32(int(ls.get("good_steps", 0))),
+             "skipped": jnp.int32(int(ls.get("skipped", 0)))},
+            engine._replicated)
+    if meta.get("lr_scheduler"):
+        engine.lr_scheduler.load_state_dict(meta["lr_scheduler"])
+    engine.global_steps = int(meta.get("global_steps", 0))
+    engine.micro_steps = int(meta.get("micro_steps", 0))
+    log_dist(f"universal checkpoint loaded from {universal_dir} "
+             f"(source mesh {meta.get('source_mesh')} → {engine.topology.sizes})")
+
+
+def _unflatten_like(template, flat: Dict[str, np.ndarray]):
+    """Rebuild a pytree with ``template``'s structure from path→array dict."""
+    from deepspeed_tpu.parallel.sharding import path_str
+
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    new_leaves = []
+    for path, leaf in leaves_with_paths:
+        key = path_str(path)
+        if key not in flat:
+            raise KeyError(f"universal checkpoint missing entry '{key}'")
+        arr = flat[key]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(f"shape mismatch for '{key}': "
+                             f"checkpoint {arr.shape} vs model {np.shape(leaf)}")
+        new_leaves.append(arr.astype(np.asarray(leaf).dtype
+                                     if hasattr(leaf, "dtype") else arr.dtype))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def zero_to_fp32(ckpt_dir: str, output_file: str, tag: Optional[str] = None) -> str:
+    """Offline consolidation to a single fp32 state dict file
+    (ref utils/zero_to_fp32.py). Master params are fp32 already; this writes
+    a flat ``{path: np.float32 array}`` pickle loadable without the engine."""
+    from deepspeed_tpu.checkpoint.engine import LATEST_FILE, _ckpt_path
+
+    if tag is None:
+        with open(os.path.join(ckpt_dir, LATEST_FILE)) as f:
+            tag = f.read().strip()
+    with open(_ckpt_path(ckpt_dir, tag), "rb") as f:
+        state = pickle.load(f)
+    flat = {k: v.astype(np.float32)
+            for k, v in _flatten_with_paths(state["module"]).items()}
+    with open(output_file, "wb") as f:
+        pickle.dump(flat, f, protocol=pickle.HIGHEST_PROTOCOL)
+    log_dist(f"fp32 consolidated state dict: {output_file} ({len(flat)} tensors)")
+    return output_file

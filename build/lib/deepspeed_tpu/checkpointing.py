@@ -1,0 +1,95 @@
+"""Activation checkpointing API surface.
+
+Analog of ``deepspeed.checkpointing`` (runtime/activation_checkpointing/
+checkpointing.py: ``checkpoint`` :948, ``configure`` , partitioned/CPU
+variants :377/:474).  On TPU the machinery is ``jax.checkpoint``; this
+module keeps the reference's call signatures so ported Megatron-style code
+runs unchanged, mapping its knobs onto remat policies:
+
+* ``partition_activations`` → handled by GSPMD sharding (activations are
+  already sharded over the mesh; nothing to split by hand)
+* ``cpu_checkpointing`` → ``offload_dots`` policy (save matmul outputs to
+  pinned host memory)
+* ``contiguous_memory_optimization``/``synchronize`` → no-ops (XLA owns
+  layout and scheduling)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+_CONFIG: Dict[str, Any] = {
+    "partition_activations": False,
+    "cpu_checkpointing": False,
+    "contiguous_memory_optimization": False,
+    "synchronize_checkpoint_boundary": False,
+    "profile": False,
+    "policy": "nothing_saveable",
+}
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None) -> None:
+    """Ref checkpointing.configure — records knobs; ``checkpoint_in_cpu``
+    selects the host-offload remat policy."""
+    if partition_activations is not None:
+        _CONFIG["partition_activations"] = bool(partition_activations)
+    if checkpoint_in_cpu is not None:
+        _CONFIG["cpu_checkpointing"] = bool(checkpoint_in_cpu)
+        _CONFIG["policy"] = "offload_dots" if checkpoint_in_cpu \
+            else "nothing_saveable"
+    if contiguous_checkpointing is not None:
+        _CONFIG["contiguous_memory_optimization"] = bool(contiguous_checkpointing)
+    if synchronize is not None:
+        _CONFIG["synchronize_checkpoint_boundary"] = bool(synchronize)
+    if profile is not None:
+        _CONFIG["profile"] = bool(profile)
+
+
+def _policy():
+    name = _CONFIG["policy"]
+    if name == "offload_dots":
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
+    if name and name != "nothing_saveable":
+        return getattr(jax.checkpoint_policies, name, None)
+    return None
+
+
+def checkpoint(function: Callable, *args):
+    """Ref checkpointing.checkpoint(function, *args): run ``function`` under
+    rematerialisation and return its output."""
+    return jax.checkpoint(function, policy=_policy(), prevent_cse=False)(*args)
+
+
+def checkpoint_wrapper(function: Callable) -> Callable:
+    """Decorator form."""
+    return jax.checkpoint(function, policy=_policy(), prevent_cse=False)
+
+
+def is_configured() -> bool:
+    return True
+
+
+def get_config() -> Dict[str, Any]:
+    return dict(_CONFIG)
+
+
+def reset() -> None:
+    """Ref checkpointing.reset — clears buffers; here: restore defaults."""
+    _CONFIG.update(partition_activations=False, cpu_checkpointing=False,
+                   contiguous_memory_optimization=False,
+                   synchronize_checkpoint_boundary=False, profile=False,
+                   policy="nothing_saveable")
+
+
+class CheckpointFunction:
+    """Name-parity shim (ref CheckpointFunction autograd.Function): calling
+    applies :func:`checkpoint`."""
+
+    @staticmethod
+    def apply(function, *args):
+        return checkpoint(function, *args)

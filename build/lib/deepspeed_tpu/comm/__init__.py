@@ -1,0 +1,7 @@
+"""deepspeed_tpu.comm — collectives façade (ref: deepspeed/comm)."""
+
+from deepspeed_tpu.comm.comm import (ReduceOp, all_gather, all_reduce, all_to_all, allgather,
+                                     allreduce, axis_index, barrier, broadcast,
+                                     get_local_rank, get_rank, get_world_size,
+                                     init_distributed, is_initialized, ppermute,
+                                     reduce_scatter)

@@ -1,0 +1,151 @@
+"""Coalesced & quantized collectives (ZeRO-3 / ZeRO++ comm paths).
+
+TPU-native analog of ``runtime/comm/coalesced_collectives.py``:
+
+* ``reduce_scatter_coalesced`` (ref :158) — one fused reduce-scatter over a
+  whole gradient pytree: leaves are flattened and concatenated into a single
+  padded buffer so the mesh sees ONE collective, then shards are split back.
+* ``all_to_all_quant_reduce`` (ref :31, the qgZ schedule of ZeRO++) — int8
+  block-quantized two-level gradient reduction: quantize → all-to-all within
+  the inner (intra-node / ICI) axis → dequant-reduce → quantize → all-to-all
+  across the outer (inter-node / DCN) axis → dequant-reduce.  Wire traffic is
+  int8 both hops, matching qgZ's 4× reduction vs fp32.
+* ``loco_quant_reduce`` (ref :81) — qgZ with error feedback (LoCo): the
+  quantization residual is carried to the next step instead of dropped.
+
+All functions are **in-jit** collectives: call them inside ``shard_map``
+(the engine does) with the relevant mesh axis names.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deepspeed_tpu.ops.quantizer import dequantize_blockwise, quantize_blockwise
+
+AxisName = Union[str, Sequence[str]]
+
+
+def _axis_size(axis: AxisName) -> jnp.ndarray:
+    return lax.psum(1, axis)
+
+
+def _flatten_concat(tree, world: int) -> Tuple[jnp.ndarray, Any, list]:
+    """Concatenate all leaves into one f32 vector padded to ``world``."""
+    leaves, treedef = jax.tree.flatten(tree)
+    flat = [jnp.ravel(x).astype(jnp.float32) for x in leaves]
+    sizes = [int(x.size) for x in flat]
+    total = sum(sizes)
+    pad = (-total) % world
+    buf = jnp.concatenate(flat + ([jnp.zeros((pad,), jnp.float32)] if pad else []))
+    return buf, treedef, sizes
+
+
+def _split_restore(buf: jnp.ndarray, treedef, sizes, shapes, dtypes):
+    out, off = [], 0
+    for size, shape, dt in zip(sizes, shapes, dtypes):
+        out.append(buf[off:off + size].reshape(shape).astype(dt))
+        off += size
+    return jax.tree.unflatten(treedef, out)
+
+
+def reduce_scatter_coalesced(tree, axis: AxisName, world: int):
+    """Fused reduce-scatter of a pytree (ref coalesced_collectives.py:158).
+
+    Returns ``(shard, meta)``: this rank's 1/world shard of the flat reduced
+    buffer plus the metadata to reassemble (used by ZeRO-2 partitioned
+    gradient consumers).  ``world`` must be the static axis size.
+    """
+    buf, treedef, sizes = _flatten_concat(tree, world)
+    shard = lax.psum_scatter(buf, axis, scatter_dimension=0, tiled=True)
+    return shard, (treedef, sizes)
+
+
+def all_gather_coalesced(shard: jnp.ndarray, meta, shapes, dtypes, axis: AxisName):
+    """Inverse: gather shards and restore the pytree (ref ZeRO-3
+    AllGatherCoalescedHandle, partition_parameters.py:704)."""
+    treedef, sizes = meta
+    buf = lax.all_gather(shard, axis, axis=0, tiled=True)
+    return _split_restore(buf, treedef, sizes, shapes, dtypes)
+
+
+# ----------------------------------------------------------------------
+# qgZ: quantized two-level all-to-all gradient reduce (ZeRO++)
+# ----------------------------------------------------------------------
+def _quant_chunked_reduce(x: jnp.ndarray, axis: AxisName, world: int,
+                          num_bits: int, group_size: int) -> jnp.ndarray:
+    """One level of qgZ: chunk → quantize → all-to-all → dequant → mean.
+
+    ``x`` is the local [N] buffer (N divisible by world); returns this
+    rank's [N/world] reduced chunk. int8 + f32-scales travel the wire.
+    """
+    m = x.size // world
+    chunks = x.reshape(world, m)
+    gs = min(group_size, m)
+    if m % gs:
+        gs = m
+    q, scale, _ = quantize_blockwise(chunks, num_bits=num_bits, group_size=gs)
+    # every rank receives chunk r from all ranks: [world, m] rows=src rank
+    q_t = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
+    s_t = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=True)
+    deq = dequantize_blockwise(q_t.reshape(world, m), s_t.reshape(world, -1))
+    return jnp.mean(deq, axis=0)
+
+
+def all_to_all_quant_reduce(tree, inner_axis: AxisName, outer_axis: AxisName,
+                            inner_size: int, outer_size: int,
+                            num_bits: int = 8, group_size: int = 256):
+    """qgZ (ref coalesced_collectives.py:31): hierarchical int8 gradient
+    reduction.  Level 1 rides the inner axis (ICI), level 2 the outer axis
+    (DCN).  Returns ``(shard, meta)`` like :func:`reduce_scatter_coalesced`
+    — this rank's 1/(inner·outer) shard of the mean gradient.
+    """
+    world = inner_size * outer_size
+    buf, treedef, sizes = _flatten_concat(tree, world)
+    lvl1 = _quant_chunked_reduce(buf, inner_axis, inner_size, num_bits, group_size)
+    if outer_size > 1:
+        lvl2 = _quant_chunked_reduce(lvl1, outer_axis, outer_size, num_bits, group_size)
+    else:
+        lvl2 = lvl1
+    return lvl2, (treedef, sizes)
+
+
+def loco_quant_reduce(tree, err_tree, inner_axis: AxisName, outer_axis: AxisName,
+                      inner_size: int, outer_size: int,
+                      num_bits: int = 8, group_size: int = 256):
+    """LoCo variant (ref coalesced_collectives.py:81): error feedback carries
+    the quantization residual of the *sent* values into the next step.
+
+    ``err_tree`` must match ``tree``; returns (shard, meta, new_err_tree).
+    """
+    world = inner_size * outer_size
+    comp = jax.tree.map(lambda g, e: g + e, tree, err_tree)
+    buf, treedef, sizes = _flatten_concat(comp, world)
+    # residual of the first (lossy) send is what error feedback tracks
+    m = buf.size // inner_size
+    gs = min(group_size, m)
+    if m % gs:
+        gs = m
+    q, scale, _ = quantize_blockwise(buf.reshape(inner_size, m), num_bits=num_bits,
+                                     group_size=gs)
+    sent = dequantize_blockwise(q, scale).reshape(-1)
+    residual_flat = buf - sent
+    shapes = [jnp.shape(x) for x in jax.tree.leaves(tree)]
+    dtypes = [jnp.result_type(x) for x in jax.tree.leaves(err_tree)]
+    new_err = _split_restore(residual_flat, treedef, sizes, shapes, dtypes)
+
+    lvl1 = _quant_chunked_reduce(buf, inner_axis, inner_size, num_bits, group_size)
+    lvl2 = (_quant_chunked_reduce(lvl1, outer_axis, outer_size, num_bits, group_size)
+            if outer_size > 1 else lvl1)
+    return lvl2, (treedef, sizes), new_err
+
+
+def tree_meta(tree):
+    """Shapes/dtypes needed to reassemble after gather."""
+    leaves = jax.tree.leaves(tree)
+    return [jnp.shape(x) for x in leaves], [jnp.result_type(x) for x in leaves]

@@ -1,0 +1,106 @@
+"""1-bit compressed allreduce with error feedback.
+
+TPU-native analog of the reference's compressed backends
+(``runtime/comm/compressed.py`` CompressedBackend:13, ``runtime/comm/nccl.py``
+NcclBackend:16, ``runtime/comm/mpi.py``): the error-feedback sign-SGD
+compression used by 1-bit Adam / 1-bit LAMB / 0/1-Adam.
+
+Algorithm (ref compressed_allreduce): with per-worker error e and server
+error s over a flat buffer c = x + e:
+
+1. chunk c into world pieces; per-chunk scale = mean|chunk|; sign-compress;
+   worker error ← c − decompress(sent).
+2. all-to-all the compressed chunks (sign bits + scales on the wire — int8
+   here; the reference packs to real bits via packbits, 8× vs our 4×... we
+   pack signs of 8 elements per byte below for the same 32× total).
+3. each rank averages its received chunk, adds server error, compresses
+   again; server error ← residual.
+4. all-gather the compressed server chunks; decompress → averaged result.
+
+In-jit: call inside ``shard_map`` over the data axis. State (worker/server
+error) is per-rank: the engine stores it as arrays with a leading
+``[world]`` axis sharded over the same mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+
+
+def pack_signs(sign01: jnp.ndarray) -> jnp.ndarray:
+    """Pack {0,1} sign bits, 8 per byte (ref csrc/xpu/packbits analog)."""
+    n = sign01.shape[-1]
+    if n % 8:
+        raise ValueError("length must be divisible by 8 to pack bits")
+    b = sign01.reshape(sign01.shape[:-1] + (n // 8, 8)).astype(jnp.uint8)
+    weights = (1 << jnp.arange(8, dtype=jnp.uint8))
+    return jnp.sum(b * weights, axis=-1).astype(jnp.uint8)
+
+
+def unpack_signs(packed: jnp.ndarray) -> jnp.ndarray:
+    bits = (packed[..., None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    return bits.reshape(packed.shape[:-1] + (packed.shape[-1] * 8,))
+
+
+def _compress(c: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """sign + L1 scale per row; returns (packed bits, scale)."""
+    scale = jnp.mean(jnp.abs(c), axis=-1)
+    bits = pack_signs((c >= 0).astype(jnp.uint8))
+    return bits, scale
+
+
+def _decompress(bits: jnp.ndarray, scale: jnp.ndarray, n: int) -> jnp.ndarray:
+    sign = unpack_signs(bits)[..., :n].astype(jnp.float32) * 2.0 - 1.0
+    return sign * scale[..., None]
+
+
+def compressed_allreduce(x: jnp.ndarray, worker_err: jnp.ndarray,
+                         server_err: jnp.ndarray, axis: AxisName,
+                         world: int) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Error-feedback 1-bit mean-allreduce of flat ``x`` (≡ ref
+    CompressedBackend.compressed_allreduce, runtime/comm/compressed.py:13).
+
+    ``x`` [N] with N divisible by world*8; ``worker_err`` [N];
+    ``server_err`` [N/world].  Returns (avg, new_worker_err, new_server_err).
+    """
+    n = x.size
+    m = n // world
+    c = x + worker_err
+
+    chunks = c.reshape(world, m)
+    bits, scales = _compress(chunks)
+    new_worker_err = c - _decompress(bits, scales, m).reshape(-1)
+
+    # exchange compressed chunks: rank r receives chunk r from every rank
+    bits_t = lax.all_to_all(bits, axis, split_axis=0, concat_axis=0, tiled=True)
+    scales_t = lax.all_to_all(scales, axis, split_axis=0, concat_axis=0, tiled=True)
+    recv = _decompress(bits_t.reshape(world, m // 8), scales_t.reshape(world), m)
+
+    server_chunk = jnp.mean(recv, axis=0) + server_err
+    s_bits, s_scale = _compress(server_chunk[None, :])
+    new_server_err = server_chunk - _decompress(s_bits, s_scale, m)[0]
+
+    # gather everyone's compressed server chunk
+    g_bits = lax.all_gather(s_bits[0], axis, axis=0, tiled=False)
+    g_scale = lax.all_gather(s_scale, axis, axis=0, tiled=False)
+    out = _decompress(g_bits, g_scale.reshape(world), m).reshape(-1)[:n]
+    return out, new_worker_err, new_server_err
+
+
+class CompressedBackend:
+    """Object façade matching the reference's backend classes; holds sizes
+    and exposes ``compressed_allreduce`` bound to a mesh axis."""
+
+    def __init__(self, axis: AxisName, world: int):
+        self.axis = axis
+        self.world = world
+        self.size = world
+
+    def compressed_allreduce(self, x, worker_err, server_err):
+        return compressed_allreduce(x, worker_err, server_err, self.axis, self.world)

@@ -1,0 +1,140 @@
+"""Shared-memory host collectives (co-located launcher processes).
+
+Python binding for ``csrc/shm_comm`` — the analog of the reference's
+``CCLBackend`` SHM path (``deepspeed/comm/ccl.py`` → csrc/cpu/comm/shm.cpp):
+host-side allreduce/broadcast/allgather/barrier between processes on one
+machine without touching the network.  Used by the launcher/elasticity for
+host coordination; device collectives stay XLA/ICI.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import OpBuilderError, load_op
+from deepspeed_tpu.utils.logging import logger
+
+_LIB = None
+_LIB_FAILED = False
+
+
+def _lib():
+    global _LIB, _LIB_FAILED
+    if _LIB is None and not _LIB_FAILED:
+        try:
+            lib = load_op("ds_shm_comm", ["shm_comm/shm_comm.cpp"])
+            lib.ds_shm_create.restype = ctypes.c_void_p
+            lib.ds_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                          ctypes.c_int, ctypes.c_int64,
+                                          ctypes.c_uint64, ctypes.c_int64]
+            f32 = ctypes.POINTER(ctypes.c_float)
+            lib.ds_shm_allreduce.restype = ctypes.c_int
+            lib.ds_shm_allreduce.argtypes = [ctypes.c_void_p, f32,
+                                             ctypes.c_int64]
+            lib.ds_shm_broadcast.restype = ctypes.c_int
+            lib.ds_shm_broadcast.argtypes = [ctypes.c_void_p, f32,
+                                             ctypes.c_int64, ctypes.c_int]
+            lib.ds_shm_allgather.restype = ctypes.c_int
+            lib.ds_shm_allgather.argtypes = [ctypes.c_void_p, f32,
+                                             ctypes.c_int64, f32]
+            lib.ds_shm_barrier.argtypes = [ctypes.c_void_p]
+            lib.ds_shm_destroy.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            _LIB = lib
+        except OpBuilderError as e:
+            logger.warning(f"shm comm unavailable: {e}")
+            _LIB_FAILED = True
+    return _LIB
+
+
+def shm_available() -> bool:
+    return _lib() is not None
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class ShmComm:
+    """Process group over POSIX shared memory (same-host ranks)."""
+
+    def __init__(self, name: str, rank: int, world: int,
+                 max_elems: int = 1 << 20, nonce: Optional[int] = None,
+                 timeout_s: float = 60.0):
+        lib = _lib()
+        if lib is None:
+            raise RuntimeError("shm comm native op unavailable")
+        self._lib = lib
+        self.rank = rank
+        self.world = world
+        # namespace per user+name so stale regions don't collide
+        shm_name = f"/dstpu_{os.environ.get('USER', 'u')}_{name}"
+        # all ranks of one run must agree on the nonce, and it must differ
+        # from a crashed previous run's: the launcher exports one per job.
+        # Fallback for co-spawned workers: parent pid mixed with the
+        # parent's start time (stable across ranks, differs when the parent
+        # pid is recycled).  Caveat: a supervisor that respawns an
+        # identical job keeps the same parent — such setups must provide
+        # DSTPU_SHM_NONCE (or nonce=) for full stale-region safety.
+        if nonce is None:
+            env = os.environ.get("DSTPU_SHM_NONCE")
+            if env is not None:
+                nonce = int(env)
+            else:
+                nonce = os.getppid()
+                try:
+                    with open(f"/proc/{nonce}/stat", "rb") as f:
+                        starttime = int(f.read().rsplit(b") ", 1)[1].split()[19])
+                    nonce = (starttime << 22) | nonce
+                except (OSError, IndexError, ValueError):
+                    pass
+        self.nonce = nonce & 0xFFFFFFFFFFFFFFFF
+        if self.nonce == 0:
+            self.nonce = 1  # 0 is the in-progress-init sentinel
+        self._h = lib.ds_shm_create(shm_name.encode(), rank, world,
+                                    max_elems * 4, self.nonce,
+                                    int(timeout_s * 1e6))
+        if not self._h:
+            if rank == 0:
+                raise RuntimeError(
+                    f"shm init failed for {shm_name}: could not create/map "
+                    f"the shared-memory region (is /dev/shm writable and "
+                    f"large enough?)")
+            raise RuntimeError(
+                f"shm init failed for {shm_name} (rank {rank}/{world}): "
+                f"rank 0 never published nonce {self.nonce} — if ranks are "
+                f"spawned from different parents, set DSTPU_SHM_NONCE to a "
+                f"shared per-job value")
+
+    def allreduce(self, arr: np.ndarray) -> np.ndarray:
+        arr = np.ascontiguousarray(arr, np.float32)
+        if self._lib.ds_shm_allreduce(self._h, _ptr(arr), arr.size) != 0:
+            raise ValueError("payload exceeds shm slot size")
+        return arr
+
+    def broadcast(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
+        arr = np.ascontiguousarray(arr, np.float32)
+        if self._lib.ds_shm_broadcast(self._h, _ptr(arr), arr.size, root) != 0:
+            raise ValueError("payload exceeds shm slot size")
+        return arr
+
+    def allgather(self, arr: np.ndarray) -> np.ndarray:
+        arr = np.ascontiguousarray(arr, np.float32)
+        out = np.empty((self.world,) + arr.shape, np.float32)
+        if self._lib.ds_shm_allgather(self._h, _ptr(arr), arr.size,
+                                      _ptr(out)) != 0:
+            raise ValueError("payload exceeds shm slot size")
+        return out
+
+    def barrier(self) -> None:
+        self._lib.ds_shm_barrier(self._h)
+
+    def close(self, unlink: Optional[bool] = None) -> None:
+        if self._h:
+            self._lib.ds_shm_destroy(
+                self._h, 1 if (unlink if unlink is not None
+                               else self.rank == 0) else 0)
+            self._h = None

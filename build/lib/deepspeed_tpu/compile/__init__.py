@@ -1,0 +1,9 @@
+"""DeepCompile-analog: compiler-analysis-driven memory/schedule passes
+(ref deepspeed/compile/)."""
+
+from deepspeed_tpu.compile.backend import (CompilePass, CompileReport,
+                                           OffloadOptStatesPass, ProfilePass,
+                                           RematPass, deepspeed_compile)
+
+__all__ = ["deepspeed_compile", "CompilePass", "CompileReport",
+           "ProfilePass", "RematPass", "OffloadOptStatesPass"]

@@ -1,0 +1,139 @@
+"""DeepCompile analog — profile-guided graph passes on a jitted step.
+
+Re-design of ``deepspeed/compile/`` (``backend.py`` torch.compile hook,
+``profilers/graph_profile.py``, ``list_schedule.py`` + ``passes/`` with the
+native runtime ``csrc/compile/*.cpp``).  The reference rewrites the fx
+graph to insert prefetching allgathers, selective unsharding and
+optimizer-state offload.  Under XLA, collective scheduling and fusion are
+the compiler's job — what remains valuable is the *decision layer*: profile
+the compiled step's cost/memory, then apply memory passes (remat policy,
+host offload of optimizer state) until the step fits the budget.
+
+``deepspeed_compile(make_fn, args, config)`` runs the pass pipeline:
+each pass inspects the profile and may re-materialise the step function
+with different knobs; the final report records every decision — the analog
+of the reference's pass schedule list.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from deepspeed_tpu.profiling.flops_profiler import profile_compiled
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclass
+class CompileReport:
+    profile: Dict[str, float] = field(default_factory=dict)
+    decisions: List[str] = field(default_factory=list)
+    knobs: Dict[str, Any] = field(default_factory=dict)
+
+
+class CompilePass:
+    """One pass: inspect (fn, profile, knobs) → updated knobs or None."""
+
+    name = "base"
+
+    def run(self, report: CompileReport, config: Dict[str, Any]
+            ) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+
+class ProfilePass(CompilePass):
+    """Populate report.profile from XLA cost/memory analysis (ref
+    profilers/graph_profile.py)."""
+
+    name = "profile"
+
+    def __init__(self, fn_factory: Callable[[Dict[str, Any]], Any], args):
+        self.fn_factory = fn_factory
+        self.args = args
+
+    def run(self, report, config):
+        fn = self.fn_factory(report.knobs)
+        report.profile = profile_compiled(jax.jit(fn), *self.args)
+        report.decisions.append(
+            f"profile: flops={report.profile.get('flops', 0):.3e} "
+            f"peak={report.profile.get('peak_memory_bytes', 0):.3e}B")
+        return None
+
+
+class RematPass(CompilePass):
+    """Escalate the remat policy while peak memory exceeds the budget
+    (ref passes/ selective unsharding ↔ here: selective rematerialisation).
+    Escalation: none → dots_saveable → nothing_saveable."""
+
+    name = "remat"
+    LADDER = ["none", "dots_saveable", "nothing_saveable"]
+
+    def run(self, report, config):
+        budget = config.get("memory_budget_bytes")
+        peak = report.profile.get("peak_memory_bytes")
+        if not budget or peak is None or peak <= budget:
+            return None
+        cur = report.knobs.get("remat_policy", "none")
+        idx = self.LADDER.index(cur) if cur in self.LADDER else 0
+        if idx + 1 >= len(self.LADDER):
+            return None
+        new = self.LADDER[idx + 1]
+        report.decisions.append(
+            f"remat: peak {peak:.3e}B > budget {budget:.3e}B → "
+            f"policy {cur} → {new}")
+        return {"remat_policy": new}
+
+
+class OffloadOptStatesPass(CompilePass):
+    """Offload optimizer state to host when even full remat does not fit
+    (ref passes/offload_opt_states + csrc/compile z1/z2/z3 offload)."""
+
+    name = "offload_opt_states"
+
+    def run(self, report, config):
+        budget = config.get("memory_budget_bytes")
+        peak = report.profile.get("peak_memory_bytes")
+        if not budget or peak is None or peak <= budget:
+            return None
+        if report.knobs.get("remat_policy") != "nothing_saveable":
+            return None  # remat ladder not exhausted yet
+        if report.knobs.get("offload_optimizer"):
+            return None
+        report.decisions.append(
+            f"offload: peak {peak:.3e}B still > budget → optimizer "
+            f"states to host")
+        return {"offload_optimizer": True}
+
+
+def deepspeed_compile(fn_factory: Callable[[Dict[str, Any]], Callable],
+                      args: Tuple, config: Optional[Dict[str, Any]] = None,
+                      max_rounds: int = 4
+                      ) -> Tuple[Callable, CompileReport]:
+    """Run the pass schedule (ref init_z1/z2/z3 + list_schedule):
+
+    ``fn_factory(knobs) -> step_fn`` rebuilds the step under the given
+    knobs ({"remat_policy", "offload_optimizer"}).  Returns the jitted
+    final fn and the report.
+    """
+    config = config or {}
+    report = CompileReport(knobs={"remat_policy": config.get(
+        "remat_policy", "none")})
+    profile = ProfilePass(fn_factory, args)
+    passes: List[CompilePass] = [RematPass(), OffloadOptStatesPass()]
+    for _ in range(max_rounds):
+        profile.run(report, config)
+        changed = False
+        for p in passes:
+            upd = p.run(report, config)
+            if upd:
+                report.knobs.update(upd)
+                changed = True
+                break  # re-profile after each materialised change
+        if not changed:
+            break
+    final = jax.jit(fn_factory(report.knobs))
+    for d in report.decisions:
+        logger.info(f"deepspeed_compile: {d}")
+    return final, report

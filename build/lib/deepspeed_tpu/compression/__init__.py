@@ -1,0 +1,19 @@
+"""Compression: QAT, structured/unstructured pruning, layer reduction.
+
+Analog of ``deepspeed/compression/``."""
+
+from deepspeed_tpu.compression.compress import (CompressionManager,
+                                                CompressionScheduler,
+                                                init_compression)
+from deepspeed_tpu.compression.basic_layers import (channel_pruning_mask,
+                                                    head_pruning_mask,
+                                                    quantize_activation_ste,
+                                                    quantize_weight_ste,
+                                                    row_pruning_mask,
+                                                    sparse_pruning_mask)
+
+__all__ = [
+    "CompressionManager", "CompressionScheduler", "init_compression",
+    "quantize_weight_ste", "quantize_activation_ste", "sparse_pruning_mask",
+    "row_pruning_mask", "channel_pruning_mask", "head_pruning_mask",
+]

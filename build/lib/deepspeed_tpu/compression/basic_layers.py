@@ -1,0 +1,121 @@
+"""Compression primitives: QAT fake-quant with STE, structured/unstructured
+pruning masks.
+
+Analog of ``deepspeed/compression/basic_layer.py`` (LinearLayer_Compress
+and friends).  The reference wraps nn.Linear modules; here every technique
+is a pure function over a weight array, applied inside the jitted forward —
+masks and quantization fuse into the surrounding matmul, so "compressed
+training" costs one elementwise op per weight instead of a module swap.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def ste_round(x):
+    """Round with straight-through gradient (QAT backward rule)."""
+    return x + jax.lax.stop_gradient(jnp.round(x) - x)
+
+
+def quantize_weight_ste(w, bits: int = 8, symmetric: bool = True,
+                        group_size: int = 0):
+    """Fake-quantize a weight for QAT (ref WEIGHT_QUANTIZE_*: symmetric /
+    asymmetric, per-tensor or grouped).  Differentiable via STE."""
+    orig_shape = w.shape
+    wf = w.astype(jnp.float32)
+    if group_size and w.size % group_size == 0:
+        wf = wf.reshape(-1, group_size)
+    qmax = 2.0 ** (bits - 1) - 1
+    if symmetric:
+        scale = jnp.max(jnp.abs(wf), axis=-1, keepdims=True) / qmax
+        scale = jnp.maximum(scale, 1e-8)
+        q = ste_round(wf / scale).clip(-qmax - 1, qmax)
+        out = q * scale
+    else:
+        mn = wf.min(axis=-1, keepdims=True)
+        mx = wf.max(axis=-1, keepdims=True)
+        scale = jnp.maximum((mx - mn) / (2.0 ** bits - 1), 1e-8)
+        q = ste_round((wf - mn) / scale).clip(0, 2.0 ** bits - 1)
+        out = q * scale + mn
+    return out.reshape(orig_shape).astype(w.dtype)
+
+
+def quantize_activation_ste(x, bits: int = 8, symmetric: bool = False,
+                            range_calibration: str = "dynamic"):
+    """Activation fake-quant (ref ACTIVATION_QUANTIZATION_*): dynamic
+    per-token range by default."""
+    xf = x.astype(jnp.float32)
+    if symmetric:
+        qmax = 2.0 ** (bits - 1) - 1
+        scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / qmax, 1e-8)
+        out = ste_round(xf / scale).clip(-qmax - 1, qmax) * scale
+    else:
+        mn = xf.min(axis=-1, keepdims=True)
+        mx = xf.max(axis=-1, keepdims=True)
+        scale = jnp.maximum((mx - mn) / (2.0 ** bits - 1), 1e-8)
+        out = ste_round((xf - mn) / scale).clip(0, 2.0 ** bits - 1) * scale + mn
+    return out.astype(x.dtype)
+
+
+# ----------------------------------------------------------------------
+# Pruning masks. All return a {0,1} mask with w's shape; masks are
+# magnitude-based like the reference's TopK defaults.
+# ----------------------------------------------------------------------
+
+def sparse_pruning_mask(w, dense_ratio: float, method: str = "topk"):
+    """Unstructured magnitude pruning (ref SPARSE_PRUNING_*): keep the
+    top ``dense_ratio`` fraction by |w|. method 'l1' == 'topk' magnitude."""
+    if dense_ratio >= 1.0:
+        return jnp.ones_like(w)
+    k = max(1, int(round(w.size * dense_ratio)))
+    flat = jnp.abs(w.reshape(-1))
+    thresh = jax.lax.top_k(flat, k)[0][-1]
+    return (jnp.abs(w) >= thresh).astype(w.dtype)
+
+
+def row_pruning_mask(w, dense_ratio: float):
+    """Structured row pruning (ref ROW_PRUNING_*): score rows (output
+    features, last dim of [in, out]) by L1 norm, keep top fraction."""
+    if dense_ratio >= 1.0:
+        return jnp.ones_like(w)
+    scores = jnp.abs(w).sum(axis=tuple(range(w.ndim - 1)))  # [out]
+    k = max(1, int(round(scores.shape[0] * dense_ratio)))
+    thresh = jax.lax.top_k(scores, k)[0][-1]
+    keep = (scores >= thresh).astype(w.dtype)
+    return jnp.broadcast_to(keep, w.shape)
+
+
+def channel_pruning_mask(w, dense_ratio: float):
+    """Structured input-channel pruning (ref CHANNEL_PRUNING_*): scores the
+    second-to-last (input) dim."""
+    if dense_ratio >= 1.0:
+        return jnp.ones_like(w)
+    axes = tuple(i for i in range(w.ndim) if i != w.ndim - 2)
+    scores = jnp.abs(w).sum(axis=axes)  # [in]
+    k = max(1, int(round(scores.shape[0] * dense_ratio)))
+    thresh = jax.lax.top_k(scores, k)[0][-1]
+    keep = (scores >= thresh).astype(w.dtype)
+    return jnp.broadcast_to(keep[:, None], w.shape)
+
+
+def head_pruning_mask(w, dense_ratio: float, num_heads: int):
+    """Attention head pruning (ref HEAD_PRUNING_*): w is an output
+    projection [..., H*D, out]; score each head's slab, keep top fraction."""
+    if dense_ratio >= 1.0:
+        return jnp.ones_like(w)
+    in_dim = w.shape[-2]
+    if in_dim % num_heads != 0:
+        raise ValueError(f"in dim {in_dim} not divisible by {num_heads} heads")
+    hd = in_dim // num_heads
+    wh = w.reshape(w.shape[:-2] + (num_heads, hd, w.shape[-1]))
+    axes = tuple(i for i in range(wh.ndim) if i != wh.ndim - 3)
+    scores = jnp.abs(wh).sum(axis=axes)  # [H]
+    k = max(1, int(round(num_heads * dense_ratio)))
+    thresh = jax.lax.top_k(scores, k)[0][-1]
+    keep = (scores >= thresh).astype(w.dtype)
+    mask = jnp.broadcast_to(keep[:, None, None], wh.shape[-3:])
+    return jnp.broadcast_to(mask.reshape((in_dim, w.shape[-1])), w.shape)

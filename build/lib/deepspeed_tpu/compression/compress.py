@@ -1,0 +1,153 @@
+"""Compression manager: config-driven QAT + pruning over a param tree.
+
+Analog of ``deepspeed/compression/compress.py`` (``init_compression``,
+``redundancy_clean``) and ``scheduler.py`` (``compression_scheduler``).
+The reference swaps nn modules for compress-capable ones and lets a
+scheduler flip them on at their ``schedule_offset``.  Here compression is a
+*pure function* ``apply(params, step)`` → compressed param view, evaluated
+inside the jitted train step: techniques switch on by step comparison
+(``jnp.where``-free — the step is a python int at call time, so disabled
+techniques compile to nothing).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.compression import basic_layers as B
+from deepspeed_tpu.compression.config import (LayerReductionConfig,
+                                              TechniqueConfig,
+                                              parse_compression_config)
+from deepspeed_tpu.parallel.sharding import path_str
+from deepspeed_tpu.utils.logging import logger
+
+
+def _match(patterns: List[str], path: str) -> bool:
+    for p in patterns:
+        if p == "*" or fnmatch.fnmatch(path, p) or fnmatch.fnmatch(path, f"*{p}*") \
+                or re.search(p, path):
+            return True
+    return False
+
+
+class CompressionScheduler:
+    """Step-gated technique enablement (ref compression/scheduler.py:12)."""
+
+    def __init__(self, techniques: Dict[str, TechniqueConfig]):
+        self.techniques = techniques
+
+    def active(self, tech: str, step: int) -> bool:
+        tc = self.techniques.get(tech)
+        if tc is None or not tc.enabled:
+            return False
+        if step < tc.schedule_offset:
+            return False
+        if tc.schedule_offset_end is not None and step > tc.schedule_offset_end:
+            return False
+        return True
+
+
+class CompressionManager:
+    """Classifies params against the config's group patterns and applies
+    QAT/pruning in the forward path (ref init_compression)."""
+
+    def __init__(self, config: Dict[str, Any]):
+        cc = config.get("compression_training", config) or {}
+        self.cfg = parse_compression_config(cc)
+        self.scheduler = CompressionScheduler(
+            {k: v for k, v in self.cfg.items() if isinstance(v, TechniqueConfig)})
+        self.layer_reduction: LayerReductionConfig = self.cfg["layer_reduction"]
+
+    # ------------------------------------------------------------------
+    def _technique_params(self, tech: str, path: str) -> Optional[Dict[str, Any]]:
+        tc: TechniqueConfig = self.cfg[tech]
+        if not tc.enabled:
+            return None
+        for g in tc.groups:
+            if _match(g.modules, path):
+                return g.params
+        return None
+
+    def apply(self, params: Any, step: int, num_heads: int = 0) -> Any:
+        """params → compressed view for this step. Pure; call inside the
+        jitted loss so masks/quant fuse with the matmuls."""
+
+        def leaf(path, w):
+            p = path_str(path)
+            if np.ndim(w) < 2:
+                return w
+            out = w
+            gp = self._technique_params("sparse_pruning", p)
+            if gp is not None and self.scheduler.active("sparse_pruning", step):
+                out = out * B.sparse_pruning_mask(
+                    out, float(gp.get("dense_ratio", 0.5)),
+                    method=gp.get("method", "topk"))
+            gp = self._technique_params("row_pruning", p)
+            if gp is not None and self.scheduler.active("row_pruning", step):
+                out = out * B.row_pruning_mask(out, float(gp.get("dense_ratio", 0.5)))
+            gp = self._technique_params("channel_pruning", p)
+            if gp is not None and self.scheduler.active("channel_pruning", step):
+                out = out * B.channel_pruning_mask(out, float(gp.get("dense_ratio", 0.5)))
+            gp = self._technique_params("head_pruning", p)
+            if gp is not None and self.scheduler.active("head_pruning", step) \
+                    and num_heads:
+                out = out * B.head_pruning_mask(
+                    out, float(gp.get("dense_ratio", 0.5)), num_heads)
+            gp = self._technique_params("weight_quantization", p)
+            if gp is not None and self.scheduler.active("weight_quantization", step):
+                out = B.quantize_weight_ste(
+                    out, bits=int(gp.get("start_bits", gp.get("target_bits", 8))),
+                    symmetric=gp.get("quantization_type", "symmetric") == "symmetric",
+                    group_size=int(self.cfg["weight_quantization"].shared.get(
+                        "quantize_groups", 0) or 0))
+            return out
+
+        return jax.tree_util.tree_map_with_path(leaf, params)
+
+    def quantize_activations(self, x, path: str, step: int):
+        gp = self._technique_params("activation_quantization", path)
+        if gp is None or not self.scheduler.active("activation_quantization", step):
+            return x
+        return B.quantize_activation_ste(
+            x, bits=int(gp.get("bits", 8)),
+            symmetric=gp.get("quantization_type", "asymmetric") == "symmetric")
+
+    # ------------------------------------------------------------------
+    def redundancy_clean(self, params: Any, num_heads: int = 0) -> Any:
+        """Permanently bake all active masks/quant into the weights (ref
+        redundancy_clean, compress.py) — run once after training."""
+        return self.apply(params, step=1 << 30, num_heads=num_heads)
+
+
+def init_compression(params: Any, config: Dict[str, Any]
+                     ) -> Tuple[Any, CompressionManager]:
+    """Ref: ``deepspeed.compression.compress.init_compression``.  Applies
+    layer reduction eagerly (student keeps ``teacher_layer`` rows of each
+    stacked [L, ...] param) and returns (params, manager)."""
+    mgr = CompressionManager(config)
+    lr = mgr.layer_reduction
+    if lr.enabled:
+        keep = lr.teacher_layer
+        if keep is None and lr.keep_number_layer:
+            keep = list(range(lr.keep_number_layer))
+        if keep:
+            keep_idx = np.asarray(keep)
+
+            def cut(path, w):
+                p = path_str(path)
+                if lr.module_name_prefix and not p.startswith(lr.module_name_prefix):
+                    return w
+                # stacked per-layer params: leading dim == num teacher layers
+                if np.ndim(w) >= 1 and np.shape(w)[0] > keep_idx.max():
+                    if "layers" in p:
+                        return w[keep_idx]
+                return w
+
+            params = jax.tree_util.tree_map_with_path(cut, params)
+            logger.info(f"layer_reduction: kept layers {keep}")
+    return params, mgr

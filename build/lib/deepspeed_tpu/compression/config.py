@@ -1,0 +1,76 @@
+"""Compression config parsing.
+
+Analog of ``deepspeed/compression/config.py`` + ``constants.py``: the
+``compression_training`` JSON block with per-technique
+``shared_parameters`` / ``different_groups`` and a ``layer_reduction``
+block.  Technique keys match the reference schema so DeepSpeed configs port
+unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+TECHNIQUES = ("weight_quantization", "activation_quantization",
+              "sparse_pruning", "row_pruning", "head_pruning",
+              "channel_pruning")
+
+
+@dataclass
+class CompressionGroup:
+    """One ``different_groups`` entry: param-path patterns + technique
+    params (ref DIFFERENT_GROUPS_* constants)."""
+    name: str
+    params: Dict[str, Any]
+    modules: List[str] = field(default_factory=lambda: ["*"])
+    related_modules: Optional[List[str]] = None
+
+
+@dataclass
+class TechniqueConfig:
+    enabled: bool = False
+    schedule_offset: int = 0
+    schedule_offset_end: Optional[int] = None
+    shared: Dict[str, Any] = field(default_factory=dict)
+    groups: List[CompressionGroup] = field(default_factory=list)
+
+
+@dataclass
+class LayerReductionConfig:
+    enabled: bool = False
+    keep_number_layer: Optional[int] = None
+    teacher_layer: Optional[List[int]] = None
+    module_name_prefix: str = ""
+    other_module_name: Optional[List[str]] = None
+
+
+def parse_compression_config(d: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """compression_training dict → {technique: TechniqueConfig,
+    "layer_reduction": LayerReductionConfig}."""
+    d = d or {}
+    out: Dict[str, Any] = {}
+    for tech in TECHNIQUES:
+        td = d.get(tech, {}) or {}
+        shared = td.get("shared_parameters", {}) or {}
+        groups = []
+        for gname, gd in (td.get("different_groups", {}) or {}).items():
+            groups.append(CompressionGroup(
+                name=gname,
+                params=gd.get("params", {}) or {},
+                modules=gd.get("modules", ["*"]),
+                related_modules=gd.get("related_modules")))
+        out[tech] = TechniqueConfig(
+            enabled=bool(shared.get("enabled", False)),
+            schedule_offset=int(shared.get("schedule_offset", 0)),
+            schedule_offset_end=(int(shared["schedule_offset_end"])
+                                 if "schedule_offset_end" in shared else None),
+            shared=shared, groups=groups)
+    lr = d.get("layer_reduction", {}) or {}
+    out["layer_reduction"] = LayerReductionConfig(
+        enabled=bool(lr.get("enabled", False)),
+        keep_number_layer=lr.get("keep_number_layer"),
+        teacher_layer=lr.get("teacher_layer"),
+        module_name_prefix=lr.get("module_name_prefix", ""),
+        other_module_name=lr.get("other_module_name"))
+    return out

@@ -1,0 +1,202 @@
+// Shared-memory host collectives for co-located processes.
+//
+// TPU-native analog of the reference's SHM collectives
+// (csrc/cpu/comm/shm.cpp, shm_interface.cpp): when several launcher
+// processes share one host, small host-side reductions (grad-norm
+// agreement, elastic heartbeats, compressed-collective server phases)
+// should ride shared memory, not the network. POSIX shm + a process-shared
+// barrier; each rank publishes into its slot, then every rank reduces all
+// slots locally (the reference's naive all-reduce path; its tiled
+// distributed reduce is an optimization for large payloads that host
+// coordination traffic doesn't need).
+//
+// Plain C ABI for ctypes (no pybind11 in this image).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct Header {
+    // Per-run nonce doubles as the init flag: a crashed previous run leaves
+    // its old nonce behind, so late joiners of the NEW run keep waiting
+    // until rank 0 has re-initialized the barrier and published the new
+    // nonce — no rank can race into a stale pthread_barrier (UB).
+    std::atomic<uint64_t> nonce;
+    pthread_barrier_t barrier;
+};
+
+struct Handle {
+    Header* header;
+    char* slots;       // world * slot_bytes payload area
+    int rank;
+    int world;
+    int64_t slot_bytes;
+    char name[128];
+    size_t total_bytes;
+};
+
+inline char* slot(Handle* h, int r) { return h->slots + r * h->slot_bytes; }
+
+}  // namespace
+
+extern "C" {
+
+namespace {
+
+void* map_region(const char* name, size_t total, bool create_fresh) {
+    int fd;
+    if (create_fresh) {
+        // retire the stale NAME first: any open that happens after this
+        // point reaches the new region, not a crashed run's leftover
+        shm_unlink(name);
+        fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+        if (fd < 0) fd = shm_open(name, O_CREAT | O_RDWR, 0600);
+    } else {
+        fd = shm_open(name, O_RDWR, 0600);  // never create: wait for rank 0
+    }
+    if (fd < 0) return nullptr;
+    if (create_fresh && ftruncate(fd, (off_t)total) != 0) {
+        close(fd);
+        return nullptr;
+    }
+    struct stat st;
+    if (!create_fresh &&
+        (fstat(fd, &st) != 0 || (size_t)st.st_size < total)) {
+        close(fd);  // region exists but rank 0 hasn't sized it yet
+        return nullptr;
+    }
+    void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);
+    return mem == MAP_FAILED ? nullptr : mem;
+}
+
+}  // namespace
+
+// timeout_us bounds how long a non-root rank waits for rank 0 to publish
+// this run's nonce (<=0 → 60 s default); on expiry it returns nullptr so
+// the caller can raise instead of hanging forever (e.g. when ranks derive
+// divergent fallback nonces).
+void* ds_shm_create(const char* name, int rank, int world,
+                    int64_t slot_bytes, uint64_t nonce, int64_t timeout_us) {
+    size_t total = sizeof(Header) + (size_t)world * slot_bytes;
+    if (timeout_us <= 0) timeout_us = 60 * 1000 * 1000;
+
+    void* mem = nullptr;
+    if (rank == 0) {
+        mem = map_region(name, total, /*create_fresh=*/true);
+        if (!mem) return nullptr;
+    } else {
+        // A non-root rank may race ahead of rank 0 and map the previous
+        // run's region before rank 0 unlinks it. It waits for this run's
+        // nonce with a per-mapping deadline; on expiry it remaps by name —
+        // the stale name is gone once rank 0 has run, so the retry
+        // converges on the fresh region. (Residual window: a supervisor
+        // respawning an identical job without DSTPU_SHM_NONCE can collide
+        // nonces; see comm/shm.py.)
+        const int64_t remap_us = 2 * 1000 * 1000;
+        int64_t total_waited = 0;
+        for (;;) {
+            while (!(mem = map_region(name, total, false))) {
+                usleep(1000);
+                total_waited += 1000;
+                if (total_waited >= timeout_us) return nullptr;
+            }
+            Header* hd = (Header*)mem;
+            int64_t waited = 0;
+            while (hd->nonce.load(std::memory_order_acquire) != nonce &&
+                   waited < remap_us && total_waited < timeout_us) {
+                usleep(100);
+                waited += 100;
+                total_waited += 100;
+            }
+            if (hd->nonce.load(std::memory_order_acquire) == nonce) break;
+            munmap(mem, total);  // likely the stale region: remap by name
+            mem = nullptr;
+            if (total_waited >= timeout_us) return nullptr;
+        }
+    }
+
+    Handle* h = new Handle();
+    h->header = (Header*)mem;
+    h->slots = (char*)mem + sizeof(Header);
+    h->rank = rank;
+    h->world = world;
+    h->slot_bytes = slot_bytes;
+    h->total_bytes = total;
+    snprintf(h->name, sizeof(h->name), "%s", name);
+
+    if (rank == 0) {
+        h->header->nonce.store(0, std::memory_order_release);
+        pthread_barrierattr_t attr;
+        pthread_barrierattr_init(&attr);
+        pthread_barrierattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+        pthread_barrier_init(&h->header->barrier, &attr, world);
+        pthread_barrierattr_destroy(&attr);
+        h->header->nonce.store(nonce, std::memory_order_release);
+    }
+    return h;
+}
+
+static void barrier(Handle* h) { pthread_barrier_wait(&h->header->barrier); }
+
+void ds_shm_barrier(void* hv) { barrier((Handle*)hv); }
+
+// Sum-allreduce of n floats, in place.  Every rank sums the slots in the
+// SAME order (0..world-1), so the FP rounding is identical on all ranks
+// and the results agree bitwise — required by the grad-norm-agreement and
+// elastic-consensus callers.
+int ds_shm_allreduce(void* hv, float* data, int64_t n) {
+    Handle* h = (Handle*)hv;
+    if ((int64_t)(n * sizeof(float)) > h->slot_bytes) return -1;
+    memcpy(slot(h, h->rank), data, n * sizeof(float));
+    barrier(h);
+    const float* first = (const float*)slot(h, 0);
+    for (int64_t i = 0; i < n; ++i) data[i] = first[i];
+    for (int r = 1; r < h->world; ++r) {
+        const float* other = (const float*)slot(h, r);
+        for (int64_t i = 0; i < n; ++i) data[i] += other[i];
+    }
+    barrier(h);  // no one overwrites slots until all have read
+    return 0;
+}
+
+int ds_shm_broadcast(void* hv, float* data, int64_t n, int root) {
+    Handle* h = (Handle*)hv;
+    if ((int64_t)(n * sizeof(float)) > h->slot_bytes) return -1;
+    if (h->rank == root) memcpy(slot(h, root), data, n * sizeof(float));
+    barrier(h);
+    if (h->rank != root) memcpy(data, slot(h, root), n * sizeof(float));
+    barrier(h);
+    return 0;
+}
+
+// out must hold world * n floats, laid out rank-major.
+int ds_shm_allgather(void* hv, const float* in, int64_t n, float* out) {
+    Handle* h = (Handle*)hv;
+    if ((int64_t)(n * sizeof(float)) > h->slot_bytes) return -1;
+    memcpy(slot(h, h->rank), in, n * sizeof(float));
+    barrier(h);
+    for (int r = 0; r < h->world; ++r) {
+        memcpy(out + r * n, slot(h, r), n * sizeof(float));
+    }
+    barrier(h);
+    return 0;
+}
+
+void ds_shm_destroy(void* hv, int unlink_region) {
+    Handle* h = (Handle*)hv;
+    if (unlink_region) shm_unlink(h->name);
+    munmap((void*)h->header, h->total_bytes);
+    delete h;
+}
+
+}  // extern "C"

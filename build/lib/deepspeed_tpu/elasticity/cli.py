@@ -1,0 +1,32 @@
+"""`dstpu_elastic` — elastic-config checker CLI (ref bin/ds_elastic)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from deepspeed_tpu.elasticity.elasticity import compute_elastic_config
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="dstpu_elastic")
+    p.add_argument("-c", "--config", required=True, help="ds config JSON path")
+    p.add_argument("-w", "--world-size", type=int, default=0)
+    args = p.parse_args(argv)
+    with open(args.config) as f:
+        ds_config = json.load(f)
+    if args.world_size > 0:
+        batch, gpus, micro = compute_elastic_config(
+            ds_config, world_size=args.world_size, return_microbatch=True)
+        print(f"world size {args.world_size} is valid; "
+              f"micro batch per chip = {micro}")
+    else:
+        batch, gpus = compute_elastic_config(ds_config)
+    print(f"final effective batch size: {batch}")
+    print(f"valid chip counts: {gpus}")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

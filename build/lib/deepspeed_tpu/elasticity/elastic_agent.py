@@ -1,0 +1,95 @@
+"""Elastic agent: supervise workers, restart on failure, re-shard on resize.
+
+Analog of the reference's ``DSElasticAgent`` (elasticity/elastic_agent.py:32,
+built on torch-elastic): monitors the local worker processes
+(ref _invoke_run :127), restarts the group up to ``max_restarts`` times, and
+on a world-size change relaunches with new DSTPU_NUM_PROCS so workers
+re-shard from the universal checkpoint.
+
+TPU differences: there is no rendezvous store to re-join — the launcher
+recomputes the world layout and workers rebuild the mesh; parameter state
+travels through the atomic universal checkpoint rather than NCCL broadcast.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+
+class WorkerSpec:
+    def __init__(self, cmd: List[str], env: Optional[Dict[str, str]] = None,
+                 local_world_size: int = 1):
+        self.cmd = list(cmd)
+        self.env = dict(env or {})
+        self.local_world_size = int(local_world_size)
+
+
+class DSElasticAgent:
+    """Run a worker group, restarting on failure (ref elastic_agent.py:32)."""
+
+    def __init__(self, spec: WorkerSpec, max_restarts: int = 3,
+                 monitor_interval: float = 1.0,
+                 world_size_fn: Optional[Callable[[], int]] = None):
+        self.spec = spec
+        self.max_restarts = int(max_restarts)
+        self.monitor_interval = float(monitor_interval)
+        self._world_size_fn = world_size_fn or (lambda: spec.local_world_size)
+        self.restarts = 0
+
+    def _start_group(self, world_size: int) -> List[subprocess.Popen]:
+        procs = []
+        for rank in range(world_size):
+            env = {**os.environ, **self.spec.env,
+                   "DSTPU_NUM_PROCS": str(world_size),
+                   "DSTPU_PROC_ID": str(rank),
+                   "LOCAL_RANK": str(rank),
+                   "RANK": str(rank),
+                   "WORLD_SIZE": str(world_size)}
+            procs.append(subprocess.Popen(self.spec.cmd, env=env))
+        return procs
+
+    def _stop_group(self, procs: List[subprocess.Popen]) -> None:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                p.kill()
+
+    def run(self) -> int:
+        """Monitor loop (ref _invoke_run :127): HEALTHY → poll; a failed
+        worker triggers a group restart; world-size change re-launches."""
+        world = self._world_size_fn()
+        procs = self._start_group(world)
+        while True:
+            time.sleep(self.monitor_interval)
+            codes = [p.poll() for p in procs]
+            if all(c == 0 for c in codes):
+                return 0
+            if any(c not in (None, 0) for c in codes):
+                self.restarts += 1
+                if self.restarts > self.max_restarts:
+                    logger.error("elastic agent: max_restarts exceeded")
+                    self._stop_group(procs)
+                    return 1
+                logger.warning(f"elastic agent: worker failed (codes={codes}); "
+                               f"restart {self.restarts}/{self.max_restarts}")
+                self._stop_group(procs)
+                world = self._world_size_fn()
+                procs = self._start_group(world)
+                continue
+            new_world = self._world_size_fn()
+            if new_world != world:
+                logger.warning(f"elastic agent: world size {world} → {new_world}; "
+                               "restarting group to re-shard")
+                self._stop_group(procs)
+                world = new_world
+                procs = self._start_group(world)

@@ -1,0 +1,96 @@
+"""Inference engine (v1-equivalent).
+
+Analog of ``deepspeed.init_inference`` → ``InferenceEngine``
+(ref inference/engine.py:40): wraps a model config + params, applies TP
+sharding via the same ShardingRules as training (AutoTP-equivalent), and
+serves greedy/sampled generation with a static KV cache that keeps shapes
+fixed for XLA.  The FastGen-equivalent ragged/continuous-batching engine
+lives in ``inference/v2`` (blocked KV cache + scheduler).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deepspeed_tpu.models import transformer as tf_model
+from deepspeed_tpu.models.transformer import TransformerConfig
+from deepspeed_tpu.parallel.sharding import ShardingRules
+from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class InferenceConfig:
+    def __init__(self, d: Optional[Dict[str, Any]] = None, **kw):
+        d = dict(d or {})
+        d.update(kw)
+        self.tensor_parallel = d.get("tensor_parallel", {})
+        if isinstance(self.tensor_parallel, dict):
+            self.tp_size = int(self.tensor_parallel.get("tp_size", 1))
+        else:
+            self.tp_size = int(self.tensor_parallel)
+        self.dtype = d.get("dtype", "bfloat16")
+        self.max_tokens = int(d.get("max_tokens", d.get("max_out_tokens", 1024)))
+        self.max_batch = int(d.get("max_batch", 8))
+        self.replace_with_kernel_inject = bool(d.get("replace_with_kernel_inject", True))
+
+
+class InferenceEngine:
+    """Greedy/temperature generation over the functional model zoo."""
+
+    def __init__(self, model: TransformerConfig, config=None,
+                 model_params: Optional[Any] = None, seed: int = 0, **kwargs):
+        self.cfg = InferenceConfig(config if isinstance(config, dict) else None, **kwargs)
+        dt = jnp.bfloat16 if "bf" in str(self.cfg.dtype) else jnp.float32
+        self.model_config = model.replace(dtype=dt)
+        mesh_sizes = {"tensor": self.cfg.tp_size} if self.cfg.tp_size > 1 else None
+        self.topology = MeshTopology(mesh_sizes)
+        set_topology(self.topology)
+        self.rules = ShardingRules(self.topology, zero_stage=0)
+        if model_params is None:
+            shapes = jax.eval_shape(partial(tf_model.init_params, self.model_config),
+                                    jax.random.PRNGKey(seed))
+            shardings = self.rules.tree_shardings(shapes)
+            self.params = jax.jit(partial(tf_model.init_params, self.model_config),
+                                  out_shardings=shardings)(jax.random.PRNGKey(seed))
+        else:
+            self.params = jax.device_put(
+                model_params, self.rules.tree_shardings(model_params))
+        self._decode_jit = None
+        log_dist(f"InferenceEngine: tp={self.cfg.tp_size} dtype={dt.__name__}")
+
+    # ------------------------------------------------------------------
+    def forward(self, input_ids) -> jnp.ndarray:
+        out = tf_model.forward(self.params, jnp.asarray(input_ids), self.model_config)
+        return out[0] if isinstance(out, tuple) else out
+
+    __call__ = forward
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 0.0, seed: int = 0) -> np.ndarray:
+        """Simple full-recompute generation loop (the KV-cached decode path
+        lives in inference/v2). Greedy when temperature == 0."""
+        ids = np.asarray(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        total = ids.shape[1] + max_new_tokens
+        if total > self.model_config.max_seq_len:
+            raise ValueError(
+                f"prompt ({ids.shape[1]}) + max_new_tokens ({max_new_tokens}) "
+                f"= {total} exceeds max_seq_len {self.model_config.max_seq_len}")
+        key = jax.random.PRNGKey(seed)
+        for _ in range(max_new_tokens):
+            logits = self.forward(jnp.asarray(ids))
+            next_logits = logits[:, -1, :].astype(jnp.float32)
+            if temperature > 0:
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(sub, next_logits / temperature, axis=-1)
+            else:
+                nxt = jnp.argmax(next_logits, axis=-1)
+            ids = np.concatenate([ids, np.asarray(nxt)[:, None]], axis=1)
+        return ids

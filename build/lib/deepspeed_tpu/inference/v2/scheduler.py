@@ -1,0 +1,75 @@
+"""Dynamic SplitFuse scheduler.
+
+Analog of the reference's FastGen scheduling
+(ref inference/v2/scheduling_utils.py + the Dynamic SplitFuse policy,
+blogs/deepspeed-fastgen): every engine step runs a FIXED token budget;
+running (decode) sequences contribute one token each, and waiting prompts
+fill the remaining budget — long prompts are *split* across steps, short
+prompts *fuse* into one step. This keeps every forward the same shape
+(compiled once) and latency flat.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, List, Tuple
+
+from deepspeed_tpu.inference.v2.ragged import DSStateManager, SequenceDescriptor
+
+
+class SplitFuseScheduler:
+    def __init__(self, mgr: DSStateManager, token_budget: int = 256):
+        self.mgr = mgr
+        self.token_budget = token_budget
+        self._decode: List[int] = []          # uids generating tokens
+        self._prefill: Deque[int] = deque()   # uids with uncached prompt tokens
+
+    def add(self, uid: int) -> None:
+        self._prefill.append(uid)
+
+    def retire(self, uid: int) -> None:
+        if uid in self._decode:
+            self._decode.remove(uid)
+        if uid in self._prefill:
+            self._prefill.remove(uid)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._decode or self._prefill)
+
+    def next_schedule(self) -> List[Tuple[SequenceDescriptor, int]]:
+        """(sequence, n_tokens) items for one step, ≤ token_budget total.
+
+        Decode sequences first (1 token each — they bound latency), then
+        prompt chunks. A prompt whose remaining tokens exceed the leftover
+        budget is split; its unsampled chunk stays queued.
+        """
+        budget = self.token_budget
+        schedule: List[Tuple[SequenceDescriptor, int]] = []
+        for uid in list(self._decode):
+            if budget == 0:
+                break
+            seq = self.mgr.get(uid)
+            if seq.uncached <= 0:
+                continue
+            schedule.append((seq, 1))
+            budget -= 1
+
+        finished_prefill = []
+        for uid in list(self._prefill):
+            if budget == 0:
+                break
+            seq = self.mgr.get(uid)
+            n = min(seq.uncached, budget)
+            if n <= 0:
+                finished_prefill.append(uid)
+                continue
+            schedule.append((seq, n))
+            budget -= n
+            if n == seq.uncached:
+                finished_prefill.append(uid)
+        for uid in finished_prefill:
+            self._prefill.remove(uid)
+            if uid not in self._decode:
+                self._decode.append(uid)
+        return schedule

@@ -1,0 +1,5 @@
+from deepspeed_tpu.launcher.runner import (fetch_hostfile, parse_hostfile,
+                                           parse_resource_filter,
+                                           encode_world_info, decode_world_info,
+                                           MultiNodeRunner, PDSHRunner,
+                                           OpenMPIRunner, SlurmRunner)

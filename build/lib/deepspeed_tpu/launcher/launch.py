@@ -1,0 +1,145 @@
+"""Per-node launcher: spawn one training process per local slot.
+
+Analog of the reference's ``launcher/launch.py:145 main``: reads the world
+layout, computes this node's global rank offsets, exports the rendezvous
+env (DSTPU_* for our comm layer + MASTER_*/RANK/LOCAL_RANK for ported
+scripts), spawns the user script once per slot, forwards SIGTERM/SIGINT to
+children, and writes a pidfile.
+
+On TPU one process usually owns all local chips (PJRT), so the common case
+is ``--nproc 1``; ``--nproc N`` with ``TPU_PROCESS_BOUNDS``-style
+chip-splitting is supported for megacore-per-process layouts and for CPU
+test meshes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+from deepspeed_tpu.launcher.runner import decode_world_info
+from deepspeed_tpu.utils.logging import logger
+
+PID_FILE_BASENAME = "dstpu_launch.pid"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dstpu-launch")
+    p.add_argument("--world_info", type=str, default="",
+                   help="base64 JSON {host: [slot ids]} from the runner")
+    p.add_argument("--node_rank", type=str, default="0",
+                   help="this node's index (pdsh passes %%n)")
+    p.add_argument("--nproc", type=int, default=0,
+                   help="local processes (overrides world_info slots)")
+    p.add_argument("--coordinator_addr", type=str, default="127.0.0.1")
+    p.add_argument("--coordinator_port", type=int, default=29500)
+    p.add_argument("--pid_dir", type=str, default="/tmp")
+    p.add_argument("user_script", type=str)
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def compute_ranks(world_info: "dict[str, List[int]]", node_rank: int):
+    """Global rank base + local slot list for this node."""
+    hosts = list(world_info)
+    if not 0 <= node_rank < len(hosts):
+        raise ValueError(f"node_rank {node_rank} out of range ({len(hosts)} hosts)")
+    base = sum(len(world_info[h]) for h in hosts[:node_rank])
+    return base, world_info[hosts[node_rank]]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    node_rank = int(args.node_rank)
+
+    if args.world_info:
+        world = decode_world_info(args.world_info)
+        rank_base, slots = compute_ranks(world, node_rank)
+        world_size = sum(len(v) for v in world.values())
+    else:
+        n = args.nproc or 1
+        rank_base, slots, world_size = 0, list(range(n)), n
+
+    coord = f"{args.coordinator_addr}:{args.coordinator_port}"
+    # one shm nonce per job: distinguishes this run's shared-memory regions
+    # from a crashed predecessor's (comm/shm.py waits on it)
+    shm_nonce = str((os.getpid() << 20) | (int(time.time()) & 0xFFFFF))
+    procs: List[subprocess.Popen] = []
+    for local_rank, slot in enumerate(slots):
+        rank = rank_base + local_rank
+        env = dict(os.environ)
+        env.update({
+            "DSTPU_COORDINATOR": coord,
+            "DSTPU_NUM_PROCS": str(world_size),
+            "DSTPU_PROC_ID": str(rank),
+            "DSTPU_SHM_NONCE": shm_nonce,
+            # reference-compatible names (launch.py:182 area)
+            "MASTER_ADDR": args.coordinator_addr,
+            "MASTER_PORT": str(args.coordinator_port),
+            "WORLD_SIZE": str(world_size),
+            "RANK": str(rank),
+            "LOCAL_RANK": str(local_rank),
+            "CROSS_RANK": str(node_rank),
+        })
+        if len(slots) > 1:
+            # Chip-per-process layout on a multi-chip host (or CPU test mesh).
+            env.setdefault("TPU_VISIBLE_DEVICES", str(slot))
+        cmd = [sys.executable, "-u", args.user_script,
+               f"--local_rank={local_rank}"] + args.user_args
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    pid_path = os.path.join(args.pid_dir, f"{PID_FILE_BASENAME}.{node_rank}")
+    try:
+        with open(pid_path, "w") as f:
+            json.dump({"launcher": os.getpid(), "children": [p.pid for p in procs]}, f)
+    except OSError:  # pragma: no cover
+        pid_path = None
+
+    def _forward(signum, frame):  # pragma: no cover - signal path
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signum)
+
+    signal.signal(signal.SIGTERM, _forward)
+    signal.signal(signal.SIGINT, _forward)
+
+    rc = 0
+    try:
+        alive = list(procs)
+        while alive:
+            for p in list(alive):
+                ret = p.poll()
+                if ret is None:
+                    continue
+                alive.remove(p)
+                if ret != 0:
+                    rc = ret
+                    logger.error(f"child {p.pid} exited with {ret}; terminating node")
+                    for q in alive:
+                        q.terminate()
+                    for q in alive:
+                        try:
+                            q.wait(timeout=30)
+                        except subprocess.TimeoutExpired:
+                            q.kill()
+                            q.wait()
+                    alive = []
+                    break
+            time.sleep(0.1)
+    finally:
+        if pid_path:
+            try:
+                os.remove(pid_path)
+            except OSError:
+                pass
+    return rc
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

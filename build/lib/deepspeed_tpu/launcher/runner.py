@@ -1,0 +1,306 @@
+"""`dstpu` CLI — multi-host job launcher.
+
+TPU-native analog of the reference `deepspeed` CLI
+(ref launcher/runner.py:436 `main`): parses a hostfile ("host slots=N"),
+applies --include/--exclude resource filters (ref runner.py:310), encodes
+the world layout as base64 JSON (ref runner.py:401), then either spawns the
+per-node launcher locally or builds a multinode command (pdsh / mpirun /
+srun — ref launcher/multinode_runner.py).
+
+On TPU the unit of a "slot" is one host *process* (PJRT owns all local
+chips per process); rendezvous is JAX's coordinator service instead of the
+torch MASTER_ADDR store.  We export both the DSTPU_* names our comm layer
+reads and the MASTER_ADDR/RANK names so ported user scripts keep working.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import re
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_COORD_PORT = 29500
+
+
+def parse_hostfile(lines) -> "OrderedDict[str, int]":
+    """Parse `hostname slots=N` lines. Ref: _parse_hostfile (runner.py:243)."""
+    resources: "OrderedDict[str, int]" = OrderedDict()
+    for line in lines:
+        line = line.split("#")[0].strip()
+        if not line:
+            continue
+        m = re.match(r"^(\S+)\s+slots=(\d+)\s*$", line)
+        if m is None:
+            raise ValueError(f"malformed hostfile line: {line!r} "
+                             "(expected '<host> slots=<n>')")
+        host, slots = m.group(1), int(m.group(2))
+        if host in resources:
+            raise ValueError(f"duplicate host {host} in hostfile")
+        resources[host] = slots
+    return resources
+
+
+def fetch_hostfile(path: Optional[str]) -> "OrderedDict[str, int]":
+    """Ref: fetch_hostfile (runner.py:230). Missing file → single-node."""
+    if not path or not os.path.isfile(path):
+        return OrderedDict()
+    with open(path) as f:
+        return parse_hostfile(f)
+
+
+def _parse_device_filter(spec: str) -> Dict[str, Optional[List[int]]]:
+    """Parse 'host1:0,1@host2' style include/exclude strings.
+
+    Returns {host: [slot ids] or None (= all slots)}.
+    Ref: parse_resource_filter (runner.py:310).
+    """
+    out: Dict[str, Optional[List[int]]] = OrderedDict()
+    for part in spec.split("@"):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            host, ids = part.split(":", 1)
+            out[host] = [int(i) for i in ids.split(",") if i != ""]
+        else:
+            out[part] = None
+    return out
+
+
+def parse_resource_filter(resources: "OrderedDict[str, int]",
+                          include: str = "",
+                          exclude: str = "") -> "OrderedDict[str, List[int]]":
+    """Apply --include/--exclude to {host: slots} → {host: [slot ids]}.
+
+    Ref: parse_resource_filter (runner.py:310): include and exclude are
+    mutually exclusive; 'host:ids' limits to specific slots.
+    """
+    if include and exclude:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    full = OrderedDict((h, list(range(n))) for h, n in resources.items())
+    if include:
+        filt = _parse_device_filter(include)
+        out = OrderedDict()
+        for host, ids in filt.items():
+            if host not in full:
+                raise ValueError(f"include host {host} not in hostfile")
+            use = full[host] if ids is None else ids
+            bad = set(use) - set(full[host])
+            if bad:
+                raise ValueError(f"include slots {sorted(bad)} out of range for {host}")
+            out[host] = sorted(use)
+        return out
+    if exclude:
+        filt = _parse_device_filter(exclude)
+        out = OrderedDict()
+        for host, ids in full.items():
+            if host in filt:
+                if filt[host] is None:
+                    continue
+                keep = sorted(set(ids) - set(filt[host]))
+                if keep:
+                    out[host] = keep
+            else:
+                out[host] = ids
+        return out
+    return full
+
+
+def encode_world_info(active: "OrderedDict[str, List[int]]") -> str:
+    """base64(JSON {host: [slot ids]}). Ref: encode_world_info (runner.py:401)."""
+    return base64.urlsafe_b64encode(json.dumps(active).encode()).decode()
+
+
+def decode_world_info(blob: str) -> "OrderedDict[str, List[int]]":
+    return OrderedDict(json.loads(base64.urlsafe_b64decode(blob.encode()).decode()))
+
+
+# ----------------------------------------------------------------------
+# Multinode runners (ref launcher/multinode_runner.py:19-393)
+# ----------------------------------------------------------------------
+class MultiNodeRunner:
+    name = "base"
+
+    def __init__(self, args, world_info_b64: str):
+        self.args = args
+        self.world_info_b64 = world_info_b64
+        self.user_cmd = [args.user_script] + list(args.user_args)
+
+    def backend_exists(self) -> bool:  # pragma: no cover - env dependent
+        return False
+
+    def get_cmd(self, environment: Dict[str, str],
+                active: "OrderedDict[str, List[int]]") -> List[str]:
+        raise NotImplementedError
+
+    @property
+    def exports(self) -> Dict[str, str]:
+        ex = {}
+        for kv in self.args.export or []:
+            k, _, v = kv.partition("=")
+            ex[k] = v
+        return ex
+
+
+class PDSHRunner(MultiNodeRunner):
+    """Ref: PDSHRunner (multinode_runner.py:19) — pdsh fan-out over ssh."""
+    name = "pdsh"
+
+    def backend_exists(self) -> bool:
+        return _which("pdsh")
+
+    def get_cmd(self, environment, active):
+        environment = dict(environment)
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        hosts = ",".join(active.keys())
+        exports = "".join(f"export {k}={shlex.quote(str(v))}; "
+                          for k, v in {**environment, **self.exports}.items())
+        node_cmd = (f"{exports}cd {shlex.quote(os.getcwd())}; "
+                    f"{sys.executable} -m deepspeed_tpu.launcher.launch "
+                    f"--world_info={self.world_info_b64} "
+                    f"--node_rank=%n "
+                    f"--coordinator_addr={self.args.master_addr} "
+                    f"--coordinator_port={self.args.master_port} "
+                    + " ".join(map(shlex.quote, self.user_cmd)))
+        return ["pdsh", "-S", "-f", "1024", "-w", hosts, node_cmd]
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    """Ref: OpenMPIRunner (multinode_runner.py:142) — one rank per slot."""
+    name = "openmpi"
+
+    def backend_exists(self) -> bool:
+        return _which("mpirun")
+
+    def get_cmd(self, environment, active):
+        # mpirun fills slots from the hostfile itself, so slot-level
+        # include/exclude cannot be honored (ref multinode_runner.py:159
+        # raises the same way).
+        if self.args.include or self.args.exclude:
+            raise ValueError("--include/--exclude are not supported with the "
+                             "openmpi launcher; use pdsh or edit the hostfile")
+        total = sum(len(v) for v in active.values())
+        hostfile_args = ["--hostfile", self.args.hostfile] if self.args.hostfile else []
+        exports = []
+        for k, v in {**environment, **self.exports}.items():
+            exports += ["-x", f"{k}={v}"]
+        return (["mpirun", "-n", str(total), "--allow-run-as-root",
+                 "--tag-output"] + hostfile_args + exports +
+                [sys.executable, "-u"] + self.user_cmd)
+
+
+class SlurmRunner(MultiNodeRunner):
+    """Ref: SlurmRunner (multinode_runner.py:304) — srun launch."""
+    name = "slurm"
+
+    def backend_exists(self) -> bool:
+        return _which("srun")
+
+    def get_cmd(self, environment, active):
+        total = sum(len(v) for v in active.values())
+        srun = ["srun", "-n", str(total), "-w", ",".join(active.keys())]
+        if getattr(self.args, "comment", ""):
+            srun += ["--comment", self.args.comment]
+        exports = ",".join(f"{k}={v}" for k, v in {**environment, **self.exports}.items())
+        if exports:
+            srun += [f"--export=ALL,{exports}"]
+        return srun + [sys.executable, "-u"] + self.user_cmd
+
+
+RUNNERS = {r.name: r for r in (PDSHRunner, OpenMPIRunner, SlurmRunner)}
+
+
+def _which(prog: str) -> bool:
+    from shutil import which
+    return which(prog) is not None
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dstpu",
+                                description="deepspeed_tpu multi-host launcher")
+    p.add_argument("-H", "--hostfile", type=str, default="/job/hostfile",
+                   help="'host slots=N' file; absent → single node")
+    p.add_argument("-i", "--include", type=str, default="",
+                   help="host[:slot,...] list to include, @-separated")
+    p.add_argument("-e", "--exclude", type=str, default="",
+                   help="host[:slot,...] list to exclude, @-separated")
+    p.add_argument("--num_nodes", type=int, default=-1)
+    p.add_argument("--num_procs", type=int, default=-1,
+                   help="processes per node (default: slots, or 1)")
+    p.add_argument("--master_addr", type=str, default="")
+    p.add_argument("--master_port", type=int, default=DEFAULT_COORD_PORT)
+    p.add_argument("--launcher", type=str, default="pdsh",
+                   choices=sorted(RUNNERS))
+    p.add_argument("--export", action="append", default=[],
+                   metavar="KEY=VAL", help="extra env to export to all ranks")
+    p.add_argument("--dry_run", action="store_true",
+                   help="print the command instead of executing")
+    p.add_argument("--comment", type=str, default="", help="slurm comment")
+    p.add_argument("user_script", type=str)
+    p.add_argument("user_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Ref: launcher/runner.py:436 main."""
+    args = build_parser().parse_args(argv)
+    resources = fetch_hostfile(args.hostfile)
+
+    if not resources:
+        # Single node: exec the per-node launcher directly.
+        if args.include or args.exclude:
+            raise ValueError("--include/--exclude require a hostfile")
+        nprocs = args.num_procs if args.num_procs > 0 else 1
+        cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+               "--nproc", str(nprocs),
+               "--coordinator_addr", args.master_addr or "127.0.0.1",
+               "--coordinator_port", str(args.master_port),
+               args.user_script] + args.user_args
+        env = dict(os.environ)
+        for kv in args.export or []:
+            k, _, v = kv.partition("=")
+            env[k] = v
+        if args.dry_run:
+            print(shlex.join(cmd))
+            return 0
+        return subprocess.call(cmd, env=env)
+
+    active = parse_resource_filter(resources, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = OrderedDict(list(active.items())[:args.num_nodes])
+    if args.num_procs > 0:
+        active = OrderedDict((h, list(range(args.num_procs))) for h in active)
+    if not active:
+        raise ValueError("no hosts left after filtering")
+
+    master_addr = args.master_addr or next(iter(active))
+    args.master_addr = master_addr
+    world_info = encode_world_info(active)
+    env = {
+        "DSTPU_COORDINATOR": f"{master_addr}:{args.master_port}",
+        "DSTPU_NUM_PROCS": str(sum(len(v) for v in active.values())),
+        "MASTER_ADDR": master_addr,
+        "MASTER_PORT": str(args.master_port),
+    }
+    runner = RUNNERS[args.launcher](args, world_info)
+    cmd = runner.get_cmd(env, active)
+    if args.dry_run:
+        print(shlex.join(cmd))
+        return 0
+    if not runner.backend_exists():  # pragma: no cover - env dependent
+        raise RuntimeError(f"launcher backend '{args.launcher}' not found in PATH")
+    logger.info(f"launching: {shlex.join(cmd)}")
+    return subprocess.call(cmd, env={**os.environ, **env})
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

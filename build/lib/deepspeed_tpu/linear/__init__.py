@@ -1,0 +1,12 @@
+"""Optimized linear: quantized base weights + LoRA adapters.
+
+Analog of ``deepspeed/linear/``."""
+
+from deepspeed_tpu.linear.config import LoRAConfig, QuantizationConfig
+from deepspeed_tpu.linear.quantization import QuantizedParameter
+from deepspeed_tpu.linear.optimized_linear import (OptimizedLinear,
+                                                   init_lora_params,
+                                                   lora_linear)
+
+__all__ = ["LoRAConfig", "QuantizationConfig", "QuantizedParameter",
+           "OptimizedLinear", "init_lora_params", "lora_linear"]

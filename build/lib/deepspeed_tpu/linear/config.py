@@ -1,0 +1,26 @@
+"""LoRA / quantization configs (ref deepspeed/linear/config.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class LoRAConfig:
+    """Ref LoRAConfig: rank/alpha plus base-weight sharding — on TPU the
+    frozen base weight shards over the "tensor" mesh axis instead of the
+    reference's manual 1/world slicing."""
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1
+    offload: bool = False
+    offload_ratio: float = 0.0
+    delay_lora_init: bool = False
+
+
+@dataclass
+class QuantizationConfig:
+    """Ref QuantizationConfig: FP-quantized frozen base weights."""
+    q_bits: int = 8
+    mantissa_bits: int = 3
+    group_size: int = 512

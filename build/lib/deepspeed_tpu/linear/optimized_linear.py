@@ -1,0 +1,93 @@
+"""OptimizedLinear — quantized frozen base weight + trainable LoRA adapter.
+
+Analog of ``deepspeed/linear/optimized_linear.py`` (``OptimizedLinear``
+:18, ``LoRAOptimizedLinear`` :76).  The reference shards the frozen base
+weight 1/world and all-gathers it per forward; here ``base_weight_sharding``
+maps to sharding the dequantized base over the "tensor" mesh axis and
+letting XLA keep the matmul sharded (no gather materialisation).
+
+Functional API: params are a dict ``{"base": QuantizedParameter | array,
+"lora_A": [in, r], "lora_B": [r, out]}``; :func:`lora_linear` is the
+forward.  Only A/B receive gradients — the base is a
+``jax.lax.stop_gradient`` leaf, which is how "frozen" is spelled in a
+functional framework.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.linear.config import LoRAConfig, QuantizationConfig
+from deepspeed_tpu.linear.quantization import QuantizedParameter
+
+
+def init_lora_params(key, in_dim: int, out_dim: int,
+                     lora_config: Optional[LoRAConfig] = None,
+                     dtype=jnp.float32) -> Dict[str, Any]:
+    """A ~ kaiming-uniform, B = 0 (standard LoRA init; ref
+    LoRAOptimizedLinear.init_lora)."""
+    lc = lora_config or LoRAConfig()
+    bound = math.sqrt(6.0 / in_dim)
+    a = jax.random.uniform(key, (in_dim, lc.lora_r), dtype,
+                           minval=-bound, maxval=bound)
+    b = jnp.zeros((lc.lora_r, out_dim), dtype)
+    return {"lora_A": a, "lora_B": b}
+
+
+def lora_linear(x, base, lora_A=None, lora_B=None,
+                lora_alpha: float = 16.0, lora_r: Optional[int] = None,
+                bias=None):
+    """y = x @ W_base (frozen) + (alpha/r) * (x @ A) @ B."""
+    w = base.dequantized() if isinstance(base, QuantizedParameter) else base
+    w = jax.lax.stop_gradient(w)
+    y = x @ w.astype(x.dtype)
+    if lora_A is not None and lora_B is not None:
+        r = lora_r or lora_A.shape[-1]
+        scale = lora_alpha / r
+        y = y + scale * ((x @ lora_A.astype(x.dtype)) @ lora_B.astype(x.dtype))
+    if bias is not None:
+        y = y + bias.astype(x.dtype)
+    return y
+
+
+class OptimizedLinear:
+    """Factory/stateful wrapper (ref OptimizedLinear.__new__ dispatch):
+    quantizes the base when a QuantizationConfig is given, attaches LoRA
+    when a LoRAConfig is given."""
+
+    def __init__(self, weight, lora_config: Optional[LoRAConfig] = None,
+                 quantization_config: Optional[QuantizationConfig] = None,
+                 bias=None, key=None):
+        self.lora_config = lora_config
+        self.bias = bias
+        if quantization_config is not None:
+            self.base = QuantizedParameter(
+                weight, q_bits=quantization_config.q_bits,
+                group_size=quantization_config.group_size)
+        else:
+            self.base = weight
+        self.lora_A = self.lora_B = None
+        if lora_config is not None and not lora_config.delay_lora_init:
+            if key is None:
+                key = jax.random.PRNGKey(0)
+            p = init_lora_params(key, weight.shape[-2], weight.shape[-1],
+                                 lora_config, dtype=weight.dtype)
+            self.lora_A, self.lora_B = p["lora_A"], p["lora_B"]
+
+    def trainable_params(self) -> Dict[str, Any]:
+        out = {}
+        if self.lora_A is not None:
+            out = {"lora_A": self.lora_A, "lora_B": self.lora_B}
+        return out
+
+    def __call__(self, x, lora_A=None, lora_B=None):
+        lc = self.lora_config or LoRAConfig()
+        return lora_linear(x, self.base,
+                           lora_A if lora_A is not None else self.lora_A,
+                           lora_B if lora_B is not None else self.lora_B,
+                           lora_alpha=lc.lora_alpha, lora_r=lc.lora_r,
+                           bias=self.bias)

@@ -1,0 +1,51 @@
+"""QuantizedParameter — int-quantized storage with on-the-fly dequant.
+
+Analog of ``deepspeed/linear/quantization.py`` (``QuantizedParameter``
+:18): a frozen weight stored as int8 (or packed int4) + per-group scales,
+dequantized inside the jitted forward so the matmul reads bf16 while HBM
+holds the compressed bytes.  Built on the blockwise quantizer kernels in
+``deepspeed_tpu.ops.quantizer`` (the TPU analog of csrc/quantization).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.quantizer import (dequantize_blockwise, pack_int4,
+                                         quantize_blockwise, unpack_int4)
+
+
+class QuantizedParameter:
+    """Quantize once at construction; ``dequantized()`` inside jit.
+
+    q_bits 8 → int8 storage; 4 → two nibbles per byte. Grouping is along
+    the last dim (``group_size`` clipped to it).
+    """
+
+    def __init__(self, weight, q_bits: int = 8, group_size: int = 512):
+        if q_bits not in (4, 8):
+            raise ValueError(f"q_bits must be 4 or 8, got {q_bits}")
+        self.shape = tuple(weight.shape)
+        self.dtype = weight.dtype
+        self.q_bits = q_bits
+        n = self.shape[-1]
+        group_size = min(group_size, n)
+        while n % group_size != 0:  # shrink to a divisor of the last dim
+            group_size -= 1
+        self.group_size = group_size
+        q, scale, zero = quantize_blockwise(weight, num_bits=q_bits,
+                                            group_size=group_size)
+        self.scale = scale
+        self.zero = zero
+        self.data = pack_int4(q) if q_bits == 4 else q
+
+    def dequantized(self) -> jnp.ndarray:
+        q = unpack_int4(self.data) if self.q_bits == 4 else self.data
+        w = dequantize_blockwise(q, self.scale, self.zero,
+                                 num_bits=self.q_bits)
+        return w.astype(self.dtype)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.data.size * self.data.dtype.itemsize
+                   + self.scale.size * self.scale.dtype.itemsize)

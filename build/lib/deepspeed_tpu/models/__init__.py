@@ -1,0 +1,3 @@
+from deepspeed_tpu.models.registry import get_model_config, list_models, register
+from deepspeed_tpu.models.transformer import (TransformerConfig, count_params, forward,
+                                              init_params, loss_fn)

@@ -1,0 +1,143 @@
+"""Model presets (flagship + test-scale configs)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.transformer import TransformerConfig
+
+_REGISTRY = {}
+
+
+def register(name: str, cfg: TransformerConfig) -> TransformerConfig:
+    _REGISTRY[name] = cfg
+    return cfg
+
+
+def get_model_config(name: str, **overrides) -> TransformerConfig:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model '{name}'; known: {sorted(_REGISTRY)}")
+    cfg = _REGISTRY[name]
+    return cfg.replace(**overrides) if overrides else cfg
+
+
+def list_models():
+    return sorted(_REGISTRY)
+
+
+# -- GPT-2 family ------------------------------------------------------
+register("gpt2-125m", TransformerConfig(
+    vocab_size=50304,  # padded to 128 multiple for MXU tiling
+    hidden_size=768, intermediate_size=3072, num_layers=12, num_heads=12,
+    max_seq_len=1024, arch="gpt2", norm="layernorm", activation="gelu"))
+
+register("gpt2-350m", TransformerConfig(
+    vocab_size=50304, hidden_size=1024, intermediate_size=4096, num_layers=24,
+    num_heads=16, max_seq_len=1024, arch="gpt2"))
+
+register("gpt2-1.3b", TransformerConfig(
+    vocab_size=50304, hidden_size=2048, intermediate_size=8192, num_layers=24,
+    num_heads=32, max_seq_len=2048, arch="gpt2"))
+
+# -- Llama family ------------------------------------------------------
+_llama = dict(arch="llama", norm="rmsnorm", activation="swiglu", use_rope=True,
+              tie_embeddings=False, rope_theta=500000.0)
+
+register("llama3-8b", TransformerConfig(
+    vocab_size=128256, hidden_size=4096, intermediate_size=14336, num_layers=32,
+    num_heads=32, num_kv_heads=8, max_seq_len=8192, **_llama))
+
+register("llama3-70b", TransformerConfig(
+    vocab_size=128256, hidden_size=8192, intermediate_size=28672, num_layers=80,
+    num_heads=64, num_kv_heads=8, max_seq_len=8192, **_llama))
+
+register("llama-tiny", TransformerConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=256, num_layers=2,
+    num_heads=4, num_kv_heads=2, max_seq_len=256, arch="llama", norm="rmsnorm",
+    activation="swiglu", use_rope=True, tie_embeddings=False, rope_theta=10000.0))
+
+# -- Mixtral-style MoE -------------------------------------------------
+register("mixtral-tiny", TransformerConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=256, num_layers=2,
+    num_heads=4, num_kv_heads=2, max_seq_len=256, arch="llama", norm="rmsnorm",
+    activation="swiglu", use_rope=True, tie_embeddings=False,
+    num_experts=4, top_k=2, moe_layer_freq=1))
+
+register("mixtral-8x7b", TransformerConfig(
+    vocab_size=32000, hidden_size=4096, intermediate_size=14336, num_layers=32,
+    num_heads=32, num_kv_heads=8, max_seq_len=8192, arch="llama", norm="rmsnorm",
+    activation="swiglu", use_rope=True, tie_embeddings=False, rope_theta=1e6,
+    num_experts=8, top_k=2, moe_layer_freq=1))
+
+# -- OPT family (ref inference/v2/model_implementations/opt) -----------
+_opt = dict(arch="opt", norm="layernorm", activation="relu",
+            learned_positions=True, use_bias=True, tie_embeddings=True)
+
+register("opt-125m", TransformerConfig(
+    vocab_size=50272, hidden_size=768, intermediate_size=3072, num_layers=12,
+    num_heads=12, max_seq_len=2048, **_opt))
+
+register("opt-1.3b", TransformerConfig(
+    vocab_size=50272, hidden_size=2048, intermediate_size=8192, num_layers=24,
+    num_heads=32, max_seq_len=2048, **_opt))
+
+# -- Mistral (ref v2 mistral: llama + sliding window) ------------------
+register("mistral-7b", TransformerConfig(
+    vocab_size=32000, hidden_size=4096, intermediate_size=14336, num_layers=32,
+    num_heads=32, num_kv_heads=8, max_seq_len=8192, arch="mistral",
+    norm="rmsnorm", activation="swiglu", use_rope=True, tie_embeddings=False,
+    rope_theta=10000.0, sliding_window=4096))
+
+# -- Qwen2 (ref v2 qwen_v2: llama + qkv bias) --------------------------
+register("qwen2-7b", TransformerConfig(
+    vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+    num_layers=28, num_heads=28, num_kv_heads=4, max_seq_len=8192,
+    arch="qwen2", norm="rmsnorm", activation="swiglu", use_rope=True,
+    tie_embeddings=False, rope_theta=1e6, qkv_bias=True))
+
+# -- Falcon (ref v2 falcon: multi-query + parallel block) --------------
+register("falcon-7b", TransformerConfig(
+    vocab_size=65024, hidden_size=4544, intermediate_size=18176,
+    num_layers=32, num_heads=71, num_kv_heads=1, max_seq_len=2048,
+    arch="falcon", norm="layernorm", activation="gelu", use_rope=True,
+    tie_embeddings=True, parallel_block=True, use_bias=False))
+
+# -- Phi (ref v2 phi: parallel block + partial rotary + biases) --------
+register("phi-2", TransformerConfig(
+    vocab_size=51200, hidden_size=2560, intermediate_size=10240,
+    num_layers=32, num_heads=32, max_seq_len=2048, arch="phi",
+    norm="layernorm", activation="gelu", use_rope=True, rotary_pct=0.4,
+    tie_embeddings=False, parallel_block=True, use_bias=True))
+
+# -- test-scale --------------------------------------------------------
+register("gpt2-tiny", TransformerConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=512, num_layers=2,
+    num_heads=4, max_seq_len=256, arch="gpt2"))
+
+register("opt-tiny", TransformerConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=512, num_layers=2,
+    num_heads=4, max_seq_len=256, **_opt))
+
+register("mistral-tiny", TransformerConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=256, num_layers=2,
+    num_heads=4, num_kv_heads=2, max_seq_len=256, arch="mistral",
+    norm="rmsnorm", activation="swiglu", use_rope=True, tie_embeddings=False,
+    sliding_window=32))
+
+register("qwen2-tiny", TransformerConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=256, num_layers=2,
+    num_heads=4, num_kv_heads=2, max_seq_len=256, arch="qwen2",
+    norm="rmsnorm", activation="swiglu", use_rope=True, tie_embeddings=False,
+    qkv_bias=True))
+
+register("falcon-tiny", TransformerConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=512, num_layers=2,
+    num_heads=8, num_kv_heads=1, max_seq_len=256, arch="falcon",
+    norm="layernorm", activation="gelu", use_rope=True, tie_embeddings=True,
+    parallel_block=True, use_bias=False))
+
+register("phi-tiny", TransformerConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=512, num_layers=2,
+    num_heads=4, max_seq_len=256, arch="phi", norm="layernorm",
+    activation="gelu", use_rope=True, rotary_pct=0.5, tie_embeddings=False,
+    parallel_block=True, use_bias=True))

@@ -1,0 +1,851 @@
+"""Functional transformer model family (GPT-2 and Llama class).
+
+TPU-first design notes (vs the reference's per-module eager torch models):
+
+* Parameters are a plain pytree (nested dicts of jnp arrays); the per-layer
+  params are **stacked along a leading layer axis** and the forward is a
+  ``lax.scan`` over layers — one compiled layer body regardless of depth,
+  which is the idiomatic XLA replacement for DeepSpeed's per-module hook
+  machinery (SURVEY §7 hard part (a)).
+* Activation checkpointing is ``jax.checkpoint`` with a configurable policy
+  (ref: runtime/activation_checkpointing/checkpointing.py:948 — here the
+  compiler does the re-materialisation).
+* Compute runs in ``config.dtype`` (bf16 by default), master params stay in
+  ``param_dtype`` (fp32) — the engine's mixed-precision contract.
+* Param paths are stable strings (e.g. ``layers/attn/wq``) so parallelism
+  sharding rules can be expressed as path-pattern → PartitionSpec maps
+  (AutoTP-equivalent, ref module_inject/auto_tp.py:193).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Dict[str, Any]
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    """Architecture hyperparameters covering GPT-2 and Llama families."""
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None  # < num_heads → GQA (Llama-3)
+    head_dim: Optional[int] = None
+    max_seq_len: int = 1024
+    # architecture switches
+    arch: str = "gpt2"  # "gpt2" | "llama" | "opt" | "mistral" | "qwen2" | "falcon" | "phi"
+    norm: str = "layernorm"  # "layernorm" | "rmsnorm"
+    activation: str = "gelu"  # "gelu" | "swiglu" | "relu"
+    use_rope: bool = False
+    rope_theta: float = 10000.0
+    rotary_pct: float = 1.0  # Phi-style partial rotary (fraction of head dim)
+    tie_embeddings: bool = True
+    # family features (ref inference/v2/model_implementations/{opt,phi,qwen,
+    # falcon,mistral}): learned absolute positions, projection biases,
+    # sliding-window attention, parallel attn+MLP residual blocks
+    learned_positions: Optional[bool] = None  # None → arch == "gpt2"/"opt"
+    use_bias: Optional[bool] = None  # all proj biases; None → gpt2/opt
+    qkv_bias: bool = False  # qkv-only bias (Qwen2)
+    sliding_window: Optional[int] = None  # Mistral
+    parallel_block: bool = False  # Falcon/Phi: x + attn(n) + mlp(n)
+    # Falcon new_decoder_architecture (40B/180B, num_ln_in_parallel_attn=2):
+    # the parallel block gets separate input norms — attn uses ln1 (HF
+    # ln_attn) and the MLP uses ln2 (HF ln_mlp) on the same residual input.
+    parallel_norms: bool = False
+    # MoE (0 ⇒ dense; ref deepspeed/moe)
+    num_experts: int = 0
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    # "auto" | "einsum" | "sorted": [T,E,C] one-hot einsum dispatch vs
+    # argsort-by-expert gather dispatch (auto switches on one-hot size)
+    moe_dispatch: str = "auto"
+    # "1f1b" (training loss runs the interleaved schedule with O(pp) live
+    # microbatches, ref runtime/pipe/schedule.py:189) | "gpipe" (fill-drain
+    # forward scan differentiated by AD)
+    pipeline_schedule: str = "1f1b"
+    # ZeRO-Infinity: stacked layer params live in pinned host memory and
+    # stream one layer at a time through the scan, fwd and bwd
+    # (runtime/infinity.py; set by the engine from offload_param config)
+    param_stream: bool = False
+    moe_layer_freq: int = 2  # every Nth layer is MoE, matching ref PR-MoE style
+    # pipeline parallelism: microbatches per forward call, i.e. per
+    # gradient-accumulation micro-step (0 → pp size); must divide the
+    # per-call batch dim
+    pipeline_microbatches: int = 0
+    # random-LTD (ref data_routing/basic_layer.py): a band of middle layers
+    # [ltd_start, ltd_end) runs on ltd_kept random tokens; 0 = disabled.
+    # ltd_kept is static per compile — the engine re-jits when the
+    # schedule raises it (same recompile cadence as the reference's
+    # shape changes).
+    ltd_kept: int = 0
+    ltd_start: int = 1
+    ltd_end: Optional[int] = None
+    # sequence-tiled logits+loss (ALST, sequence/alst.py): never
+    # materialises [B, S, V]; 0 = full logits
+    loss_tiles: int = 0
+    # layer-scan unroll factor (XLA overlaps across unrolled iterations)
+    scan_unroll: int = 1
+    # numerics
+    dtype: Any = jnp.bfloat16  # compute dtype
+    param_dtype: Any = jnp.float32  # master dtype
+    layernorm_eps: float = 1e-5
+    # remat policy name: none|full|nothing_saveable|dots_saveable|dots_with_no_batch_dims_saveable
+    remat_policy: str = "nothing_saveable"
+    attn_impl: str = "auto"  # "auto" | "xla" | "pallas_flash" | "sparse"
+    # block-sparse attention config (ref ops/sparse_attention sparsity
+    # configs): {"mode": "fixed"|"bigbird"|"bslongformer"|"variable",
+    # "block": 16, ...mode kwargs}; selected when attn_impl == "sparse"
+    sparse_attention: Optional[Any] = None
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def dim_per_head(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    @property
+    def has_learned_positions(self) -> bool:
+        if self.learned_positions is not None:
+            return self.learned_positions
+        return self.arch in ("gpt2", "opt")
+
+    @property
+    def has_bias(self) -> bool:
+        if self.use_bias is not None:
+            return self.use_bias
+        return self.arch in ("gpt2", "opt", "phi")
+
+    def replace(self, **kw) -> "TransformerConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# ----------------------------------------------------------------------
+# Init
+# ----------------------------------------------------------------------
+def _dense_init(key, shape, scale, dtype):
+    return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+
+def init_layer_params(cfg: TransformerConfig, key) -> Params:
+    """One transformer block's params (unstacked)."""
+    h, ffn = cfg.hidden_size, cfg.intermediate_size
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
+    keys = jax.random.split(key, 8)
+    scale = 1.0 / math.sqrt(h)
+    out_scale = scale / math.sqrt(2 * cfg.num_layers)  # GPT-2 style residual scaling
+    pd = cfg.param_dtype
+
+    attn = {
+        "wq": _dense_init(keys[0], (h, nh * hd), scale, pd),
+        "wk": _dense_init(keys[1], (h, nkv * hd), scale, pd),
+        "wv": _dense_init(keys[2], (h, nkv * hd), scale, pd),
+        "wo": _dense_init(keys[3], (nh * hd, h), out_scale, pd),
+    }
+    if cfg.has_bias or cfg.qkv_bias:
+        attn["bq"] = jnp.zeros((nh * hd,), pd)
+        attn["bk"] = jnp.zeros((nkv * hd,), pd)
+        attn["bv"] = jnp.zeros((nkv * hd,), pd)
+    if cfg.has_bias:
+        attn["bo"] = jnp.zeros((h,), pd)
+
+    def mlp_params(k1, k2, k3):
+        if cfg.activation == "swiglu":
+            return {
+                "wi": _dense_init(k1, (h, ffn), scale, pd),
+                "wg": _dense_init(k2, (h, ffn), scale, pd),
+                "wo": _dense_init(k3, (ffn, h), out_scale, pd),
+            }
+        mlp = {
+            "wi": _dense_init(k1, (h, ffn), scale, pd),
+            "wo": _dense_init(k3, (ffn, h), out_scale, pd),
+        }
+        if cfg.has_bias:
+            mlp["bi"] = jnp.zeros((ffn,), pd)
+            mlp["bo"] = jnp.zeros((h,), pd)
+        return mlp
+
+    block: Params = {"attn": attn, "mlp": mlp_params(keys[4], keys[5], keys[6])}
+
+    if cfg.is_moe:
+        # Expert weights stacked on a leading expert axis (sharded over the
+        # "expert" mesh axis); router is replicated. Ref: moe/experts.py +
+        # sharded_moe.py TopKGate.
+        ek = jax.random.split(keys[7], 4)
+        e = cfg.num_experts
+        block["moe"] = {
+            "router": _dense_init(ek[0], (h, e), scale, pd),
+            "wi": _dense_init(ek[1], (e, h, ffn), scale, pd),
+            "wg": _dense_init(ek[2], (e, h, ffn), scale, pd) if cfg.activation == "swiglu" else None,
+            "wo": _dense_init(ek[3], (e, ffn, h), out_scale, pd),
+        }
+        block["moe"] = {k: v for k, v in block["moe"].items() if v is not None}
+
+    def norm_params():
+        p = {"scale": jnp.ones((h,), pd)}
+        if cfg.norm == "layernorm":
+            p["bias"] = jnp.zeros((h,), pd)
+        return p
+
+    block["ln1"] = norm_params()
+    block["ln2"] = norm_params()
+    return block
+
+
+def init_params(cfg: TransformerConfig, key) -> Params:
+    """Full model params with per-layer params stacked on axis 0."""
+    keys = jax.random.split(key, cfg.num_layers + 3)
+    scale = 1.0 / math.sqrt(cfg.hidden_size)
+    pd = cfg.param_dtype
+
+    layer_list = [init_layer_params(cfg, keys[i]) for i in range(cfg.num_layers)]
+    layers = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *layer_list)
+
+    params: Params = {
+        "embed": {"tokens": _dense_init(keys[-3], (cfg.vocab_size, cfg.hidden_size), scale, pd)},
+        "layers": layers,
+        "final_norm": {"scale": jnp.ones((cfg.hidden_size,), pd)},
+    }
+    if cfg.norm == "layernorm":
+        params["final_norm"]["bias"] = jnp.zeros((cfg.hidden_size,), pd)
+    if cfg.has_learned_positions:
+        params["embed"]["positions"] = _dense_init(
+            keys[-2], (cfg.max_seq_len, cfg.hidden_size), scale, pd)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _dense_init(keys[-1], (cfg.hidden_size, cfg.vocab_size), scale, pd)
+    return params
+
+
+def count_params(params: Params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+# ----------------------------------------------------------------------
+# Forward pieces
+# ----------------------------------------------------------------------
+def _norm(x, p, cfg: TransformerConfig):
+    dt = x.dtype
+    x32 = x.astype(jnp.float32)
+    if cfg.norm == "rmsnorm":
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        out = x32 * lax.rsqrt(var + cfg.layernorm_eps) * p["scale"].astype(jnp.float32)
+    else:
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        out = (x32 - mean) * lax.rsqrt(var + cfg.layernorm_eps)
+        out = out * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return out.astype(dt)
+
+
+def _rope(q, k, positions, cfg: TransformerConfig):
+    """Rotary embeddings (Llama). q,k: [B, S, H, D].  ``rotary_pct`` < 1
+    rotates only the leading fraction of the head dim (Phi partial rotary,
+    ref inference/v2 phi containers)."""
+    d = cfg.dim_per_head
+    rot_d = d if cfg.rotary_pct >= 1.0 else max(2, int(d * cfg.rotary_pct) // 2 * 2)
+    freqs = 1.0 / (cfg.rope_theta ** (jnp.arange(0, rot_d, 2, dtype=jnp.float32) / rot_d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, rot_d/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    def rot(x):
+        xf = x.astype(jnp.float32)
+        xr, x_pass = xf[..., :rot_d], xf[..., rot_d:]
+        x1, x2 = jnp.split(xr, 2, axis=-1)
+        xr = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        return jnp.concatenate([xr, x_pass], axis=-1)
+
+    return rot(q).astype(q.dtype), rot(k).astype(k.dtype)
+
+
+def _attention_scores(q, k, v, cfg: TransformerConfig, segment_pos=None):
+    """Causal MHA/GQA over [B, S, H, D] via XLA einsums (MXU-friendly).
+    Pallas flash attention is selected by the engine when attn_impl allows."""
+    b, s, nh, d = q.shape
+    nkv = k.shape[2]
+    if nkv != nh:  # GQA: repeat kv heads
+        rep = nh // nkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    if cfg.sliding_window:
+        # Mistral sliding-window: key within the last `window` positions
+        qpos = lax.broadcasted_iota(jnp.int32, (s, s), 0)
+        kpos = lax.broadcasted_iota(jnp.int32, (s, s), 1)
+        mask = mask & (qpos - kpos < cfg.sliding_window)
+    scores = jnp.where(mask[None, None, :, :], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _sparse_attn(q, k, v, cfg: TransformerConfig):
+    """Block-sparse attention path (ref ops/sparse_attention configs);
+    causal composes with the layout."""
+    from deepspeed_tpu.ops.sparse_attention import (BigBirdSparsityConfig,
+                                                    BSLongformerSparsityConfig,
+                                                    DenseSparsityConfig,
+                                                    FixedSparsityConfig,
+                                                    VariableSparsityConfig,
+                                                    sparse_attention)
+
+    sc = dict(cfg.sparse_attention or {})
+    mode = sc.pop("mode", "fixed")
+    cls = {"fixed": FixedSparsityConfig, "bigbird": BigBirdSparsityConfig,
+           "bslongformer": BSLongformerSparsityConfig,
+           "variable": VariableSparsityConfig,
+           "dense": DenseSparsityConfig}[mode]
+    sparsity = cls(num_heads=q.shape[2], **sc)
+    return sparse_attention(q, k, v, sparsity, causal=True)
+
+
+def _attn_block(x, p, positions, cfg: TransformerConfig):
+    b, s, h = x.shape
+    nh, nkv, d = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
+    dt = x.dtype
+
+    def proj(w, b_, out_dim):
+        y = x @ w.astype(dt)
+        if b_ is not None:
+            y = y + b_.astype(dt)
+        return y
+
+    q = proj(p["wq"], p.get("bq"), nh * d).reshape(b, s, nh, d)
+    k = proj(p["wk"], p.get("bk"), nkv * d).reshape(b, s, nkv, d)
+    v = proj(p["wv"], p.get("bv"), nkv * d).reshape(b, s, nkv, d)
+    if cfg.use_rope:
+        q, k = _rope(q, k, positions, cfg)
+
+    # Ulysses SP: re-shard seq-sharded q/k/v to head-sharded (XLA lowers the
+    # layout switch to all-to-all over ICI; ref sequence/layer.py:331).
+    from deepspeed_tpu.sequence.layer import (ulysses_output_constraint,
+                                              ulysses_qkv_constraint)
+
+    q, k, v = ulysses_qkv_constraint(q, k, v)
+
+    if cfg.attn_impl == "sparse":
+        out = _sparse_attn(q, k, v, cfg)
+    elif cfg.attn_impl in ("pallas_flash", "auto") and not cfg.sliding_window:
+        # flash_attention dispatches: Pallas kernel on TPU (tiled online
+        # softmax, no [S,S] materialisation), equivalent XLA math elsewhere.
+        from deepspeed_tpu.ops.flash_attention import flash_attention
+
+        out = flash_attention(q, k, v, causal=True)
+    else:
+        out = _attention_scores(q, k, v, cfg)
+    out = ulysses_output_constraint(out.reshape(b, s, nh * d))
+    out = out @ p["wo"].astype(dt)
+    if p.get("bo") is not None:
+        out = out + p["bo"].astype(dt)
+    return out
+
+
+def _mlp_block(x, p, cfg: TransformerConfig):
+    dt = x.dtype
+    if cfg.activation == "swiglu":
+        gate = jax.nn.silu(x @ p["wg"].astype(dt))
+        up = x @ p["wi"].astype(dt)
+        return (gate * up) @ p["wo"].astype(dt)
+    y = x @ p["wi"].astype(dt)
+    if p.get("bi") is not None:
+        y = y + p["bi"].astype(dt)
+    y = jax.nn.relu(y) if cfg.activation == "relu" \
+        else jax.nn.gelu(y, approximate=True)
+    y = y @ p["wo"].astype(dt)
+    if p.get("bo") is not None:
+        y = y + p["bo"].astype(dt)
+    return y
+
+
+def _moe_block(x, p, cfg: TransformerConfig, allow_ep: bool = True):
+    """MoE block used inside the scan.  With an expert mesh axis of size
+    > 1 the explicit shard_map + all_to_all expert-parallel path runs
+    (deepspeed_tpu/moe/sharded_moe.moe_forward_ep — the reference's
+    `_AllToAll` dispatch on ICI); otherwise the single-group path.
+
+    ``allow_ep=False`` is passed from ``lax.cond`` call sites: a shard_map
+    collective inside a cond branch crashes XLA's backward pass, so traced
+    MoE-vs-dense selection keeps the auto-partitioned formulation (the
+    grouped scan in :func:`forward` makes the selection static precisely
+    so the EP path applies on aligned configs)."""
+    from deepspeed_tpu.moe.sharded_moe import moe_forward, moe_forward_ep
+    from deepspeed_tpu.parallel.topology import get_topology
+
+    topo = get_topology()
+    if allow_ep and topo is not None and topo.ep_size > 1:
+        return moe_forward_ep(x, p, cfg, topo)
+    return moe_forward(x, p, cfg)
+
+
+def _select_ffn(h, layer_params, cfg: TransformerConfig, layer_is_moe):
+    """MoE-vs-dense FFN selection on normed input ``h`` → (y, aux).
+
+    A static ``layer_is_moe`` keeps the choice out of the compiled graph
+    (and lets the expert-parallel shard_map path apply); a traced one
+    lowers to ``lax.cond`` with the auto-partitioned MoE (a shard_map
+    collective under cond crashes XLA backward)."""
+    def dense_branch(h):
+        return _mlp_block(h, layer_params["mlp"], cfg), jnp.zeros((), jnp.float32)
+
+    if "moe" not in layer_params:
+        return dense_branch(h)
+    if isinstance(layer_is_moe, bool):
+        return (_moe_block(h, layer_params["moe"], cfg) if layer_is_moe
+                else dense_branch(h))
+
+    def moe_branch(h):
+        return _moe_block(h, layer_params["moe"], cfg, allow_ep=False)
+
+    return lax.cond(layer_is_moe, moe_branch, dense_branch, h)
+
+
+def transformer_layer(x, layer_params, positions, cfg: TransformerConfig,
+                      layer_is_moe=False):
+    """One pre-norm transformer block. Returns (x, moe_aux_loss).
+
+    ``layer_is_moe`` may be a traced bool (layer index inside a scan): the
+    MoE-vs-dense choice then lowers to ``lax.cond``, which is how the
+    reference's per-layer MoE placement (PR-MoE, moe_layer_freq) maps onto a
+    uniform scan-over-layers body.
+    """
+    if cfg.parallel_block:
+        # Falcon/Phi residual form: shared (or, with parallel_norms, per-
+        # branch) input norms feed attention and MLP in parallel (ref
+        # falcon/phi v2 containers).
+        n = _norm(x, layer_params["ln1"], cfg)
+        n_mlp = _norm(x, layer_params["ln2"], cfg) if cfg.parallel_norms else n
+        attn_out = _attn_block(n, layer_params["attn"], positions, cfg)
+        y, aux = _select_ffn(n_mlp, layer_params, cfg, layer_is_moe)
+        return x + attn_out + y, aux
+    x = x + _attn_block(_norm(x, layer_params["ln1"], cfg), layer_params["attn"], positions, cfg)
+    h = _norm(x, layer_params["ln2"], cfg)
+    y, aux = _select_ffn(h, layer_params, cfg, layer_is_moe)
+    return x + y, aux
+
+
+_REMAT_POLICIES = {
+    "none": None,
+    "full": None,
+    "nothing_saveable": "nothing_saveable",
+    "dots_saveable": "dots_saveable",
+    # dots + the repo flash kernel's named residuals (flash_out/flash_lse):
+    # the backward then never re-runs the attention forward kernel.
+    "dots_flash_saveable": "dots_flash_saveable",
+    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+    # CPU activation checkpointing (ref checkpointing.py:474): matmul
+    # outputs are saved to pinned host memory instead of rematerialised —
+    # trades PCIe/DMA bandwidth for recompute, like the reference's
+    # cpu_checkpointing flag.
+    "offload_dots": "offload_dot_with_no_batch_dims",
+}
+
+
+def _maybe_remat(fn, cfg: TransformerConfig):
+    if cfg.remat_policy in ("none",):
+        return fn
+    policy = None
+    name = _REMAT_POLICIES.get(cfg.remat_policy)
+    if name == "offload_dot_with_no_batch_dims":
+        # factory: activations saved to pinned host instead of recomputed
+        policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
+    elif name == "dots_flash_saveable":
+        policy = jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_saveable,
+            jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"))
+    elif name:
+        policy = getattr(jax.checkpoint_policies, name)
+    return jax.checkpoint(fn, policy=policy, prevent_cse=False)
+
+
+def make_pipeline_stage_fn(cfg: TransformerConfig, topo):
+    """Per-stage layer applier for the SPMD pipeline: scans this stage's
+    ``L/pp`` stacked layers, returns ``(h, aux)``.
+
+    MoE placement must be static inside the pipe shard_map (the stage
+    index is a traced ``axis_index``, so a global-layer-index predicate
+    would put the MoE collective under a traced cond — see
+    :func:`_select_ffn`): with ``layers_per_stage % moe_layer_freq == 0``
+    every stage has the same local pattern — groups of f layers whose last
+    member is MoE.  Ref: MoE+PP composition, utils/groups.py:384.
+    """
+    pp = topo.pp_size
+    if cfg.num_layers % pp:
+        raise ValueError(f"num_layers={cfg.num_layers} not divisible by "
+                         f"pipeline stages ({pp})")
+    lp_count = cfg.num_layers // pp
+    f = max(1, cfg.moe_layer_freq) if cfg.is_moe else 1
+    if cfg.is_moe and lp_count % f != 0:
+        raise NotImplementedError(
+            f"MoE + pipeline requires layers_per_stage ({lp_count}) "
+            f"divisible by moe_layer_freq ({f}) so expert placement is "
+            "static per stage")
+
+    def stage_fn(stage_params, h, pos_mb):
+        zero = jnp.zeros((), jnp.float32)
+        if f > 1:
+            steps = lp_count // f
+
+            def body(carry, glp):
+                h, aux_acc = carry
+                for j in range(f):
+                    lp = jax.tree.map(lambda p, j=j: p[j], glp)
+                    h, aux = transformer_layer(h, lp, pos_mb, cfg,
+                                               layer_is_moe=(j == f - 1))
+                    aux_acc = aux_acc + aux
+                return (h, aux_acc), None
+
+            body = _maybe_remat(body, cfg)
+            grouped = jax.tree.map(
+                lambda p: p.reshape((steps, f) + p.shape[1:]), stage_params)
+            (h, aux), _ = lax.scan(body, (h, zero), grouped)
+        else:
+            def body(carry, lp):
+                h, aux_acc = carry
+                h, aux = transformer_layer(h, lp, pos_mb, cfg,
+                                           layer_is_moe=cfg.is_moe)
+                return (h, aux_acc + aux), None
+
+            body = _maybe_remat(body, cfg)
+            (h, aux), _ = lax.scan(body, (h, zero), stage_params)
+        return h, aux
+
+    return stage_fn
+
+
+def forward(params: Params, input_ids, cfg: TransformerConfig,
+            positions=None, pld_theta=None,
+            return_hidden: bool = False) -> jnp.ndarray:
+    """Token ids [B, S] → logits [B, S, V]. lax.scan over stacked layers.
+    ``pld_theta``: progressive-layer-drop keep prob (traced scalar or None).
+    ``return_hidden``: final-norm hidden states instead of logits (tiled
+    loss path)."""
+    b, s = input_ids.shape
+    dt = cfg.dtype
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+
+    x = _embed(params, input_ids, positions, cfg)
+
+    moe_every = max(1, cfg.moe_layer_freq)
+
+    from deepspeed_tpu.parallel.topology import get_topology
+
+    topo = get_topology()
+    moe_aux = jnp.zeros((), jnp.float32)
+    if topo is not None and topo.pp_size > 1:
+        # Pipeline path: layers circulate microbatches over the "pipe" axis
+        # (ref runtime/pipe/engine.py TrainSchedule → spmd_pipeline here).
+        if pld_theta is not None:
+            raise NotImplementedError(
+                "progressive layer drop + pipeline parallelism not supported")
+        if 0 < cfg.ltd_kept < s:
+            raise NotImplementedError(
+                "random-LTD + pipeline parallelism not supported")
+        if cfg.param_stream:
+            raise NotImplementedError(
+                "param streaming + pipeline parallelism not supported "
+                "(the pipe axis already partitions layers pp-ways)")
+        from deepspeed_tpu.parallel.pipeline import spmd_pipeline
+
+        stage_fn = make_pipeline_stage_fn(cfg, topo)
+        n_micro = cfg.pipeline_microbatches or topo.pp_size
+        x, moe_aux = spmd_pipeline(stage_fn, params["layers"], x, topo=topo,
+                                   n_micro=n_micro, extras=positions)
+    else:
+        def scan_segment(x, pos, layers_slice, idx0, n_layers):
+            """Scan a contiguous slice of the stacked layers.
+
+            MoE placement is kept **static** so the expert-parallel
+            shard_map path applies: with moe_layer_freq f, the f-aligned
+            middle of the segment scans *groups* of f layers whose last
+            member is statically MoE (no lax.cond in the scan body — a
+            shard_map collective under a traced cond crashes XLA
+            backward), and the unaligned head/tail layers (e.g. where a
+            random-LTD band cuts through a group) run unrolled with their
+            static global indices.
+            """
+            f = moe_every if cfg.is_moe else 1
+            if n_layers == 0:
+                return x, jnp.zeros((), jnp.float32)
+
+            def apply_layer(h, aux_acc, lp, layer_idx, is_moe_layer):
+                h2, aux = transformer_layer(h, lp, pos, cfg,
+                                            layer_is_moe=is_moe_layer)
+                if pld_theta is not None:
+                    # progressive layer drop (ref progressive_layer_drop.py
+                    # + stochastic depth): deeper layers drop more; batch
+                    # content seeds the per-step coin so the step stays a
+                    # single compile.
+                    key = jax.random.fold_in(
+                        jax.random.PRNGKey(17),
+                        (jnp.sum(input_ids) % 100003).astype(jnp.int32)
+                        * 1000 + layer_idx)
+                    depth_frac = (layer_idx + 1) / cfg.num_layers
+                    p_keep = 1.0 - (1.0 - pld_theta) * depth_frac
+                    coin = jax.random.bernoulli(key, p_keep)
+                    h2 = jnp.where(coin, h2, h)
+                return h2, aux_acc + aux
+
+            aux0 = jnp.zeros((), jnp.float32)
+            head = min((-idx0) % f, n_layers)
+            mid = (n_layers - head) // f * f
+
+            if cfg.param_stream:
+                # ZeRO-Infinity: layer slices stream host→device inside the
+                # scan; the custom VJP (runtime/infinity.streamed_scan)
+                # parks each layer's gradient back to a host accumulator so
+                # neither params nor their grads are ever device-resident in
+                # full. Placement must be static end to end.
+                if head or mid != n_layers:
+                    raise NotImplementedError(
+                        "param streaming requires moe_layer_freq-aligned "
+                        "segments (no random-LTD bands)")
+                if pld_theta is not None:
+                    raise NotImplementedError(
+                        "param streaming + progressive layer drop "
+                        "not supported")
+                from deepspeed_tpu.runtime.infinity import streamed_scan
+
+                if f > 1:
+                    steps = n_layers // f
+                    stacked = jax.tree.map(
+                        lambda p: p.reshape((steps, f) + p.shape[1:]),
+                        layers_slice)
+                else:
+                    stacked = layers_slice
+
+                def step_fn(lp, h, pos_, i):
+                    aux_acc = jnp.zeros((), jnp.float32)
+                    if f > 1:
+                        for j in range(f):
+                            sub = jax.tree.map(lambda p, j=j: p[j], lp)
+                            h, aux = transformer_layer(
+                                h, sub, pos_, cfg, layer_is_moe=(j == f - 1))
+                            aux_acc = aux_acc + aux
+                    else:
+                        h, aux = transformer_layer(
+                            h, lp, pos_, cfg, layer_is_moe=cfg.is_moe)
+                        aux_acc = aux_acc + aux
+                    return h, aux_acc
+
+                return streamed_scan(step_fn, stacked, x, extras=pos)
+            # head/tail: static global indices → static MoE placement
+            def run_unrolled(x, aux, lo, hi):
+                for j in range(lo, hi):
+                    lp = jax.tree.map(lambda p, j=j: p[j], layers_slice)
+                    is_moe = cfg.is_moe and ((idx0 + j) % f == f - 1)
+                    step = _maybe_remat(
+                        lambda h, a, lp, j=j, m=is_moe:
+                        apply_layer(h, a, lp, idx0 + j, m), cfg)
+                    x, aux = step(x, aux, lp)
+                return x, aux
+
+            x, aux0 = run_unrolled(x, aux0, 0, head)
+            if mid > 0:
+                grouped = f > 1
+
+                def body(carry, scanned):
+                    h, aux_acc = carry
+                    layer_params, i = scanned
+                    if grouped:
+                        for j in range(f):
+                            lp = jax.tree.map(lambda p, j=j: p[j],
+                                              layer_params)
+                            h, aux_acc = apply_layer(h, aux_acc, lp,
+                                                     i * f + j, j == f - 1)
+                    else:
+                        h, aux_acc = apply_layer(h, aux_acc, layer_params, i,
+                                                 cfg.is_moe and f == 1)
+                    return (h, aux_acc), None
+
+                body = _maybe_remat(body, cfg)
+                mid_slice = jax.tree.map(lambda p: p[head:head + mid],
+                                         layers_slice)
+                if grouped:
+                    steps = mid // f
+                    layers_scan = jax.tree.map(
+                        lambda p: p.reshape((steps, f) + p.shape[1:]),
+                        mid_slice)
+                    idxs = jnp.arange((idx0 + head) // f,
+                                      (idx0 + head) // f + steps)
+                else:
+                    steps = mid
+                    layers_scan = mid_slice
+                    idxs = jnp.arange(idx0 + head, idx0 + head + mid)
+                unroll = max(1, cfg.scan_unroll)
+                if steps % unroll != 0:
+                    unroll = 1
+                (x, aux_mid), _ = lax.scan(
+                    body, (x, jnp.zeros((), jnp.float32)),
+                    (layers_scan, idxs), unroll=unroll)
+                aux0 = aux0 + aux_mid
+            x, aux0 = run_unrolled(x, aux0, head + mid, n_layers)
+            return x, aux0
+
+        def layer_slice(a, b_):
+            return jax.tree.map(lambda p: p[a:b_], params["layers"])
+
+        ltd_on = 0 < cfg.ltd_kept < s
+        if ltd_on:
+            # random-LTD: middle band runs on a random token subset
+            # (ref RandomLayerTokenDrop; gather/scatter = csrc/random_ltd)
+            from deepspeed_tpu.runtime.data_pipeline.data_routing import (
+                random_ltd_drop, random_ltd_indices, random_ltd_restore)
+
+            a = max(0, min(cfg.ltd_start, cfg.num_layers))
+            z = cfg.ltd_end if cfg.ltd_end is not None else cfg.num_layers - 1
+            z = max(a, min(z, cfg.num_layers))
+            x, aux0 = scan_segment(x, positions, layer_slice(0, a), 0, a)
+            key = jax.random.fold_in(jax.random.PRNGKey(23),
+                                     jnp.sum(input_ids[:, :1]).astype(jnp.int32))
+            idx = random_ltd_indices(key, s, cfg.ltd_kept, b)
+            x_kept = random_ltd_drop(x, idx)
+            pos_kept = jnp.take_along_axis(positions, idx, axis=1)
+            x_kept, aux1 = scan_segment(x_kept, pos_kept, layer_slice(a, z),
+                                        a, z - a)
+            x = random_ltd_restore(x, x_kept, idx)
+            x, aux2 = scan_segment(x, positions, layer_slice(z, cfg.num_layers),
+                                   z, cfg.num_layers - z)
+            moe_aux = aux0 + aux1 + aux2
+        else:
+            x, moe_aux = scan_segment(x, positions, params["layers"], 0,
+                                      cfg.num_layers)
+
+    x = _norm(x, params["final_norm"], cfg)
+    if return_hidden:
+        return (x, moe_aux) if cfg.is_moe else x
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"]["tokens"].astype(dt).T
+    else:
+        logits = x @ params["lm_head"].astype(dt)
+    if cfg.is_moe:
+        # stash aux loss on the fwd for the engine loss fn via closure return
+        return logits, moe_aux
+    return logits
+
+
+MOE_AUX_COEF = 0.01
+
+
+def _nll_sum(logits32, labels_mb):
+    """Summed token NLL with -100 = ignore (HF convention)."""
+    m = labels_mb != -100
+    safe = jnp.where(m, labels_mb, 0)
+    logz = jax.nn.logsumexp(logits32, axis=-1)
+    gold = jnp.take_along_axis(logits32, safe[..., None], axis=-1)[..., 0]
+    return jnp.sum((logz - gold) * m)
+
+
+def _embed(params: Params, input_ids, positions, cfg: TransformerConfig):
+    """Embedding prologue shared by forward() and the 1F1B loss path."""
+    x = params["embed"]["tokens"].astype(cfg.dtype)[input_ids]
+    if cfg.has_learned_positions:
+        x = x + params["embed"]["positions"].astype(cfg.dtype)[positions]
+    return x
+
+
+def _pipeline_1f1b_loss(params, batch, cfg: TransformerConfig, topo,
+                        labels_eff, denom):
+    """Training loss through the 1F1B pipeline schedule (the head + NLL run
+    per microbatch on the last stage, ref runtime/pipe/engine.py:337)."""
+    from deepspeed_tpu.parallel.pipeline import make_pipeline_train_loss
+
+    input_ids = batch["input_ids"]
+    b, s = input_ids.shape
+    dt = cfg.dtype
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :],
+                                 (b, s))
+    x = _embed(params, input_ids, positions, cfg)
+
+    def tail_fn(tp, h, labels_mb):
+        h = _norm(h, tp["final_norm"], cfg)
+        w = tp["w"].astype(dt)
+        logits = h @ (w.T if cfg.tie_embeddings else w)
+        return _nll_sum(logits.astype(jnp.float32), labels_mb)
+
+    tail_params = {"final_norm": params["final_norm"],
+                   "w": params["embed"]["tokens"] if cfg.tie_embeddings
+                   else params["lm_head"]}
+    stage_fn = make_pipeline_stage_fn(cfg, topo)
+    n_micro = cfg.pipeline_microbatches or topo.pp_size
+    f = make_pipeline_train_loss(
+        stage_fn, tail_fn, topo, n_micro,
+        aux_coef=MOE_AUX_COEF if cfg.is_moe else 0.0)
+    return f(params["layers"], tail_params, x, labels_eff, positions,
+             denom)
+
+
+def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfig):
+    """Causal LM cross-entropy. ``batch``: input_ids [B,S], labels [B,S]
+    (-100 = ignore, HF convention), optional loss_mask, optional pld_theta
+    (progressive layer drop keep prob, passed through the batch so the
+    schedule never forces a recompile).
+
+    With ``cfg.loss_tiles`` set (and dividing S), the loss is computed in
+    sequence tiles (ALST, sequence/alst.py) so [B, S, V] logits are never
+    materialised.
+    """
+    labels = batch["labels"]
+    mask = (labels != -100)
+    if "loss_mask" in batch:
+        mask = mask & (batch["loss_mask"] > 0)
+
+    s = batch["input_ids"].shape[1]
+    tiled = cfg.loss_tiles and s % cfg.loss_tiles == 0
+
+    from deepspeed_tpu.parallel.topology import get_topology
+
+    topo = get_topology()
+    if (topo is not None and topo.pp_size > 1
+            and cfg.pipeline_schedule == "1f1b" and not tiled
+            and not cfg.param_stream   # forward() raises for pp+streaming
+            and batch.get("pld_theta") is None
+            and not (0 < cfg.ltd_kept < s)      # forward() raises for pp+LTD
+            # fp16 needs the dynamic loss scale inside the backward, but the
+            # 1F1B custom VJP computes grads in its forward before the scale
+            # cotangent exists — fp16 stays on the AD-differentiated GPipe
+            # path (bf16 shares f32's exponent range; no scaling needed)
+            and cfg.dtype != jnp.float16):
+        labels_eff = jnp.where(mask, labels, -100)
+        denom = jnp.maximum(mask.sum(), 1).astype(jnp.float32)
+        return _pipeline_1f1b_loss(params, batch, cfg, topo, labels_eff,
+                                   denom)
+    out = forward(params, batch["input_ids"], cfg,
+                  pld_theta=batch.get("pld_theta"), return_hidden=bool(tiled))
+    moe_aux = jnp.zeros((), jnp.float32)
+    if isinstance(out, tuple):
+        out, moe_aux = out
+
+    if tiled:
+        from deepspeed_tpu.sequence.alst import tiled_logits_loss
+
+        w = params["embed"]["tokens"] if cfg.tie_embeddings \
+            else params["lm_head"].T
+        loss, _ = tiled_logits_loss(out, w.astype(cfg.dtype),
+                                    jnp.where(mask, labels, -100),
+                                    cfg.loss_tiles)
+    else:
+        loss = _nll_sum(out.astype(jnp.float32),
+                        jnp.where(mask, labels, -100)) \
+            / jnp.maximum(mask.sum(), 1)
+    if cfg.is_moe:
+        loss = loss + MOE_AUX_COEF * moe_aux
+    return loss

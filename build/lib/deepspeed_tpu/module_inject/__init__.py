@@ -1,0 +1,9 @@
+from deepspeed_tpu.module_inject.auto_tp import AutoTP, tp_model_init
+from deepspeed_tpu.module_inject.layers import (column_parallel_linear,
+                                                linear_allreduce, linear_layer,
+                                                row_parallel_linear,
+                                                vocab_parallel_logits)
+
+__all__ = ["AutoTP", "tp_model_init", "column_parallel_linear",
+           "row_parallel_linear", "linear_allreduce", "linear_layer",
+           "vocab_parallel_logits"]

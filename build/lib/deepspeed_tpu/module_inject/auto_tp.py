@@ -1,0 +1,160 @@
+"""AutoTP — automatic tensor-parallel sharding of arbitrary param trees.
+
+TPU-native analog of the reference's AutoTP (``module_inject/auto_tp.py:193``)
+and ``deepspeed.tp_model_init`` (deepspeed/__init__.py:380).  The reference
+walks an nn.Module graph, classifies each Linear as all-reduce (row
+parallel) or split (column parallel) by name/policy, and swaps in
+``LinearAllreduce``/``LinearLayer`` wrappers (module_inject/layers.py:388/465).
+
+Here a model is a param pytree; AutoTP classifies each weight by its *path*
+(the same layer-name heuristics the reference's ``tp_parser`` applies to HF
+module names) and emits a ``PartitionSpec`` tree.  ``jax.device_put`` +
+``jit`` then realise Megatron-style TP: XLA inserts the row-parallel output
+all-reduce that ``LinearAllreduce`` performs eagerly in the reference.
+
+Classification (mirroring the reference's policy lists):
+* row-parallel (shard INPUT dim, output psum): attention output and MLP
+  down projections — ``o_proj, out_proj, dense (in attention), down_proj,
+  dense_4h_to_h, wo, w2, fc2, c_proj``.
+* column-parallel (shard OUTPUT dim): q/k/v/gate/up and fused projections —
+  everything else 2-D that is divisible.
+* replicated: norms, small vectors, anything indivisible (with a warning —
+  ref ``tp_grain_size`` rounding).
+* embeddings: vocab dim sharded (ref VocabParallelEmbedding path).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.sharding import path_str
+from deepspeed_tpu.parallel.topology import TENSOR_AXIS, MeshTopology, get_topology
+from deepspeed_tpu.utils.logging import logger
+
+# name fragments → row parallel (output needs the allreduce). Mirrors the
+# reference's all-reduce linear lists (auto_tp.py tp_parser / policy files).
+ROW_PARALLEL_PATTERNS = [
+    r"o_proj$", r"out_proj$", r"down_proj$", r"dense_4h_to_h$", r"c_proj$",
+    r"attn/wo$", r"attention/wo$", r"mlp/wo$", r"moe/wo$", r"/w2$", r"fc2$",
+    r"attention/dense$", r"self_attention/dense$", r"wo$",
+]
+# name fragments → column parallel explicitly (fused qkv etc.)
+COLUMN_PARALLEL_PATTERNS = [
+    r"q_proj$", r"k_proj$", r"v_proj$", r"gate_proj$", r"up_proj$",
+    r"query_key_value$", r"c_attn$", r"dense_h_to_4h$", r"fc1$",
+    r"attn/w[qkv]$", r"mlp/w[ig]$", r"moe/w[ig]$", r"/w[13]$",
+    r"lm_head$", r"embed_out$",
+]
+EMBEDDING_PATTERNS = [r"embed[^/]*/tokens$", r"embed_tokens", r"wte$", r"word_embeddings$"]
+
+
+class AutoTP:
+    """Classify params and emit TP PartitionSpecs (ref AutoTP class)."""
+
+    def __init__(self, topology: Optional[MeshTopology] = None,
+                 tp_grain_size: int = 1):
+        self.topo = topology or get_topology()
+        if self.topo is None:
+            raise RuntimeError("AutoTP needs an initialized topology "
+                               "(call deepspeed_tpu.comm.init_distributed)")
+        self.tp_size = self.topo.tp_size
+        self.tp_grain_size = tp_grain_size
+        self._row = [re.compile(p) for p in ROW_PARALLEL_PATTERNS]
+        self._col = [re.compile(p) for p in COLUMN_PARALLEL_PATTERNS]
+        self._emb = [re.compile(p) for p in EMBEDDING_PATTERNS]
+
+    # ------------------------------------------------------------------
+    def classify(self, path: str, shape: Tuple[int, ...]) -> str:
+        """→ "row" | "column" | "embedding" | "replicate"."""
+        if any(p.search(path) for p in self._emb):
+            return "embedding"
+        # Biases follow their matrix: column-parallel biases shard their
+        # feature (last) dim, row-parallel biases replicate (they are added
+        # once, after the psum). Detected by name, not ndim — stacked
+        # per-layer biases are [L, dim] and must still classify as biases.
+        leaf = path.rsplit("/", 1)[-1]
+        if leaf == "bias" or (len(leaf) == 2 and leaf[0] == "b"):
+            parent = path[:-(len(leaf) + 1)] if "/" in path else ""
+            cands = [parent]
+            if leaf != "bias":
+                cands.append(f"{parent}/w{leaf[1:]}" if parent else f"w{leaf[1:]}")
+            if any(p.search(c) for p in self._row for c in cands):
+                return "replicate"
+            if any(p.search(c) for p in self._col for c in cands):
+                return "column_bias"
+            return "replicate"  # norm biases & unknowns: safe under GSPMD
+        if len(shape) < 2:
+            return "replicate"
+        if any(p.search(path) for p in self._row):
+            return "row"
+        if any(p.search(path) for p in self._col):
+            return "column"
+        return "column"  # default Linear → split output (ref LinearLayer)
+
+    def _divisible(self, n: int) -> bool:
+        return (n % (self.tp_size * max(1, self.tp_grain_size))) == 0 or \
+            (n % self.tp_size == 0 and self.tp_grain_size <= 1)
+
+    def spec_for(self, path: str, shape: Tuple[int, ...]) -> P:
+        if self.tp_size <= 1:
+            return P()
+        kind = self.classify(path, shape)
+        ndim = len(shape)
+        spec: List[Any] = [None] * ndim
+        if kind == "replicate":
+            return P()
+        if kind == "embedding":
+            # vocab (dim 0 of [V, H]) sharded; leading stacked dims skipped
+            dim = ndim - 2
+            if self._divisible(shape[dim]):
+                spec[dim] = TENSOR_AXIS
+            return P(*spec)
+        if kind == "column_bias":
+            if self._divisible(shape[-1]):
+                spec[-1] = TENSOR_AXIS
+            return P(*spec)
+        if kind == "row":
+            dim = ndim - 2  # input dim of [..., in, out]
+            if self._divisible(shape[dim]):
+                spec[dim] = TENSOR_AXIS
+            else:
+                logger.warning(f"AutoTP: {path} dim {shape[dim]} not divisible "
+                               f"by tp={self.tp_size}; replicating")
+            return P(*spec)
+        # column
+        if self._divisible(shape[-1]):
+            spec[-1] = TENSOR_AXIS
+        else:
+            logger.warning(f"AutoTP: {path} dim {shape[-1]} not divisible "
+                           f"by tp={self.tp_size}; replicating")
+        return P(*spec)
+
+    # ------------------------------------------------------------------
+    def tree_specs(self, params: Any):
+        def leaf(path, x):
+            return self.spec_for(path_str(path), np.shape(x))
+
+        return jax.tree_util.tree_map_with_path(leaf, params)
+
+    def tree_shardings(self, params: Any):
+        return jax.tree.map(lambda s: NamedSharding(self.topo.mesh, s),
+                            self.tree_specs(params),
+                            is_leaf=lambda x: isinstance(x, P))
+
+
+def tp_model_init(params: Any, topology: Optional[MeshTopology] = None,
+                  tp_grain_size: int = 1) -> Any:
+    """Shard a param tree tensor-parallel over the mesh "tensor" axis.
+
+    Ref: ``deepspeed.tp_model_init`` (deepspeed/__init__.py:380) +
+    ``TpTrainingManager`` (runtime/tensor_parallel/tp_manager.py) — AutoTP
+    for *training*.  Returns the resharded tree; subsequent jitted steps
+    see TP-sharded weights and XLA inserts the Megatron collectives.
+    """
+    tp = AutoTP(topology, tp_grain_size=tp_grain_size)
+    return jax.device_put(params, tp.tree_shardings(params))

@@ -1,0 +1,62 @@
+"""Tensor-parallel linear layer functions.
+
+TPU-native analog of ``module_inject/layers.py`` (``LinearAllreduce``:388,
+``LinearLayer``:465, ``ColumnParallel``:125, ``RowParallel``:64).  The
+reference wraps nn.Linear with eager NCCL calls; here each is a pure
+function used inside ``shard_map`` (explicit mode, tests/bench) — under
+plain ``jit`` + sharded weights the same collectives appear automatically
+via AutoTP's PartitionSpecs, so models never need to call these directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.parallel.topology import TENSOR_AXIS
+
+
+def column_parallel_linear(x, w_shard, b_shard=None, *,
+                           gather_output: bool = False,
+                           axis: str = TENSOR_AXIS):
+    """Y_local = X @ W[:, shard] (ref ColumnParallel, layers.py:125).
+
+    Output is head/ffn-sharded; with ``gather_output`` the shards are
+    all-gathered (rarely wanted — keep activations sharded between the
+    column→row pair, the Megatron pattern).
+    """
+    y = x @ w_shard
+    if b_shard is not None:
+        y = y + b_shard
+    if gather_output:
+        y = lax.all_gather(y, axis, axis=y.ndim - 1, tiled=True)
+    return y
+
+
+def row_parallel_linear(x_shard, w_shard, b=None, *, axis: str = TENSOR_AXIS):
+    """Y = psum_tp(X[:, shard] @ W[shard, :]) (ref RowParallel, layers.py:64;
+    LinearAllreduce:388). Bias is added AFTER the reduce, once."""
+    y = lax.psum(x_shard @ w_shard, axis)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def linear_allreduce(x_shard, w_shard, b=None, *, axis: str = TENSOR_AXIS):
+    """Alias matching the reference's class name (LinearAllreduce:388)."""
+    return row_parallel_linear(x_shard, w_shard, b, axis=axis)
+
+
+def linear_layer(x, w_shard, b_shard=None, *, axis: str = TENSOR_AXIS):
+    """Alias matching the reference's LinearLayer (column split, :465)."""
+    return column_parallel_linear(x, w_shard, b_shard, axis=axis)
+
+
+def vocab_parallel_logits(x, embed_shard, *, axis: str = TENSOR_AXIS):
+    """lm-head over a vocab-sharded embedding: local partial logits are
+    all-gathered on the vocab dim (ref VocabParallelEmbedding path)."""
+    logits_local = x @ embed_shard.T
+    return lax.all_gather(logits_local, axis, axis=logits_local.ndim - 1,
+                          tiled=True)

@@ -1,0 +1,131 @@
+"""Monitoring fan-out: TensorBoard / WandB / CSV / Comet.
+
+Analog of ``deepspeed/monitor/monitor.py`` (Monitor ABC :13, MonitorMaster
+:30).  Events are ``(tag, value, step)`` tuples written at step boundaries
+from process 0.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+import jax
+
+from deepspeed_tpu.utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    def __init__(self, cfg):
+        self.enabled = cfg.enabled
+
+    def write_events(self, event_list: List[Event]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.summary_writer = None
+        if self.enabled and jax.process_index() == 0:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                log_dir = os.path.join(cfg.output_path or "./runs", cfg.job_name)
+                self.summary_writer = SummaryWriter(log_dir=log_dir)
+            except Exception as e:
+                logger.warning(f"tensorboard unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self.summary_writer is None:
+            return
+        for tag, value, step in event_list:
+            self.summary_writer.add_scalar(tag, value, step)
+        self.summary_writer.flush()
+
+
+class CSVMonitor(Monitor):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.output_path = cfg.output_path or "./csv_monitor"
+        self.job_name = cfg.job_name
+        if self.enabled and jax.process_index() == 0:
+            os.makedirs(os.path.join(self.output_path, self.job_name), exist_ok=True)
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not self.enabled or jax.process_index() != 0:
+            return
+        for tag, value, step in event_list:
+            fname = os.path.join(self.output_path, self.job_name,
+                                 tag.replace("/", "_") + ".csv")
+            with open(fname, "a", newline="") as f:
+                csv.writer(f).writerow([step, value])
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self._wandb = None
+        if self.enabled and jax.process_index() == 0:
+            try:
+                import wandb
+
+                wandb.init(project=cfg.project, group=cfg.group, team=cfg.team)
+                self._wandb = wandb
+            except Exception as e:
+                logger.warning(f"wandb unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self._wandb is None:
+            return
+        for tag, value, step in event_list:
+            self._wandb.log({tag: value}, step=step)
+
+
+class CometMonitor(Monitor):
+    """Comet backend (ref monitor/comet.py); gated on the comet_ml SDK."""
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self._exp = None
+        if self.enabled and jax.process_index() == 0:
+            try:
+                import comet_ml
+
+                self._exp = comet_ml.Experiment(
+                    project_name=getattr(cfg, "project", None))
+            except Exception as e:
+                logger.warning(f"comet unavailable: {e}")
+                self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self._exp is None:
+            return
+        for tag, value, step in event_list:
+            self._exp.log_metric(tag, value, step=step)
+
+
+class MonitorMaster(Monitor):
+    """Fans events out to every enabled backend (ref monitor.py:30)."""
+
+    def __init__(self, ds_config):
+        self.monitors: List[Monitor] = []
+        for cfg, cls in ((ds_config.tensorboard, TensorBoardMonitor),
+                         (ds_config.wandb, WandbMonitor),
+                         (ds_config.csv_monitor, CSVMonitor),
+                         (getattr(ds_config, "comet", None), CometMonitor)):
+            if getattr(cfg, "enabled", False):
+                self.monitors.append(cls(cfg))
+        self.enabled = any(m.enabled for m in self.monitors)
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if jax.process_index() != 0:
+            return
+        for m in self.monitors:
+            if m.enabled:
+                m.write_events(event_list)

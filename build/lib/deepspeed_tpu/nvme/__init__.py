@@ -1,0 +1,5 @@
+"""NVMe/AIO performance tuning (ref deepspeed/nvme/)."""
+
+from deepspeed_tpu.nvme.perf_sweep import run_sweep, sweep_main
+
+__all__ = ["run_sweep", "sweep_main"]

@@ -1,0 +1,128 @@
+"""AIO parameter sweep — find the best (block_size, queue_depth) for this
+host's storage.
+
+Analog of ``deepspeed/nvme/`` (``perf_run_sweep.py``, the ``ds_nvme_tune``
+CLI): writes/reads a scratch file across a grid of AIO settings, reports
+GB/s, and emits the best config as the ``aio`` JSON block users paste into
+their config.  Uses the native AIO handle (csrc/aio) when built, falling
+back to buffered I/O so the tool still ranks block sizes on any host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.aio import AsyncIOHandle, aio_available
+from deepspeed_tpu.utils.logging import logger
+
+DEFAULT_BLOCK_SIZES = [256 << 10, 1 << 20, 4 << 20, 8 << 20]
+DEFAULT_QUEUE_DEPTHS = [4, 8, 16, 32]
+
+
+def _bench_one(path: str, data: np.ndarray, block_size: int, queue_depth: int,
+               read: bool, use_direct: bool = False):
+    """→ (GB/s, direct_effective) for one configuration."""
+    direct_effective = use_direct
+    if aio_available():
+        h = AsyncIOHandle(block_size=block_size, queue_depth=queue_depth,
+                          use_direct=use_direct)
+        t0 = time.perf_counter()
+        if read:
+            h.pread(data, path)
+        else:
+            h.pwrite(data, path)
+        dt = time.perf_counter() - t0
+        if use_direct and h.direct_fallbacks() > 0:
+            direct_effective = False  # FS rejected O_DIRECT: cache numbers
+    else:  # buffered fallback: block_size still matters, queue_depth doesn't
+        t0 = time.perf_counter()
+        if read:
+            with open(path, "rb", buffering=0) as f:
+                for off in range(0, data.nbytes, block_size):
+                    f.read(block_size)
+        else:
+            with open(path, "wb", buffering=0) as f:
+                view = data.view(np.uint8).reshape(-1)
+                for off in range(0, data.nbytes, block_size):
+                    f.write(view[off:off + block_size].tobytes())
+                f.flush()
+                os.fsync(f.fileno())
+        dt = time.perf_counter() - t0
+    return data.nbytes / dt / 1e9, direct_effective
+
+
+def run_sweep(nvme_dir: str, io_bytes: int = 64 << 20,
+              block_sizes: Optional[List[int]] = None,
+              queue_depths: Optional[List[int]] = None) -> Dict[str, Any]:
+    """Sweep read+write and return results + best aio config."""
+    block_sizes = block_sizes or DEFAULT_BLOCK_SIZES
+    queue_depths = queue_depths or DEFAULT_QUEUE_DEPTHS
+    os.makedirs(nvme_dir, exist_ok=True)
+    path = os.path.join(nvme_dir, "_dstpu_sweep.bin")
+    data = np.random.default_rng(0).integers(
+        0, 255, size=io_bytes, dtype=np.uint8)
+    results = []
+    try:
+        for bs in block_sizes:
+            for qd in (queue_depths if aio_available() else [queue_depths[0]]):
+                # buffered vs O_DIRECT: direct measures the device, not the
+                # page cache (ref csrc/aio O_DIRECT discipline)
+                for direct in ([False, True] if aio_available() else [False]):
+                    wr, d_ok = _bench_one(path, data, bs, qd, read=False,
+                                          use_direct=direct)
+                    rd, d_ok2 = _bench_one(path, data, bs, qd, read=True,
+                                           use_direct=direct)
+                    eff = direct and d_ok and d_ok2
+                    results.append({"block_size": bs, "queue_depth": qd,
+                                    "use_direct": direct,
+                                    "direct_effective": eff,
+                                    "write_gbps": wr, "read_gbps": rd,
+                                    "score": min(wr, rd)})
+                    logger.info(f"aio sweep bs={bs} qd={qd} direct={direct}"
+                                f"{'' if eff == direct else ' (FELL BACK)'}: "
+                                f"write {wr:.2f} GB/s read {rd:.2f} GB/s")
+    finally:
+        if os.path.exists(path):
+            os.remove(path)
+    # recommend from DIRECT rows when the FS honors O_DIRECT: buffered
+    # scores are page-cache-inflated and mispredict real NVMe behaviour;
+    # buffered rows remain in `results` for the cache-speed comparison
+    direct_rows = [r for r in results if r.get("direct_effective")]
+    pool = direct_rows or results
+    best = max(pool, key=lambda r: r["score"])
+    return {
+        "results": results,
+        "best": best,
+        "direct_honored": bool(direct_rows),
+        "aio_config": {"block_size": best["block_size"],
+                       "queue_depth": best["queue_depth"],
+                       "use_direct": bool(best.get("use_direct", False)),
+                       "single_submit": False, "overlap_events": True,
+                       "thread_count": 1},
+        "native_aio": aio_available(),
+    }
+
+
+def sweep_main(argv=None) -> int:
+    """`dstpu_nvme_tune` entry point (ref bin/ds_nvme_tune)."""
+    ap = argparse.ArgumentParser(description="AIO/NVMe performance sweep")
+    ap.add_argument("--nvme_dir", required=True)
+    ap.add_argument("--io_size", type=int, default=64 << 20)
+    ap.add_argument("--json", default=None, help="write results to this file")
+    args = ap.parse_args(argv)
+    out = run_sweep(args.nvme_dir, io_bytes=args.io_size)
+    print(json.dumps(out["aio_config"], indent=2))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=2)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(sweep_main())

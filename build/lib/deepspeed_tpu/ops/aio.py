@@ -1,0 +1,113 @@
+"""Python wrapper for the native async-IO engine (DeepNVMe equivalent).
+
+API mirrors the reference's ``aio_handle`` (ops/aio, csrc/aio/py_lib/
+py_ds_aio.cpp): ``AsyncIOHandle(block_size, queue_depth, thread_count)``
+with ``async_pread/async_pwrite`` over numpy buffers + ``wait()``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import load_op
+
+
+def _lib():
+    lib = load_op("ds_aio", ["aio/ds_aio.cpp"])
+    lib.ds_aio_create.restype = ctypes.c_void_p
+    lib.ds_aio_create.argtypes = [ctypes.c_long, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int]
+    lib.ds_aio_destroy.argtypes = [ctypes.c_void_p]
+    lib.ds_aio_pread.restype = ctypes.c_int
+    lib.ds_aio_pread.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_long,
+                                 ctypes.c_char_p, ctypes.c_long]
+    lib.ds_aio_pwrite.restype = ctypes.c_int
+    lib.ds_aio_pwrite.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_long,
+                                  ctypes.c_char_p, ctypes.c_long]
+    lib.ds_aio_wait.restype = ctypes.c_long
+    lib.ds_aio_wait.argtypes = [ctypes.c_void_p]
+    lib.ds_aio_pending.restype = ctypes.c_long
+    lib.ds_aio_pending.argtypes = [ctypes.c_void_p]
+    lib.ds_aio_direct_fallbacks.restype = ctypes.c_long
+    lib.ds_aio_direct_fallbacks.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class AsyncIOHandle:
+    """Async pread/pwrite of numpy arrays through the native thread pool."""
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 8,
+                 thread_count: int = 4, use_direct: bool = False):
+        self._lib = _lib()
+        self._h = self._lib.ds_aio_create(block_size, queue_depth, thread_count,
+                                          1 if use_direct else 0)
+        self.block_size = block_size
+        self.queue_depth = queue_depth
+        self.thread_count = thread_count
+        self.use_direct = use_direct
+        # keep buffers alive while IO is in flight
+        self._inflight_bufs = []
+
+    def async_pwrite(self, array: np.ndarray, path: str, offset: int = 0) -> int:
+        arr = np.ascontiguousarray(array)
+        self._inflight_bufs.append(arr)
+        return self._lib.ds_aio_pwrite(
+            self._h, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes,
+            path.encode(), offset)
+
+    def async_pread(self, array: np.ndarray, path: str, offset: int = 0) -> int:
+        if not array.flags["C_CONTIGUOUS"] or not array.flags["WRITEABLE"]:
+            raise ValueError("read target must be a writable contiguous array")
+        self._inflight_bufs.append(array)
+        return self._lib.ds_aio_pread(
+            self._h, array.ctypes.data_as(ctypes.c_void_p), array.nbytes,
+            path.encode(), offset)
+
+    def wait(self) -> int:
+        """Block until all submitted ops finish. Returns failed chunk count."""
+        errors = int(self._lib.ds_aio_wait(self._h))
+        self._inflight_bufs.clear()
+        return errors
+
+    def pending(self) -> int:
+        return int(self._lib.ds_aio_pending(self._h))
+
+    def direct_fallbacks(self) -> int:
+        """O_DIRECT chunks that fell back to buffered I/O since last call
+        (non-zero means 'direct' timings measured the page cache)."""
+        return int(self._lib.ds_aio_direct_fallbacks(self._h))
+
+    # sync conveniences (ref: aio_handle.read/write)
+    def pwrite(self, array: np.ndarray, path: str, offset: int = 0) -> None:
+        self.async_pwrite(array, path, offset)
+        errs = self.wait()
+        if errs:
+            raise IOError(f"aio pwrite to {path}: {errs} failed chunks")
+
+    def pread(self, array: np.ndarray, path: str, offset: int = 0) -> None:
+        self.async_pread(array, path, offset)
+        errs = self.wait()
+        if errs:
+            raise IOError(f"aio pread from {path}: {errs} failed chunks")
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ds_aio_wait(self._h)
+                self._lib.ds_aio_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+def aio_available() -> bool:
+    """True when the native csrc/aio library builds/loads on this host."""
+    try:
+        _lib()
+        return True
+    except Exception:
+        return False

@@ -1,0 +1,179 @@
+"""Native host optimizers (ZeRO-Offload step path).
+
+API mirrors the reference's ``DeepSpeedCPUAdam`` (ops/adam/cpu_adam.py:13),
+``DeepSpeedCPUAdagrad`` and ``DeepSpeedCPULion``: fused, vectorized
+optimizer steps over fp32 host arrays, backed by
+``csrc/cpu_optimizer/cpu_optimizer.cpp`` (the analog of
+csrc/adam/cpu_adam_impl.cpp's AVX kernels) with a numpy fallback when no
+compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deepspeed_tpu.ops.op_builder import OpBuilderError, load_op
+from deepspeed_tpu.utils.logging import logger
+
+_LIB = None
+_LIB_FAILED = False
+
+
+def _lib():
+    global _LIB, _LIB_FAILED
+    if _LIB is None and not _LIB_FAILED:
+        try:
+            lib = load_op("ds_cpu_optimizer",
+                          ["cpu_optimizer/cpu_optimizer.cpp"],
+                          extra_flags=["-fopenmp"])
+            f32 = ctypes.POINTER(ctypes.c_float)
+            lib.ds_adam_step.argtypes = [f32, f32, f32, f32, ctypes.c_int64,
+                                         ctypes.c_float, ctypes.c_float,
+                                         ctypes.c_float, ctypes.c_float,
+                                         ctypes.c_float, ctypes.c_int,
+                                         ctypes.c_int]
+            lib.ds_adagrad_step.argtypes = [f32, f32, f32, ctypes.c_int64,
+                                            ctypes.c_float, ctypes.c_float,
+                                            ctypes.c_float]
+            lib.ds_lion_step.argtypes = [f32, f32, f32, ctypes.c_int64,
+                                         ctypes.c_float, ctypes.c_float,
+                                         ctypes.c_float, ctypes.c_float]
+            _LIB = lib
+        except OpBuilderError as e:
+            logger.warning(f"native cpu optimizer unavailable ({e}); "
+                           "using numpy fallback")
+            _LIB_FAILED = True
+    return _LIB
+
+
+def cpu_optimizer_available() -> bool:
+    return _lib() is not None
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _check(*arrays: np.ndarray) -> None:
+    for a in arrays:
+        if a.dtype != np.float32 or not a.flags["C_CONTIGUOUS"]:
+            raise ValueError("cpu optimizer needs contiguous fp32 arrays")
+
+
+class DeepSpeedCPUAdam:
+    """Fused host Adam/AdamW over a list of fp32 numpy params (in-place)."""
+
+    def __init__(self, params: List[np.ndarray], lr: float = 1e-3,
+                 betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True):
+        self.params = params
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.step_count = 0
+        self.exp_avg = [np.zeros_like(p) for p in params]
+        self.exp_avg_sq = [np.zeros_like(p) for p in params]
+
+    def step(self, grads: List[np.ndarray],
+             lr: Optional[float] = None) -> None:
+        self.step_count += 1
+        lr = self.lr if lr is None else lr
+        lib = _lib()
+        for p, g, m, v in zip(self.params, grads, self.exp_avg,
+                              self.exp_avg_sq):
+            g = np.ascontiguousarray(g, np.float32)
+            if lib is not None:
+                _check(p, m, v)
+                lib.ds_adam_step(_ptr(p), _ptr(g), _ptr(m), _ptr(v), p.size,
+                                 lr, self.beta1, self.beta2, self.eps,
+                                 self.weight_decay, self.step_count,
+                                 int(self.adamw_mode))
+            else:
+                adam_step_numpy(p, g, m, v, lr, self.beta1, self.beta2,
+                                self.eps, self.weight_decay, self.step_count,
+                                self.adamw_mode)
+
+    def state_dict(self) -> Dict:
+        return {"step": self.step_count, "exp_avg": self.exp_avg,
+                "exp_avg_sq": self.exp_avg_sq}
+
+    def load_state_dict(self, sd: Dict) -> None:
+        self.step_count = int(sd["step"])
+        self.exp_avg = [np.array(x, np.float32) for x in sd["exp_avg"]]
+        self.exp_avg_sq = [np.array(x, np.float32) for x in sd["exp_avg_sq"]]
+
+
+class DeepSpeedCPUAdagrad:
+    def __init__(self, params: List[np.ndarray], lr: float = 1e-2,
+                 eps: float = 1e-10, weight_decay: float = 0.0):
+        self.params = params
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.exp_avg_sq = [np.zeros_like(p) for p in params]
+
+    def step(self, grads: List[np.ndarray],
+             lr: Optional[float] = None) -> None:
+        lr = self.lr if lr is None else lr
+        lib = _lib()
+        for p, g, v in zip(self.params, grads, self.exp_avg_sq):
+            g = np.ascontiguousarray(g, np.float32)
+            if lib is not None:
+                _check(p, v)
+                lib.ds_adagrad_step(_ptr(p), _ptr(g), _ptr(v), p.size, lr,
+                                    self.eps, self.weight_decay)
+            else:
+                if self.weight_decay:
+                    g = g + self.weight_decay * p
+                v += g * g
+                p -= lr * g / (np.sqrt(v) + self.eps)
+
+
+class DeepSpeedCPULion:
+    def __init__(self, params: List[np.ndarray], lr: float = 1e-4,
+                 betas=(0.9, 0.99), weight_decay: float = 0.0):
+        self.params = params
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.weight_decay = weight_decay
+        self.exp_avg = [np.zeros_like(p) for p in params]
+
+    def step(self, grads: List[np.ndarray],
+             lr: Optional[float] = None) -> None:
+        lr = self.lr if lr is None else lr
+        lib = _lib()
+        for p, g, m in zip(self.params, grads, self.exp_avg):
+            g = np.ascontiguousarray(g, np.float32)
+            if lib is not None:
+                _check(p, m)
+                lib.ds_lion_step(_ptr(p), _ptr(g), _ptr(m), p.size, lr,
+                                 self.beta1, self.beta2, self.weight_decay)
+            else:
+                c = self.beta1 * m + (1 - self.beta1) * g
+                upd = np.sign(c)
+                if self.weight_decay:
+                    upd = upd + self.weight_decay * p
+                p -= lr * upd
+                m[:] = self.beta2 * m + (1 - self.beta2) * g
+
+
+def adam_step_numpy(p, g, m, v, lr, beta1, beta2, eps, weight_decay, step,
+                    adamw) -> None:
+    """Reference/fallback implementation (in-place)."""
+    if not adamw and weight_decay:
+        g = g + weight_decay * p
+    m *= beta1
+    m += (1 - beta1) * g
+    v *= beta2
+    v += (1 - beta2) * g * g
+    bc1 = 1 - beta1 ** step
+    bc2 = 1 - beta2 ** step
+    denom = np.sqrt(v) / np.sqrt(bc2) + eps
+    if adamw and weight_decay:
+        p -= lr * weight_decay * p
+    p -= (lr / bc1) * m / denom

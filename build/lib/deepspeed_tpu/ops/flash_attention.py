@@ -1,0 +1,133 @@
+"""Flash attention for TPU.
+
+Replaces the reference's fused attention CUDA kernels
+(``csrc/transformer``/FlashAttention paths). The default TPU path is the
+**repo-owned** Pallas kernel (`deepspeed_tpu.ops.pallas.flash_mha`):
+GQA-native (KV never repeated), any sequence length (tail-pad + in-kernel
+mask — no silent O(S²) fallback), saved-residual backward. The upstream
+jax library kernel remains available as ``impl="pallas_lib"``; non-TPU
+backends (the 8-device CPU test mesh) use a numerically equivalent XLA
+implementation so the same model code runs everywhere.
+
+Layout contract: q, k, v are ``[batch, seq, heads, head_dim]`` (the model's
+natural layout); the kernels operate in ``[batch, heads, seq, head_dim]``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+_warned_fallback = False
+
+
+def _repeat_kv(q, k, v):
+    """Repeat KV heads up to the query head count (GQA -> MHA) for the
+    paths whose kernels are not GQA-native."""
+    nh, nkv = q.shape[2], k.shape[2]
+    if nkv != nh:
+        k = jnp.repeat(k, nh // nkv, axis=2)
+        v = jnp.repeat(v, nh // nkv, axis=2)
+    return k, v
+
+
+def _xla_attention(q, k, v, causal: bool, sm_scale: float):
+    b, s_q, h, d = q.shape
+    k, v = _repeat_kv(q, k, v)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
+    if causal:
+        s_k = k.shape[1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _block_for(s: int, max_block: int = 512) -> int | None:
+    """Largest block ≤ max_block that divides ``s`` and is a multiple of
+    the 128-lane register width; None if the library kernel can't tile
+    ``s``."""
+    for blk in range(min(max_block, s), 127, -128):
+        if blk % 128 == 0 and s % blk == 0:
+            return blk
+    return None
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _lib_flash(q, k, v, causal, sm_scale, blk):
+    """Upstream jax.experimental Pallas kernel (KV repeated to MHA)."""
+    k, v = _repeat_kv(q, k, v)
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes, flash_attention as pallas_flash)
+
+    qt = q.swapaxes(1, 2)  # [B, H, S, D]
+    kt = k.swapaxes(1, 2)
+    vt = v.swapaxes(1, 2)
+    sizes = BlockSizes(
+        block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
+        block_q_major_dkv=blk, block_k_major_dkv=blk, block_k_dkv=blk,
+        block_q_dkv=blk, block_k_major_dq=blk, block_k_dq=blk, block_q_dq=blk)
+    out = pallas_flash(qt, kt, vt, causal=causal, sm_scale=sm_scale,
+                       block_sizes=sizes)
+    return out.swapaxes(1, 2)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "impl"))
+def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None,
+                    impl: str = "auto"):
+    """Multi-head attention over [B, S, H, D] tensors.
+
+    ``impl``: "auto" (repo Pallas kernel on TPU, XLA elsewhere) | "pallas"
+    (repo kernel) | "pallas_lib" (upstream library kernel) | "xla".
+    """
+    global _warned_fallback
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+
+    if impl == "xla" or not (impl in ("auto", "pallas", "pallas_lib")
+                             and _on_tpu()):
+        return _xla_attention(q, k, v, causal, sm_scale)
+
+    if impl == "pallas_lib":
+        blk = _block_for(q.shape[1])
+        if blk is None:
+            if not _warned_fallback:
+                logger.warning(
+                    "flash_attention: seq %d has no 128-aligned divisor; "
+                    "library kernel unavailable, using XLA attention",
+                    q.shape[1])
+                _warned_fallback = True
+            return _xla_attention(q, k, v, causal, sm_scale)
+        return _lib_flash(q, k, v, causal, sm_scale, blk)
+
+    from deepspeed_tpu.ops.pallas import flash_mha
+    from deepspeed_tpu.ops.pallas.flash_mha import supports
+
+    if not supports(q.shape[1], q.shape[-1]):
+        # beyond even the KV-blocked path's ceiling (S·D > 2^25) — shard
+        # the sequence (Ulysses/FPDT) at such lengths. Last resorts: the
+        # library kernel (repeats KV), then XLA.
+        blk = _block_for(q.shape[1])
+        if blk is not None:
+            return _lib_flash(q, k, v, causal, sm_scale, blk)
+        if not _warned_fallback:
+            logger.warning(
+                "flash_attention: seq %d (head_dim %d) exceeds kernel "
+                "budgets; using XLA attention", q.shape[1], q.shape[-1])
+            _warned_fallback = True
+        return _xla_attention(q, k, v, causal, sm_scale)
+
+    out = flash_mha(q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2),
+                    causal, sm_scale)
+    return out.swapaxes(1, 2)

@@ -1,0 +1,110 @@
+"""Floating-point quantization: FP8 / FP6 / FP12 quantize-dequantize.
+
+TPU-native analog of the reference's FP quantizer
+(``csrc/fp_quantizer/fp_quantize.{cpp,cu}``, ``ops/fp_quantizer/`` — SURVEY
+§2.6): used for FP8 gradient/weight compression and FP6 weight-only
+inference (cuda_linear).  FP8 uses the hardware-backed
+``float8_e4m3fn``/``float8_e5m2`` dtypes (XLA lowers conversions natively);
+FP6 (e3m2) and FP12 (e4m7) are emulated by mantissa truncation + exponent
+clamping on f32 bit patterns — the same numerics the CUDA kernel computes,
+expressed as vectorizable integer ops XLA fuses.
+
+Layout note: the CUDA path stores FP6 in packed 6-bit lanes for the
+weight-only GEMM; on TPU the MXU consumes bf16, so quantized values are kept
+in byte lanes and dequantized to bf16 at the matmul boundary (XLA fuses the
+dequant into the matmul's operand load).
+
+Scaled variants group the last axis (``group_size``) with one f32 scale per
+group, mirroring ``quantize()``'s q_range scaling (ref fp_quantize.cu).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+_FORMATS = {
+    # name: (exp_bits, man_bits, jnp dtype or None → emulated)
+    "fp8_e4m3": (4, 3, jnp.float8_e4m3fn),
+    "fp8_e5m2": (5, 2, jnp.float8_e5m2),
+    "fp6_e3m2": (3, 2, None),
+    "fp12_e4m7": (4, 7, None),
+}
+
+
+def _emulate_round(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
+    """Round f32 to a small float format by mantissa truncation (round to
+    nearest even) and exponent clamping, returning f32 holding representable
+    values."""
+    xf = x.astype(jnp.float32)
+    bits = jnp.asarray(xf).view(jnp.uint32)
+    drop = 23 - man_bits
+    # round-to-nearest-even on the dropped mantissa bits
+    round_bit = jnp.uint32(1) << (drop - 1)
+    sticky_mask = round_bit - 1
+    lsb = (bits >> drop) & 1
+    rounded = bits + round_bit - 1 + lsb
+    bits = (rounded >> drop) << drop
+    y = bits.view(jnp.float32)
+    # clamp exponent range: bias = 2^(e-1)-1; max normal exponent
+    bias = 2 ** (exp_bits - 1) - 1
+    max_exp = bias
+    max_val = (2.0 - 2.0 ** (-man_bits)) * (2.0 ** max_exp)
+    min_normal = 2.0 ** (1 - bias)
+    y = jnp.clip(y, -max_val, max_val)
+    # subnormals: fixed-point grid of 2^(1-bias-man) below the normal range
+    sub_step = min_normal * 2.0 ** (-man_bits)
+    y_sub = jnp.round(xf / sub_step) * sub_step
+    y = jnp.where(jnp.abs(xf) < min_normal, y_sub, y)
+    return jnp.where(x == 0, 0.0, y).astype(jnp.float32)
+
+
+def fp_quantize(x: jnp.ndarray, fmt: str = "fp8_e4m3",
+                group_size: int = 0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantize to a low-bit float format with optional per-group scaling.
+
+    Returns ``(q, scales)``; ``q`` is the format's dtype (or f32 holding
+    representable values for emulated formats). Ref: fp_quantize.cu
+    quantize().
+    """
+    if fmt not in _FORMATS:
+        raise ValueError(f"unknown fp format {fmt}; have {list(_FORMATS)}")
+    exp_bits, man_bits, dtype = _FORMATS[fmt]
+    xf = x.astype(jnp.float32)
+    if group_size and group_size < xf.shape[-1]:
+        if xf.shape[-1] % group_size != 0:
+            raise ValueError(f"last dim {xf.shape[-1]} % group {group_size} != 0")
+        g = xf.reshape(xf.shape[:-1] + (-1, group_size))
+        bias = 2 ** (exp_bits - 1) - 1
+        max_val = (2.0 - 2.0 ** (-man_bits)) * (2.0 ** bias)
+        absmax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+        scale = jnp.where(absmax == 0, 1.0, absmax / max_val)
+        g = g / scale
+        xf = g.reshape(xf.shape)
+        scales = scale.squeeze(-1)
+    else:
+        scales = jnp.ones(xf.shape[:-1] + (1,), jnp.float32)
+        group_size = xf.shape[-1]
+    if dtype is not None:
+        q = xf.astype(dtype)
+    else:
+        q = _emulate_round(xf, exp_bits, man_bits)
+    return q, scales
+
+
+def fp_dequantize(q: jnp.ndarray, scales: jnp.ndarray, fmt: str = "fp8_e4m3",
+                  dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of :func:`fp_quantize` (ref fp_quantize.cu dequantize)."""
+    xf = q.astype(jnp.float32)
+    group_size = xf.shape[-1] // scales.shape[-1]
+    g = xf.reshape(xf.shape[:-1] + (scales.shape[-1], group_size))
+    out = g * scales[..., None]
+    return out.reshape(xf.shape).astype(dtype)
+
+
+def fp_fake_quantize(x: jnp.ndarray, fmt: str = "fp8_e4m3",
+                     group_size: int = 0) -> jnp.ndarray:
+    """Quantize-dequantize roundtrip (selective_dequant analog)."""
+    q, s = fp_quantize(x, fmt, group_size)
+    return fp_dequantize(q, s, fmt, dtype=x.dtype)

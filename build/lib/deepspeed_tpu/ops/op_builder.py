@@ -1,0 +1,66 @@
+"""Native op builder: JIT-compiles C++ host ops and loads them via ctypes.
+
+Analog of the reference's ``op_builder`` system (OpBuilder.jit_load,
+op_builder/builder.py:544): sources live in ``csrc/``, are compiled with
+g++ on first use into a cache directory, and reloaded from cache afterwards
+(hash of source → .so name).  No torch cpp_extension / pybind11 — plain C
+ABIs consumed with ctypes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from typing import List, Optional
+
+from deepspeed_tpu.utils.logging import logger
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+CSRC_DIR = os.path.join(_REPO_ROOT, "csrc")
+CACHE_DIR = os.environ.get("DSTPU_OPS_CACHE",
+                           os.path.expanduser("~/.cache/deepspeed_tpu/ops"))
+
+
+class OpBuilderError(RuntimeError):
+    pass
+
+
+def _source_hash(paths: List[str], extra: str = "") -> str:
+    h = hashlib.sha256(extra.encode())
+    for p in paths:
+        with open(p, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+def build_op(name: str, sources: List[str],
+             extra_flags: Optional[List[str]] = None) -> ctypes.CDLL:
+    """Compile ``sources`` (relative to csrc/) into lib<name>.so and dlopen it."""
+    srcs = [os.path.join(CSRC_DIR, s) for s in sources]
+    for s in srcs:
+        if not os.path.exists(s):
+            raise OpBuilderError(f"missing source {s}")
+    flags = ["-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+             "-march=native"] + (extra_flags or [])
+    tag = _source_hash(srcs, " ".join(flags))
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    so_path = os.path.join(CACHE_DIR, f"lib{name}-{tag}.so")
+    if not os.path.exists(so_path):
+        cmd = ["g++"] + flags + srcs + ["-o", so_path]
+        logger.info(f"building native op '{name}': {' '.join(cmd)}")
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise OpBuilderError(f"g++ failed for {name}:\n{proc.stderr}")
+    return ctypes.CDLL(so_path)
+
+
+_LOADED = {}
+
+
+def load_op(name: str, sources: List[str],
+            extra_flags: Optional[List[str]] = None) -> ctypes.CDLL:
+    if name not in _LOADED:
+        _LOADED[name] = build_op(name, sources, extra_flags)
+    return _LOADED[name]

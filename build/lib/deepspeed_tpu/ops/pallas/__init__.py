@@ -1,0 +1,9 @@
+"""Repo-owned Pallas TPU kernels.
+
+These are the hand-written kernels backing the hot ops (training flash
+attention, paged decode attention) — the TPU equivalents of the reference's
+``csrc/`` CUDA kernels. Everything here degrades to a numerically equivalent
+XLA path on non-TPU backends.
+"""
+
+from deepspeed_tpu.ops.pallas.flash_mha import flash_mha  # noqa: F401

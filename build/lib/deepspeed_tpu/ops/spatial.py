@@ -1,0 +1,60 @@
+"""Spatial (diffusion) ops — NHWC channels-last conv helpers.
+
+Analog of ``csrc/spatial/`` (channels-last conv + fused bias kernels used
+by the stable-diffusion path).  TPU convolutions are natively NHWC, so the
+"channels-last" transform the reference implements in CUDA is simply the
+default layout here; the fused bias/activation epilogues fold into the
+conv under XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def conv2d_nhwc(x, w, bias=None, stride: Tuple[int, int] = (1, 1),
+                padding: str = "SAME", activation: Optional[str] = None):
+    """x [B, H, W, Cin], w [KH, KW, Cin, Cout] → [B, H', W', Cout]
+    (ref spatial conv wrappers; bias+silu fused epilogue)."""
+    out = lax.conv_general_dilated(
+        x, w.astype(x.dtype), window_strides=stride, padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if bias is not None:
+        out = out + bias.astype(out.dtype)
+    if activation == "silu":
+        out = jax.nn.silu(out)
+    elif activation == "gelu":
+        out = jax.nn.gelu(out)
+    return out
+
+
+def bias_add_nhwc(x, bias):
+    """Fused channel bias add (ref csrc/spatial bias_add)."""
+    return x + bias.astype(x.dtype)
+
+
+def group_norm_nhwc(x, scale, bias, num_groups: int = 32,
+                    eps: float = 1e-5):
+    """GroupNorm over NHWC (diffusion UNet blocks)."""
+    b, h, w, c = x.shape
+    if c % num_groups != 0:
+        raise ValueError(f"channels {c} not divisible by groups {num_groups}")
+    xf = x.astype(jnp.float32).reshape(b, h, w, num_groups, c // num_groups)
+    mean = xf.mean(axis=(1, 2, 4), keepdims=True)
+    var = xf.var(axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mean) * lax.rsqrt(var + eps)
+    out = xf.reshape(b, h, w, c) * scale.astype(jnp.float32) \
+        + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def upsample_nearest_nhwc(x, factor: int = 2):
+    """Nearest-neighbour upsample (diffusion decoder)."""
+    b, h, w, c = x.shape
+    x = jnp.broadcast_to(x[:, :, None, :, None, :],
+                         (b, h, factor, w, factor, c))
+    return x.reshape(b, h * factor, w * factor, c)

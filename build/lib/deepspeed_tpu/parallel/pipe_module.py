@@ -1,0 +1,195 @@
+"""PipelineModule API — LayerSpec-based stage partitioning.
+
+Analog of ``deepspeed/runtime/pipe/module.py`` (``LayerSpec`` :30,
+``TiedLayerSpec`` :77, ``PipelineModule`` :86 with ``_partition_layers``
+:393) and the balanced-partition helpers (``runtime/utils.py``
+``partition_uniform`` :606 / ``partition_balanced`` :627).
+
+The functional layer zoo executes homogeneous stacks through the compiled
+SPMD pipeline (parallel/pipeline.py); this module provides the
+*heterogeneous* LayerSpec surface reference users have: declare arbitrary
+layers, choose a partition method (uniform / parameters / type:regex),
+inspect the stage boundaries, and run the composed forward.  Tied specs
+share one param entry across occurrences (ref TiedLayerSpec embedding
+tying).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Stage boundaries with equal layer counts (ref partition_uniform,
+    runtime/utils.py:606) → len num_parts+1 prefix list."""
+    parts = [0] * (num_parts + 1)
+    chunk = num_items // num_parts
+    rem = num_items % num_parts
+    for p in range(num_parts):
+        parts[p + 1] = parts[p] + chunk + (1 if p < rem else 0)
+    return parts
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int) -> List[int]:
+    """Boundaries minimising the heaviest stage (ref partition_balanced,
+    runtime/utils.py:627 — binary search over the bottleneck weight)."""
+    n = len(weights)
+    if num_parts >= n:
+        return partition_uniform(n, num_parts)
+    prefix = np.concatenate([[0.0], np.cumsum(weights)])
+
+    def parts_needed(limit: float) -> Optional[List[int]]:
+        bounds = [0]
+        start = 0
+        for _ in range(num_parts):
+            # furthest end with sum(weights[start:end]) <= limit
+            end = int(np.searchsorted(prefix, prefix[start] + limit, "right")) - 1
+            if end <= start:
+                return None  # one item alone exceeds limit
+            bounds.append(min(end, n))
+            start = bounds[-1]
+            if start >= n:
+                break
+        if bounds[-1] < n:
+            return None
+        while len(bounds) < num_parts + 1:
+            bounds.append(n)
+        return bounds
+
+    lo = float(max(weights))
+    hi = float(prefix[-1])
+    best = parts_needed(hi)
+    for _ in range(60):
+        mid = (lo + hi) / 2
+        cand = parts_needed(mid)
+        if cand is not None:
+            best, hi = cand, mid
+        else:
+            lo = mid
+    return best
+
+
+class LayerSpec:
+    """Deferred layer (ref LayerSpec): built lazily on the owning stage.
+
+    ``init_fn(key, *args, **kwargs) -> params``;
+    ``apply_fn(params, x) -> x``.  A plain callable (no params) may be
+    passed as ``apply_fn`` with ``init_fn=None``.
+    """
+
+    def __init__(self, apply_fn: Callable, init_fn: Optional[Callable] = None,
+                 *args, **kwargs):
+        self.apply_fn = apply_fn
+        self.init_fn = init_fn
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self, key):
+        if self.init_fn is None:
+            return None
+        return self.init_fn(key, *self.args, **self.kwargs)
+
+    def param_count(self, key) -> int:
+        p = self.build(key)
+        return 0 if p is None else sum(np.size(x) for x in jax.tree.leaves(p))
+
+    @property
+    def typename(self) -> str:
+        return getattr(self.apply_fn, "__name__", type(self.apply_fn).__name__)
+
+
+class TiedLayerSpec(LayerSpec):
+    """Share params across occurrences by ``key`` (ref TiedLayerSpec)."""
+
+    def __init__(self, tied_key: str, apply_fn: Callable,
+                 init_fn: Optional[Callable] = None, *args, **kwargs):
+        super().__init__(apply_fn, init_fn, *args, **kwargs)
+        self.tied_key = tied_key
+
+
+class PipelineModule:
+    """LayerSpec list + partitioning (ref PipelineModule :86).
+
+    ``partition_method``: "uniform" | "parameters" | "type:<regex>" (stage
+    boundaries balance the count of layers whose typename matches).
+    ``num_stages`` defaults to the topology's pipe size (1 without one).
+    """
+
+    def __init__(self, layers: Sequence[LayerSpec], num_stages: int = 1,
+                 partition_method: str = "parameters", seed: int = 0):
+        self.specs = list(layers)
+        self.num_stages = num_stages
+        self.partition_method = partition_method
+        self._key = jax.random.PRNGKey(seed)
+        self.parts = self._partition_layers(partition_method)
+        self.params = self._build_params()
+
+    # ------------------------------------------------------------------
+    def _partition_layers(self, method: str) -> List[int]:
+        n = len(self.specs)
+        m = method.lower()
+        if m == "uniform":
+            return partition_uniform(n, self.num_stages)
+        if m == "parameters":
+            keys = jax.random.split(self._key, n)
+            weights = [max(1, s.param_count(k))
+                       for s, k in zip(self.specs, keys)]
+            return partition_balanced(weights, self.num_stages)
+        if m.startswith("type:"):
+            pat = re.compile(method[len("type:"):], re.IGNORECASE)
+            weights = [1 if pat.search(s.typename) else 0 for s in self.specs]
+            if sum(weights) == 0:
+                raise ValueError(f"no layer matches {method!r}")
+            return partition_balanced([w + 1e-6 for w in weights],
+                                      self.num_stages)
+        raise ValueError(f"unknown partition_method {method!r}")
+
+    def stage_of(self, layer_idx: int) -> int:
+        for s in range(self.num_stages):
+            if self.parts[s] <= layer_idx < self.parts[s + 1]:
+                return s
+        raise IndexError(layer_idx)
+
+    def stage_layers(self, stage: int) -> List[int]:
+        return list(range(self.parts[stage], self.parts[stage + 1]))
+
+    # ------------------------------------------------------------------
+    def _build_params(self) -> Dict[str, Any]:
+        keys = jax.random.split(self._key, len(self.specs))
+        params: Dict[str, Any] = {}
+        self.tied_comms: Dict[str, List[int]] = {}
+        for i, (spec, k) in enumerate(zip(self.specs, keys)):
+            if isinstance(spec, TiedLayerSpec):
+                self.tied_comms.setdefault(spec.tied_key, []).append(i)
+                if spec.tied_key not in params:
+                    params[spec.tied_key] = spec.build(k)
+            else:
+                built = spec.build(k)
+                if built is not None:
+                    params[f"layer_{i}"] = built
+        return params
+
+    def _layer_params(self, params, i: int):
+        spec = self.specs[i]
+        if isinstance(spec, TiedLayerSpec):
+            return params[spec.tied_key]
+        return params.get(f"layer_{i}")
+
+    def __call__(self, params, x):
+        for i, spec in enumerate(self.specs):
+            p = self._layer_params(params, i)
+            x = spec.apply_fn(p, x) if p is not None else spec.apply_fn(x)
+        return x
+
+    def forward_stage(self, params, x, stage: int):
+        """Apply only one stage's layers — the per-stage body handed to
+        spmd_pipeline for homogeneous stacks, or to a manual schedule."""
+        for i in self.stage_layers(stage):
+            p = self._layer_params(params, i)
+            fn = self.specs[i].apply_fn
+            x = fn(p, x) if p is not None else fn(x)
+        return x

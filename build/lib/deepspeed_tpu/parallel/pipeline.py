@@ -1,0 +1,445 @@
+"""Pipeline parallelism via SPMD collective-permute.
+
+TPU-native re-design of ``runtime/pipe/`` (PipelineModule module.py:86,
+PipelineEngine engine.py:337, TrainSchedule schedule.py:189, P2P p2p.py):
+instead of an instruction-schedule interpreter issuing eager P2P sends
+between stage processes, the whole pipeline is ONE ``shard_map`` over the
+"pipe" mesh axis:
+
+* layer params are stacked ``[L, ...]`` and sharded over "pipe", so each
+  stage holds ``L/pp`` layers — the analog of ``PipelineModule``'s layer
+  partitioning ("uniform" method, ref module.py:393);
+* microbatches circulate between stages with ``lax.ppermute`` (ICI
+  neighbour exchange), the analog of SendActivation/RecvActivation
+  (ref engine.py:1016/:1108);
+* :func:`spmd_pipeline` is the forward schedule (GPipe fill-drain as a
+  differentiable ``lax.scan``); finished microbatches **ring-drain**
+  through a single-slot transit buffer to a home stage (``o % pp``), so
+  each stage stores ``ceil(n_micro/pp)`` microbatches, drain traffic is
+  one microbatch per tick, and a single all-gather at the end replaces
+  the old full-buffer psum broadcast.
+* :func:`make_pipeline_train_loss` is the **1F1B** training schedule
+  (ref TrainSchedule, schedule.py:189): a custom-VJP loss whose forward
+  runs a host-precomputed interleaved F/B tick table and produces the
+  gradients itself (each backward tick re-linearizes its stage with
+  ``jax.vjp`` from an O(pp) input stash), so live activations are
+  bounded by pp microbatches per stage instead of n_micro — the defining
+  property of 1F1B — and the outer ``jax.grad`` merely rescales the
+  stashed grads.
+
+Other mesh axes (data/tensor/seq/expert) stay in GSPMD "auto" mode inside
+the shard_map (jax 0.9 ``axis_names``), so pipeline composes with ZeRO/DP/TP
+sharding unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deepspeed_tpu.parallel.topology import PIPE_AXIS, MeshTopology
+
+
+def _drain_schedule(n_micro: int, pp: int):
+    """Static capture schedule for the transit-slot ring drain.
+
+    Finished microbatch ``o`` (emitted by the last stage at tick
+    ``o + pp - 1``) travels the ring one hop per tick in a single-slot
+    transit buffer until it reaches its home stage ``o % pp``, which
+    captures it into row ``o // pp`` of its local (never-permuted) store.
+    Emissions are one per tick and every trip is < pp hops, so at most one
+    item occupies any stage's transit slot at a time — inter-stage drain
+    traffic is one microbatch per tick (the old full-buffer rotation moved
+    ceil(n_micro/pp) of them every tick).
+
+    Returns ``(cap_do [T, pp], cap_row [T, pp], T)`` where tick ``t``'s
+    entries say whether stage ``s`` captures its incoming transit item
+    this tick and into which row; ``T`` includes the post-compute drain
+    ticks that flush the last items home.
+    """
+    compute_ticks = n_micro + pp - 1
+    T = compute_ticks + pp - 1
+    cap_do = np.zeros((T, pp), np.bool_)
+    cap_row = np.zeros((T, pp), np.int32)
+    for o in range(n_micro):
+        home = o % pp
+        hops = (home - (pp - 1)) % pp
+        if hops == 0:
+            continue  # captured directly at emission on the last stage
+        t_arrive = (o + pp - 1) + hops
+        cap_do[t_arrive, home] = True
+        cap_row[t_arrive, home] = o // pp
+    return cap_do, cap_row, T
+
+
+def spmd_pipeline(layer_fn: Callable,
+                  stage_params,
+                  x: jnp.ndarray,
+                  *,
+                  topo: MeshTopology,
+                  n_micro: int,
+                  extras=None):
+    """Run stacked layers over the "pipe" axis in pipelined fashion.
+
+    ``layer_fn(stage_local_params, h, extras_mb) -> (h, aux)`` must apply
+    this stage's layers to a microbatch of activations ``[mb, S, H]``
+    (typically a scan over the local ``L/pp`` stacked layers) and return an
+    auxiliary scalar (e.g. the MoE load-balancing loss; 0 for dense).
+    ``stage_params`` leaves have a leading layer axis sharded over "pipe".
+    ``x``: ``[B, S, H]`` activations after the (replicated) embedding;
+    ``B % n_micro == 0``.  ``extras`` is an optional pytree of per-example
+    side inputs (leading dim B, e.g. RoPE positions); each stage receives
+    the slice belonging to the microbatch it is currently processing.
+
+    Returns ``([B, S, H], aux)`` with activations after all L layers,
+    replicated over the pipe axis, and the auxiliary scalar averaged over
+    microbatches and summed over stages.
+    """
+    pp = topo.pp_size
+    b = x.shape[0]
+    assert b % n_micro == 0, f"batch {b} not divisible by microbatches {n_micro}"
+    mb = b // n_micro
+    extras = extras if extras is not None else ()
+    if pp == 1:
+        return layer_fn(stage_params, x, extras)
+
+    rows = -(-n_micro // pp)
+    cap_do_np, cap_row_np, total_ticks = _drain_schedule(n_micro, pp)
+    compute_ticks = n_micro + pp - 1
+
+    dtype = x.dtype
+
+    def per_stage(stage_local_params, x_local, extras_local):
+        idx = lax.axis_index(PIPE_AXIS)
+        x_local = x_local.astype(dtype)
+        micro = x_local.reshape((n_micro, mb) + x_local.shape[1:])
+        micro_extras = jax.tree.map(
+            lambda e: e.reshape((n_micro, mb) + e.shape[1:]), extras_local)
+        state = jnp.zeros_like(micro[0])
+        # local store of finished microbatches (never permuted) + the
+        # single-slot transit buffer carrying one finished microbatch per
+        # tick toward its home stage o % pp
+        store = jnp.zeros((rows,) + micro.shape[1:], micro.dtype)
+        transit = jnp.zeros_like(micro[0])
+        cap_do = jnp.asarray(cap_do_np)
+        cap_row = jnp.asarray(cap_row_np)
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def drain_step(store, transit, out, t):
+            """Move the transit slot one hop, capture at home stages, and
+            emit this tick's finished microbatch (``out`` on the last
+            stage; it goes straight to the store when home == pp-1)."""
+            transit = lax.ppermute(transit, PIPE_AXIS, perm)
+            o = t - (pp - 1)
+            emit = (idx == pp - 1) & (o >= 0) & (o < n_micro)
+            direct = emit & (o % pp == pp - 1)
+            do_cap = cap_do[t, idx] | direct
+            row = jnp.clip(jnp.where(direct, o // pp, cap_row[t, idx]),
+                           0, rows - 1)
+            val = jnp.where(direct, out.astype(store.dtype), transit)
+            cur = lax.dynamic_index_in_dim(store, row, axis=0, keepdims=False)
+            store = lax.dynamic_update_index_in_dim(
+                store, jnp.where(do_cap, val, cur), row, axis=0)
+            # non-home emissions enter the transit slot
+            transit = jnp.where(emit & ~direct, out.astype(transit.dtype),
+                                transit)
+            return store, transit
+
+        def tick(carry, t):
+            state, store, transit, aux_acc = carry
+            # Stage 0 ingests microbatch t (while t < n_micro); other stages
+            # use what arrived from the previous stage.
+            inp = micro[jnp.minimum(t, n_micro - 1)]
+            feed = jnp.where((idx == 0) & (t < n_micro), 1.0, 0.0).astype(state.dtype)
+            h = feed * inp + (1 - feed) * state
+            # This stage is processing microbatch t - idx right now.
+            cur_mb = jnp.clip(t - idx, 0, n_micro - 1)
+            extras_mb = jax.tree.map(lambda e: e[cur_mb], micro_extras)
+            out, aux = layer_fn(stage_local_params, h, extras_mb)
+            # fill/drain ticks recycle garbage state: only count aux from
+            # ticks where this stage held a real microbatch
+            useful = (t >= idx) & (t - idx < n_micro)
+            aux_acc = aux_acc + jnp.where(useful, aux, 0.0)
+            store, transit = drain_step(store, transit, out, t)
+            state = lax.ppermute(out, PIPE_AXIS, perm)
+            return (state, store, transit, aux_acc), None
+
+        def flush_tick(carry, t):
+            store, transit = carry
+            store, transit = drain_step(store, transit,
+                                        jnp.zeros_like(transit), t)
+            return (store, transit), None
+
+        (state, store, transit, aux_acc), _ = lax.scan(
+            tick, (state, store, transit, jnp.zeros((), jnp.float32)),
+            jnp.arange(compute_ticks))
+        # post-compute ticks flush the last in-flight items home
+        (store, transit), _ = lax.scan(
+            flush_tick, (store, transit),
+            jnp.arange(compute_ticks, total_ticks))
+        # gather every stage's store and restore batch order: microbatch o
+        # lives at (stage o % pp, row o // pp). fp32 across the collective —
+        # its VJP is a reduce-scatter, and a bf16 one aborts XLA CPU's
+        # AllReducePromotion pass.
+        gathered = lax.all_gather(store.astype(jnp.float32), PIPE_AXIS,
+                                  axis=0)                    # [pp, rows, ...]
+        o = np.arange(n_micro)
+        outputs = gathered[o % pp, o // pp].astype(store.dtype)
+        aux = lax.psum(aux_acc, PIPE_AXIS) / n_micro
+        return outputs.reshape(x_local.shape), aux
+
+    from jax.sharding import PartitionSpec as P
+
+    param_specs = jax.tree.map(lambda _: P(PIPE_AXIS), stage_params)
+    extras_specs = jax.tree.map(lambda _: P(), extras)
+    out, aux = jax.shard_map(
+        per_stage,
+        mesh=topo.mesh,
+        in_specs=(param_specs, P(), extras_specs),
+        out_specs=(P(), P()),
+        axis_names={PIPE_AXIS},
+        check_vma=False,
+        # the replicated activation boundary crosses in fp32: the VJP of a
+        # replicated bf16 input is a bf16 psum, which XLA CPU's
+        # AllReducePromotion pass aborts on (and fp32 boundary grads are
+        # what the embedding wants anyway)
+    )(stage_params, x.astype(jnp.float32), extras)
+    return out.astype(dtype), aux
+
+
+# ----------------------------------------------------------------------
+# 1F1B training schedule
+# ----------------------------------------------------------------------
+def _make_1f1b_schedule(pp: int, m: int):
+    """Greedy B-priority 1F1B tick table (ref TrainSchedule,
+    runtime/pipe/schedule.py:189).
+
+    Each tick every stage does one unit of work: a Forward for its next
+    microbatch (if its predecessor's activation has arrived and fewer than
+    pp microbatches are in flight — the 1F1B stash bound) or, preferably, a
+    Backward (if the successor's cotangent has arrived; the last stage
+    needs only its own forward).  Returns ``(wt, wm)`` int32 ``[T, pp]``:
+    work type (0 idle / 1 fwd / 2 bwd) and microbatch index.
+    """
+    next_f = [0] * pp
+    next_b = [0] * pp
+    f_tick = [[-1] * m for _ in range(pp)]
+    b_tick = [[-1] * m for _ in range(pp)]
+    wt_rows, wm_rows = [], []
+    t = 0
+    while min(next_b) < m:
+        wt, wm = [0] * pp, [0] * pp
+        for s in range(pp):
+            ob, of = next_b[s], next_f[s]
+            can_b = ob < m and (
+                (s == pp - 1 and 0 <= f_tick[s][ob] < t)
+                or (s < pp - 1 and 0 <= b_tick[s + 1][ob] < t))
+            can_f = of < m and (of - next_b[s]) < pp and (
+                s == 0 or 0 <= f_tick[s - 1][of] < t)
+            if can_b:
+                wt[s], wm[s] = 2, ob
+                b_tick[s][ob] = t
+                next_b[s] += 1
+            elif can_f:
+                wt[s], wm[s] = 1, of
+                f_tick[s][of] = t
+                next_f[s] += 1
+        wt_rows.append(wt)
+        wm_rows.append(wm)
+        t += 1
+        if t > 4 * (m + pp) + 8:
+            raise RuntimeError("1F1B schedule did not converge")
+    return np.asarray(wt_rows, np.int32), np.asarray(wm_rows, np.int32)
+
+
+def make_pipeline_train_loss(stage_fn: Callable, tail_fn: Callable,
+                             topo: MeshTopology, n_micro: int,
+                             aux_coef: float = 0.0):
+    """Build the 1F1B pipelined training loss.
+
+    ``stage_fn(stage_params, h, extras_mb) -> (h, aux)`` applies one
+    stage's layers; ``tail_fn(tail_params, h, labels_mb) -> nll_sum``
+    computes the summed token NLL of one microbatch on the last stage's
+    output.  The returned callable
+
+        ``loss = f(stage_params, tail_params, x, labels, extras, denom)``
+
+    computes ``sum(nll)/denom + aux_coef * mean_micro(sum_stage(aux))``
+    with a custom VJP: its *forward* runs the interleaved 1F1B tick table
+    (so each stage keeps at most pp stashed microbatch inputs — O(pp)
+    live activations, vs the GPipe scan's O(n_micro) residuals) and
+    already produces the parameter/input gradients; the backward pass
+    just scales them by the incoming cotangent.  ``denom`` is the global
+    valid-token count (computable from labels before any compute).
+    """
+    pp = topo.pp_size
+    wt_np, wm_np = _make_1f1b_schedule(pp, n_micro)
+    ticks = wt_np.shape[0]
+    from jax.sharding import PartitionSpec as P
+
+    def _run(stage_params, tail_params, x, labels, extras, denom):
+        b = x.shape[0]
+        assert b % n_micro == 0
+        mb = b // n_micro
+        dtype = x.dtype
+
+        def per_stage(sp, tp, x_local, labels_local, extras_local):
+            idx = lax.axis_index(PIPE_AXIS)
+            micro = x_local.reshape((n_micro, mb) + x_local.shape[1:])
+            lab_micro = labels_local.reshape((n_micro, mb)
+                                             + labels_local.shape[1:])
+            ex_micro = jax.tree.map(
+                lambda e: e.reshape((n_micro, mb) + e.shape[1:]),
+                extras_local)
+            wt = jnp.asarray(wt_np)
+            wm = jnp.asarray(wm_np)
+            hshape = (mb,) + x_local.shape[1:]
+            fperm = [(i, (i + 1) % pp) for i in range(pp)]
+            bperm = [(i, (i - 1) % pp) for i in range(pp)]
+
+            carry = dict(
+                arr_f=jnp.zeros((pp,) + hshape, dtype),   # arrived activations
+                arr_b=jnp.zeros((pp,) + hshape, dtype),   # arrived cotangents
+                a_in=jnp.zeros((pp,) + hshape, dtype),    # 1F1B input stash
+                state_f=jnp.zeros(hshape, dtype),
+                state_b=jnp.zeros(hshape, dtype),
+                g_sp=jax.tree.map(jnp.zeros_like, sp),
+                g_tp=jax.tree.map(jnp.zeros_like, tp),
+                dx=jnp.zeros((n_micro,) + hshape, jnp.float32),
+                nll=jnp.zeros((), jnp.float32),
+                aux=jnp.zeros((), jnp.float32),
+            )
+
+            def tick(c, t):
+                # deliver last tick's ring arrivals per the schedule
+                left = jnp.clip(idx - 1, 0, pp - 1)
+                right = jnp.clip(idx + 1, 0, pp - 1)
+                tm1 = jnp.maximum(t - 1, 0)
+                got_f = (t > 0) & (idx > 0) & (wt[tm1, left] == 1)
+                got_b = (t > 0) & (idx < pp - 1) & (wt[tm1, right] == 2)
+                sf = wm[tm1, left] % pp
+                sb = wm[tm1, right] % pp
+                arr_f = c["arr_f"].at[sf].set(
+                    jnp.where(got_f, c["state_f"], c["arr_f"][sf]))
+                arr_b = c["arr_b"].at[sb].set(
+                    jnp.where(got_b, c["state_b"], c["arr_b"][sb]))
+
+                my_wt = wt[t, idx]
+                my_m = wm[t, idx]
+                slot = my_m % pp
+                x_mb = micro[my_m]
+                lab_mb = lab_micro[my_m]
+                ex_mb = jax.tree.map(lambda e: e[my_m], ex_micro)
+                h_f_in = jnp.where(idx == 0, x_mb, arr_f[slot])
+
+                def idle(op):
+                    a_in, g_sp, g_tp, dx, nll, aux = op
+                    return (jnp.zeros(hshape, dtype), jnp.zeros(hshape, dtype),
+                            a_in, g_sp, g_tp, dx, nll, aux)
+
+                def fwd_work(op):
+                    a_in, g_sp, g_tp, dx, nll, aux = op
+                    a_in = a_in.at[slot].set(h_f_in)
+                    h_out, _ = stage_fn(sp, h_f_in, ex_mb)
+                    return (h_out.astype(dtype), jnp.zeros(hshape, dtype),
+                            a_in, g_sp, g_tp, dx, nll, aux)
+
+                def bwd_work(op):
+                    a_in, g_sp, g_tp, dx, nll, aux = op
+                    h_in = a_in[slot]
+                    last_stage = idx == pp - 1
+
+                    def stage_plus(sp_, tp_, h_):
+                        h_out, aux_ = stage_fn(sp_, h_, ex_mb)
+                        # the [mb,S,V] head projection + NLL only exists on
+                        # the last stage; other stages skip it entirely
+                        # (no collectives inside, so cond is safe here)
+                        nll_ = lax.cond(
+                            last_stage,
+                            lambda h: tail_fn(tp_, h, lab_mb),
+                            lambda h: jnp.zeros((), jnp.float32),
+                            h_out)
+                        return h_out, aux_, nll_
+
+                    (h_out, aux_v, nll_v), pull = jax.vjp(
+                        stage_plus, sp, tp, h_in)
+                    last = idx == pp - 1
+                    d_h = jnp.where(last, jnp.zeros_like(h_out),
+                                    arr_b[slot].astype(h_out.dtype))
+                    d_aux = jnp.asarray(aux_coef / n_micro, aux_v.dtype)
+                    d_nll = jnp.where(last, 1.0 / denom,
+                                      0.0).astype(nll_v.dtype)
+                    d_sp, d_tp, d_hin = pull((d_h, d_aux, d_nll))
+                    g_sp = jax.tree.map(jnp.add, g_sp, d_sp)
+                    g_tp = jax.tree.map(jnp.add, g_tp, d_tp)
+                    dx = dx.at[my_m].set(
+                        jnp.where(idx == 0, d_hin.astype(jnp.float32),
+                                  dx[my_m]))
+                    nll = nll + jnp.where(last, nll_v.astype(jnp.float32), 0.0)
+                    aux = aux + aux_v.astype(jnp.float32)
+                    return (jnp.zeros(hshape, dtype), d_hin.astype(dtype),
+                            a_in, g_sp, g_tp, dx, nll, aux)
+
+                op = (c["a_in"], c["g_sp"], c["g_tp"], c["dx"], c["nll"],
+                      c["aux"])
+                send_f, send_b, a_in, g_sp, g_tp, dx, nll, aux = lax.switch(
+                    my_wt, [idle, fwd_work, bwd_work], op)
+                return dict(
+                    arr_f=arr_f, arr_b=arr_b, a_in=a_in,
+                    state_f=lax.ppermute(send_f, PIPE_AXIS, fperm),
+                    state_b=lax.ppermute(send_b, PIPE_AXIS, bperm),
+                    g_sp=g_sp, g_tp=g_tp, dx=dx, nll=nll, aux=aux), None
+
+            c, _ = lax.scan(tick, carry, jnp.arange(ticks))
+            nll = lax.psum(c["nll"], PIPE_AXIS)          # last stage only
+            aux = lax.psum(c["aux"], PIPE_AXIS) / n_micro
+            loss = nll / denom + aux_coef * aux
+            g_tp = jax.tree.map(lambda a: lax.psum(a, PIPE_AXIS), c["g_tp"])
+            dx = lax.psum(c["dx"], PIPE_AXIS)            # stage 0 only
+            return loss, c["g_sp"], g_tp, dx.reshape(x_local.shape)
+
+        sp_specs = jax.tree.map(lambda _: P(PIPE_AXIS), stage_params)
+        tp_specs = jax.tree.map(lambda _: P(), tail_params)
+        ex_specs = jax.tree.map(lambda _: P(), extras)
+        return jax.shard_map(
+            per_stage,
+            mesh=topo.mesh,
+            in_specs=(sp_specs, tp_specs, P(), P(), ex_specs),
+            out_specs=(P(), sp_specs, tp_specs, P()),
+            axis_names={PIPE_AXIS},
+            check_vma=False,
+        )(stage_params, tail_params, x, labels, extras)
+
+    @jax.custom_vjp
+    def f(stage_params, tail_params, x, labels, extras, denom):
+        # loss-only (non-differentiated) calls — e.g. eval_batch — take the
+        # plain GPipe forward instead of paying the full fwd+bwd tick table;
+        # mathematically identical: tail NLL is per-token additive, and
+        # spmd_pipeline's aux is the same psum/n_micro statistic
+        def wrap(sp, h, ex):
+            return stage_fn(sp, h, ex)
+
+        h, aux = spmd_pipeline(wrap, stage_params, x, topo=topo,
+                               n_micro=n_micro, extras=extras)
+        return tail_fn(tail_params, h, labels) / denom + aux_coef * aux
+
+    def f_fwd(stage_params, tail_params, x, labels, extras, denom):
+        loss, g_sp, g_tp, dx = _run(stage_params, tail_params, x, labels,
+                                    extras, denom)
+        return loss, (g_sp, g_tp, dx.astype(x.dtype))
+
+    def f_bwd(res, g):
+        g_sp, g_tp, dx = res
+
+        def scale(tree):
+            return jax.tree.map(lambda a: (a * g).astype(a.dtype), tree)
+
+        return (scale(g_sp), scale(g_tp), scale(dx), None, None, None)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f

@@ -1,0 +1,202 @@
+"""Parameter sharding rules: ZeRO stages + tensor parallelism as PartitionSpecs.
+
+This module is the TPU-native core of what the reference spreads across
+``runtime/zero/partition_parameters.py`` (ZeRO-3 param partitioning),
+``runtime/zero/stage_1_and_2.py`` (optimizer/grad partitioning) and
+``module_inject/auto_tp.py`` (AutoTP tensor-parallel sharding):
+
+* Every parameter path maps to a tuple of **logical dims** (table below).
+* Logical dims map to mesh axes depending on the active config:
+    - ``tensor``-class dims (attention heads out, ffn, vocab) → "tensor" axis
+      (Megatron column/row parallel layout, ref module_inject/layers.py).
+    - the designated **fsdp dim** → the ZeRO axes ("data","expert","seq")
+      when stage == 3 (param partitioning, ref partition_parameters.py:1644);
+      unsharded otherwise.
+    - "expert" dim (stacked expert weights) → "expert" axis
+      (ref groups._create_expert_and_data_parallel, groups.py:240).
+* Optimizer state reuses the stage-3 spec whenever stage >= 1 — partitioned
+  optimizer states are exactly ZeRO-1 (ref stage_1_and_2.py:125).
+* The gradient-accumulation buffer uses the stage-3 spec when stage >= 2 —
+  partitioned gradients are ZeRO-2.
+
+XLA then inserts the all-gather / reduce-scatter collectives that the
+reference issues eagerly, and its latency-hiding scheduler replaces the
+prefetch coordinator (ref partitioned_param_coordinator.py).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import (DATA_AXIS, EXPERT_AXIS, PIPE_AXIS, SEQ_AXIS,
+                                             SUBDATA_AXIS, TENSOR_AXIS, MeshTopology)
+from deepspeed_tpu.utils.logging import logger
+
+# path-pattern → logical dims, one entry per array dim.
+# Logical dim vocabulary:
+#   layer   — stacked-layer scan axis (never sharded)
+#   expert  — stacked-expert axis → "expert" mesh axis
+#   embed   — hidden/residual dim  → fsdp candidate
+#   mlp     — ffn intermediate dim → "tensor" (column-parallel)
+#   heads   — attention projection out dim → "tensor" (column-parallel)
+#   vocab   — vocabulary dim → "tensor"
+#   norm    — layernorm vector → fsdp candidate (1-D, ZeRO-3 shards these too)
+#   pos     — position-embedding rows
+DEFAULT_RULES: List[Tuple[str, Tuple[Optional[str], ...]]] = [
+    (r"embed/tokens$", ("vocab", "embed")),
+    (r"embed/positions$", ("pos", "embed")),
+    (r"attn/w[qkv]$", ("layer", "embed", "heads")),
+    (r"attn/b[qkv]$", ("layer", "heads")),
+    (r"attn/wo$", ("layer", "heads", "embed")),
+    (r"attn/bo$", ("layer", "embed")),
+    (r"mlp/w[ig]$", ("layer", "embed", "mlp")),
+    (r"mlp/bi$", ("layer", "mlp")),
+    (r"mlp/wo$", ("layer", "mlp", "embed")),
+    (r"mlp/bo$", ("layer", "embed")),
+    (r"moe/router$", ("layer", "embed", None)),
+    (r"moe/w[ig]$", ("layer", "expert", "embed", "mlp")),
+    (r"moe/wo$", ("layer", "expert", "mlp", "embed")),
+    (r"ln\d/(scale|bias)$", ("layer", "norm")),
+    (r"final_norm/(scale|bias)$", ("norm",)),
+    (r"lm_head$", ("embed", "vocab")),
+]
+
+
+def path_str(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+class ShardingRules:
+    """Resolves param paths to NamedShardings for a given topology + config."""
+
+    def __init__(self, topology: MeshTopology, zero_stage: int = 0,
+                 rules: Optional[List[Tuple[str, Tuple[Optional[str], ...]]]] = None,
+                 shard_norms: bool = True, secondary_mode: str = "none"):
+        """``secondary_mode``: hierarchical partitioning over the factored
+        (data=outer, subdata=inner) DP world —
+          "hpz"  — ZeRO++ secondary partition: PARAMS shard only over the
+                   inner axes (within-node gather rides ICI), optimizer/grad
+                   state still shards over the full ZeRO world
+                   (ref zero_hpz_partition_size, runtime/zero/config.py:300);
+          "mics" — MiCS: params AND optimizer/grad state shard only within
+                   the sub-group; the outer data axis is pure replication
+                   with (XLA-inserted) hierarchical gradient allreduce
+                   (ref MiCS_Init/MiCS_Optimizer, runtime/zero/mics.py).
+        """
+        self.topo = topology
+        self.zero_stage = zero_stage
+        self.rules = [(re.compile(pat), dims) for pat, dims in (rules or DEFAULT_RULES)]
+        self.shard_norms = shard_norms
+        if secondary_mode not in ("none", "hpz", "mics"):
+            raise ValueError(f"secondary_mode {secondary_mode!r}")
+        self.secondary_mode = secondary_mode
+
+    # ------------------------------------------------------------------
+    def _fsdp_axes(self, is_expert_param: bool,
+                   param_style: bool) -> Tuple[str, ...]:
+        if self.secondary_mode == "mics" or (self.secondary_mode == "hpz"
+                                             and param_style):
+            candidates = (SUBDATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
+        else:
+            candidates = (DATA_AXIS, SUBDATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
+        axes = []
+        for ax in candidates:
+            if is_expert_param and ax == EXPERT_AXIS:
+                continue  # expert dim already consumes the expert axis
+            if self.topo.axis_size(ax) > 1:
+                axes.append(ax)
+        return tuple(axes)
+
+    def _logical_dims(self, path: str, ndim: int) -> Optional[Tuple[Optional[str], ...]]:
+        for pat, dims in self.rules:
+            if pat.search(path):
+                if len(dims) != ndim:
+                    logger.warning(f"sharding rule for '{path}' has {len(dims)} dims, "
+                                   f"array has {ndim}; replicating")
+                    return None
+                return dims
+        return None
+
+    def spec_for(self, path: str, shape: Tuple[int, ...],
+                 param_style: bool = True) -> P:
+        """PartitionSpec for a parameter array.
+
+        ``param_style=True`` applies stage-3 fsdp sharding only when
+        zero_stage == 3; pass False to get the always-fsdp spec used for
+        optimizer state (stage>=1) and grad accumulators (stage>=2).
+        """
+        ndim = len(shape)
+        dims = self._logical_dims(path, ndim)
+        if dims is None:
+            return P()
+        is_expert = "expert" in dims
+        fsdp_axes = self._fsdp_axes(is_expert, param_style)
+        apply_fsdp = bool(fsdp_axes) and (not param_style or self.zero_stage >= 3)
+        tp = self.topo.tp_size > 1
+
+        spec: List[Any] = [None] * ndim
+        for i, d in enumerate(dims):
+            if d == "layer" and self.topo.pp_size > 1:
+                # stacked-layer axis → pipeline stages (ref PipelineModule
+                # uniform partitioning, runtime/pipe/module.py:393)
+                if shape[i] % self.topo.pp_size == 0:
+                    spec[i] = PIPE_AXIS
+            elif d == "expert" and self.topo.ep_size > 1:
+                if shape[i] % self.topo.ep_size == 0:
+                    spec[i] = EXPERT_AXIS
+            elif d in ("mlp", "heads", "vocab") and tp:
+                if shape[i] % self.topo.tp_size == 0:
+                    spec[i] = TENSOR_AXIS
+
+        if apply_fsdp:
+            n_shard = int(np.prod([self.topo.axis_size(a) for a in fsdp_axes]))
+            # Prefer the designated fsdp dim ("embed" / "norm" / "pos"),
+            # falling back to any unsharded divisible dim.
+            candidates = [i for i, d in enumerate(dims)
+                          if d in ("embed", "norm", "pos") and spec[i] is None]
+            if not self.shard_norms:
+                candidates = [i for i in candidates if dims[i] != "norm"]
+            candidates += [i for i, d in enumerate(dims)
+                           if d in ("mlp", "heads", "vocab") and spec[i] is None]
+            for i in candidates:
+                if shape[i] % n_shard == 0:
+                    spec[i] = fsdp_axes if len(fsdp_axes) > 1 else fsdp_axes[0]
+                    break
+        return P(*spec)
+
+    # ------------------------------------------------------------------
+    def tree_specs(self, params, param_style: bool = True):
+        """Pytree of PartitionSpecs matching ``params``."""
+        def leaf_spec(path, leaf):
+            return self.spec_for(path_str(path), np.shape(leaf), param_style=param_style)
+
+        return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+    def tree_shardings(self, params, param_style: bool = True):
+        specs = self.tree_specs(params, param_style=param_style)
+        return jax.tree.map(lambda s: NamedSharding(self.topo.mesh, s), specs,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def param_shardings(self, params):
+        return self.tree_shardings(params, param_style=True)
+
+    def optimizer_shardings(self, params):
+        """Optimizer-state sharding: partitioned when stage >= 1 (ZeRO-1)."""
+        return self.tree_shardings(params, param_style=self.zero_stage < 1)
+
+    def grad_accum_shardings(self, params):
+        """Grad-accumulator sharding: partitioned when stage >= 2 (ZeRO-2)."""
+        return self.tree_shardings(params, param_style=self.zero_stage < 2)

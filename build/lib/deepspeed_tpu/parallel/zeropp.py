@@ -1,0 +1,76 @@
+"""ZeRO++ — quantized & hierarchical ZeRO communication.
+
+TPU-native realisation of the three ZeRO++ techniques (ref
+``runtime/zero/config.py:300-313``, ``csrc/quantization/swizzled_quantize.cu``,
+``runtime/comm/coalesced_collectives.py:31``):
+
+* **qwZ** (``zero_quantized_weights``): the stage-3 parameter all-gather
+  moves int8 blocks + scales instead of bf16.  Here the param shard is
+  block-quantized while still sharded, the *int8* arrays are resharded to
+  the gathered layout (XLA lowers that constraint to an all-gather of the
+  int8 payload — the qwZ bandwidth win), then dequantized locally.
+  Gradients flow straight-through to the original params.
+* **hpZ** (``zero_hpz_partition_size``): params shard only over the inner
+  ("subdata") factor of the DP world and replicate across the outer factor,
+  so fwd/bwd gathers ride ICI within a node — realised purely as shardings
+  (see ShardingRules.secondary_mode="hpz", parallel/sharding.py).
+* **qgZ** (``zero_quantized_gradients``): int8 two-level all-to-all gradient
+  reduction — ``comm/coalesced_collectives.all_to_all_quant_reduce``; the
+  engine's compressed-DP mode wires it into the train step.
+
+MiCS (ref runtime/zero/mics.py) reuses the same factored mesh with
+secondary_mode="mics": params AND optimizer state shard within the
+sub-group only.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.ops.quantizer import dequantize_blockwise, quantize_blockwise
+from deepspeed_tpu.parallel.sharding import ShardingRules
+
+
+def gathered_rules(rules: ShardingRules) -> ShardingRules:
+    """Sharding rules for the *gathered* (compute-time) layout: tensor/
+    pipe/expert sharding kept, ZeRO fsdp sharding removed."""
+    return ShardingRules(rules.topo, zero_stage=0,
+                         rules=[(p.pattern, d) for p, d in rules.rules],
+                         shard_norms=rules.shard_norms)
+
+
+def qwz_weight_gather(params: Any, rules: ShardingRules,
+                      num_bits: int = 8, group_size: int = 256) -> Any:
+    """Quantized stage-3 weight gather with straight-through gradients.
+
+    Apply inside the jitted train step to the (fsdp-sharded) params before
+    the loss: the resharding constraint sits between quantize and
+    dequantize, so the all-gather XLA inserts moves int8+scales — the same
+    wire format as qwZ's quantized_gather (ref partition_parameters.py:823
+    CUDAQuantizer + all_gather_coalesced).
+    """
+    g_rules = gathered_rules(rules)
+    mesh = rules.topo.mesh
+
+    def one(path, p):
+        if p.ndim == 0 or p.size < group_size:
+            return p
+        from deepspeed_tpu.parallel.sharding import path_str
+
+        spec = g_rules.spec_for(path_str(path), p.shape, param_style=True)
+        gs = group_size if p.shape[-1] % group_size == 0 else p.shape[-1]
+        q, s, _ = quantize_blockwise(p.astype(jnp.float32), num_bits, gs)
+        q = lax.with_sharding_constraint(q, NamedSharding(mesh, spec))
+        s_spec = P(*(list(spec)[:-1] + [None])) if len(spec) else P()
+        s = lax.with_sharding_constraint(s, NamedSharding(mesh, s_spec))
+        w = dequantize_blockwise(q, s, num_bits=num_bits).astype(p.dtype)
+        # straight-through: forward sees quantized-gathered weights, grads
+        # flow to the master param untouched
+        return p + lax.stop_gradient(w - p)
+
+    return jax.tree_util.tree_map_with_path(one, params)

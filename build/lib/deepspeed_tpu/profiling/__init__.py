@@ -1,0 +1,7 @@
+"""Profiling: XLA-cost-analysis flops profiler (ref deepspeed/profiling/)."""
+
+from deepspeed_tpu.profiling.flops_profiler import (FlopsProfiler,
+                                                    get_model_profile, mfu,
+                                                    profile_compiled)
+
+__all__ = ["FlopsProfiler", "get_model_profile", "mfu", "profile_compiled"]

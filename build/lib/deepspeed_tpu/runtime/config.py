@@ -1,0 +1,528 @@
+"""DeepSpeed-compatible JSON config → typed config objects.
+
+TPU-native re-design of the reference config system
+(``deepspeed/runtime/config.py`` + ``runtime/config_utils.py`` +
+``runtime/zero/config.py``).  A single JSON document (path or dict) with the
+same key surface as DeepSpeed produces a ``DeepSpeedConfig`` instance; batch
+sizes are resolved with the same divisibility rules
+(``train_batch_size == micro_batch * gradient_accumulation_steps * dp_world``).
+
+TPU extensions live under the ``"mesh"`` key (explicit axis sizes) but every
+reference key keeps its meaning, so an existing ``ds_config.json`` ports
+unchanged.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import json
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.utils.logging import logger
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+def _filter_kwargs(cls, data: Dict[str, Any], context: str) -> Dict[str, Any]:
+    """Keep only keys that are fields of ``cls``; warn about the rest."""
+    valid = {f.name for f in fields(cls)}
+    out = {}
+    for k, v in data.items():
+        if k in valid:
+            out[k] = v
+        else:
+            logger.warning(f"Config: ignoring unknown key '{k}' in '{context}'")
+    return out
+
+
+def _from_dict(cls, data: Optional[Dict[str, Any]], context: str):
+    data = data or {}
+    if not isinstance(data, dict):
+        raise DeepSpeedConfigError(f"'{context}' must be a dict, got {type(data)}")
+    return cls(**_filter_kwargs(cls, data, context))
+
+
+@dataclass
+class OptimizerConfig:
+    """``"optimizer": {"type": ..., "params": {...}}``"""
+    type: str = C.ADAMW_OPTIMIZER
+    params: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        self.type = self.type.lower()
+
+    @property
+    def lr(self) -> float:
+        return float(self.params.get("lr", 1e-3))
+
+
+@dataclass
+class SchedulerConfig:
+    """``"scheduler": {"type": ..., "params": {...}}``"""
+    type: str = "WarmupLR"
+    params: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class FP16Config:
+    """Reference: ``runtime/fp16`` config block. ``loss_scale == 0`` means
+    dynamic loss scaling (DynamicLossScaler, ref loss_scaler.py:99)."""
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+    fp16_master_weights_and_grads: bool = False
+
+    @property
+    def dynamic(self) -> bool:
+        return self.loss_scale == 0
+
+
+@dataclass
+class BF16Config:
+    enabled: bool = False
+    immediate_grad_update: bool = True
+    check_grad_overflow: bool = False
+
+
+@dataclass
+class OffloadParamConfig:
+    """Ref: runtime/zero/offload_config.py (DeepSpeedZeroOffloadParamConfig)."""
+    device: str = "none"  # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    buffer_count: int = 5
+    buffer_size: int = 100_000_000
+    max_in_cpu: int = 1_000_000_000
+    pin_memory: bool = False
+
+
+@dataclass
+class OffloadOptimizerConfig:
+    """Ref: runtime/zero/offload_config.py (DeepSpeedZeroOffloadOptimizerConfig)."""
+    device: str = "none"  # none | cpu | nvme
+    nvme_path: Optional[str] = None
+    buffer_count: int = 4
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = 1.0  # TwinFlow/Offload++ partial offload fraction
+
+
+@dataclass
+class ZeroConfig:
+    """Ref: ``DeepSpeedZeroConfig`` (runtime/zero/config.py).
+
+    On TPU the stages map to sharding specs over the (data×fsdp) mesh axes:
+      stage 0 → replicated params/grads/opt-state (pure DP)
+      stage 1 → optimizer state sharded
+      stage 2 → optimizer state + gradients sharded (reduce-scatter semantics)
+      stage 3 → params also sharded; XLA inserts gather/release collectives
+    Bucket-size knobs are accepted for compat; XLA's latency-hiding scheduler
+    replaces the IPG bucketing machinery.
+    """
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = 500_000_000
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = 500_000_000
+    overlap_comm: Optional[bool] = None
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+    offload_param: Optional[OffloadParamConfig] = None
+    offload_optimizer: Optional[OffloadOptimizerConfig] = None
+    sub_group_size: int = 1_000_000_000
+    cpu_offload: Optional[bool] = None  # deprecated alias
+    cpu_offload_params: Optional[bool] = None  # deprecated alias
+    prefetch_bucket_size: int = 50_000_000
+    param_persistence_threshold: int = 100_000
+    model_persistence_threshold: int = 2 ** 63 - 1
+    max_live_parameters: int = 1_000_000_000
+    max_reuse_distance: int = 1_000_000_000
+    gather_16bit_weights_on_model_save: bool = False
+    use_all_reduce_for_fetch_params: bool = False
+    stage3_gather_16bit_weights_on_model_save: Optional[bool] = None
+    ignore_unused_parameters: bool = True
+    legacy_stage1: bool = False
+    round_robin_gradients: bool = False
+    # ZeRO++ knobs (ref runtime/zero/config.py:300-313)
+    zero_hpz_partition_size: int = 1
+    zero_quantized_weights: bool = False
+    zero_quantized_nontrainable_weights: bool = False
+    zero_quantized_gradients: bool = False
+    # MiCS (ref runtime/zero/mics.py)
+    mics_shard_size: int = -1
+    mics_hierarchical_params_gather: bool = False
+    memory_efficient_linear: bool = True
+    pipeline_loading_checkpoint: bool = False
+    override_module_apply: bool = True
+    log_trace_cache_warnings: bool = False
+
+    def __post_init__(self):
+        if isinstance(self.offload_param, dict):
+            self.offload_param = _from_dict(OffloadParamConfig, self.offload_param,
+                                            "zero_optimization.offload_param")
+        if isinstance(self.offload_optimizer, dict):
+            self.offload_optimizer = _from_dict(OffloadOptimizerConfig, self.offload_optimizer,
+                                                "zero_optimization.offload_optimizer")
+        # deprecated aliases from older DeepSpeed configs
+        if self.cpu_offload and self.offload_optimizer is None:
+            self.offload_optimizer = OffloadOptimizerConfig(device="cpu")
+        if self.cpu_offload_params and self.offload_param is None:
+            self.offload_param = OffloadParamConfig(device="cpu")
+        if self.stage3_gather_16bit_weights_on_model_save is not None:
+            self.gather_16bit_weights_on_model_save = self.stage3_gather_16bit_weights_on_model_save
+        if not 0 <= self.stage <= 3:
+            raise DeepSpeedConfigError(f"zero_optimization.stage must be in [0,3], got {self.stage}")
+
+    @property
+    def offload_optimizer_device(self) -> str:
+        return self.offload_optimizer.device if self.offload_optimizer else "none"
+
+    @property
+    def offload_param_device(self) -> str:
+        return self.offload_param.device if self.offload_param else "none"
+
+
+@dataclass
+class ActivationCheckpointingConfig:
+    """Ref: runtime/activation_checkpointing/config. On TPU this selects the
+    ``jax.checkpoint`` (remat) policy applied to each transformer block."""
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU extension: jax remat policy name
+    # (full | nothing_saveable | dots_saveable | dots_with_no_batch_dims_saveable | offload_dots)
+    remat_policy: str = "nothing_saveable"
+
+
+@dataclass
+class DataEfficiencyConfig:
+    """Ref: data_efficiency JSON block (runtime/data_pipeline/config.py):
+    curriculum learning under data_sampling, random-LTD under data_routing.
+    Legacy top-level ``curriculum_learning`` is also accepted."""
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: Dict[str, Any] = field(default_factory=dict)
+    data_routing: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def curriculum_config(self) -> Optional[Dict[str, Any]]:
+        cl = (self.data_sampling or {}).get("curriculum_learning", {})
+        if cl.get("enabled"):
+            # single-metric shorthand or per-metric "curriculum_metrics"
+            metrics = cl.get("curriculum_metrics")
+            if metrics:
+                return next(iter(metrics.values()))
+            return cl
+        return None
+
+    @property
+    def random_ltd_config(self) -> Optional[Dict[str, Any]]:
+        rl = (self.data_routing or {}).get("random_ltd", {})
+        return rl if rl.get("enabled") else None
+
+
+@dataclass
+class MonitorBackendConfig:
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedJobName"
+    # wandb/comet extras
+    team: Optional[str] = None
+    group: Optional[str] = None
+    project: Optional[str] = None
+    experiment_name: Optional[str] = None
+    api_key: Optional[str] = None
+    workspace: Optional[str] = None
+    mode: Optional[str] = None
+    samples_log_interval: int = 100
+
+
+@dataclass
+class FlopsProfilerConfig:
+    enabled: bool = False
+    recompute_fwd_factor: float = 0.0
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+@dataclass
+class CommsLoggerConfig:
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = field(default_factory=list)
+
+
+@dataclass
+class TensorParallelConfig:
+    """Ref: runtime/tensor_parallel config + AutoTP. ``autotp_size`` sets the
+    mesh "tensor" axis; sharding rules come from the model's param-path
+    patterns (AutoTP-equivalent, module_inject/auto_tp.py:193)."""
+    enabled: bool = True
+    autotp_size: int = 1
+    tp_size: Optional[int] = None
+    tp_grain_size: int = 64
+
+    @property
+    def size(self) -> int:
+        return int(self.tp_size or self.autotp_size or 1)
+
+
+@dataclass
+class PipelineConfig:
+    """Ref: runtime/pipe. ``stages`` sets the mesh "pipe" axis."""
+    stages: int = 1
+    partition_method: str = "parameters"
+    activation_checkpoint_interval: int = 0
+    pipe_partitioned: bool = True
+    grad_partitioned: bool = True
+    num_microbatches: Optional[int] = None
+
+
+@dataclass
+class MeshConfig:
+    """TPU extension: explicit logical mesh axis sizes.
+
+    Any axis set to -1 is inferred so the product equals the device count.
+    Axis semantics (outer→inner, DCN-friendly axes first):
+      pipe   — pipeline stages            (ref: runtime/pipe/topology.py)
+      data   — pure data parallel / ZeRO  (ref: DP groups, groups.py)
+      expert — expert parallel subdivision of data (ref: groups.py:240)
+      seq    — Ulysses sequence parallel  (ref: sequence/layer.py)
+      tensor — tensor/model parallel      (ref: AutoTP)
+    """
+    pipe: int = 1
+    data: int = -1
+    expert: int = 1
+    seq: int = 1
+    tensor: int = 1
+
+    def sizes(self) -> Dict[str, int]:
+        return {"pipe": self.pipe, "data": self.data, "expert": self.expert,
+                "seq": self.seq, "tensor": self.tensor}
+
+    def resolved(self, n_devices: int) -> Dict[str, int]:
+        """Delegates to the topology resolver so config and MeshTopology can
+        never disagree on mesh semantics."""
+        from deepspeed_tpu.parallel.topology import resolve_mesh_sizes
+
+        try:
+            return resolve_mesh_sizes(self.sizes(), n_devices)
+        except ValueError as e:
+            raise DeepSpeedConfigError(str(e)) from e
+
+
+@dataclass
+class CheckpointConfig:
+    """Ref: runtime/config checkpoint block + checkpoint_engine selection."""
+    tag_validation: str = "Warn"  # Ignore | Warn | Fail
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write_pipeline: bool = False
+    async_save: bool = False
+    writer: Optional[Dict[str, Any]] = None
+
+
+@dataclass
+class AIOConfig:
+    """Ref: op_builder/async_io.py + deepspeed/runtime/swap_tensor/constants.py."""
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+    use_gds: bool = False
+    use_direct: bool = False  # O_DIRECT data path (bypass the page cache)
+
+
+class DeepSpeedConfig:
+    """Parsed + validated config. Accepts a JSON path or a dict.
+
+    Ref: ``DeepSpeedConfig`` (runtime/config.py). ``world_size`` here is the
+    *data-parallel* world (dp×expert axes), used for batch resolution exactly
+    like the reference's ``dp_world_size``.
+    """
+
+    def __init__(self, config: Union[str, Dict[str, Any], None],
+                 world_size: Optional[int] = 1,
+                 n_devices: Optional[int] = None):
+        if config is None:
+            config = {}
+        if isinstance(config, str):
+            with open(config, "r") as f:
+                config = json.load(f)
+        if not isinstance(config, dict):
+            raise DeepSpeedConfigError(f"config must be a dict or JSON path, got {type(config)}")
+        self._param_dict = copy.deepcopy(config)
+        self.world_size = world_size
+
+        d = self._param_dict
+        # -- batch sizes (resolved below) --
+        self.train_batch_size: Optional[int] = d.get(C.TRAIN_BATCH_SIZE)
+        self.train_micro_batch_size_per_gpu: Optional[int] = d.get(C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        self.gradient_accumulation_steps: Optional[int] = d.get(C.GRADIENT_ACCUMULATION_STEPS)
+
+        # -- sub-configs --
+        opt = d.get(C.OPTIMIZER)
+        self.optimizer: Optional[OptimizerConfig] = (
+            _from_dict(OptimizerConfig, opt, "optimizer") if opt is not None else None)
+        sched = d.get(C.SCHEDULER)
+        self.scheduler: Optional[SchedulerConfig] = (
+            _from_dict(SchedulerConfig, sched, "scheduler") if sched is not None else None)
+        self.fp16 = _from_dict(FP16Config, d.get(C.FP16), "fp16")
+        bf16_dict = d.get(C.BFLOAT16, d.get(C.BFLOAT16_OLD))
+        self.bf16 = _from_dict(BF16Config, bf16_dict, "bf16")
+        if self.fp16.enabled and self.bf16.enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        self.zero_config = _from_dict(ZeroConfig, d.get(C.ZERO_OPTIMIZATION), "zero_optimization")
+        self.activation_checkpointing = _from_dict(
+            ActivationCheckpointingConfig, d.get(C.ACTIVATION_CHECKPOINTING), "activation_checkpointing")
+        self.tensorboard = _from_dict(MonitorBackendConfig, d.get(C.TENSORBOARD), "tensorboard")
+        self.wandb = _from_dict(MonitorBackendConfig, d.get(C.WANDB), "wandb")
+        self.csv_monitor = _from_dict(MonitorBackendConfig, d.get(C.CSV_MONITOR), "csv_monitor")
+        self.comet = _from_dict(MonitorBackendConfig, d.get(C.COMET), "comet")
+        self.flops_profiler = _from_dict(FlopsProfilerConfig, d.get(C.FLOPS_PROFILER), "flops_profiler")
+        self.comms_logger = _from_dict(CommsLoggerConfig, d.get(C.COMMS_LOGGER), "comms_logger")
+        self.tensor_parallel = _from_dict(TensorParallelConfig, d.get(C.TENSOR_PARALLEL), "tensor_parallel")
+        self.pipeline = _from_dict(PipelineConfig, d.get(C.PIPELINE), "pipeline")
+        self.checkpoint_config = _from_dict(CheckpointConfig, d.get(C.CHECKPOINT), "checkpoint")
+        self.aio_config = _from_dict(AIOConfig, d.get("aio"), "aio")
+        de = d.get(C.DATA_EFFICIENCY)
+        if de is None and d.get(C.CURRICULUM_LEARNING_LEGACY, {}).get("enabled"):
+            # legacy top-level curriculum_learning block → wrap it
+            de = {"enabled": True,
+                  "data_sampling": {"curriculum_learning":
+                                    d[C.CURRICULUM_LEARNING_LEGACY]}}
+        self.data_efficiency = _from_dict(DataEfficiencyConfig, de,
+                                          "data_efficiency")
+
+        # -- mesh --
+        mesh_dict = dict(d.get(C.MESH) or {})
+        if "tensor" not in mesh_dict and self.tensor_parallel.size > 1:
+            mesh_dict["tensor"] = self.tensor_parallel.size
+        if "seq" not in mesh_dict and d.get(C.SEQUENCE_PARALLEL_SIZE):
+            mesh_dict["seq"] = int(d[C.SEQUENCE_PARALLEL_SIZE])
+        if "pipe" not in mesh_dict and self.pipeline.stages > 1:
+            mesh_dict["pipe"] = self.pipeline.stages
+        if "expert" not in mesh_dict and d.get(C.EXPERT_PARALLEL_SIZE):
+            mesh_dict["expert"] = int(d[C.EXPERT_PARALLEL_SIZE])
+        self.mesh = _from_dict(MeshConfig, mesh_dict, "mesh")
+
+        # -- scalars --
+        self.gradient_clipping: float = float(d.get(C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT))
+        self.prescale_gradients: bool = bool(d.get(C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT))
+        self.gradient_predivide_factor: float = float(
+            d.get(C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT))
+        self.steps_per_print: int = int(d.get(C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT))
+        self.wall_clock_breakdown: bool = bool(d.get(C.WALL_CLOCK_BREAKDOWN, False))
+        self.memory_breakdown: bool = bool(d.get(C.MEMORY_BREAKDOWN, False))
+        self.dump_state: bool = bool(d.get(C.DUMP_STATE, False))
+        self.zero_allow_untested_optimizer: bool = bool(d.get(C.ZERO_ALLOW_UNTESTED_OPTIMIZER, False))
+        self.communication_data_type: Optional[str] = d.get(C.COMMUNICATION_DATA_TYPE)
+        self.sparse_gradients_enabled: bool = bool(d.get(C.SPARSE_GRADIENTS, False))
+        self.load_universal_checkpoint: bool = bool(
+            d.get(C.LOAD_UNIVERSAL_CHECKPOINT, self.checkpoint_config.load_universal))
+        self.dataloader_drop_last: bool = bool(d.get(C.DATALOADER_DROP_LAST, False))
+        self.seed: int = int(d.get("seed", 42))
+        self.gradient_accumulation_dtype: str = d.get("data_types", {}).get(
+            "grad_accum_dtype", "fp32") if isinstance(d.get("data_types"), dict) else "fp32"
+
+        # world_size=None defers batch resolution until the topology is known
+        # (engine calls resolve_world()).
+        if world_size is not None:
+            self._resolve_batch_sizes()
+
+    def resolve_world(self, world_size: int) -> None:
+        """Set the data-parallel world and resolve batch sizes (deferred)."""
+        self.world_size = world_size
+        self._resolve_batch_sizes()
+
+    # ------------------------------------------------------------------
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self) -> int:
+        return self.zero_config.stage
+
+    @property
+    def compute_dtype(self):
+        import jax.numpy as jnp
+
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        if self.fp16.enabled:
+            return jnp.float16
+        return jnp.float32
+
+    def _resolve_batch_sizes(self) -> None:
+        """Same resolution rules as ref runtime/config.py batch assertions:
+        train == micro * gas * dp_world; any one may be inferred."""
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        ws = max(1, self.world_size)
+
+        if train is not None and micro is not None and gas is not None:
+            pass
+        elif train is not None and micro is not None:
+            if train % (micro * ws) != 0:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {train} not divisible by micro_batch*world {micro * ws}")
+            gas = train // (micro * ws)
+        elif train is not None and gas is not None:
+            if train % (gas * ws) != 0:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {train} not divisible by gas*world {gas * ws}")
+            micro = train // (gas * ws)
+        elif micro is not None:
+            gas = gas or C.GRADIENT_ACCUMULATION_STEPS_DEFAULT
+            train = micro * gas * ws
+        elif train is not None:
+            gas = 1
+            if train % ws != 0:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {train} not divisible by world size {ws}")
+            micro = train // ws
+        else:
+            raise DeepSpeedConfigError(
+                "At least one of train_batch_size / train_micro_batch_size_per_gpu must be set")
+
+        if train != micro * gas * ws:
+            raise DeepSpeedConfigError(
+                f"Inconsistent batch config: train_batch_size={train} != "
+                f"micro({micro}) * gas({gas}) * dp_world({ws})")
+        self.train_batch_size = int(train)
+        self.train_micro_batch_size_per_gpu = int(micro)
+        self.gradient_accumulation_steps = int(gas)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return copy.deepcopy(self._param_dict)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        parts = [f"train_batch_size={self.train_batch_size}",
+                 f"micro={self.train_micro_batch_size_per_gpu}",
+                 f"gas={self.gradient_accumulation_steps}",
+                 f"zero_stage={self.zero_config.stage}",
+                 f"bf16={self.bf16.enabled}", f"fp16={self.fp16.enabled}"]
+        return "DeepSpeedConfig(" + ", ".join(parts) + ")"

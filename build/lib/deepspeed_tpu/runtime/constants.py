@@ -1,0 +1,119 @@
+"""Config key names and defaults.
+
+Mirrors the reference ``deepspeed/runtime/constants.py`` key surface so that
+DeepSpeed JSON configs can be consumed unchanged by the TPU build.
+"""
+
+#############################################
+# Batch size
+#############################################
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+
+#############################################
+# Optimizer / scheduler
+#############################################
+OPTIMIZER = "optimizer"
+OPTIMIZER_TYPE = "type"
+OPTIMIZER_PARAMS = "params"
+SCHEDULER = "scheduler"
+SCHEDULER_TYPE = "type"
+SCHEDULER_PARAMS = "params"
+MAX_GRAD_NORM = "max_grad_norm"
+
+ADAM_OPTIMIZER = "adam"
+ADAMW_OPTIMIZER = "adamw"
+FUSED_ADAM_OPTIMIZER = "fusedadam"
+LAMB_OPTIMIZER = "lamb"
+LION_OPTIMIZER = "lion"
+ADAGRAD_OPTIMIZER = "adagrad"
+SGD_OPTIMIZER = "sgd"
+MUON_OPTIMIZER = "muon"
+ONEBIT_ADAM_OPTIMIZER = "onebitadam"
+ONEBIT_LAMB_OPTIMIZER = "onebitlamb"
+ZERO_ONE_ADAM_OPTIMIZER = "zerooneadam"
+DEEPSPEED_OPTIMIZERS = [
+    ADAM_OPTIMIZER, ADAMW_OPTIMIZER, FUSED_ADAM_OPTIMIZER, LAMB_OPTIMIZER, LION_OPTIMIZER,
+    ADAGRAD_OPTIMIZER, SGD_OPTIMIZER, MUON_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
+    ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER
+]
+
+#############################################
+# Precision
+#############################################
+FP16 = "fp16"
+FP16_ENABLED = "enabled"
+FP16_LOSS_SCALE = "loss_scale"
+FP16_INITIAL_SCALE_POWER = "initial_scale_power"
+FP16_LOSS_SCALE_WINDOW = "loss_scale_window"
+FP16_HYSTERESIS = "hysteresis"
+FP16_MIN_LOSS_SCALE = "min_loss_scale"
+BFLOAT16 = "bf16"
+BFLOAT16_OLD = "bfloat16"
+BFLOAT16_ENABLED = "enabled"
+
+#############################################
+# ZeRO
+#############################################
+ZERO_OPTIMIZATION = "zero_optimization"
+
+#############################################
+# Misc engine knobs
+#############################################
+GRADIENT_CLIPPING = "gradient_clipping"
+PRESCALE_GRADIENTS = "prescale_gradients"
+GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
+STEPS_PER_PRINT = "steps_per_print"
+WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
+MEMORY_BREAKDOWN = "memory_breakdown"
+DUMP_STATE = "dump_state"
+ZERO_ALLOW_UNTESTED_OPTIMIZER = "zero_allow_untested_optimizer"
+ZERO_FORCE_DS_CPU_OPTIMIZER = "zero_force_ds_cpu_optimizer"
+DISABLE_ALLGATHER = "disable_allgather"
+COMMUNICATION_DATA_TYPE = "communication_data_type"
+SEQ_PARALLEL_COMMUNICATION_DATA_TYPE = "seq_parallel_communication_data_type"
+SPARSE_GRADIENTS = "sparse_gradients"
+SPARSE_ATTENTION = "sparse_attention"
+
+#############################################
+# Activation checkpointing (→ remat on TPU)
+#############################################
+ACTIVATION_CHECKPOINTING = "activation_checkpointing"
+
+#############################################
+# Monitoring
+#############################################
+TENSORBOARD = "tensorboard"
+WANDB = "wandb"
+CSV_MONITOR = "csv_monitor"
+COMET = "comet"
+FLOPS_PROFILER = "flops_profiler"
+COMMS_LOGGER = "comms_logger"
+
+#############################################
+# Parallel topology (TPU mesh extension + reference keys)
+#############################################
+MESH = "mesh"  # TPU extension: explicit axis sizes
+TENSOR_PARALLEL = "tensor_parallel"
+SEQUENCE_PARALLEL_SIZE = "sequence_parallel_size"
+PIPELINE = "pipeline"
+EXPERT_PARALLEL_SIZE = "expert_parallel_size"
+DATA_EFFICIENCY = "data_efficiency"
+CURRICULUM_LEARNING_LEGACY = "curriculum_learning"
+COMPRESSION_TRAINING = "compression_training"
+ELASTICITY = "elasticity"
+AUTOTUNING = "autotuning"
+CHECKPOINT = "checkpoint"
+LOAD_UNIVERSAL_CHECKPOINT = "load_universal_checkpoint"
+USE_DATA_BEFORE_EXPERT_PARALLEL = "use_data_before_expert_parallel_"
+DATALOADER_DROP_LAST = "dataloader_drop_last"
+
+#############################################
+# Defaults
+#############################################
+STEPS_PER_PRINT_DEFAULT = 10
+GRADIENT_ACCUMULATION_STEPS_DEFAULT = 1
+PRESCALE_GRADIENTS_DEFAULT = False
+GRADIENT_PREDIVIDE_FACTOR_DEFAULT = 1.0
+GRADIENT_CLIPPING_DEFAULT = 0.0

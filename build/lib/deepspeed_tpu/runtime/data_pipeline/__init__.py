@@ -1,0 +1,25 @@
+"""Data efficiency: curriculum learning, curriculum-capable sampling,
+random-LTD token dropping, variable-batch-size-and-LR.
+
+Analog of ``deepspeed/runtime/data_pipeline/`` (curriculum_scheduler.py,
+data_sampling/data_sampler.py, data_routing/, variable_batch_size_and_lr.py).
+"""
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
+from deepspeed_tpu.runtime.data_pipeline.data_routing import (RandomLTDScheduler,
+                                                              random_ltd_drop,
+                                                              random_ltd_restore)
+from deepspeed_tpu.runtime.data_pipeline.variable_batch import (
+    batch_by_token_budget, scale_lr_by_batch_size)
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    IndexedDataset, IndexedDatasetBuilder)
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (DataAnalyzer,
+                                                               load_metric)
+
+__all__ = [
+    "CurriculumScheduler", "DeepSpeedDataSampler", "RandomLTDScheduler",
+    "random_ltd_drop", "random_ltd_restore", "batch_by_token_budget",
+    "scale_lr_by_batch_size", "IndexedDataset", "IndexedDatasetBuilder",
+    "DataAnalyzer", "load_metric",
+]

@@ -1,0 +1,98 @@
+"""Curriculum learning difficulty schedules.
+
+Analog of ``deepspeed/runtime/data_pipeline/curriculum_scheduler.py``
+(``CurriculumScheduler`` :11): maps the global step to a "difficulty" (for
+seqlen-based curricula: the current max sequence length).  Schedule types
+match the reference: ``fixed_linear`` / ``fixed_root`` / ``fixed_discrete``
+/ ``custom``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+
+class CurriculumScheduler:
+    """step → difficulty.
+
+    config keys (matching the reference's JSON schema)::
+
+        {"curriculum_type": "seqlen",
+         "min_difficulty": 8, "max_difficulty": 1024,
+         "schedule_type": "fixed_linear",
+         "schedule_config": {"total_curriculum_step": 10000,
+                             "difficulty_step": 8,
+                             # fixed_root only:
+                             "root_degree": 2,
+                             # fixed_discrete only:
+                             "difficulty": [...], "max_step": [...]}}
+    """
+
+    def __init__(self, config: Dict[str, Any],
+                 custom_get_difficulty: Optional[Callable[[int], int]] = None):
+        self.config = config
+        self.min_difficulty = int(config.get("min_difficulty", 1))
+        self.max_difficulty = int(config.get("max_difficulty", self.min_difficulty))
+        self.schedule_type = config.get("schedule_type", "fixed_linear")
+        sc = config.get("schedule_config", {}) or {}
+        self.schedule_config = sc
+        self.current_difficulty = self.min_difficulty
+        self.first_step = True
+        self._custom = custom_get_difficulty
+
+        if self.schedule_type in ("fixed_linear", "fixed_root"):
+            if "total_curriculum_step" not in sc:
+                raise ValueError(
+                    f"{self.schedule_type} schedule requires schedule_config"
+                    "['total_curriculum_step']")
+            self.total_step = int(sc["total_curriculum_step"])
+            self.difficulty_step = int(sc.get("difficulty_step", 1))
+            self.root_degree = int(sc.get("root_degree", 2))
+        elif self.schedule_type == "fixed_discrete":
+            if "difficulty" not in sc or "max_step" not in sc:
+                raise ValueError(
+                    "fixed_discrete schedule requires schedule_config"
+                    "['difficulty'] and ['max_step']")
+            self.discrete_difficulty = [int(x) for x in sc["difficulty"]]
+            self.discrete_max_step = [int(x) for x in sc["max_step"]]
+            if len(self.discrete_max_step) != len(self.discrete_difficulty) - 1:
+                raise ValueError("max_step must have len(difficulty)-1 entries")
+        elif self.schedule_type == "custom":
+            if custom_get_difficulty is None:
+                raise ValueError("custom schedule requires custom_get_difficulty")
+        else:
+            raise ValueError(f"unknown schedule_type {self.schedule_type!r}")
+
+    # ------------------------------------------------------------------
+    def _root_difficulty(self, step: int, degree: int) -> int:
+        frac = min(1.0, max(0.0, step / self.total_step))
+        next_diff = self.min_difficulty + (
+            (self.max_difficulty - self.min_difficulty) * frac ** (1.0 / degree))
+        next_diff = int(next_diff / self.difficulty_step) * self.difficulty_step
+        return min(self.max_difficulty, max(self.min_difficulty, next_diff))
+
+    def get_difficulty(self, global_steps: int) -> int:
+        if self.schedule_type == "fixed_linear":
+            return self._root_difficulty(global_steps, 1)
+        if self.schedule_type == "fixed_root":
+            return self._root_difficulty(global_steps, self.root_degree)
+        if self.schedule_type == "fixed_discrete":
+            for diff, boundary in zip(self.discrete_difficulty, self.discrete_max_step):
+                if global_steps <= boundary:
+                    return diff
+            return self.discrete_difficulty[-1]
+        return int(self._custom(global_steps))
+
+    def update_difficulty(self, global_steps: int) -> int:
+        self.current_difficulty = self.get_difficulty(global_steps)
+        return self.current_difficulty
+
+    def get_current_difficulty(self) -> int:
+        return self.current_difficulty
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.current_difficulty = int(state["current_difficulty"])

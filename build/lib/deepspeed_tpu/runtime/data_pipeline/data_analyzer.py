@@ -1,0 +1,109 @@
+"""Dataset analysis → per-sample curriculum metric files.
+
+Analog of ``deepspeed/runtime/data_pipeline/data_sampling/data_analyzer.py``
+(``DataAnalyzer``): maps a dataset once (parallelizable by worker shards),
+computing per-sample difficulty metrics (seqlen, vocab rarity, custom fns),
+writes them as ``.npy`` metric files plus a sorted index-by-metric, which
+``DeepSpeedDataSampler`` consumes as its ``difficulties``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def metric_seqlen(sample) -> float:
+    return float(len(sample["input_ids"] if isinstance(sample, dict)
+                     else sample))
+
+
+def metric_vocab_rarity(sample, token_freq: Optional[np.ndarray] = None) -> float:
+    """Mean negative log-frequency of the sample's tokens (rarer = harder)."""
+    toks = np.asarray(sample["input_ids"] if isinstance(sample, dict)
+                      else sample)
+    if token_freq is None:
+        return float(len(toks))
+    f = token_freq[np.clip(toks, 0, len(token_freq) - 1)]
+    return float(-np.log(np.maximum(f, 1e-12)).mean())
+
+
+class DataAnalyzer:
+    """Map a dataset to metric files (ref DataAnalyzer.run_map/run_reduce).
+
+    ``metrics``: {name: fn(sample) -> float}.  ``num_workers``/``worker_id``
+    shard the map phase; ``run_reduce`` merges shard files.
+    """
+
+    def __init__(self, dataset, output_dir: str,
+                 metrics: Optional[Dict[str, Callable]] = None,
+                 num_workers: int = 1, worker_id: int = 0):
+        self.dataset = dataset
+        self.output_dir = output_dir
+        self.metrics = metrics or {"seqlen": metric_seqlen}
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        os.makedirs(output_dir, exist_ok=True)
+
+    # ------------------------------------------------------------------
+    def _shard_indices(self) -> np.ndarray:
+        n = len(self.dataset)
+        return np.arange(self.worker_id, n, self.num_workers)
+
+    def run_map(self) -> Dict[str, str]:
+        """Compute this worker's metric shard → file paths."""
+        idx = self._shard_indices()
+        out = {}
+        for name, fn in self.metrics.items():
+            vals = np.asarray([fn(self.dataset[int(i)]) for i in idx],
+                              np.float64)
+            path = os.path.join(self.output_dir,
+                                f"{name}.worker{self.worker_id}.npy")
+            np.save(path, np.stack([idx.astype(np.float64), vals], axis=1))
+            out[name] = path
+        return out
+
+    def run_reduce(self) -> Dict[str, str]:
+        """Merge all worker shards into ``<metric>_values.npy`` (dense,
+        index-aligned) + ``<metric>_index_sorted.npy`` (sample indices
+        sorted by metric) + a JSON summary."""
+        n = len(self.dataset)
+        results = {}
+        for name in self.metrics:
+            dense = np.zeros(n, np.float64)
+            seen = np.zeros(n, bool)
+            for w in range(self.num_workers):
+                path = os.path.join(self.output_dir, f"{name}.worker{w}.npy")
+                if not os.path.exists(path):
+                    raise RuntimeError(
+                        f"metric {name}: worker {w} shard missing ({path}) — "
+                        "did every worker run_map?")
+                pairs = np.load(path)
+                ii = pairs[:, 0].astype(np.int64)
+                dense[ii] = pairs[:, 1]
+                seen[ii] = True
+            if not seen.all():
+                raise RuntimeError(
+                    f"metric {name}: {int((~seen).sum())} samples missing — "
+                    "did every worker run_map?")
+            vpath = os.path.join(self.output_dir, f"{name}_values.npy")
+            spath = os.path.join(self.output_dir, f"{name}_index_sorted.npy")
+            np.save(vpath, dense)
+            np.save(spath, np.argsort(dense, kind="stable"))
+            results[name] = vpath
+        summary = {name: {"min": float(np.load(p).min()),
+                          "max": float(np.load(p).max()),
+                          "mean": float(np.load(p).mean())}
+                   for name, p in results.items()}
+        with open(os.path.join(self.output_dir, "analysis_summary.json"),
+                  "w") as f:
+            json.dump(summary, f, indent=2)
+        return results
+
+
+def load_metric(output_dir: str, name: str = "seqlen") -> np.ndarray:
+    """Load a reduced metric as the sampler's ``difficulties`` array."""
+    return np.load(os.path.join(output_dir, f"{name}_values.npy"))

@@ -1,0 +1,104 @@
+"""Random-LTD — random layerwise token dropping.
+
+Analog of ``deepspeed/runtime/data_pipeline/data_routing/``
+(``basic_layer.py`` RandomLayerTokenDrop, ``scheduler.py:38`` the kept-
+seqlen schedule) and the gather/scatter kernels in ``csrc/random_ltd/``.
+
+A band of middle layers runs on a random subset of tokens; the untouched
+tokens bypass those layers and are scattered back afterwards.  On TPU the
+gather/scatter are `jnp.take_along_axis`/``.at[].set`` — XLA lowers them to
+dynamic-gather/scatter HLOs, the role the reference's CUDA kernels play —
+and the random subset is drawn with a jax PRNG key so the whole step stays
+jittable (the kept count is a *static* python int per compile, exactly like
+the reference where the schedule changes the tensor shape between steps).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class RandomLTDScheduler:
+    """Kept-sequence-length schedule (ref data_routing/scheduler.py:38).
+
+    Linearly increases the kept seqlen from ``min_value`` to ``max_value``
+    over ``total_steps``, rounded down to a multiple of ``step_size``.
+    """
+
+    def __init__(self, min_value: int, max_value: int, total_steps: int,
+                 step_size: int = 8):
+        self.min_value = int(min_value)
+        self.max_value = int(max_value)
+        self.total_steps = int(total_steps)
+        self.step_size = int(step_size)
+        self.current_seqlen = self.min_value
+
+    def get_seqlen(self, global_step: int) -> int:
+        frac = min(1.0, max(0.0, global_step / max(1, self.total_steps)))
+        val = self.min_value + (self.max_value - self.min_value) * frac
+        val = int(val // self.step_size) * self.step_size
+        return min(self.max_value, max(self.min_value, val))
+
+    def update(self, global_step: int) -> int:
+        self.current_seqlen = self.get_seqlen(global_step)
+        return self.current_seqlen
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"current_seqlen": self.current_seqlen}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.current_seqlen = int(state["current_seqlen"])
+
+
+def random_ltd_indices(key, seq_len: int, kept: int, batch: int):
+    """Per-sample sorted random subset of token positions → [B, kept].
+    Sorted order preserves causality within the kept subsequence (ref
+    token_sort_ kernels, csrc/random_ltd/)."""
+    keys = jax.random.split(key, batch)
+
+    def one(k):
+        perm = jax.random.permutation(k, seq_len)[:kept]
+        return jnp.sort(perm)
+
+    return jax.vmap(one)(keys)
+
+
+def random_ltd_drop(x, indices):
+    """Gather kept tokens: x [B, S, ...] + indices [B, K] → [B, K, ...]
+    (ref gather kernel, csrc/random_ltd/gather_scatter.cu analog)."""
+    idx = indices.reshape(indices.shape + (1,) * (x.ndim - 2))
+    return jnp.take_along_axis(x, idx, axis=1)
+
+
+def random_ltd_restore(x_full, x_kept, indices):
+    """Scatter processed tokens back into the full sequence; dropped tokens
+    keep their (bypassed) values from ``x_full`` (ref scatter kernel)."""
+    rows = jnp.arange(x_full.shape[0])[:, None]
+    return x_full.at[rows, indices].set(x_kept)
+
+
+class RandomLTDLayerWrapper:
+    """Apply a layer stack on a random token subset (ref RandomLayerTokenDrop,
+    data_routing/basic_layer.py).
+
+    ``layer_fn(x, positions) -> x`` runs on the kept tokens only; dropped
+    tokens bypass via identity.  ``kept`` must be static per compile.
+    """
+
+    def __init__(self, layer_fn, scheduler: RandomLTDScheduler):
+        self.layer_fn = layer_fn
+        self.scheduler = scheduler
+
+    def __call__(self, x, positions, key, kept: int):
+        b, s = x.shape[0], x.shape[1]
+        if kept >= s:
+            return self.layer_fn(x, positions)
+        idx = random_ltd_indices(key, s, kept, b)
+        x_kept = random_ltd_drop(x, idx)
+        pos_kept = jnp.take_along_axis(positions, idx, axis=1) \
+            if positions is not None and positions.ndim == 2 else positions
+        y_kept = self.layer_fn(x_kept, pos_kept)
+        return random_ltd_restore(x, y_kept, idx)

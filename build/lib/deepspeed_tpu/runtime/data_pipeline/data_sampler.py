@@ -1,0 +1,128 @@
+"""Curriculum-capable deterministic data sampler.
+
+Analog of ``deepspeed/runtime/data_pipeline/data_sampling/data_sampler.py``
+(``DeepSpeedDataSampler`` :36): yields per-step index batches, optionally
+filtered by a per-sample difficulty metric so only samples at or below the
+curriculum's current difficulty are drawn.  Deterministic in
+(seed, epoch, step) so every DP rank computes the same global order and
+takes its own disjoint slice — the TPU-native replacement for a
+torch.distributed sampler.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+
+class DeepSpeedDataSampler:
+    """Yields lists of dataset indices, one list per *global* batch.
+
+    difficulties: optional per-sample difficulty values (e.g. sequence
+    lengths).  When both ``difficulties`` and ``curriculum`` are given, each
+    batch draws only from samples with difficulty ≤ the schedule's current
+    value (ref CL-enabled DeepSpeedDataSampler).
+    """
+
+    def __init__(self, total_samples: int, batch_size: int,
+                 difficulties: Optional[Sequence] = None,
+                 curriculum: Optional[CurriculumScheduler] = None,
+                 dp_rank: int = 0, dp_size: int = 1,
+                 shuffle: bool = True, seed: int = 1234,
+                 drop_last: bool = True):
+        if batch_size % dp_size != 0:
+            raise ValueError(f"global batch {batch_size} not divisible by dp={dp_size}")
+        self.total_samples = total_samples
+        self.batch_size = batch_size
+        self.micro_batch = batch_size // dp_size
+        self.difficulties = (np.asarray(difficulties)
+                             if difficulties is not None else None)
+        if self.difficulties is not None and len(self.difficulties) != total_samples:
+            raise ValueError("difficulties must have one entry per sample")
+        self.curriculum = curriculum
+        self.dp_rank = dp_rank
+        self.dp_size = dp_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.consumed_batches = 0  # global steps served (for resume)
+
+    # ------------------------------------------------------------------
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def _order(self) -> np.ndarray:
+        order = np.arange(self.total_samples)
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(order)
+        return order
+
+    def __len__(self) -> int:
+        n = self.total_samples // self.batch_size
+        if not self.drop_last and self.total_samples % self.batch_size:
+            n += 1
+        return n
+
+    def __iter__(self) -> Iterator[List[int]]:
+        order = self._order()
+        if self.curriculum is not None and self.difficulties is not None:
+            # stable-sort eligibility per step: draw sequentially from the
+            # shuffled order, skipping too-hard samples (they become
+            # eligible as difficulty rises) — same sample-once-per-epoch
+            # guarantee as the reference.
+            pos = 0
+            for _ in range(len(self)):
+                diff = self.curriculum.update_difficulty(self.consumed_batches)
+                batch: List[int] = []
+                scan = pos
+                deferred: List[int] = []
+                while len(batch) < self.batch_size and scan < len(order):
+                    idx = int(order[scan])
+                    if self.difficulties[idx] <= diff:
+                        batch.append(idx)
+                    else:
+                        deferred.append(idx)
+                    scan += 1
+                # keep deferred (too hard now) at the front for later steps
+                order = np.concatenate([
+                    np.asarray(deferred, dtype=order.dtype),
+                    order[scan:]])
+                pos = 0
+                if len(batch) < self.batch_size and self.drop_last:
+                    return
+                if not batch:
+                    return
+                self.consumed_batches += 1
+                yield self._rank_slice(batch)
+        else:
+            for start in range(0, self.total_samples, self.batch_size):
+                batch = [int(i) for i in order[start:start + self.batch_size]]
+                if len(batch) < self.batch_size and self.drop_last:
+                    return
+                self.consumed_batches += 1
+                yield self._rank_slice(batch)
+        self.epoch += 1
+
+    def _rank_slice(self, batch: List[int]) -> List[int]:
+        if self.dp_size == 1:
+            return batch
+        per = max(1, len(batch) // self.dp_size)
+        return batch[self.dp_rank * per:(self.dp_rank + 1) * per]
+
+    # -- resume ---------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        state = {"epoch": self.epoch, "consumed_batches": self.consumed_batches}
+        if self.curriculum is not None:
+            state["curriculum"] = self.curriculum.state_dict()
+        return state
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.epoch = int(state["epoch"])
+        self.consumed_batches = int(state["consumed_batches"])
+        if self.curriculum is not None and "curriculum" in state:
+            self.curriculum.load_state_dict(state["curriculum"])

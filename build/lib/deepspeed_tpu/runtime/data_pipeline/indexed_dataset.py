@@ -1,0 +1,90 @@
+"""Memory-mapped indexed token dataset.
+
+Analog of ``deepspeed/runtime/data_pipeline/data_sampling/
+indexed_dataset.py`` (the Megatron MMapIndexedDataset lineage): token
+sequences live in one flat binary file plus an index of (offset, length)
+pairs, read back through ``np.memmap`` so multi-million-document corpora
+cost no resident RAM.  Builder + reader + on-disk format:
+
+``<path>.bin``  — concatenated token arrays
+``<path>.idx``  — header (magic, version, dtype code, count) then
+                  int64 offsets[count+1] (prefix sums, in elements)
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterable, List, Sequence
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+_DTYPES = {1: np.uint16, 2: np.int32, 3: np.int64, 4: np.uint8}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+class IndexedDatasetBuilder:
+    """Stream sequences to disk (ref MMapIndexedDatasetBuilder)."""
+
+    def __init__(self, path_prefix: str, dtype=np.int32):
+        self.path_prefix = path_prefix
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in _DTYPE_CODES:
+            raise ValueError(f"unsupported dtype {dtype}")
+        os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+        self._bin = open(path_prefix + ".bin", "wb")
+        self._offsets: List[int] = [0]
+
+    def add_item(self, tokens: Sequence[int]) -> None:
+        arr = np.asarray(tokens, dtype=self.dtype)
+        self._bin.write(arr.tobytes())
+        self._offsets.append(self._offsets[-1] + arr.size)
+
+    def add_items(self, seqs: Iterable[Sequence[int]]) -> None:
+        for s in seqs:
+            self.add_item(s)
+
+    def finalize(self) -> None:
+        self._bin.close()
+        with open(self.path_prefix + ".idx", "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<HHQ", _VERSION,
+                                _DTYPE_CODES[self.dtype],
+                                len(self._offsets) - 1))
+            f.write(np.asarray(self._offsets, np.int64).tobytes())
+
+
+class IndexedDataset:
+    """Read-only memory-mapped view (ref MMapIndexedDataset)."""
+
+    def __init__(self, path_prefix: str):
+        with open(path_prefix + ".idx", "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(f"{path_prefix}.idx: bad magic {magic!r}")
+            version, dcode, count = struct.unpack("<HHQ", f.read(12))
+            if version != _VERSION:
+                raise ValueError(f"unsupported index version {version}")
+            self.dtype = np.dtype(_DTYPES[dcode])
+            self._offsets = np.frombuffer(f.read(8 * (count + 1)), np.int64)
+        self._data = np.memmap(path_prefix + ".bin", dtype=self.dtype,
+                               mode="r")
+        self._count = int(count)
+
+    def __len__(self) -> int:
+        return self._count
+
+    def __getitem__(self, idx: int) -> np.ndarray:
+        if idx < 0:
+            idx += self._count
+        if not 0 <= idx < self._count:
+            raise IndexError(idx)
+        return np.asarray(
+            self._data[self._offsets[idx]:self._offsets[idx + 1]])
+
+    @property
+    def sizes(self) -> np.ndarray:
+        """Per-sequence lengths — the default curriculum difficulty metric."""
+        return np.diff(self._offsets)

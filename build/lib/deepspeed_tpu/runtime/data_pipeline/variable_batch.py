@@ -1,0 +1,68 @@
+"""Variable batch size and LR.
+
+Analog of ``deepspeed/runtime/data_pipeline/data_sampling/
+variable_batch_size_and_lr.py``: pack variable-length samples into batches
+of roughly constant *token* count (so step cost is uniform even when seq
+lengths vary wildly), and scale the LR for each batch's effective size so
+the optimization trajectory matches fixed-batch training.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def batch_by_token_budget(seqlens: Sequence[int], token_budget: int,
+                          max_batch_size: int = 0,
+                          shuffle_seed: int = -1,
+                          sort_by_length: bool = True) -> List[List[int]]:
+    """Plan index batches with ≤ ``token_budget`` total tokens each.
+
+    Sorting by length first (the reference's default) minimises padding
+    waste; a fixed seed shuffles the *batches* afterwards so step order is
+    still random.  ``max_batch_size`` (0 = unlimited) caps rows per batch.
+    """
+    seqlens = np.asarray(seqlens)
+    order = np.argsort(seqlens, kind="stable") if sort_by_length \
+        else np.arange(len(seqlens))
+    batches: List[List[int]] = []
+    cur: List[int] = []
+    cur_max = 0
+    for idx in order:
+        sl = int(seqlens[idx])
+        if sl > token_budget:
+            raise ValueError(f"sample {idx} ({sl} tokens) exceeds budget "
+                             f"{token_budget}")
+        new_max = max(cur_max, sl)
+        # padded cost = rows * max_len (padding counts against the budget)
+        if cur and ((len(cur) + 1) * new_max > token_budget
+                    or (max_batch_size and len(cur) >= max_batch_size)):
+            batches.append(cur)
+            cur, cur_max = [], 0
+            new_max = sl
+        cur.append(int(idx))
+        cur_max = new_max
+    if cur:
+        batches.append(cur)
+    if shuffle_seed >= 0:
+        rng = np.random.default_rng(shuffle_seed)
+        rng.shuffle(batches)
+    return batches
+
+
+def scale_lr_by_batch_size(base_lr: float, batch_size: int,
+                           base_batch_size: int,
+                           method: str = "linear") -> float:
+    """LR scaling for a variable batch (ref scale_lr in
+    variable_batch_size_and_lr.py): ``linear`` (Goyal et al.) or ``sqrt``
+    (Hoffer et al.) scaling; ``none`` disables."""
+    if method == "none" or batch_size == base_batch_size:
+        return base_lr
+    ratio = batch_size / base_batch_size
+    if method == "linear":
+        return base_lr * ratio
+    if method == "sqrt":
+        return base_lr * ratio ** 0.5
+    raise ValueError(f"unknown lr scaling method {method!r}")

@@ -1,0 +1,110 @@
+"""Hybrid engine — RLHF train ↔ generate on shared weights.
+
+Analog of ``deepspeed/runtime/hybrid_engine.py`` (``DeepSpeedHybridEngine``
+:30): during RLHF, the actor model alternates between generation (rollout)
+and training (PPO update).  The reference re-wires ZeRO-3-partitioned
+weights into inference kernel containers and back.  On TPU there is nothing
+to re-wire: training params are a sharded pytree, and generation jits a
+decode step over the *same* arrays — mode switching is free, which is the
+whole point of keeping both paths functional over one param tree.
+
+Latency bookkeeping mirrors the reference's generate/train timers.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models import transformer as tf_model
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class DeepSpeedHybridEngine:
+    """Wraps a training engine; ``generate`` reads its live params.
+
+    Usage: ``he = DeepSpeedHybridEngine(engine)``; rollout with
+    ``he.eval(); he.generate(...)``; then ``he.train();
+    he.train_batch(...)`` — weights stay shared throughout.
+    """
+
+    def __init__(self, engine, inference_tp_size: Optional[int] = None):
+        self.engine = engine
+        self.model_config = engine.model_config
+        if self.model_config is None:
+            raise ValueError("hybrid engine requires an engine built from a "
+                             "TransformerConfig model")
+        self.inference_tp_size = inference_tp_size
+        self._training = True
+        self._generate_latency = 0.0
+        self._train_latency = 0.0
+        self._generate_tokens = 0
+        self._logits_jit = jax.jit(self._logits)
+
+    # -- mode switches (ref eval()/train() container swap) --------------
+    def eval(self) -> None:
+        self._training = False
+
+    def train(self, mode: bool = True) -> None:
+        self._training = mode
+
+    def release_inference_cache(self) -> None:
+        """Parity no-op: there is no separate inference weight cache — the
+        decode path reads the training arrays directly."""
+
+    # -- training delegate ----------------------------------------------
+    def train_batch(self, data):
+        t0 = time.perf_counter()
+        loss = self.engine.train_batch(data)
+        self._train_latency += time.perf_counter() - t0
+        return loss
+
+    def __getattr__(self, name):
+        return getattr(self.engine, name)
+
+    # -- generation ------------------------------------------------------
+    def _logits(self, params, ids):
+        out = tf_model.forward(params, ids, self.model_config)
+        return out[0] if isinstance(out, tuple) else out
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 temperature: float = 0.0, seed: int = 0) -> np.ndarray:
+        """Rollout on the live training weights (ref generate,
+        hybrid_engine.py: shares ZeRO-3 weights with inference containers)."""
+        if self._training:
+            log_dist("hybrid engine: generate() called in train mode; "
+                     "switching to eval", level="warning")
+            self.eval()
+        t0 = time.perf_counter()
+        ids = np.asarray(input_ids)
+        if ids.ndim == 1:
+            ids = ids[None, :]
+        total = ids.shape[1] + max_new_tokens
+        if total > self.model_config.max_seq_len:
+            raise ValueError(f"prompt+new tokens {total} exceeds max_seq_len "
+                             f"{self.model_config.max_seq_len}")
+        key = jax.random.PRNGKey(seed)
+        for _ in range(max_new_tokens):
+            logits = self._logits_jit(self.engine.params, jnp.asarray(ids))
+            nxt_logits = logits[:, -1, :].astype(jnp.float32)
+            if temperature > 0:
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(sub, nxt_logits / temperature, -1)
+            else:
+                nxt = jnp.argmax(nxt_logits, axis=-1)
+            ids = np.concatenate([ids, np.asarray(nxt)[:, None]], axis=1)
+        self._generate_latency += time.perf_counter() - t0
+        self._generate_tokens += max_new_tokens * ids.shape[0]
+        return ids
+
+    # -- stats (ref _generate_latency/_training_latency reporting) -------
+    def stats(self) -> dict:
+        return {"generate_seconds": self._generate_latency,
+                "train_seconds": self._train_latency,
+                "generated_tokens": self._generate_tokens,
+                "tokens_per_sec": (self._generate_tokens / self._generate_latency
+                                   if self._generate_latency else 0.0)}

@@ -1,0 +1,175 @@
+"""Training-dynamics model features: progressive layer drop, block
+eigenvalue estimation, tiled linear, sparse gradients.
+
+Analogs of ``deepspeed/runtime/progressive_layer_drop.py:10``,
+``runtime/eigenvalue.py:13``, ``runtime/zero/tiling.py`` (TiledLinear) and
+``runtime/sparse_tensor.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class ProgressiveLayerDrop:
+    """Keep-probability schedule for stochastic depth (ref
+    ProgressiveLayerDrop: theta(t) = (1-theta)·exp(-gamma·t) + theta)."""
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self, global_step: Optional[int] = None) -> float:
+        if global_step is None:
+            return self.current_theta
+        return (1.0 - self.theta) * float(np.exp(-self.gamma * global_step)) \
+            + self.theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = self.get_theta(global_step)
+        return self.current_theta
+
+    def get_state(self) -> Dict[str, float]:
+        return {"progressive_layer_drop": True, "pld_theta": self.current_theta}
+
+
+def layer_drop(layer_fn: Callable, x, keep_prob: float, key,
+               layer_idx: int = 0, num_layers: int = 1, *args, **kwargs):
+    """Stochastic-depth wrapper: skip the layer (identity) with prob
+    1 - keep_prob·scale, where deeper layers drop more (PLD's linear depth
+    scaling).  Output is rescaled at train time like dropout."""
+    p = keep_prob * (1.0 - layer_idx / max(1, num_layers) * (1.0 - keep_prob))
+    p = jnp.clip(p, 0.0, 1.0)
+    coin = jax.random.bernoulli(key, p)
+    out = layer_fn(x, *args, **kwargs)
+    y = out[0] if isinstance(out, tuple) else out
+    kept = jnp.where(coin, y, x)
+    return (kept,) + tuple(out[1:]) if isinstance(out, tuple) else kept
+
+
+# ----------------------------------------------------------------------
+class Eigenvalue:
+    """Power-iteration max-eigenvalue of the loss Hessian per param block
+    (ref Eigenvalue, runtime/eigenvalue.py:13 — used by MoQ to schedule
+    precision switching).  Hessian-vector products come from
+    ``jax.jvp(jax.grad(loss))`` — no Hessian materialisation.
+    """
+
+    def __init__(self, max_iter: int = 10, tol: float = 1e-2,
+                 stability: float = 1e-6):
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+
+    def compute(self, loss_fn: Callable[[Any], jnp.ndarray], params: Any,
+                key) -> Dict[str, float]:
+        """→ {leaf_path: max |eigenvalue| estimate}."""
+        grad_fn = jax.grad(loss_fn)
+
+        def hvp(v):
+            return jax.jvp(grad_fn, (params,), (v,))[1]
+
+        # random unit start per leaf
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        keys = jax.random.split(key, len(leaves))
+        v = jax.tree_util.tree_unflatten(
+            treedef, [jax.random.normal(k, l.shape, jnp.float32)
+                      for k, l in zip(keys, leaves)])
+        v = _normalize_tree(v, self.stability)
+        eig = 0.0
+        for _ in range(self.max_iter):
+            hv = hvp(v)
+            new_eig = float(_tree_dot(v, hv))
+            v = _normalize_tree(hv, self.stability)
+            if abs(new_eig - eig) <= self.tol * max(1.0, abs(new_eig)):
+                eig = new_eig
+                break
+            eig = new_eig
+        # per-leaf contribution: ||Hv_leaf|| as block estimate
+        hv = hvp(v)
+        out = {}
+        for (path, leaf) in jax.tree_util.tree_flatten_with_path(hv)[0]:
+            name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                            for p in path)
+            out[name] = float(jnp.linalg.norm(leaf.astype(jnp.float32)))
+        out["__global__"] = abs(eig)
+        return out
+
+
+def _tree_dot(a, b) -> jnp.ndarray:
+    return sum(jnp.vdot(x.astype(jnp.float32), y.astype(jnp.float32))
+               for x, y in zip(jax.tree_util.tree_leaves(a),
+                               jax.tree_util.tree_leaves(b)))
+
+
+def _normalize_tree(t, eps: float):
+    norm = jnp.sqrt(sum((x.astype(jnp.float32) ** 2).sum()
+                        for x in jax.tree_util.tree_leaves(t)))
+    return jax.tree.map(lambda x: (x / (norm + eps)).astype(x.dtype), t)
+
+
+# ----------------------------------------------------------------------
+def tiled_linear(x, w, bias=None, in_splits: int = 1, out_splits: int = 1,
+                 activation: Optional[Callable] = None):
+    """TiledLinear (ref runtime/zero/tiling.py): evaluate a large linear as
+    an in_splits × out_splits grid of sub-matmuls, accumulating over input
+    tiles.  Under jit XLA sees smaller live intermediates, which is the
+    memory effect the reference gets from sequential sub-layers."""
+    in_dim, out_dim = w.shape[-2], w.shape[-1]
+    if in_dim % in_splits or out_dim % out_splits:
+        raise ValueError(f"dims {w.shape} not divisible by splits "
+                         f"({in_splits}, {out_splits})")
+    it, ot = in_dim // in_splits, out_dim // out_splits
+    outs = []
+    for j in range(out_splits):
+        acc = None
+        for i in range(in_splits):
+            xi = x[..., i * it:(i + 1) * it]
+            wij = w[i * it:(i + 1) * it, j * ot:(j + 1) * ot]
+            part = xi @ wij
+            acc = part if acc is None else acc + part
+        if bias is not None:
+            acc = acc + bias[j * ot:(j + 1) * ot]
+        if activation is not None:
+            acc = activation(acc)
+        outs.append(acc)
+    return jnp.concatenate(outs, axis=-1)
+
+
+# ----------------------------------------------------------------------
+class SparseTensor:
+    """COO sparse gradient carrier (ref runtime/sparse_tensor.py) for
+    embedding-style row-sparse grads; allreduce concatenates (indices,
+    values) across ranks like the reference's sparse allreduce
+    (engine.py:145 split_half_float_double_sparse)."""
+
+    def __init__(self, indices, values, dense_shape: Tuple[int, ...]):
+        self.indices = jnp.asarray(indices)
+        self.values = jnp.asarray(values)
+        self.dense_shape = tuple(dense_shape)
+
+    @classmethod
+    def from_dense(cls, dense, threshold: float = 0.0) -> "SparseTensor":
+        rows = jnp.where(jnp.abs(dense).sum(axis=tuple(range(1, dense.ndim)))
+                         > threshold)[0]
+        return cls(rows, dense[rows], dense.shape)
+
+    def to_dense(self) -> jnp.ndarray:
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def sparse_size(self) -> int:
+        return int(self.indices.size + self.values.size)
+
+    @staticmethod
+    def add(a: "SparseTensor", b: "SparseTensor") -> "SparseTensor":
+        if a.dense_shape != b.dense_shape:
+            raise ValueError("shape mismatch")
+        return SparseTensor(jnp.concatenate([a.indices, b.indices]),
+                            jnp.concatenate([a.values, b.values]),
+                            a.dense_shape)

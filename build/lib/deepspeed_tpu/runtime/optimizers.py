@@ -1,0 +1,148 @@
+"""Optimizer factory.
+
+Analog of the reference's optimizer zoo (``_configure_basic_optimizer``,
+runtime/engine.py:1536 — FusedAdam/CPUAdam/Lamb/Lion/Adagrad/Muon/1-bit).
+On TPU there is no fused-vs-unfused split: every optimizer below is a pure
+pytree transform that XLA fuses into the (sharded) update step, which *is*
+the fused multi-tensor kernel — applied to ZeRO-partitioned state when the
+engine shards opt state (ZeRO-1).
+
+The learning rate is NOT baked into the transform chain: ``update_fn`` takes
+``lr`` as a traced scalar so host-side LR schedules never retrigger
+compilation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deepspeed_tpu.runtime import constants as C
+from deepspeed_tpu.utils.logging import logger
+
+
+@dataclass
+class Optimizer:
+    """init/update pair over param pytrees."""
+    name: str
+    init_fn: Callable[[Any], Any]
+    update_fn: Callable[..., Tuple[Any, Any]]  # (grads, state, params, lr) -> (params, state)
+    defaults: Dict[str, Any]
+
+    def init(self, params):
+        return self.init_fn(params)
+
+    def update(self, grads, state, params, lr):
+        return self.update_fn(grads, state, params, lr)
+
+
+def _chain_to_optimizer(name: str, tx: optax.GradientTransformation,
+                        defaults: Dict[str, Any]) -> Optimizer:
+    def update_fn(grads, state, params, lr):
+        updates, new_state = tx.update(grads, state, params)
+        updates = jax.tree.map(lambda u: (-lr * u).astype(u.dtype), updates)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_state
+
+    return Optimizer(name=name, init_fn=tx.init, update_fn=update_fn, defaults=defaults)
+
+
+def _adam(params_cfg: Dict[str, Any], adam_w_mode: bool) -> Optimizer:
+    betas = params_cfg.get("betas", (0.9, 0.999))
+    eps = float(params_cfg.get("eps", 1e-8))
+    wd = float(params_cfg.get("weight_decay", 0.01 if adam_w_mode else 0.0))
+    txs = [optax.scale_by_adam(b1=float(betas[0]), b2=float(betas[1]), eps=eps)]
+    if wd:
+        if adam_w_mode:
+            txs.append(optax.add_decayed_weights(wd))
+        else:
+            # plain Adam + L2: decay folded into grads happens pre-moment in
+            # torch Adam; approximate with decoupled decay is NOT identical,
+            # so add L2 term up front instead.
+            txs.insert(0, optax.add_decayed_weights(wd))
+    name = "adamw" if adam_w_mode else "adam"
+    return _chain_to_optimizer(name, optax.chain(*txs),
+                               dict(betas=betas, eps=eps, weight_decay=wd))
+
+
+def _lion(params_cfg: Dict[str, Any]) -> Optimizer:
+    betas = params_cfg.get("betas", (0.9, 0.99))
+    wd = float(params_cfg.get("weight_decay", 0.0))
+    txs = [optax.scale_by_lion(b1=float(betas[0]), b2=float(betas[1]))]
+    if wd:
+        txs.append(optax.add_decayed_weights(wd))
+    return _chain_to_optimizer("lion", optax.chain(*txs), dict(betas=betas, weight_decay=wd))
+
+
+def _lamb(params_cfg: Dict[str, Any]) -> Optimizer:
+    betas = params_cfg.get("betas", (0.9, 0.999))
+    eps = float(params_cfg.get("eps", 1e-6))
+    wd = float(params_cfg.get("weight_decay", 0.0))
+    txs = [optax.scale_by_adam(b1=float(betas[0]), b2=float(betas[1]), eps=eps)]
+    if wd:
+        txs.append(optax.add_decayed_weights(wd))
+    txs.append(optax.scale_by_trust_ratio())
+    return _chain_to_optimizer("lamb", optax.chain(*txs),
+                               dict(betas=betas, eps=eps, weight_decay=wd))
+
+
+def _adagrad(params_cfg: Dict[str, Any]) -> Optimizer:
+    eps = float(params_cfg.get("eps", 1e-10))
+    wd = float(params_cfg.get("weight_decay", 0.0))
+    txs = [optax.scale_by_rss(initial_accumulator_value=0.0, eps=eps)]
+    if wd:
+        txs.insert(0, optax.add_decayed_weights(wd))
+    return _chain_to_optimizer("adagrad", optax.chain(*txs), dict(eps=eps, weight_decay=wd))
+
+
+def _sgd(params_cfg: Dict[str, Any]) -> Optimizer:
+    momentum = float(params_cfg.get("momentum", 0.0))
+    wd = float(params_cfg.get("weight_decay", 0.0))
+    txs = []
+    if wd:
+        txs.append(optax.add_decayed_weights(wd))
+    if momentum:
+        txs.append(optax.trace(decay=momentum, nesterov=bool(params_cfg.get("nesterov", False))))
+    tx = optax.chain(*txs) if txs else optax.identity()
+    return _chain_to_optimizer("sgd", tx, dict(momentum=momentum, weight_decay=wd))
+
+
+def _muon(params_cfg: Dict[str, Any]) -> Optimizer:
+    """Muon: momentum + Newton–Schulz orthogonalisation for 2-D params
+    (ref runtime/zero/muon/original_muon.py:36); non-2D params fall back to
+    Adam, matching the reference's use_muon split."""
+    from deepspeed_tpu.ops.muon import build_muon
+
+    return build_muon(params_cfg)
+
+
+def build_optimizer(opt_type: str, params_cfg: Optional[Dict[str, Any]] = None) -> Optimizer:
+    params_cfg = dict(params_cfg or {})
+    params_cfg.pop("lr", None)  # lr flows through update_fn
+    t = opt_type.lower()
+    if t in (C.ADAM_OPTIMIZER, C.FUSED_ADAM_OPTIMIZER):
+        adam_w_mode = bool(params_cfg.pop("adam_w_mode", True))
+        return _adam(params_cfg, adam_w_mode)
+    if t == C.ADAMW_OPTIMIZER:
+        params_cfg.pop("adam_w_mode", None)
+        return _adam(params_cfg, True)
+    if t in (C.LION_OPTIMIZER, "fusedlion"):
+        return _lion(params_cfg)
+    if t in (C.LAMB_OPTIMIZER, "fusedlamb"):
+        return _lamb(params_cfg)
+    if t == C.ADAGRAD_OPTIMIZER:
+        return _adagrad(params_cfg)
+    if t == C.SGD_OPTIMIZER:
+        return _sgd(params_cfg)
+    if t == C.MUON_OPTIMIZER:
+        return _muon(params_cfg)
+    if t in (C.ONEBIT_ADAM_OPTIMIZER, C.ONEBIT_LAMB_OPTIMIZER, C.ZERO_ONE_ADAM_OPTIMIZER):
+        # Compressed-communication optimizers: on TPU gradient reduction is
+        # compiled; the compression variant lives in ops/compressed_optimizer.
+        logger.warning(f"{opt_type}: using uncompressed TPU variant (XLA-reduced grads)")
+        return _adam(params_cfg, bool(params_cfg.pop("adam_w_mode", True)))
+    raise ValueError(f"unknown optimizer type '{opt_type}'")

@@ -1,0 +1,136 @@
+"""MoQ — Mixture-of-Quantization: scheduled precision reduction during
+training.
+
+Analog of ``deepspeed/runtime/quantize.py`` (``Quantizer`` :180, MoQ): start
+training at high bit-width, halve the quantization period's target bits on
+a schedule (``quantize_period`` doubling per transition), optionally gating
+each transition on the loss-landscape curvature (block eigenvalue — a high
+top-eigenvalue layer is still moving, so its precision drop is deferred).
+
+The quantization itself reuses the compression suite's STE fake-quant; MoQ
+is the *scheduler* that decides per-step, per-group target bits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.compression.basic_layers import quantize_weight_ste
+from deepspeed_tpu.runtime.model_features import Eigenvalue
+from deepspeed_tpu.utils.logging import logger
+
+
+class MoQScheduler:
+    """Per-step target bit-width (ref Quantizer schedule fields:
+    start_bits → target_bits, quantize_period doubling)."""
+
+    def __init__(self, start_bits: int = 16, target_bits: int = 8,
+                 quantize_period: int = 100, period_factor: int = 2):
+        if target_bits > start_bits:
+            raise ValueError("target_bits must be <= start_bits")
+        self.start_bits = start_bits
+        self.target_bits = target_bits
+        self.quantize_period = quantize_period
+        self.period_factor = period_factor
+        self.current_bits = start_bits
+        self._next_transition = quantize_period
+        self._period = quantize_period
+
+    def update(self, step: int, allow_transition: bool = True) -> int:
+        """Advance to ``step``; one bit-halving per elapsed period (gated
+        by ``allow_transition`` — the eigenvalue hook)."""
+        while (step >= self._next_transition
+               and self.current_bits > self.target_bits):
+            if not allow_transition:
+                # defer: re-check after the same period
+                self._next_transition = step + self._period
+                return self.current_bits
+            self.current_bits = max(self.target_bits, self.current_bits // 2)
+            self._period *= self.period_factor
+            self._next_transition += self._period
+            logger.info(f"MoQ: step {step} → {self.current_bits}-bit")
+        return self.current_bits
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"current_bits": self.current_bits,
+                "next_transition": self._next_transition,
+                "period": self._period}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.current_bits = int(sd["current_bits"])
+        self._next_transition = int(sd["next_transition"])
+        self._period = int(sd["period"])
+
+
+class MoQQuantizer:
+    """Config-driven MoQ over a param tree (ref Quantizer.quantize).
+
+    config (mirroring the reference's ``quantize_training`` block)::
+
+        {"enabled": true, "quantize_bits": {"start_bits": 16,
+         "target_bits": 8}, "schedule": {"quantize_period": 100,
+         "schedule_offset": 0}, "quantize_groups": 64,
+         "eigenvalue": {"enabled": false, "max_iter": 10, "tol": 1e-2,
+                        "stability": 1e-6}}
+    """
+
+    def __init__(self, config: Dict[str, Any]):
+        qt = config.get("quantize_training", config) or {}
+        self.enabled = bool(qt.get("enabled", False))
+        bits = qt.get("quantize_bits", {})
+        sched = qt.get("schedule", {})
+        self.schedule_offset = int(sched.get("schedule_offset", 0))
+        self.scheduler = MoQScheduler(
+            start_bits=int(bits.get("start_bits", 16)),
+            target_bits=int(bits.get("target_bits", 8)),
+            quantize_period=int(sched.get("quantize_period", 100)))
+        self.quantize_groups = int(qt.get("quantize_groups", 64))
+        ev = qt.get("eigenvalue", {}) or {}
+        self.eigenvalue_enabled = bool(ev.get("enabled", False))
+        self._eig = Eigenvalue(max_iter=int(ev.get("max_iter", 10)),
+                               tol=float(ev.get("tol", 1e-2)),
+                               stability=float(ev.get("stability", 1e-6))) \
+            if self.eigenvalue_enabled else None
+        self._last_eig: Optional[float] = None
+        self._eig_threshold = float(ev.get("threshold", 1.0))
+
+    # ------------------------------------------------------------------
+    def check_eigenvalue(self, loss_fn: Callable, params: Any, key) -> bool:
+        """Transition gate: allow the bit drop only once curvature settled
+        below threshold (ref eigenvalue-based MoQ precision switching)."""
+        if self._eig is None:
+            return True
+        out = self._eig.compute(loss_fn, params, key)
+        self._last_eig = out["__global__"]
+        ok = self._last_eig <= self._eig_threshold
+        if not ok:
+            logger.info(f"MoQ: eigenvalue {self._last_eig:.3g} > "
+                        f"{self._eig_threshold:.3g}; deferring bit drop")
+        return ok
+
+    def current_bits(self, step: int, loss_fn: Optional[Callable] = None,
+                     params: Any = None, key=None) -> int:
+        if not self.enabled or step < self.schedule_offset:
+            return self.scheduler.start_bits
+        allow = True
+        if (self.eigenvalue_enabled and loss_fn is not None
+                and step >= self.scheduler._next_transition
+                and self.scheduler.current_bits > self.scheduler.target_bits):
+            allow = self.check_eigenvalue(
+                loss_fn, params,
+                key if key is not None else jax.random.PRNGKey(step))
+        return self.scheduler.update(step - self.schedule_offset, allow)
+
+    def quantize(self, params: Any, step: int, **gate_kw) -> Any:
+        """Fake-quantize ≥2-D weights at the current bit-width (apply
+        inside the jitted loss like the compression manager)."""
+        bits = self.current_bits(step, **gate_kw)
+        if not self.enabled or bits >= 16:
+            return params
+        return jax.tree.map(
+            lambda w: quantize_weight_ste(w, bits=bits,
+                                          group_size=self.quantize_groups)
+            if np.ndim(w) >= 2 else w, params)

@@ -1,0 +1,137 @@
+"""SuperOffload — pipelined host optimizer with rollback.
+
+Analog of ``deepspeed/runtime/superoffload/superoffload_stage3.py`` (646
+LoC): on superchip-class hosts (fast host↔device links; on TPU VMs the
+PCIe/DMA path plays this role), the full fp32 optimizer state lives on the
+host and the Adam step runs there, *bucketed and pipelined* so host compute
+for bucket i overlaps the device→host transfer of bucket i+1.  A one-step
+rollback window supports overflow recovery: if the engine detects a
+non-finite global grad norm after the fact, ``rollback()`` restores the
+previous master params and moments (the reference's rollback optimizer).
+
+The device keeps only the working-precision params; ``step`` returns the
+refreshed device tree (the host→device push of updated masters).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SuperOffloadOptimizer:
+    """Host-resident bucketed Adam with one-step rollback.
+
+    ``bucket_size``: leaves are grouped into roughly equal-byte buckets;
+    each bucket's (transfer → host adam) runs on a thread pool so transfers
+    and host math overlap (ref CPUAdam batching in superoffload_stage3).
+    """
+
+    def __init__(self, params: Any, lr: float = 1e-3, betas=(0.9, 0.999),
+                 eps: float = 1e-8, weight_decay: float = 0.0,
+                 bucket_bytes: int = 64 << 20, max_workers: int = 4,
+                 rollback_window: int = 1):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.step_count = 0
+        self.rollback_window = rollback_window
+        leaves, self._treedef = jax.tree_util.tree_flatten(params)
+        self._dtypes = [l.dtype for l in leaves]
+        # np.array (copy) — device_get may return read-only buffers
+        self._master = [np.array(jax.device_get(l), np.float32) for l in leaves]
+        self._m = [np.zeros_like(x) for x in self._master]
+        self._v = [np.zeros_like(x) for x in self._master]
+        self._prev: Optional[Dict[str, Any]] = None
+        # bucket planning by bytes
+        self._buckets: List[List[int]] = []
+        cur: List[int] = []
+        cur_bytes = 0
+        for i, x in enumerate(self._master):
+            cur.append(i)
+            cur_bytes += x.nbytes
+            if cur_bytes >= bucket_bytes:
+                self._buckets.append(cur)
+                cur, cur_bytes = [], 0
+        if cur:
+            self._buckets.append(cur)
+        self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=max_workers)
+
+    # ------------------------------------------------------------------
+    def _snapshot(self) -> None:
+        if self.rollback_window > 0:
+            self._prev = {"master": [x.copy() for x in self._master],
+                          "m": [x.copy() for x in self._m],
+                          "v": [x.copy() for x in self._v],
+                          "step": self.step_count}
+
+    def rollback(self) -> None:
+        """Restore the pre-step state (ref rollback optimizer for overflow
+        recovery)."""
+        if self._prev is None:
+            raise RuntimeError("no snapshot available to roll back to")
+        self._master = self._prev["master"]
+        self._m = self._prev["m"]
+        self._v = self._prev["v"]
+        self.step_count = self._prev["step"]
+        self._prev = None
+
+    def _bucket_step(self, bucket: List[int], grads: List[np.ndarray],
+                     step: int) -> None:
+        from deepspeed_tpu.ops.cpu_optimizer import _lib, _ptr, adam_step_numpy
+
+        lib = _lib()
+        b1, b2 = self.beta1, self.beta2
+        for j, i in enumerate(bucket):
+            g = np.ascontiguousarray(grads[j], np.float32)
+            p, m, v = self._master[i], self._m[i], self._v[i]
+            if lib is not None:
+                # vectorized fused step (csrc/cpu_optimizer) — classic Adam
+                # with coupled weight decay, matching the numpy fallback
+                lib.ds_adam_step(_ptr(p), _ptr(g), _ptr(m), _ptr(v), p.size,
+                                 self.lr, b1, b2, self.eps,
+                                 self.weight_decay, step, 0)
+            else:
+                adam_step_numpy(p, g, m, v, self.lr, b1, b2, self.eps,
+                                self.weight_decay, step, adamw=False)
+
+    def step(self, params: Any, grads: Any) -> Any:
+        """grads (device tree) → updated device params.  Transfers and host
+        Adam are pipelined per bucket."""
+        self._snapshot()
+        self.step_count += 1
+        step = self.step_count
+        flat_g = jax.tree_util.tree_flatten(grads)[0]
+        futures = []
+        for bucket in self._buckets:
+            # device→host fetch for this bucket (async under the hood), then
+            # hand host math to the pool while the next bucket transfers
+            host_g = [np.asarray(jax.device_get(flat_g[i]), np.float32)
+                      for i in bucket]
+            futures.append(self._pool.submit(self._bucket_step, bucket,
+                                             host_g, step))
+        for f in futures:
+            f.result()
+        new_leaves = [jnp.asarray(x, dt) for x, dt in
+                      zip(self._master, self._dtypes)]
+        flat_p = jax.tree_util.tree_flatten(params)[0]
+        new_leaves = [jax.device_put(x, l.sharding) if hasattr(l, "sharding")
+                      else x for x, l in zip(new_leaves, flat_p)]
+        return jax.tree_util.tree_unflatten(self._treedef, new_leaves)
+
+    # ------------------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        return {"step": self.step_count,
+                "master": self._master, "m": self._m, "v": self._v}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.step_count = int(state["step"])
+        self._master = [np.array(x, np.float32) for x in state["master"]]
+        self._m = [np.array(x, np.float32) for x in state["m"]]
+        self._v = [np.array(x, np.float32) for x in state["v"]]

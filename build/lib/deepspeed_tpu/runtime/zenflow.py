@@ -1,0 +1,233 @@
+"""ZenFlow — stall-free optimizer offload via importance-split updates.
+
+Analog of ``deepspeed/runtime/zenflow/`` (+ ``ops/adam/zenflow_torch_adam.py
+:43``): plain ZeRO-Offload stalls the accelerator while the CPU runs the
+full optimizer step.  ZenFlow splits gradients by importance: the top-k
+columns of each weight (by squared norm) are updated *immediately* with
+device-resident Adam state, while the cold remainder accumulates on the
+host and is applied asynchronously every ``update_interval`` steps — the
+device never waits on the host path.
+
+TPU realisation: the hot update is a jitted gather→adam→scatter on a
+fixed-k column set (``jax.lax.top_k`` keeps shapes static), so XLA fuses it
+into the step.  Hot columns are zeroed out of the gradient before it joins
+the host accumulator, so hot and cold partitions never double-apply; the
+async host Adam produces a *pending delta* that is added to the device
+params at the start of the next step after the worker lands — the same
+eventual-consistency contract as the reference's async CPU AdamW.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _hot_update(param, grad, m, v, idx, lr, beta1, beta2, eps, step):
+    """Adam on the selected columns only (gather → update → scatter).
+    Returns (new_param, new_m, new_v, cold_grad) where cold_grad has the
+    hot columns zeroed."""
+    gf = grad.astype(jnp.float32)
+    g_hot = jnp.take(gf, idx, axis=-1)
+    m_hot = beta1 * jnp.take(m, idx, axis=-1) + (1 - beta1) * g_hot
+    v_hot = beta2 * jnp.take(v, idx, axis=-1) + (1 - beta2) * g_hot ** 2
+    mh = m_hot / (1 - beta1 ** step)
+    vh = v_hot / (1 - beta2 ** step)
+    delta = lr * mh / (jnp.sqrt(vh) + eps)
+    p32 = param.astype(jnp.float32)
+    new_p = p32.at[..., idx].set(jnp.take(p32, idx, axis=-1) - delta)
+    cold = gf.at[..., idx].set(0.0)
+    return (new_p.astype(param.dtype), m.at[..., idx].set(m_hot),
+            v.at[..., idx].set(v_hot), cold)
+
+
+def _topk_columns(g, k: int):
+    norms = (g.astype(jnp.float32) ** 2).reshape(-1, g.shape[-1]).sum(axis=0)
+    return jax.lax.top_k(norms, k)[1]
+
+
+class ZenFlowOptimizer:
+    """Importance-split Adam over a param pytree.
+
+    ``topk_ratio``: fraction of columns updated on device each step.
+    ``update_interval``: cold (host) update cadence in steps.
+    ``overlap``: run the host Adam on a worker thread (stall-free mode).
+    """
+
+    def __init__(self, params: Any, lr: float = 1e-3, betas=(0.9, 0.999),
+                 eps: float = 1e-8, topk_ratio: float = 0.1,
+                 update_interval: int = 4, overlap: bool = True):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.topk_ratio = topk_ratio
+        self.update_interval = update_interval
+        self.overlap = overlap
+        self.step_count = 0
+        self.cold_updates = 0
+        is_mat = lambda x: x.ndim >= 2  # noqa: E731
+        # device Adam moments, touched only on hot columns
+        self._dev_m = jax.tree.map(
+            lambda x: jnp.zeros(x.shape, jnp.float32) if is_mat(x) else None, params)
+        self._dev_v = jax.tree.map(
+            lambda x: jnp.zeros(x.shape, jnp.float32) if is_mat(x) else None, params)
+        # host Adam state, touched only on cold entries
+        self._host_m = jax.tree.map(
+            lambda x: np.zeros(x.shape, np.float32), params)
+        self._host_v = jax.tree.map(
+            lambda x: np.zeros(x.shape, np.float32), params)
+        self._cold_acc = jax.tree.map(
+            lambda x: np.zeros(x.shape, np.float32), params)
+        self._cold_steps = 0
+        self._pending_delta: Optional[Any] = None
+        self._worker: Optional[threading.Thread] = None
+        self._hot_jit = jax.jit(_hot_update)
+        self._apply_delta_jit = jax.jit(
+            lambda p, d: jax.tree.map(
+                lambda a, b: (a.astype(jnp.float32) + b).astype(a.dtype), p, d))
+
+    def _k(self, width: int) -> int:
+        return max(1, int(round(width * self.topk_ratio)))
+
+    # ------------------------------------------------------------------
+    def step(self, params: Any, grads: Any) -> Any:
+        """One ZenFlow step → new params."""
+        self.wait()
+        if self._pending_delta is not None:  # land the async cold update
+            params = self._apply_delta_jit(
+                params, jax.device_put(self._pending_delta))
+            self._pending_delta = None
+        self.step_count += 1
+        step = self.step_count
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_g = jax.tree_util.tree_flatten(grads)[0]
+        none_leaf = lambda x: x is None  # noqa: E731
+        flat_m = jax.tree_util.tree_flatten(self._dev_m, is_leaf=none_leaf)[0]
+        flat_v = jax.tree_util.tree_flatten(self._dev_v, is_leaf=none_leaf)[0]
+        out_p, out_m, out_v, cold_g = [], [], [], []
+        for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v):
+            if m is None:  # vectors/scalars: all-cold
+                out_p.append(p)
+                out_m.append(None)
+                out_v.append(None)
+                cold_g.append(g.astype(jnp.float32))
+                continue
+            idx = _topk_columns(g, self._k(p.shape[-1]))
+            # step as a traced array so per-step calls hit the jit cache
+            p2, m2, v2, cg = self._hot_jit(p, g, m, v, idx,
+                                           jnp.float32(self.lr), self.beta1,
+                                           self.beta2, self.eps,
+                                           jnp.float32(step))
+            out_p.append(p2)
+            out_m.append(m2)
+            out_v.append(v2)
+            cold_g.append(cg)
+        self._dev_m = jax.tree_util.tree_unflatten(treedef, out_m)
+        self._dev_v = jax.tree_util.tree_unflatten(treedef, out_v)
+        new_params = jax.tree_util.tree_unflatten(treedef, out_p)
+
+        host_cold = [np.asarray(jax.device_get(g), np.float32) for g in cold_g]
+        flat_acc = jax.tree_util.tree_flatten(self._cold_acc)[0]
+        for acc, g in zip(flat_acc, host_cold):
+            acc += g
+        self._cold_steps += 1
+        if self._cold_steps >= self.update_interval:
+            n = self._cold_steps
+            self._cold_steps = 0
+            if self.overlap:
+                self._worker = threading.Thread(target=self._cold_update,
+                                                args=(n,), daemon=True)
+                self._worker.start()
+            else:
+                self._cold_update(n)
+        return new_params
+
+    def _cold_update(self, n_accum: int) -> None:
+        """Host Adam on the averaged cold grads → pending delta.  Entries
+        with zero accumulated grad (the hot columns) see only moment decay,
+        matching the reference's disjoint partitions."""
+        self.cold_updates += 1
+        step = self.cold_updates
+
+        def upd(m, v, acc):
+            g = acc / max(1, n_accum)
+            m *= self.beta1
+            m += (1 - self.beta1) * g
+            v *= self.beta2
+            v += (1 - self.beta2) * g * g
+            mh = m / (1 - self.beta1 ** step)
+            vh = v / (1 - self.beta2 ** step)
+            delta = (-self.lr * mh / (np.sqrt(vh) + self.eps)).astype(np.float32)
+            # hot columns contributed no grad this round: suppress their
+            # decay-only drift so only cold entries move
+            delta[acc == 0] = 0.0
+            acc[:] = 0
+            return delta
+
+        self._pending_delta = jax.tree.map(upd, self._host_m, self._host_v,
+                                           self._cold_acc)
+
+    def wait(self) -> None:
+        if self._worker is not None:
+            self._worker.join()
+            self._worker = None
+
+    def flush(self, params: Any) -> Any:
+        """Force any pending/partial cold state to land (checkpoint
+        boundary)."""
+        self.wait()
+        if self._cold_steps:
+            self._cold_update(self._cold_steps)
+            self._cold_steps = 0
+        if self._pending_delta is not None:
+            params = self._apply_delta_jit(params,
+                                           jax.device_put(self._pending_delta))
+            self._pending_delta = None
+        return params
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Complete optimizer state: host AND device moments, the partial
+        cold accumulator, and any un-landed pending delta — so a
+        save/resume continues the exact trajectory (hot-column Adam state
+        and in-flight cold work included)."""
+        self.wait()
+        none_leaf = lambda x: x is None  # noqa: E731
+        to_np = lambda t: jax.tree.map(  # noqa: E731
+            lambda x: None if x is None else np.asarray(jax.device_get(x)),
+            t, is_leaf=none_leaf)
+        # host state is mutated IN PLACE by _cold_update — snapshot copies
+        # so later steps can't corrupt a saved checkpoint
+        copy_np = lambda t: jax.tree.map(np.copy, t)  # noqa: E731
+        return {"step": self.step_count, "cold_updates": self.cold_updates,
+                "cold_steps": self._cold_steps,
+                "host_m": copy_np(self._host_m),
+                "host_v": copy_np(self._host_v),
+                "cold_acc": copy_np(self._cold_acc),
+                "dev_m": to_np(self._dev_m), "dev_v": to_np(self._dev_v),
+                "pending_delta": None if self._pending_delta is None
+                else copy_np(self._pending_delta)}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.wait()
+        self.step_count = int(state["step"])
+        self.cold_updates = int(state["cold_updates"])
+        self._cold_steps = int(state.get("cold_steps", 0))
+        copy_np = lambda t: jax.tree.map(np.copy, t)  # noqa: E731
+        self._host_m = copy_np(state["host_m"])
+        self._host_v = copy_np(state["host_v"])
+        if "cold_acc" in state:
+            self._cold_acc = copy_np(state["cold_acc"])
+        none_leaf = lambda x: x is None  # noqa: E731
+        if "dev_m" in state:
+            to_dev = lambda t: jax.tree.map(  # noqa: E731
+                lambda x: None if x is None else jnp.asarray(x),
+                t, is_leaf=none_leaf)
+            self._dev_m = to_dev(state["dev_m"])
+            self._dev_v = to_dev(state["dev_v"])
+        pend = state.get("pending_delta")
+        self._pending_delta = None if pend is None else copy_np(pend)

@@ -1,0 +1,19 @@
+"""Sequence parallelism & long-context: Ulysses (layer), FPDT (fpdt),
+ALST tiled compute (alst)."""
+
+from deepspeed_tpu.sequence.layer import (DistributedAttention,
+                                          UlyssesAttentionHF,
+                                          single_all_to_all,
+                                          ulysses_output_constraint,
+                                          ulysses_qkv_constraint)
+from deepspeed_tpu.sequence.fpdt import (FPDTAttention, chunked_attention,
+                                         chunked_ffn)
+from deepspeed_tpu.sequence.alst import (SPDataLoader, sp_shard_batch,
+                                         tiled_logits_loss, tiled_mlp)
+
+__all__ = [
+    "DistributedAttention", "UlyssesAttentionHF", "single_all_to_all",
+    "ulysses_qkv_constraint", "ulysses_output_constraint",
+    "FPDTAttention", "chunked_attention", "chunked_ffn",
+    "SPDataLoader", "sp_shard_batch", "tiled_logits_loss", "tiled_mlp",
+]

@@ -1,0 +1,136 @@
+"""ALST — Arctic Long Sequence Training building blocks.
+
+Re-design of the reference's Ulysses-SP HF integration
+(``deepspeed/runtime/sequence_parallel/ulysses_sp.py``: ``UlyssesSPAttentionHF``
+:49, DataLoader shard adapter :471, ``TiledMLP`` :838, tiled logits+loss
+:960).  The attention half lives in :mod:`deepspeed_tpu.sequence.layer`
+(Ulysses all-to-all); this module provides the memory-capping tiled compute
+and the sequence-sharding data adapter.
+
+TPU-native notes: tiling is a ``lax.scan`` over sequence tiles with
+``jax.checkpoint`` per tile, so the backward pass rematerialises one tile at
+a time — the same activation-memory bound the reference gets from its
+autograd-function tiling, but visible to XLA as a single compiled loop.
+The tiled loss never materialises the [B, S, V] logits tensor: each tile
+computes logits → log-sum-exp → label pick and only the scalar partial sums
+cross tile boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def tiled_mlp(fn: Callable, x, num_tiles: int, remat: bool = True):
+    """Apply ``fn`` over sequence tiles sequentially (ref TiledMLP,
+    ulysses_sp.py:838).
+
+    ``fn(x_tile) -> y_tile`` must be pointwise in the sequence dim (true for
+    transformer MLPs / layernorms).  x: [B, S, ...] with S divisible by
+    ``num_tiles``.  Live activation memory is one tile.
+    """
+    b, s = x.shape[0], x.shape[1]
+    if s % num_tiles != 0:
+        raise ValueError(f"seq {s} not divisible by num_tiles {num_tiles}")
+    tile = s // num_tiles
+    xt = x.reshape((b, num_tiles, tile) + x.shape[2:])
+    xt = jnp.moveaxis(xt, 1, 0)  # [N, B, tile, ...]
+    body = jax.checkpoint(fn) if remat else fn
+
+    def step(_, xi):
+        return None, body(xi)
+
+    _, yt = lax.scan(step, None, xt)
+    yt = jnp.moveaxis(yt, 0, 1)
+    return yt.reshape((b, s) + yt.shape[3:])
+
+
+def tiled_logits_loss(hidden, w_embed, labels, num_tiles: int,
+                      ignore_index: int = -100,
+                      logit_cap: Optional[float] = None):
+    """Sequence-tiled cross-entropy without materialising [B, S, V] logits
+    (ref tiled logits+loss, ulysses_sp.py:960).
+
+    hidden: [B, S, E]; w_embed: [V, E] (tied output embedding); labels:
+    [B, S] int32 with ``ignore_index`` masking.  Returns (mean_loss,
+    valid_token_count).
+    """
+    b, s, e = hidden.shape
+    if s % num_tiles != 0:
+        raise ValueError(f"seq {s} not divisible by num_tiles {num_tiles}")
+    tile = s // num_tiles
+    ht = jnp.moveaxis(hidden.reshape(b, num_tiles, tile, e), 1, 0)
+    lt = jnp.moveaxis(labels.reshape(b, num_tiles, tile), 1, 0)
+
+    def tile_loss(h_i, y_i):
+        # matmul in the input dtype (bf16 on TPU → MXU) with fp32
+        # accumulation; fp32 inputs are unchanged
+        logits = jnp.einsum("bte,ve->btv", h_i, w_embed,
+                            preferred_element_type=jnp.float32)
+        if logit_cap is not None:
+            logits = logit_cap * jnp.tanh(logits / logit_cap)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        y_safe = jnp.where(y_i == ignore_index, 0, y_i)
+        gold = jnp.take_along_axis(logits, y_safe[..., None], axis=-1)[..., 0]
+        valid = (y_i != ignore_index)
+        nll = jnp.where(valid, lse - gold, 0.0)
+        return nll.sum(), valid.sum()
+
+    def step(carry, xs):
+        loss_sum, count = carry
+        h_i, y_i = xs
+        li, ci = jax.checkpoint(tile_loss)(h_i, y_i)
+        return (loss_sum + li, count + ci), None
+
+    (loss_sum, count), _ = lax.scan(
+        step, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)), (ht, lt))
+    return loss_sum / jnp.maximum(count, 1).astype(jnp.float32), count
+
+
+def sp_shard_batch(batch: Dict[str, np.ndarray], sp_rank: int, sp_size: int,
+                   seq_keys=("input_ids", "labels", "attention_mask",
+                             "position_ids")) -> Dict[str, np.ndarray]:
+    """Slice a host batch's sequence dim for one SP rank (ref DataLoader
+    shard adapter, ulysses_sp.py:471).
+
+    Each SP rank sees the same samples but a disjoint 1/sp_size slice of the
+    sequence; keys not in ``seq_keys`` pass through unsliced.
+    """
+    if sp_size == 1:
+        return dict(batch)
+    out = {}
+    for key, val in batch.items():
+        if key in seq_keys and val is not None and np.ndim(val) >= 2:
+            s = val.shape[1]
+            if s % sp_size != 0:
+                raise ValueError(
+                    f"batch['{key}'] seq len {s} not divisible by sp_size {sp_size}")
+            shard = s // sp_size
+            out[key] = val[:, sp_rank * shard:(sp_rank + 1) * shard]
+        else:
+            out[key] = val
+    return out
+
+
+class SPDataLoader:
+    """Wrap an iterable of host batches, yielding this rank's sequence shard
+    (ref UlyssesSPDataLoaderAdapter, ulysses_sp.py:471)."""
+
+    def __init__(self, loader, sp_rank: int, sp_size: int, seq_keys=None):
+        self.loader = loader
+        self.sp_rank = sp_rank
+        self.sp_size = sp_size
+        self.seq_keys = tuple(seq_keys) if seq_keys else (
+            "input_ids", "labels", "attention_mask", "position_ids")
+
+    def __iter__(self):
+        for batch in self.loader:
+            yield sp_shard_batch(batch, self.sp_rank, self.sp_size, self.seq_keys)
+
+    def __len__(self):
+        return len(self.loader)

@@ -1,0 +1,57 @@
+"""OnDevice — meta/abstract model construction.
+
+Analog of ``deepspeed/utils/init_on_device.py`` (``OnDevice``): build a
+model without allocating real storage ("meta" device) or directly on a
+target device/dtype.  Functionally: ``device="meta"`` evaluates the init
+shape-only (``jax.eval_shape``); a real device jits the init with placement.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class OnDevice:
+    """Usage::
+
+        with OnDevice(dtype=jnp.bfloat16, device="meta") as ctx:
+            shapes = ctx.init(init_fn, rng)      # ShapeDtypeStructs only
+
+        with OnDevice(dtype=jnp.bfloat16) as ctx:  # default device
+            params = ctx.init(init_fn, rng)
+    """
+
+    def __init__(self, dtype=None, device: Optional[str] = None,
+                 enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+
+    def __enter__(self) -> "OnDevice":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def init(self, init_fn: Callable, *args) -> Any:
+        fn = init_fn
+        if self.dtype is not None:
+            base = init_fn
+
+            def fn(*a):
+                return jax.tree.map(
+                    lambda x: x.astype(self.dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x, base(*a))
+
+        if not self.enabled:
+            return fn(*args)
+        if self.device == "meta":
+            return jax.eval_shape(fn, *args)
+        if self.device is None:
+            return jax.jit(fn)(*args)
+        dev = jax.devices(self.device)[0] if isinstance(self.device, str) \
+            else self.device
+        return jax.device_put(jax.jit(fn)(*args), dev)

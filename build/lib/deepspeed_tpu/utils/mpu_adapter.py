@@ -1,0 +1,93 @@
+"""Megatron ``mpu`` interface adapter.
+
+The reference accepts an ``mpu`` object (Megatron's model-parallel unit)
+everywhere group information is needed (``deepspeed.initialize(mpu=...)``,
+``groups.initialize(mpu=mpu)``).  :class:`MpuAdapter` exposes that
+interface backed by the mesh topology, so ported Megatron-style callers
+keep their ``mpu.get_*`` call sites; conversely :func:`topology_from_mpu`
+builds a mesh from a foreign mpu's sizes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from deepspeed_tpu.parallel.topology import MeshTopology, get_topology
+
+
+class MpuAdapter:
+    """Megatron mpu surface over a MeshTopology (ref utils/groups.py mpu
+    consumption: get_model_parallel_world_size/rank, get_data_parallel_*,
+    get_tensor_model_parallel_*, get_pipeline_model_parallel_*)."""
+
+    def __init__(self, topology: Optional[MeshTopology] = None):
+        self._topo = topology
+
+    @property
+    def topo(self) -> MeshTopology:
+        t = self._topo or get_topology()
+        if t is None:
+            raise RuntimeError("mpu adapter needs an initialized topology")
+        return t
+
+    # -- tensor/model parallel -----------------------------------------
+    def get_model_parallel_world_size(self) -> int:
+        return self.topo.tp_size
+
+    get_tensor_model_parallel_world_size = get_model_parallel_world_size
+
+    def get_model_parallel_rank(self) -> int:
+        # single-controller SPMD: rank-dependent code paths don't exist;
+        # report the process's first local device's coordinate
+        return 0
+
+    get_tensor_model_parallel_rank = get_model_parallel_rank
+
+    def get_model_parallel_group(self):
+        return ("tensor",)  # mesh-axis handle usable with shard_map
+
+    get_tensor_model_parallel_group = get_model_parallel_group
+
+    # -- data parallel --------------------------------------------------
+    def get_data_parallel_world_size(self) -> int:
+        return self.topo.dp_size
+
+    def get_data_parallel_rank(self) -> int:
+        return 0
+
+    def get_data_parallel_group(self):
+        return ("data",)
+
+    # -- pipeline parallel ----------------------------------------------
+    def get_pipeline_model_parallel_world_size(self) -> int:
+        return self.topo.pp_size
+
+    def get_pipeline_model_parallel_rank(self) -> int:
+        return 0
+
+    def get_pipeline_model_parallel_group(self):
+        return ("pipe",)
+
+    # -- sequence parallel (ALST parallel_state_sp parity) ---------------
+    def get_sequence_parallel_world_size(self) -> int:
+        return self.topo.sp_size
+
+    def get_sequence_parallel_group(self):
+        return ("seq",)
+
+
+def topology_from_mpu(mpu) -> MeshTopology:
+    """Build a mesh from a foreign Megatron mpu's sizes (ref
+    engine._configure_distributed_model mpu path)."""
+    sizes = {}
+    tp = getattr(mpu, "get_tensor_model_parallel_world_size",
+                 getattr(mpu, "get_model_parallel_world_size", lambda: 1))()
+    pp = getattr(mpu, "get_pipeline_model_parallel_world_size", lambda: 1)()
+    dp = getattr(mpu, "get_data_parallel_world_size", lambda: 1)()
+    if tp > 1:
+        sizes["tensor"] = tp
+    if pp > 1:
+        sizes["pipe"] = pp
+    if dp > 1:
+        sizes["data"] = dp
+    return MeshTopology(sizes or None)

@@ -1,0 +1,159 @@
+"""Wall-clock timers and throughput accounting.
+
+TPU-native analog of ``deepspeed/utils/timer.py``: instead of CUDA events we
+block on JAX async dispatch with ``jax.block_until_ready`` (opt-in, since on
+TPU every forced sync costs pipeline overlap).  Timer names mirror the
+reference (``SynchronizedWallClockTimer``, ``ThroughputTimer``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.utils.logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+class Timer:
+    """One named timer supporting start/stop/elapsed with accumulation."""
+
+    def __init__(self, name: str, synchronize: bool = False):
+        self.name = name
+        self.synchronize = synchronize
+        self.started = False
+        self._start_time = 0.0
+        self._elapsed = 0.0
+        self._count = 0
+        self._records: List[float] = []
+
+    def _sync(self, obj: Any = None) -> None:
+        if self.synchronize:
+            import jax
+
+            if obj is not None:
+                jax.block_until_ready(obj)
+            else:
+                # Drain all pending device work.
+                jax.effects_barrier()
+
+    def start(self) -> None:
+        if self.started:
+            return
+        self._sync()
+        self._start_time = time.perf_counter()
+        self.started = True
+
+    def stop(self, record: bool = True, ready: Any = None) -> None:
+        if not self.started:
+            return
+        self._sync(ready)
+        dt = time.perf_counter() - self._start_time
+        self._elapsed += dt
+        self._count += 1
+        if record:
+            self._records.append(dt)
+        self.started = False
+
+    def reset(self) -> None:
+        self.started = False
+        self._elapsed = 0.0
+        self._count = 0
+        self._records = []
+
+    def elapsed(self, reset: bool = True) -> float:
+        """Total elapsed seconds since last reset."""
+        value = self._elapsed
+        if self.started:
+            value += time.perf_counter() - self._start_time
+        if reset:
+            self.reset()
+        return value
+
+    def mean(self) -> float:
+        return self._elapsed / self._count if self._count else 0.0
+
+
+class SynchronizedWallClockTimer:
+    """Group of named timers. ``timer(name)`` creates on first use."""
+
+    def __init__(self, synchronize: bool = False):
+        self.timers: Dict[str, Timer] = {}
+        self.synchronize = synchronize
+
+    def __call__(self, name: str) -> Timer:
+        if name not in self.timers:
+            self.timers[name] = Timer(name, synchronize=self.synchronize)
+        return self.timers[name]
+
+    def has_timer(self, name: str) -> bool:
+        return name in self.timers
+
+    def log(self, names: List[str], reset: bool = True, ranks=None) -> None:
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0
+                parts.append(f"{name}: {ms:.2f}ms")
+        if parts:
+            log_dist("time (ms) | " + " | ".join(parts), ranks=ranks)
+
+    def get_mean(self, names: List[str]) -> Dict[str, float]:
+        return {n: self.timers[n].mean() * 1000.0 for n in names if n in self.timers}
+
+
+class ThroughputTimer:
+    """samples/sec + tokens/sec tracking across steps (ref: utils/timer.py).
+
+    ``batch_size`` is the global train batch; call ``start()``/``stop()``
+    around each step. The first ``start_step`` steps are treated as warmup.
+    """
+
+    def __init__(self,
+                 batch_size: int,
+                 start_step: int = 2,
+                 steps_per_output: Optional[int] = None,
+                 monitor_memory: bool = False):
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self._start_time = 0.0
+        self.started = False
+
+    def start(self) -> None:
+        self.started = True
+        self._start_time = time.perf_counter()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True) -> None:
+        if not self.started:
+            return
+        self.started = False
+        duration = time.perf_counter() - self._start_time
+        if global_step:
+            self.global_step_count += 1
+            if self.global_step_count > self.start_step:
+                self.total_elapsed_time += duration
+                self.step_elapsed_time += duration
+            if (report_speed and self.steps_per_output
+                    and self.global_step_count % self.steps_per_output == 0):
+                log_dist(
+                    f"step={self.global_step_count}, "
+                    f"samples/sec={self.avg_samples_per_sec():.2f}")
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        counted = self.global_step_count - self.start_step
+        if counted > 0 and self.total_elapsed_time > 0:
+            return counted * self.batch_size / self.total_elapsed_time
+        return 0.0

@@ -1,0 +1,162 @@
+"""ZeRO user-facing API surface.
+
+Analogs of ``deepspeed.zero``:
+* :class:`Init` — construct params already partitioned (ref ``zero.Init``,
+  runtime/zero/partition_parameters.py:878).  The reference patches
+  nn.Module constructors to scatter tensors at creation; functionally, the
+  same contract is "init functions evaluated shape-only, then materialised
+  directly into ZeRO-3 shardings" — no full replica ever exists.
+* :func:`GatheredParameters` — temporarily materialise full params (ref
+  partition_parameters.py GatheredParameters ctx) for host-side surgery.
+* Memory estimators (ref runtime/zero/stage3.py
+  ``estimate_zero3_model_states_mem_needs_all_live``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from deepspeed_tpu.parallel.sharding import ShardingRules
+from deepspeed_tpu.parallel.topology import MeshTopology, get_topology
+
+
+class Init:
+    """Sharded model construction context (ref zero.Init).
+
+    Usage::
+
+        with deepspeed_tpu.zero.Init(zero_stage=3) as zinit:
+            params = zinit.materialize(init_fn, rng)
+
+    ``materialize`` evaluates ``init_fn`` abstractly (shapes only), plans
+    ZeRO shardings for the current mesh, and jits the initializer with
+    those out-shardings — each device materialises only its shard, the
+    functional equivalent of the reference's scatter-at-construction.
+    """
+
+    def __init__(self, zero_stage: int = 3,
+                 topology: Optional[MeshTopology] = None,
+                 dtype=None):
+        self.zero_stage = zero_stage
+        self.topology = topology
+        self.dtype = dtype
+        self._rules: Optional[ShardingRules] = None
+
+    def __enter__(self) -> "Init":
+        topo = self.topology or get_topology()
+        if topo is None:
+            from deepspeed_tpu.comm.comm import init_distributed
+
+            topo = init_distributed()
+        self.topology = topo
+        self._rules = ShardingRules(topo, zero_stage=self.zero_stage)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def materialize(self, init_fn: Callable, *args) -> Any:
+        if self._rules is None:
+            raise RuntimeError("zero.Init used outside its context")
+        shapes = jax.eval_shape(init_fn, *args)
+        shardings = self._rules.tree_shardings(shapes, param_style=True)
+        fn = init_fn
+        if self.dtype is not None:
+            base = init_fn
+
+            def fn(*a):
+                return jax.tree.map(lambda x: x.astype(self.dtype), base(*a))
+
+        return jax.jit(fn, out_shardings=shardings)(*args)
+
+    def shardings_for(self, params_or_shapes) -> Any:
+        if self._rules is None:
+            raise RuntimeError("zero.Init used outside its context")
+        return self._rules.tree_shardings(params_or_shapes, param_style=True)
+
+
+class GatheredParameters:
+    """Materialise full host copies of sharded params inside the context
+    (ref GatheredParameters, partition_parameters.py): ``ctx.params`` is a
+    mutable numpy tree; after exit ``ctx.updated`` holds the edited tree
+    re-scattered to the original shardings.
+
+    Functional arrays can't be mutated in place, so the reference's
+    "modifications write back into the module" becomes "read
+    ``ctx.updated`` after the block" (or use :func:`gathered_update`).
+    """
+
+    def __init__(self, params, modifier_rank: Optional[int] = 0):
+        self._orig = params
+        self.params = None
+        self.updated = None
+
+    def __enter__(self):
+        self.params = jax.tree.map(
+            lambda x: np.array(jax.device_get(x)), self._orig)
+        return self.params
+
+    def __exit__(self, *exc):
+        def put_back(orig, new):
+            if hasattr(orig, "sharding"):
+                return jax.device_put(np.asarray(new, dtype=orig.dtype),
+                                      orig.sharding)
+            return new
+
+        self.updated = jax.tree.map(put_back, self._orig, self.params)
+        return None
+
+
+def gathered_update(params, edit_fn: Callable) -> Any:
+    """Functional form of GatheredParameters: gather → edit on host →
+    re-scatter; returns the updated sharded tree."""
+    full = jax.tree.map(lambda x: np.array(jax.device_get(x)), params)
+    edited = edit_fn(full)
+
+    def put_back(orig, new):
+        if hasattr(orig, "sharding"):
+            return jax.device_put(np.asarray(new, dtype=orig.dtype),
+                                  orig.sharding)
+        return new
+
+    return jax.tree.map(put_back, params, edited)
+
+
+# ----------------------------------------------------------------------
+def estimate_zero3_model_states_mem_needs(total_params: int,
+                                          num_gpus_per_node: int = 1,
+                                          num_nodes: int = 1,
+                                          cpu_offload: bool = True,
+                                          cpu_offload_params: bool = False,
+                                          additional_buffer_factor: float = 1.5):
+    """Per-device + host bytes for ZeRO-3 (ref stage3.py estimator)."""
+    world = num_gpus_per_node * num_nodes
+    gpu = 2 * total_params / world  # bf16 shard
+    if not cpu_offload:
+        gpu += 16 * total_params / world  # fp32 master + adam moments
+        host = additional_buffer_factor * 4 * total_params
+    elif not cpu_offload_params:
+        host = additional_buffer_factor * 16 * total_params
+    else:
+        gpu = 2 * total_params / world
+        host = additional_buffer_factor * 18 * total_params
+    return int(gpu), int(host)
+
+
+def estimate_zero2_model_states_mem_needs(total_params: int,
+                                          num_gpus_per_node: int = 1,
+                                          num_nodes: int = 1,
+                                          cpu_offload: bool = True,
+                                          additional_buffer_factor: float = 1.5):
+    """Ref stage_1_and_2.py estimator."""
+    world = num_gpus_per_node * num_nodes
+    gpu = 4 * total_params  # bf16 params + grads replicated
+    if cpu_offload:
+        host = additional_buffer_factor * 12 * total_params
+    else:
+        gpu += 12 * total_params / world
+        host = 0
+    return int(gpu), int(host)
