@@ -72,6 +72,18 @@ def _flatten(tree, prefix: str):
     return entries, index
 
 
+def _opt_tree_for_save(engine):
+    """Optimizer tree to serialize.  SuperOffload keeps the fp32 masters and
+    moments in the host optimizer (``engine.opt_state`` is None), so saves
+    must round-trip ``_super_opt.state_dict()`` — mirroring the pickle
+    engine (checkpoint/engine.py) — or the restore silently loses them."""
+    if getattr(engine, "_super_opt", None) is not None:
+        return {"superoffload": engine._super_opt.state_dict()}
+    if getattr(engine, "_opt_store", None) is not None:
+        return engine._opt_store.swap_in()
+    return engine.opt_state
+
+
 class _CheckpointReader:
     """Lazy view over every process's tensor file + shard index in a
     checkpoint dir: only the small JSON indices are read up front; entry
@@ -146,6 +158,18 @@ def _load_tree(template, shardings, reader: _CheckpointReader, prefix: str):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def _load_host_tree(template, reader: _CheckpointReader, prefix: str):
+    """Rebuild ``template`` as host numpy (no device placement) — for
+    host-resident optimizer state (SuperOffload masters/moments)."""
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths:
+        arr = reader.read_leaf(_leaf_name(prefix, path))
+        tl = np.asarray(leaf)
+        leaves.append(arr.astype(tl.dtype).reshape(tl.shape))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 class FastCheckpointEngine:
     """Indexed-binary checkpoint via FastFileWriter (ref
     FastCheckpointEngine): one ``model_states.bin`` per tag holding params
@@ -184,8 +208,7 @@ class FastCheckpointEngine:
             from deepspeed_tpu.comm import comm
 
             comm.barrier()
-        opt_tree = (engine.opt_state if getattr(engine, "_opt_store", None) is None
-                    else engine._opt_store.swap_in())
+        opt_tree = _opt_tree_for_save(engine)
         ok = False
         all_ok = True
         try:
@@ -249,7 +272,22 @@ class FastCheckpointEngine:
         reader = _CheckpointReader(d)
         engine.params = _load_tree(engine.params, engine.param_shardings,
                                    reader, "module")
-        if load_optimizer_states and engine.opt_state is not None \
+        ckpt_is_super = reader.has_prefix("optimizer/superoffload")
+        engine_is_super = getattr(engine, "_super_opt", None) is not None
+        if load_optimizer_states and reader.has_prefix("optimizer") \
+                and ckpt_is_super != engine_is_super:
+            raise ValueError(
+                "checkpoint optimizer mode mismatch: the checkpoint was saved "
+                + ("with" if ckpt_is_super else "without")
+                + " SuperOffload but this engine is configured "
+                + ("without" if ckpt_is_super else "with")
+                + " it — match offload_optimizer.super_offload, or pass "
+                "load_optimizer_states=False to resume weights only")
+        if load_optimizer_states and ckpt_is_super and engine_is_super:
+            engine._super_opt.load_state_dict(
+                _load_host_tree(engine._super_opt.state_dict(), reader,
+                                "optimizer/superoffload"))
+        elif load_optimizer_states and engine.opt_state is not None \
                 and reader.has_prefix("optimizer"):
             engine.opt_state = _load_tree(engine.opt_state,
                                           engine.opt_shardings, reader,
@@ -301,8 +339,22 @@ class DecoupledCheckpointEngine:
         snap = _Snapshot()
         snap.params = jax.tree.map(lambda x: np.asarray(jax.device_get(x)),
                                    engine.params)
-        opt_tree = (engine.opt_state if getattr(engine, "_opt_store", None) is None
-                    else engine._opt_store.swap_in())
+        snap._super_opt = None
+        if getattr(engine, "_super_opt", None) is not None:
+            # deep-copy: the SuperOffload host thread mutates these buffers
+            # in place while the write is in flight
+            frozen_sd = jax.tree.map(np.copy, engine._super_opt.state_dict())
+
+            class _FrozenSuper:
+                def state_dict(self):
+                    return frozen_sd
+
+            snap._super_opt = _FrozenSuper()
+            opt_tree = None
+        else:
+            opt_tree = (engine.opt_state
+                        if getattr(engine, "_opt_store", None) is None
+                        else engine._opt_store.swap_in())
         snap.opt_state = None if opt_tree is None else jax.tree.map(
             lambda x: np.asarray(jax.device_get(x)), opt_tree)
         snap.loss_scale_state = jax.tree.map(

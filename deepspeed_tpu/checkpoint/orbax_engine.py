@@ -31,8 +31,23 @@ class OrbaxCheckpointEngine:
             if async_save else ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
         self._pending = None
 
+    def _reject_superoffload(self, engine) -> None:
+        # SuperOffload keeps fp32 masters/moments host-side in _super_opt;
+        # this writer's pytree contains only engine.opt_state, so a save
+        # would silently drop them (and a load would be reverted by the
+        # stale masters at the next push_params).  Refuse loudly; the
+        # pickle/fast/decoupled writers round-trip SuperOffload state.
+        if getattr(engine, "_super_opt", None) is not None:
+            from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+
+            raise DeepSpeedConfigError(
+                "offload_optimizer.super_offload is not supported by the "
+                "orbax checkpoint writer — use writer type 'fast', "
+                "'decoupled', or the default pickle engine")
+
     def save(self, engine, save_dir: str, tag: str,
              client_state: Optional[Dict[str, Any]] = None) -> None:
+        self._reject_superoffload(engine)
         path = os.path.abspath(os.path.join(save_dir, str(tag), "orbax"))
         meta = {
             "global_steps": engine.global_steps,
@@ -72,6 +87,7 @@ class OrbaxCheckpointEngine:
              load_lr_scheduler_states: bool = True):
         import json
 
+        self._reject_superoffload(engine)
         if tag is None:
             with open(os.path.join(load_dir, "latest")) as f:
                 tag = f.read().strip()

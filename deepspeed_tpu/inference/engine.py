@@ -61,7 +61,7 @@ class InferenceEngine:
         else:
             self.params = jax.device_put(
                 model_params, self.rules.tree_shardings(model_params))
-        self._decode_jit = None
+        self._kv_gen = None
         log_dist(f"InferenceEngine: tp={self.cfg.tp_size} dtype={dt.__name__}")
 
     # ------------------------------------------------------------------
@@ -73,24 +73,14 @@ class InferenceEngine:
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, seed: int = 0) -> np.ndarray:
-        """Simple full-recompute generation loop (the KV-cached decode path
-        lives in inference/v2). Greedy when temperature == 0."""
-        ids = np.asarray(input_ids)
-        if ids.ndim == 1:
-            ids = ids[None, :]
-        total = ids.shape[1] + max_new_tokens
-        if total > self.model_config.max_seq_len:
-            raise ValueError(
-                f"prompt ({ids.shape[1]}) + max_new_tokens ({max_new_tokens}) "
-                f"= {total} exceeds max_seq_len {self.model_config.max_seq_len}")
-        key = jax.random.PRNGKey(seed)
-        for _ in range(max_new_tokens):
-            logits = self.forward(jnp.asarray(ids))
-            next_logits = logits[:, -1, :].astype(jnp.float32)
-            if temperature > 0:
-                key, sub = jax.random.split(key)
-                nxt = jax.random.categorical(sub, next_logits / temperature, axis=-1)
-            else:
-                nxt = jnp.argmax(next_logits, axis=-1)
-            ids = np.concatenate([ids, np.asarray(nxt)[:, None]], axis=1)
-        return ids
+        """KV-cached paged generation — O(S) per emitted token: one ragged
+        prefill writes the prompt into KV pages, then a fused on-device
+        decode loop samples the rest (shares inference/v2's model path; ref
+        inference/engine.py:40 generate + FastGen KV semantics).  Greedy
+        when temperature == 0."""
+        if self._kv_gen is None:
+            from deepspeed_tpu.inference.kv_generate import KVCachedGenerator
+
+            self._kv_gen = KVCachedGenerator(self.model_config)
+        return self._kv_gen.generate(self.params, input_ids, max_new_tokens,
+                                     temperature=temperature, seed=seed)

@@ -1059,17 +1059,22 @@ class DeepSpeedEngine:
             raise RuntimeError(
                 "last train_batch was overflow-skipped (no optimizer step "
                 "ran); the rollback snapshot belongs to an earlier step")
+        bk = getattr(self, "_super_prev_bookkeeping", None)
+        if bk is None:
+            # No snapshot means there is no consistent state to revert the
+            # scheduler/loss-scale/counters to; a partial revert (params
+            # rolled back, bookkeeping not) would silently diverge.
+            raise RuntimeError(
+                "rollback requires a bookkeeping snapshot from a completed "
+                "train_batch; none exists (no step has run since the last "
+                "rollback or load)")
         self._super_opt.rollback()
         self.params = self._super_opt.push_params(self.params)
-        bk = getattr(self, "_super_prev_bookkeeping", None)
-        if bk is not None:
-            self.lr_scheduler.load_state_dict(bk["sched"])
-            self.loss_scale_state = bk["ls"]
-            self.global_steps = bk["global_steps"]
-            self.micro_steps = bk["micro_steps"]
-            self._super_prev_bookkeeping = None
-        else:
-            self.global_steps = max(0, self.global_steps - 1)
+        self.lr_scheduler.load_state_dict(bk["sched"])
+        self.loss_scale_state = bk["ls"]
+        self.global_steps = bk["global_steps"]
+        self.micro_steps = bk["micro_steps"]
+        self._super_prev_bookkeeping = None
 
     def _advance_loss_scale_host(self, finite: bool) -> None:
         """Host-side entry to the SAME loss-scale policy the jitted step

@@ -44,6 +44,7 @@ class DeepSpeedHybridEngine:
         self._train_latency = 0.0
         self._generate_tokens = 0
         self._logits_jit = jax.jit(self._logits)
+        self._kv_gen = None
 
     # -- mode switches (ref eval()/train() container swap) --------------
     def eval(self) -> None:
@@ -73,30 +74,25 @@ class DeepSpeedHybridEngine:
 
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, seed: int = 0) -> np.ndarray:
-        """Rollout on the live training weights (ref generate,
-        hybrid_engine.py: shares ZeRO-3 weights with inference containers)."""
+        """KV-cached rollout on the live training weights (ref generate,
+        hybrid_engine.py:30: the reference shares ZeRO-3 weights with
+        kernel-injected inference containers precisely so RLHF rollouts get
+        a KV cache).  Paged prefill + fused decode loop from inference/v2
+        jitted over ``engine.params`` — per-token cost is O(S), not the
+        O(S²) full-recompute of a naive loop, and mode switching stays
+        free because both paths read the same arrays."""
         if self._training:
             log_dist("hybrid engine: generate() called in train mode; "
                      "switching to eval", level="warning")
             self.eval()
         t0 = time.perf_counter()
-        ids = np.asarray(input_ids)
-        if ids.ndim == 1:
-            ids = ids[None, :]
-        total = ids.shape[1] + max_new_tokens
-        if total > self.model_config.max_seq_len:
-            raise ValueError(f"prompt+new tokens {total} exceeds max_seq_len "
-                             f"{self.model_config.max_seq_len}")
-        key = jax.random.PRNGKey(seed)
-        for _ in range(max_new_tokens):
-            logits = self._logits_jit(self.engine.params, jnp.asarray(ids))
-            nxt_logits = logits[:, -1, :].astype(jnp.float32)
-            if temperature > 0:
-                key, sub = jax.random.split(key)
-                nxt = jax.random.categorical(sub, nxt_logits / temperature, -1)
-            else:
-                nxt = jnp.argmax(nxt_logits, axis=-1)
-            ids = np.concatenate([ids, np.asarray(nxt)[:, None]], axis=1)
+        if self._kv_gen is None:
+            from deepspeed_tpu.inference.kv_generate import KVCachedGenerator
+
+            self._kv_gen = KVCachedGenerator(self.model_config)
+        ids = self._kv_gen.generate(self.engine.params, input_ids,
+                                    max_new_tokens, temperature=temperature,
+                                    seed=seed)
         self._generate_latency += time.perf_counter() - t0
         self._generate_tokens += max_new_tokens * ids.shape[0]
         return ids

