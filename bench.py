@@ -26,6 +26,16 @@ import time
 import numpy as np
 
 SMOKE = "--smoke" in sys.argv
+if SMOKE:
+    # smoke mode is a CPU plumbing check — pin the platform BEFORE any
+    # backend touch, or a down TPU tunnel blocks the run forever (env
+    # vars alone can't override the axon plugin's jax.config pin)
+    import os
+
+    os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 def _sync(x) -> float:

@@ -103,6 +103,10 @@ def load_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
             + ("without" if opt_is_super else "with")
             + " it — match offload_optimizer.super_offload, or pass "
             "load_optimizer_states=False to resume weights only")
+    if engine_is_super and not (load_optimizer_states and opt_is_super):
+        # weights-only resume: re-seed the host masters or the next
+        # push_params would revert the freshly loaded params
+        engine._super_opt.reset_masters(engine.params)
     if load_optimizer_states and opt_is_super and engine_is_super:
         engine._super_opt.load_state_dict(opt["superoffload"])
     elif load_optimizer_states and opt is not None:

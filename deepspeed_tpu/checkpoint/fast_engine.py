@@ -283,6 +283,10 @@ class FastCheckpointEngine:
                 + ("without" if ckpt_is_super else "with")
                 + " it — match offload_optimizer.super_offload, or pass "
                 "load_optimizer_states=False to resume weights only")
+        if engine_is_super and not (load_optimizer_states and ckpt_is_super):
+            # weights-only resume: re-seed the host masters or the next
+            # push_params would revert the freshly loaded params
+            engine._super_opt.reset_masters(engine.params)
         if load_optimizer_states and ckpt_is_super and engine_is_super:
             engine._super_opt.load_state_dict(
                 _load_host_tree(engine._super_opt.state_dict(), reader,

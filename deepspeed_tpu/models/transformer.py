@@ -778,13 +778,18 @@ def _pipeline_1f1b_loss(params, batch, cfg: TransformerConfig, topo,
     dt = cfg.dtype
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :],
                                  (b, s))
-    x = _embed(params, input_ids, positions, cfg)
 
     def tail_fn(tp, h, labels_mb):
         h = _norm(h, tp["final_norm"], cfg)
         w = tp["w"].astype(dt)
         logits = h @ (w.T if cfg.tie_embeddings else w)
         return _nll_sum(logits.astype(jnp.float32), labels_mb)
+
+    def embed_fn(ep, ids_mb, pos_mb):
+        # runs inside the pipelined region: stage 0 embeds per microbatch
+        # and its backward folds the input cotangent straight into these
+        # tables (no O(batch) dx stash — see make_pipeline_train_loss)
+        return _embed(ep, ids_mb, pos_mb, cfg)
 
     tail_params = {"final_norm": params["final_norm"],
                    "w": params["embed"]["tokens"] if cfg.tie_embeddings
@@ -793,9 +798,9 @@ def _pipeline_1f1b_loss(params, batch, cfg: TransformerConfig, topo,
     n_micro = cfg.pipeline_microbatches or topo.pp_size
     f = make_pipeline_train_loss(
         stage_fn, tail_fn, topo, n_micro,
-        aux_coef=MOE_AUX_COEF if cfg.is_moe else 0.0)
-    return f(params["layers"], tail_params, x, labels_eff, positions,
-             denom)
+        aux_coef=MOE_AUX_COEF if cfg.is_moe else 0.0, embed_fn=embed_fn)
+    return f(params["layers"], tail_params, {"embed": params["embed"]},
+             input_ids, labels_eff, positions, denom)
 
 
 def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfig,

@@ -35,7 +35,7 @@ sharding unchanged.
 from __future__ import annotations
 
 from functools import partial
-from typing import Callable
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -259,7 +259,8 @@ def _make_1f1b_schedule(pp: int, m: int):
 
 def make_pipeline_train_loss(stage_fn: Callable, tail_fn: Callable,
                              topo: MeshTopology, n_micro: int,
-                             aux_coef: float = 0.0):
+                             aux_coef: float = 0.0,
+                             embed_fn: Optional[Callable] = None):
     """Build the 1F1B pipelined training loss.
 
     ``stage_fn(stage_params, h, extras_mb) -> (h, aux)`` applies one
@@ -268,6 +269,9 @@ def make_pipeline_train_loss(stage_fn: Callable, tail_fn: Callable,
     output.  The returned callable
 
         ``loss = f(stage_params, tail_params, x, labels, extras, denom)``
+        (or, with ``embed_fn``:
+        ``f(stage_params, tail_params, embed_params, ids, labels, extras,
+        denom)``)
 
     computes ``sum(nll)/denom + aux_coef * mean_micro(sum_stage(aux))``
     with a custom VJP: its *forward* runs the interleaved 1F1B tick table
@@ -276,19 +280,38 @@ def make_pipeline_train_loss(stage_fn: Callable, tail_fn: Callable,
     already produces the parameter/input gradients; the backward pass
     just scales them by the incoming cotangent.  ``denom`` is the global
     valid-token count (computable from labels before any compute).
+
+    ``embed_fn(embed_params, ids_mb, extras_mb) -> h_mb``, when given,
+    moves the embedding prologue *inside* the pipelined region: stage 0
+    embeds each microbatch on its forward tick and, on the backward tick,
+    converts the microbatch input-cotangent straight into embed-parameter
+    gradients (a scatter-add into an O(vocab·H) accumulator).  Without it
+    the input cotangent must be returned whole, which costs an
+    O(n_micro)·activation ``dx`` stash on every stage — the exact
+    anti-pattern 1F1B exists to avoid (ref TrainSchedule intent,
+    runtime/pipe/schedule.py:189).
     """
     pp = topo.pp_size
     wt_np, wm_np = _make_1f1b_schedule(pp, n_micro)
     ticks = wt_np.shape[0]
     from jax.sharding import PartitionSpec as P
 
-    def _run(stage_params, tail_params, x, labels, extras, denom):
+    def _run(stage_params, tail_params, embed_params, x, labels, extras,
+             denom):
         b = x.shape[0]
         assert b % n_micro == 0
         mb = b // n_micro
-        dtype = x.dtype
+        if embed_fn is None:
+            hstruct = jax.eval_shape(lambda a: a[:mb], x)
+        else:
+            mb_ids = jax.ShapeDtypeStruct((mb,) + x.shape[1:], x.dtype)
+            mb_ex = jax.tree.map(
+                lambda e: jax.ShapeDtypeStruct((mb,) + e.shape[1:], e.dtype),
+                extras)
+            hstruct = jax.eval_shape(embed_fn, embed_params, mb_ids, mb_ex)
+        dtype = hstruct.dtype
 
-        def per_stage(sp, tp, x_local, labels_local, extras_local):
+        def per_stage(sp, tp, ep, x_local, labels_local, extras_local):
             idx = lax.axis_index(PIPE_AXIS)
             micro = x_local.reshape((n_micro, mb) + x_local.shape[1:])
             lab_micro = labels_local.reshape((n_micro, mb)
@@ -298,10 +321,19 @@ def make_pipeline_train_loss(stage_fn: Callable, tail_fn: Callable,
                 extras_local)
             wt = jnp.asarray(wt_np)
             wm = jnp.asarray(wm_np)
-            hshape = (mb,) + x_local.shape[1:]
+            hshape = hstruct.shape
             fperm = [(i, (i + 1) % pp) for i in range(pp)]
             bperm = [(i, (i - 1) % pp) for i in range(pp)]
 
+            # "acc" is the input-gradient accumulator: with embed_fn the
+            # per-microbatch input cotangent is folded into O(vocab·H)
+            # embed grads immediately; without it the full-batch dx must
+            # be stashed (in the activation dtype — it is cast to x.dtype
+            # by f_fwd anyway, so fp32 storage would be pure waste)
+            if embed_fn is None:
+                acc0 = jnp.zeros((n_micro,) + hshape, dtype)
+            else:
+                acc0 = jax.tree.map(jnp.zeros_like, ep)
             carry = dict(
                 arr_f=jnp.zeros((pp,) + hshape, dtype),   # arrived activations
                 arr_b=jnp.zeros((pp,) + hshape, dtype),   # arrived cotangents
@@ -310,7 +342,7 @@ def make_pipeline_train_loss(stage_fn: Callable, tail_fn: Callable,
                 state_b=jnp.zeros(hshape, dtype),
                 g_sp=jax.tree.map(jnp.zeros_like, sp),
                 g_tp=jax.tree.map(jnp.zeros_like, tp),
-                dx=jnp.zeros((n_micro,) + hshape, jnp.float32),
+                acc=acc0,
                 nll=jnp.zeros((), jnp.float32),
                 aux=jnp.zeros((), jnp.float32),
             )
@@ -335,22 +367,28 @@ def make_pipeline_train_loss(stage_fn: Callable, tail_fn: Callable,
                 x_mb = micro[my_m]
                 lab_mb = lab_micro[my_m]
                 ex_mb = jax.tree.map(lambda e: e[my_m], ex_micro)
-                h_f_in = jnp.where(idx == 0, x_mb, arr_f[slot])
+
+                def stage0_input():
+                    return x_mb if embed_fn is None else embed_fn(ep, x_mb,
+                                                                  ex_mb)
 
                 def idle(op):
-                    a_in, g_sp, g_tp, dx, nll, aux = op
+                    a_in, g_sp, g_tp, acc, nll, aux = op
                     return (jnp.zeros(hshape, dtype), jnp.zeros(hshape, dtype),
-                            a_in, g_sp, g_tp, dx, nll, aux)
+                            a_in, g_sp, g_tp, acc, nll, aux)
 
                 def fwd_work(op):
-                    a_in, g_sp, g_tp, dx, nll, aux = op
+                    a_in, g_sp, g_tp, acc, nll, aux = op
+                    h_f_in = jnp.where(idx == 0,
+                                       stage0_input().astype(dtype),
+                                       arr_f[slot])
                     a_in = a_in.at[slot].set(h_f_in)
                     h_out, _ = stage_fn(sp, h_f_in, ex_mb)
                     return (h_out.astype(dtype), jnp.zeros(hshape, dtype),
-                            a_in, g_sp, g_tp, dx, nll, aux)
+                            a_in, g_sp, g_tp, acc, nll, aux)
 
                 def bwd_work(op):
-                    a_in, g_sp, g_tp, dx, nll, aux = op
+                    a_in, g_sp, g_tp, acc, nll, aux = op
                     h_in = a_in[slot]
                     last_stage = idx == pp - 1
 
@@ -377,46 +415,68 @@ def make_pipeline_train_loss(stage_fn: Callable, tail_fn: Callable,
                     d_sp, d_tp, d_hin = pull((d_h, d_aux, d_nll))
                     g_sp = jax.tree.map(jnp.add, g_sp, d_sp)
                     g_tp = jax.tree.map(jnp.add, g_tp, d_tp)
-                    dx = dx.at[my_m].set(
-                        jnp.where(idx == 0, d_hin.astype(jnp.float32),
-                                  dx[my_m]))
+                    if embed_fn is None:
+                        acc = acc.at[my_m].set(
+                            jnp.where(idx == 0, d_hin.astype(dtype),
+                                      acc[my_m]))
+                    else:
+                        # stage 0 folds the input cotangent straight into
+                        # embed grads; other stages contribute zeros (the
+                        # cotangent is masked, not the — collective-free —
+                        # vjp computation, so lax.switch stays safe)
+                        d_emb = jnp.where(idx == 0, d_hin,
+                                          jnp.zeros_like(d_hin))
+                        _, pull_e = jax.vjp(
+                            lambda ep_: embed_fn(ep_, x_mb, ex_mb)
+                            .astype(d_hin.dtype), ep)
+                        (d_ep,) = pull_e(d_emb)
+                        acc = jax.tree.map(jnp.add, acc, d_ep)
                     nll = nll + jnp.where(last, nll_v.astype(jnp.float32), 0.0)
                     aux = aux + aux_v.astype(jnp.float32)
                     return (jnp.zeros(hshape, dtype), d_hin.astype(dtype),
-                            a_in, g_sp, g_tp, dx, nll, aux)
+                            a_in, g_sp, g_tp, acc, nll, aux)
 
-                op = (c["a_in"], c["g_sp"], c["g_tp"], c["dx"], c["nll"],
+                op = (c["a_in"], c["g_sp"], c["g_tp"], c["acc"], c["nll"],
                       c["aux"])
-                send_f, send_b, a_in, g_sp, g_tp, dx, nll, aux = lax.switch(
+                send_f, send_b, a_in, g_sp, g_tp, acc, nll, aux = lax.switch(
                     my_wt, [idle, fwd_work, bwd_work], op)
                 return dict(
                     arr_f=arr_f, arr_b=arr_b, a_in=a_in,
                     state_f=lax.ppermute(send_f, PIPE_AXIS, fperm),
                     state_b=lax.ppermute(send_b, PIPE_AXIS, bperm),
-                    g_sp=g_sp, g_tp=g_tp, dx=dx, nll=nll, aux=aux), None
+                    g_sp=g_sp, g_tp=g_tp, acc=acc, nll=nll, aux=aux), None
 
             c, _ = lax.scan(tick, carry, jnp.arange(ticks))
             nll = lax.psum(c["nll"], PIPE_AXIS)          # last stage only
             aux = lax.psum(c["aux"], PIPE_AXIS) / n_micro
             loss = nll / denom + aux_coef * aux
             g_tp = jax.tree.map(lambda a: lax.psum(a, PIPE_AXIS), c["g_tp"])
-            dx = lax.psum(c["dx"], PIPE_AXIS)            # stage 0 only
-            return loss, c["g_sp"], g_tp, dx.reshape(x_local.shape)
+            # stage 0 only contributes; fp32 across the collective (a bf16
+            # psum aborts XLA CPU's AllReducePromotion pass)
+            acc = jax.tree.map(
+                lambda a: lax.psum(a.astype(jnp.float32), PIPE_AXIS)
+                .astype(a.dtype), c["acc"])
+            if embed_fn is None:
+                acc = acc.reshape(x_local.shape)
+            return loss, c["g_sp"], g_tp, acc
 
         sp_specs = jax.tree.map(lambda _: P(PIPE_AXIS), stage_params)
         tp_specs = jax.tree.map(lambda _: P(), tail_params)
+        ep_specs = jax.tree.map(lambda _: P(), embed_params)
         ex_specs = jax.tree.map(lambda _: P(), extras)
+        acc_specs = (P() if embed_fn is None
+                     else jax.tree.map(lambda _: P(), embed_params))
         return jax.shard_map(
             per_stage,
             mesh=topo.mesh,
-            in_specs=(sp_specs, tp_specs, P(), P(), ex_specs),
-            out_specs=(P(), sp_specs, tp_specs, P()),
+            in_specs=(sp_specs, tp_specs, ep_specs, P(), P(), ex_specs),
+            out_specs=(P(), sp_specs, tp_specs, acc_specs),
             axis_names={PIPE_AXIS},
             check_vma=False,
-        )(stage_params, tail_params, x, labels, extras)
+        )(stage_params, tail_params, embed_params, x, labels, extras)
 
-    @jax.custom_vjp
-    def f(stage_params, tail_params, x, labels, extras, denom):
+    def _primal(stage_params, tail_params, embed_params, x, labels, extras,
+                denom):
         # loss-only (non-differentiated) calls — e.g. eval_batch — take the
         # plain GPipe forward instead of paying the full fwd+bwd tick table;
         # mathematically identical: tail NLL is per-token additive, and
@@ -424,22 +484,56 @@ def make_pipeline_train_loss(stage_fn: Callable, tail_fn: Callable,
         def wrap(sp, h, ex):
             return stage_fn(sp, h, ex)
 
+        if embed_fn is not None:
+            x = embed_fn(embed_params, x, extras)
         h, aux = spmd_pipeline(wrap, stage_params, x, topo=topo,
                                n_micro=n_micro, extras=extras)
         return tail_fn(tail_params, h, labels) / denom + aux_coef * aux
 
-    def f_fwd(stage_params, tail_params, x, labels, extras, denom):
-        loss, g_sp, g_tp, dx = _run(stage_params, tail_params, x, labels,
-                                    extras, denom)
-        return loss, (g_sp, g_tp, dx.astype(x.dtype))
+    if embed_fn is None:
+
+        @jax.custom_vjp
+        def f(stage_params, tail_params, x, labels, extras, denom):
+            return _primal(stage_params, tail_params, (), x, labels, extras,
+                           denom)
+
+        def f_fwd(stage_params, tail_params, x, labels, extras, denom):
+            loss, g_sp, g_tp, dx = _run(stage_params, tail_params, (), x,
+                                        labels, extras, denom)
+            return loss, (g_sp, g_tp, dx.astype(x.dtype))
+
+        def f_bwd(res, g):
+            g_sp, g_tp, dx = res
+
+            def scale(tree):
+                return jax.tree.map(lambda a: (a * g).astype(a.dtype), tree)
+
+            return (scale(g_sp), scale(g_tp), scale(dx), None, None, None)
+
+        f.defvjp(f_fwd, f_bwd)
+        return f
+
+    @jax.custom_vjp
+    def f(stage_params, tail_params, embed_params, ids, labels, extras,
+          denom):
+        return _primal(stage_params, tail_params, embed_params, ids, labels,
+                       extras, denom)
+
+    def f_fwd(stage_params, tail_params, embed_params, ids, labels, extras,
+              denom):
+        loss, g_sp, g_tp, g_ep = _run(stage_params, tail_params,
+                                      embed_params, ids, labels, extras,
+                                      denom)
+        return loss, (g_sp, g_tp, g_ep)
 
     def f_bwd(res, g):
-        g_sp, g_tp, dx = res
+        g_sp, g_tp, g_ep = res
 
         def scale(tree):
             return jax.tree.map(lambda a: (a * g).astype(a.dtype), tree)
 
-        return (scale(g_sp), scale(g_tp), scale(dx), None, None, None)
+        return (scale(g_sp), scale(g_tp), scale(g_ep), None, None, None,
+                None)
 
     f.defvjp(f_fwd, f_bwd)
     return f

@@ -178,6 +178,40 @@ class ShardingRules:
         return P(*spec)
 
     # ------------------------------------------------------------------
+    def audit_replicated(self, params, min_bytes: int = 1 << 20):
+        """Large parameters that fall through ``spec_for``'s divisibility
+        fallback and end up fully replicated despite a >1 shardable world.
+
+        A big replicated tensor silently degrades ZeRO-3 to ZeRO-1 for
+        that param (and AutoTP to no-op) — callers must surface this
+        loudly rather than discover it as OOM at scale.  Returns
+        ``[(path, shape, nbytes)]``; empty when every axis is size 1
+        (nothing could shard) or all large params got a sharded dim.
+        """
+        fsdp_axes = self._fsdp_axes(False, param_style=True)
+        fsdp_world = int(np.prod([self.topo.axis_size(a)
+                                  for a in fsdp_axes])) if fsdp_axes else 1
+        # pp deliberately excluded: pipeline shards only the stacked-layer
+        # dim; embeds/head replicating across stages is by design
+        shard_world = max(fsdp_world if self.zero_stage >= 3 else 1,
+                          self.topo.tp_size)
+        if shard_world <= 1:
+            return []
+        offenders = []
+
+        def visit(path, leaf):
+            shape = tuple(np.shape(leaf))
+            dt = np.dtype(getattr(leaf, "dtype", np.float32))
+            nbytes = int(np.prod(shape)) * dt.itemsize if shape else 0
+            if nbytes < min_bytes:
+                return
+            spec = self.spec_for(path_str(path), shape, param_style=True)
+            if all(s is None for s in spec):
+                offenders.append((path_str(path), shape, nbytes))
+
+        jax.tree_util.tree_map_with_path(visit, params)
+        return offenders
+
     def tree_specs(self, params, param_style: bool = True):
         """Pytree of PartitionSpecs matching ``params``."""
         def leaf_spec(path, leaf):

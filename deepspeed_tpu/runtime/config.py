@@ -172,6 +172,10 @@ class ZeroConfig:
     pipeline_loading_checkpoint: bool = False
     override_module_apply: bool = True
     log_trace_cache_warnings: bool = False
+    # TPU extension: fail hard when a >1MB param falls through the
+    # divisibility fallback and silently replicates under ZeRO-3/TP
+    # (ShardingRules.audit_replicated)
+    strict_sharding: bool = False
 
     def __post_init__(self):
         if isinstance(self.offload_param, dict):

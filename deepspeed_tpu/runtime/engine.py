@@ -206,6 +206,20 @@ class DeepSpeedEngine:
             jax.tree.map(lambda x: x, params_shape), param_style=True)
         self._replicated = NamedSharding(topology.mesh, P())
 
+        offenders = self.rules.audit_replicated(params_shape)
+        if offenders:
+            desc = ", ".join(f"{p} {s} ({b / 1e6:.1f}MB)"
+                             for p, s, b in offenders[:8])
+            msg = (f"{len(offenders)} large param(s) could not be sharded "
+                   f"(no dim divisible by the shard world) and will be "
+                   f"REPLICATED on every device: {desc}")
+            if self.config.zero_config.strict_sharding:
+                from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+
+                raise DeepSpeedConfigError(
+                    msg + " — zero_optimization.strict_sharding is set")
+            log_dist(msg, level="warning")
+
         if model_params is not None:
             self.params = jax.device_put(model_params, self.param_shardings)
         else:

@@ -43,7 +43,6 @@ class DeepSpeedHybridEngine:
         self._generate_latency = 0.0
         self._train_latency = 0.0
         self._generate_tokens = 0
-        self._logits_jit = jax.jit(self._logits)
         self._kv_gen = None
 
     # -- mode switches (ref eval()/train() container swap) --------------
@@ -68,10 +67,6 @@ class DeepSpeedHybridEngine:
         return getattr(self.engine, name)
 
     # -- generation ------------------------------------------------------
-    def _logits(self, params, ids):
-        out = tf_model.forward(params, ids, self.model_config)
-        return out[0] if isinstance(out, tuple) else out
-
     def generate(self, input_ids, max_new_tokens: int = 32,
                  temperature: float = 0.0, seed: int = 0) -> np.ndarray:
         """KV-cached rollout on the live training weights (ref generate,

@@ -136,6 +136,21 @@ class SuperOffloadOptimizer:
                       else x for x, l in zip(new_leaves, flat_p)]
         return jax.tree_util.tree_unflatten(self._treedef, new_leaves)
 
+    def reset_masters(self, params: Any,
+                      reset_moments: bool = True) -> None:
+        """Re-seed the host fp32 masters from a (freshly loaded) device
+        param tree.  A weights-only checkpoint resume must call this —
+        otherwise the next step's ``push_params`` silently reverts the
+        load to the stale masters."""
+        leaves = jax.tree_util.tree_flatten(params)[0]
+        self._master = [np.array(jax.device_get(l), np.float32)
+                        for l in leaves]
+        if reset_moments:
+            self._m = [np.zeros_like(x) for x in self._master]
+            self._v = [np.zeros_like(x) for x in self._master]
+            self.step_count = 0
+        self._prev = None
+
     # ------------------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
         return {"step": self.step_count,

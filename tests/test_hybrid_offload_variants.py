@@ -49,7 +49,10 @@ def test_hybrid_engine_train_generate_shared_weights():
     assert stats["generated_tokens"] == 12 and stats["generate_seconds"] > 0
     # weights changed → decode path reads live training params (token ids
     # may or may not differ; check the underlying logits moved)
-    l1 = he._logits_jit(engine.params, jnp.asarray(prompt))
+    from deepspeed_tpu.models import transformer as tf_model
+
+    l1 = jax.jit(lambda p, i: tf_model.forward(p, i, engine.model_config))(
+        engine.params, jnp.asarray(prompt))
     assert np.isfinite(np.asarray(l1, np.float32)).all()
     _reset_topo()
 

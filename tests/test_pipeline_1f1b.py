@@ -119,8 +119,10 @@ def test_1f1b_live_activation_bound():
     # per-microbatch work is constant (mb=1); only the stash should differ.
     small = temp_bytes("1f1b", 4)
     big = temp_bytes("1f1b", 16)
-    # O(pp) bound: 4x more microbatches must not cost anywhere near 4x —
-    # allow modest growth for the larger dx/output buffers (O(B))
-    assert big < small * 2.2, (small, big)
+    # O(pp) bound: with the embedding inside the pipelined region the
+    # input cotangent folds into O(vocab·H) embed grads per tick — no
+    # O(n_micro) dx stash — so 4x more microbatches is near-flat (the
+    # only O(B) growth left is the int32 ids/labels themselves)
+    assert big < small * 1.15, (small, big)
     gpipe_big = temp_bytes("gpipe", 16)
     assert big < gpipe_big, (big, gpipe_big)
