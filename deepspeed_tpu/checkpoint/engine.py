@@ -49,9 +49,12 @@ def save_checkpoint(engine, save_dir: str, tag: Optional[str] = None,
     if getattr(engine, "_super_opt", None) is not None:
         # SuperOffload: masters/moments live in the host optimizer
         opt_tree = {"superoffload": engine._super_opt.state_dict()}
+    elif getattr(engine, "_opt_store", None) is not None:
+        # join any pipelined prefetch first (single-owner AIO handle)
+        read = getattr(engine, "_opt_store_read", engine._opt_store.swap_in)
+        opt_tree = read()
     else:
-        opt_tree = (engine.opt_state if getattr(engine, "_opt_store", None) is None
-                    else engine._opt_store.swap_in())
+        opt_tree = engine.opt_state
     state = {
         "module": _to_host(engine.params),
         "optimizer": _to_host(opt_tree),

@@ -80,7 +80,9 @@ def _opt_tree_for_save(engine):
     if getattr(engine, "_super_opt", None) is not None:
         return {"superoffload": engine._super_opt.state_dict()}
     if getattr(engine, "_opt_store", None) is not None:
-        return engine._opt_store.swap_in()
+        # join any pipelined prefetch first (single-owner AIO handle)
+        read = getattr(engine, "_opt_store_read", engine._opt_store.swap_in)
+        return read()
     return engine.opt_state
 
 
@@ -356,9 +358,7 @@ class DecoupledCheckpointEngine:
             snap._super_opt = _FrozenSuper()
             opt_tree = None
         else:
-            opt_tree = (engine.opt_state
-                        if getattr(engine, "_opt_store", None) is None
-                        else engine._opt_store.swap_in())
+            opt_tree = _opt_tree_for_save(engine)
         snap.opt_state = None if opt_tree is None else jax.tree.map(
             lambda x: np.asarray(jax.device_get(x)), opt_tree)
         snap.loss_scale_state = jax.tree.map(

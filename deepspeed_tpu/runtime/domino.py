@@ -10,7 +10,12 @@ so XLA's latency-hiding scheduler interleaves them on ICI — the compiled
 equivalent of Domino's hand-rolled double-buffering.
 
 ``domino_transformer_layer`` is numerically identical to the plain layer
-(same params, same math, batch-chunked) — verified by test.
+(same params, same math, batch-chunked) — verified by test, and the
+compile-level independence that overlap requires is pinned by
+``test_domino_chunk_collectives_stay_independent``: the per-chunk psums
+survive compilation as separate chunk-shaped all-reduce ops on distinct
+channels (XLA's combiner does not merge them into one serializing
+collective).
 """
 
 from __future__ import annotations

@@ -414,6 +414,23 @@ class DeepSpeedEngine:
         if self._param_store is not None:
             self._param_store.swap_out(self.params["layers"])
             self.params = {**self.params, "layers": None}
+        # Pipelined (overlapped) store swapping, ref
+        # swap_tensor/pipelined_optimizer_swapper.py:26: with
+        # offload_optimizer.pipeline_read set, the next step's store reads
+        # drain on a worker thread behind the writes while the host
+        # dispatches this step's compute.  (pipeline_write is accepted for
+        # config parity but controls nothing extra: store writes are
+        # always issued async via the AIO handle.)
+        self._opt_fut = None
+        self._param_fut = None
+        self._swap_pool = None
+        if (off_opt is not None and off_opt.pipeline_read
+                and (self._opt_store is not None
+                     or self._param_store is not None)):
+            import concurrent.futures
+
+            self._swap_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="dstpu-swap")
 
         self.grad_shardings = self.rules.grad_accum_shardings(params_shape)
         if self._param_stream:
@@ -787,6 +804,18 @@ class DeepSpeedEngine:
                 out_shardings=(self._replicated, self.grad_shardings,
                                self._replicated, self._replicated))
 
+        if self._opt_store is not None and not self._param_stream:
+            # Pipelined-swap split: grads need no optimizer state, so the
+            # store read can drain while this compiles/runs; apply_step
+            # then consumes the prefetched state (train_batch split path).
+            def grads_batch_store(params, batch_stack, scale):
+                grads, loss_sum = accum_grads(params, batch_stack, scale)
+                return loss_sum / gas, grads
+
+            self._grads_batch_store_jit = jax.jit(
+                grads_batch_store,
+                out_shardings=(self._replicated, self.grad_shardings))
+
         state_out = (self.param_shardings, self.opt_shardings, self._replicated,
                      jax.tree.map(lambda _: self._replicated,
                                   {"loss": 0, "grad_norm": 0, "loss_scale": 0, "skipped": 0}))
@@ -840,10 +869,59 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # NVMe optimizer-state swapping (ZeRO-Infinity)
     # ------------------------------------------------------------------
+    def _opt_store_read(self):
+        """All opt-store reads funnel here: join an in-flight prefetch if
+        one exists (the AIO handle is single-owner; concurrent use from
+        two threads is not allowed), else read synchronously."""
+        fut, self._opt_fut = self._opt_fut, None
+        return fut.result() if fut is not None else self._opt_store.swap_in()
+
+    def _param_store_read(self):
+        fut, self._param_fut = self._param_fut, None
+        return fut.result() if fut is not None \
+            else self._param_store.swap_in()
+
+    def _prefetch_stores(self) -> None:
+        """Queue the next step's store reads behind the writes just issued
+        (ref pipelined_optimizer_swapper.py:26 + async_swapper.py:19): the
+        swapper's swap_in drains pending writes then reads, all on a worker
+        thread, overlapping the host's dispatch of the next step."""
+        if self._swap_pool is None:
+            return
+        if self._opt_store is not None and self._opt_fut is None:
+            self._opt_fut = self._swap_pool.submit(self._opt_store.swap_in)
+        if self._param_store is not None and self._param_fut is None:
+            self._param_fut = self._swap_pool.submit(
+                self._param_store.swap_in)
+
+    def _cancel_prefetch(self) -> None:
+        """Join and discard in-flight prefetches — required before any
+        out-of-band store write (checkpoint load) so the stale read result
+        is never consumed.  Errors are swallowed: the result is discarded
+        by construction, and the caller is usually about to overwrite the
+        very state the failed read targeted."""
+        for name in ("_opt_fut", "_param_fut"):
+            fut = getattr(self, name, None)
+            if fut is not None:
+                try:
+                    fut.result()
+                except Exception as e:
+                    logger.warning(f"discarded prefetch failed: {e}")
+                setattr(self, name, None)
+
+    def destroy(self) -> None:
+        """Release background resources (swap worker pool, in-flight
+        prefetches).  Ref DeepSpeedEngine.destroy."""
+        self._cancel_prefetch()
+        if self._swap_pool is not None:
+            self._swap_pool.shutdown(wait=True)
+            self._swap_pool = None
+
     def _swap_in_opt_state(self):
         if self._opt_store is None:
             return self.opt_state
-        return jax.device_put(self._opt_store.swap_in(), self._opt_device_shardings)
+        return jax.device_put(self._opt_store_read(),
+                              self._opt_device_shardings)
 
     def _swap_out_opt_state(self, opt_state) -> None:
         if self._opt_store is None:
@@ -858,7 +936,7 @@ class DeepSpeedEngine:
         partitioned_param_swapper.py:37)."""
         if self._param_store is None or self.params.get("layers") is not None:
             return
-        layers = jax.device_put(self._param_store.swap_in(),
+        layers = jax.device_put(self._param_store_read(),
                                 self.param_shardings["layers"])
         self.params = {**self.params, "layers": layers}
 
@@ -991,19 +1069,39 @@ class DeepSpeedEngine:
         batch_stack = self._maybe_add_pld(batch_stack)
         batch_stack = self._put_batch(batch_stack, stacked=True)
         lr = jnp.float32(self.lr_scheduler(self.global_steps))
-        opt_state = self._swap_in_opt_state()
-        self._swap_in_params()
-        if (self._flops_profiler is not None
-                and not self._flops_profiler.profile_done
-                and self.global_steps + 1 >= self.config.flops_profiler.profile_step):
-            self._last_flops_profile = self._flops_profiler.profile_engine_step(
-                self, self.params, opt_state, self.loss_scale_state,
-                batch_stack, lr)
-            self._flops_profiler.print_profile(self._last_flops_profile)
-        self.params, opt_state, self.loss_scale_state, metrics = self._train_step_jit(
-            self.params, opt_state, self.loss_scale_state, batch_stack, lr)
+        profiling = (self._flops_profiler is not None
+                     and not self._flops_profiler.profile_done
+                     and self.global_steps + 1
+                     >= self.config.flops_profiler.profile_step)
+        if (self._swap_pool is not None and self._opt_store is not None
+                and not self._param_stream and not profiling):
+            # Overlapped store path: dispatch the grads compute (needs no
+            # optimizer state), then join the prefetched store read — the
+            # NVMe/host transfer drains while the device computes, so step
+            # time approaches max(compute, transfer) instead of the sum.
+            self._swap_in_params()
+            loss, grads = self._grads_batch_store_jit(
+                self.params, batch_stack, self.loss_scale_state["scale"])
+            opt_state = self._swap_in_opt_state()
+            self.params, opt_state, self.loss_scale_state, metrics = \
+                self._apply_step_jit(self.params, opt_state,
+                                     self.loss_scale_state, grads, lr)
+            metrics = {**metrics, "loss": loss}
+        else:
+            opt_state = self._swap_in_opt_state()
+            self._swap_in_params()
+            if profiling:
+                self._last_flops_profile = \
+                    self._flops_profiler.profile_engine_step(
+                        self, self.params, opt_state, self.loss_scale_state,
+                        batch_stack, lr)
+                self._flops_profiler.print_profile(self._last_flops_profile)
+            self.params, opt_state, self.loss_scale_state, metrics = \
+                self._train_step_jit(self.params, opt_state,
+                                     self.loss_scale_state, batch_stack, lr)
         self._swap_out_opt_state(opt_state)
         self._swap_out_params()
+        self._prefetch_stores()
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps_value
         self.lr_scheduler.step()
@@ -1173,6 +1271,7 @@ class DeepSpeedEngine:
             self.params, opt_state, self.loss_scale_state, self._grad_buffer, lr)
         self._swap_out_opt_state(opt_state)
         self._swap_out_params()
+        self._prefetch_stores()
         self._grad_buffer = None
         self._micro_in_step = 0
         self.global_steps += 1
@@ -1295,12 +1394,13 @@ class DeepSpeedEngine:
         if self.opt_state is not None:
             return self.opt_state
         if self._opt_store is not None:
-            return self._opt_store.swap_in()
+            return self._opt_store_read()
         return None
 
     def _sync_store_after_load(self) -> None:
         """After any checkpoint load: if an offload store is authoritative,
         push the freshly-loaded optimizer state into it."""
+        self._cancel_prefetch()  # a pre-load prefetch would be stale
         if self._opt_store is not None and self.opt_state is not None:
             self._opt_store.swap_out(self.opt_state)
             self.opt_state = None

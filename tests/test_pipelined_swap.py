@@ -1,0 +1,151 @@
+"""Pipelined (overlapped) NVMe/host store swapping.
+
+Ref VERDICT r3 Missing #4 / pipelined_optimizer_swapper.py:26: with
+``offload_optimizer.pipeline_read``, the next step's store read drains on a
+worker thread behind the writes while the host dispatches compute, so step
+time approaches max(compute, transfer) instead of the sum.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import get_model_config
+from tests.conftest import make_lm_batch
+
+
+def _reset_topo():
+    from deepspeed_tpu.parallel import topology
+
+    topology._GLOBAL_TOPOLOGY = None
+
+
+class _SlowStore:
+    """Delegating store proxy that injects read latency and records which
+    thread performed each read."""
+
+    def __init__(self, inner, delay: float):
+        self.inner = inner
+        self.delay = delay
+        self.reads = []  # (t_start, thread_name)
+
+    def swap_in(self):
+        self.reads.append((time.perf_counter(),
+                           threading.current_thread().name))
+        time.sleep(self.delay)
+        return self.inner.swap_in()
+
+    def swap_out(self, tree):
+        self.inner.swap_out(tree)
+
+    def wait(self):
+        self.inner.wait()
+
+
+def _nvme_engine(tmp_path, pipeline: bool, seed=5):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "steps_per_print": 1000,
+        "mesh": {"data": 1},
+        "zero_optimization": {
+            "stage": 1,
+            "offload_optimizer": {"device": "nvme",
+                                  "nvme_path": str(tmp_path),
+                                  "pipeline_read": pipeline}},
+    }
+    model = get_model_config("gpt2-tiny")
+    engine, _, _, _ = ds.initialize(model=model, config=cfg, seed=seed)
+    return engine, model
+
+
+def test_pipelined_matches_serial_losses(tmp_path):
+    rng = np.random.default_rng(9)
+    batch = make_lm_batch(rng, 2, 32, 512)
+    eng_s, _ = _nvme_engine(tmp_path / "serial", False)
+    serial = [float(np.asarray(eng_s.train_batch(batch))) for _ in range(4)]
+    _reset_topo()
+    eng_p, _ = _nvme_engine(tmp_path / "pipe", True)
+    assert eng_p._swap_pool is not None
+    piped = [float(np.asarray(eng_p.train_batch(batch))) for _ in range(4)]
+    np.testing.assert_allclose(serial, piped, rtol=1e-5, atol=1e-6)
+    # prefetch was queued at the end of the step
+    assert eng_p._opt_fut is not None
+    eng_p.destroy()
+    _reset_topo()
+
+
+def test_step_time_is_max_not_sum(tmp_path):
+    """With an artificial 0.25s transfer and an artificial 0.25s compute,
+    serial steps cost ~0.5s while pipelined steps cost ~0.25s."""
+    delay = 0.25
+    steps = 3
+    rng = np.random.default_rng(11)
+    batch = make_lm_batch(rng, 2, 32, 512)
+
+    def timed(pipeline, sub):
+        engine, _ = _nvme_engine(tmp_path / sub, pipeline)
+        engine._opt_store = _SlowStore(engine._opt_store, delay)
+        orig = engine._grads_batch_store_jit
+
+        def slow_grads(*a):
+            out = orig(*a)
+            import jax
+
+            jax.block_until_ready(out)
+            time.sleep(delay)  # stands in for device compute time
+            return out
+
+        engine._grads_batch_store_jit = slow_grads
+        # serial path has no split grads fn; emulate compute latency in
+        # the monolithic step the same way
+        orig_mono = engine._train_step_jit
+
+        def slow_mono(*a):
+            out = orig_mono(*a)
+            import jax
+
+            jax.block_until_ready(out)
+            time.sleep(delay)
+            return out
+
+        engine._train_step_jit = slow_mono
+        engine.train_batch(batch)  # warmup / compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            engine.train_batch(batch)
+        dt = time.perf_counter() - t0
+        reads = list(engine._opt_store.reads)
+        engine.destroy()
+        _reset_topo()
+        return dt, reads
+
+    dt_serial, _ = timed(False, "serial")
+    dt_piped, reads = timed(True, "pipe")
+    # serial pays read+compute per step; pipelined pays ~max(read, compute).
+    # Generous margins for a loaded 1-core CI box.
+    assert dt_serial > steps * 2 * delay * 0.9, dt_serial
+    assert dt_piped < dt_serial - steps * delay * 0.5, (dt_piped, dt_serial)
+    # the overlapped reads ran on the swap worker thread, not the main one
+    worker_reads = [t for _, t in reads if "dstpu-swap" in t]
+    assert len(worker_reads) >= steps, reads
+
+
+def test_checkpoint_save_joins_prefetch(tmp_path):
+    """A checkpoint save between steps must consume the in-flight prefetch
+    (single-owner AIO handle) and still serialize the current state."""
+    rng = np.random.default_rng(12)
+    batch = make_lm_batch(rng, 2, 32, 512)
+    engine, _ = _nvme_engine(tmp_path / "ck", True)
+    engine.train_batch(batch)
+    assert engine._opt_fut is not None
+    engine.save_checkpoint(str(tmp_path / "out"), tag="t")
+    assert engine._opt_fut is None  # prefetch consumed, not raced
+    loss1 = float(np.asarray(engine.train_batch(batch)))
+    assert np.isfinite(loss1)
+    engine.destroy()
+    _reset_topo()
