@@ -1,0 +1,440 @@
+"""Block-sparse flash attention with true block skipping (Pallas, TPU).
+
+TPU-native analog of the reference's Triton block-sparse kernels
+(``deepspeed/ops/sparse_attention/matmul.py`` SDD/DSD + ``softmax.py`` +
+``sparse_self_attention.py``): a DeepSpeed ``SparsityConfig`` block layout
+``[H, nb, nb]`` gates, per kernel tile,
+
+* the MXU compute — ``pl.when`` on the tile's layout slab, so dead tiles
+  cost no FLOPs (generalising flash_mha's causal skip to arbitrary
+  layouts), and
+* the K/V DMAs — the host-side liveness table clamps the k-block index of
+  dead tiles to the most recent live one, and the Pallas pipeline does not
+  re-fetch an unchanged index (the same trick ``_clamped_kv_index`` plays
+  for the causal triangle).
+
+Within a live tile the (coarser) layout slab expands to a token mask via
+two tiny 0/1 expansion matmuls (MXU-friendly — no gathers or lane-dim
+reshapes).  Numerics match the dense-masked reference implementation
+(``ops/sparse_attention.sparse_attention``).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import importlib
+
+# the package re-exports the flash_mha *function* over the submodule name;
+# import the module itself (shared helpers + INTERPRET flag)
+_fm = importlib.import_module("deepspeed_tpu.ops.pallas.flash_mha")
+
+NEG_INF = -1e30
+
+
+def _kernel_block(lb: int) -> int:
+    """Kernel tile edge: 128 (fine skip granularity, full MXU tiles) or
+    the layout block itself when that is coarser."""
+    return lb if lb > 128 else 128
+
+
+def _pad_layout(layout: np.ndarray, nb_pad: int) -> np.ndarray:
+    h, nbq, nbk = layout.shape
+    out = np.zeros((h, nb_pad, nb_pad), layout.dtype)
+    out[:, :nbq, :nbk] = layout
+    return out
+
+
+def _tile_live(layout: np.ndarray, bq: int, bk: int, lb: int,
+               causal: bool) -> np.ndarray:
+    """Host-side per-kernel-tile liveness [H, nq, nk] — the exact
+    predicate the kernel's ``pl.when`` evaluates (tests call this to
+    assert compute scales with layout density)."""
+    h, nb, _ = layout.shape
+    tq, tk = max(1, bq // lb), max(1, bk // lb)
+    nq, nk = nb // tq, nb // tk
+    live = layout.reshape(h, nq, tq, nk, tk).max((2, 4)) > 0
+    if causal:
+        iq = np.arange(nq)[:, None] * bq + bq - 1
+        ik = np.arange(nk)[None, :] * bk
+        live = live & (ik <= iq)[None]
+    return live
+
+
+def _kv_pick(live: np.ndarray, inner_is_k: bool) -> np.ndarray:
+    """Clamp table for the non-owned operand's block index: dead steps
+    reuse the most recent live index (no re-fetch), leading dead steps
+    borrow the first upcoming live one (acts as prefetch).  Vectorised —
+    this runs per trace (32k/64 layouts are ~4M entries)."""
+    rows = live if inner_is_k else live.swapaxes(1, 2)  # [H, outer, inner]
+    ni = rows.shape[2]
+    idx = np.arange(ni, dtype=np.int32)
+    # last live index at-or-before i (−1 where none yet)
+    last = np.maximum.accumulate(np.where(rows, idx, -1), axis=2)
+    # first live index anywhere (fallback for the leading dead run)
+    any_live = rows.any(axis=2, keepdims=True)
+    first = np.where(any_live, rows.argmax(axis=2, keepdims=True), 0)
+    return np.where(last >= 0, last,
+                    np.broadcast_to(first, last.shape)).astype(np.int32)
+
+
+def _expand_mask(lt, bq: int, bk: int, lb: int):
+    """[tq, tk] layout slab → [bq, bk] bool token mask via two 0/1
+    expansion matmuls (no gather, no lane-dim reshape)."""
+    tq, tk = lt.shape
+    if tq == 1 and tk == 1:
+        return jnp.broadcast_to(lt > 0, (bq, bk))
+    er = (lax.broadcasted_iota(jnp.int32, (bq, tq), 0) // lb
+          == lax.broadcasted_iota(jnp.int32, (bq, tq), 1)
+          ).astype(jnp.float32)
+    ec = (lax.broadcasted_iota(jnp.int32, (tk, bk), 0)
+          == lax.broadcasted_iota(jnp.int32, (tk, bk), 1) // lb
+          ).astype(jnp.float32)
+    m = jax.lax.dot_general(er, lt.astype(jnp.float32),
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    m = jax.lax.dot_general(m, ec, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    return m > 0.5
+
+
+def _alive(lt, causal, iq, ik, bq, bk):
+    pred = jnp.max(lt) > 0
+    if causal:
+        pred = jnp.logical_and(pred, ik * bk <= iq * bq + bq - 1)
+    return pred
+
+
+# ----------------------------------------------------------------------
+# Kernels (structure mirrors flash_mha's KV-blocked kernels)
+# ----------------------------------------------------------------------
+def _fwd_kernel(pick_ref, q_ref, k_ref, v_ref, lt_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, sm_scale, causal, bq, bk, lb,
+                s_real):
+    del pick_ref  # consumed by the index maps (scalar prefetch)
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+    lt = lt_ref[0]
+
+    @pl.when(ik == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = _fm._scores(q, k, sm_scale)
+        valid = _fm._block_mask(bq, bk, iq * bq, ik * bk, s_real, causal)
+        valid = jnp.logical_and(valid, _expand_mask(lt, bq, bk, lb))
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_scr[:, 0:1]
+        l_prev = l_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        p = jnp.where(valid, p, 0.0)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * alpha + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    pl.when(_alive(lt, causal, iq, ik, bq, bk))(compute)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        l = l_scr[:, 0:1]
+        safe_l = jnp.where(l > 0, l, 1.0)
+        # fully-masked rows (no visible key anywhere) emit zeros, matching
+        # the dense-masked reference's uniform-zero convention
+        has = m_scr[:, 0:1] > NEG_INF / 2
+        o_ref[0, 0] = jnp.where(has, acc_scr[...] / safe_l,
+                                0.0).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.broadcast_to(m_scr[:, 0:1] + jnp.log(safe_l),
+                                         lse_ref.shape[2:])
+
+
+def _dq_kernel(pick_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               lt_ref, dq_ref, dq_scr, *, sm_scale, causal, bq, bk, lb,
+               s_real):
+    del pick_ref
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+    lt = lt_ref[0]
+
+    @pl.when(ik == 0)
+    def _():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, 0:1]
+        delta = delta_ref[0, 0][:, 0:1]
+        s = _fm._scores(q, k, sm_scale)
+        valid = _fm._block_mask(bq, bk, iq * bq, ik * bk, s_real, causal)
+        valid = jnp.logical_and(valid, _expand_mask(lt, bq, bk, lb))
+        s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        p = jnp.where(valid, p, 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[...] += jax.lax.dot_general(ds.astype(k.dtype), k,
+                                           (((1,), (0,)), ((), ())),
+                                           preferred_element_type=jnp.float32)
+
+    pl.when(_alive(lt, causal, iq, ik, bq, bk))(compute)
+
+    @pl.when(ik == nk - 1)
+    def _():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(pick_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                lt_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, sm_scale,
+                causal, bq, bk, lb, s_real, group):
+    del pick_ref
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    lt_all = lt_ref[...]  # [group, tq, tk]
+
+    def compute():
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        for g in range(group):
+            lt = lt_all[g]
+            q = q_ref[0, g]
+            do = do_ref[0, g]
+            lse = lse_ref[0, g][:, 0:1]
+            delta = delta_ref[0, g][:, 0:1]
+            s = _fm._scores(q, k, sm_scale)
+            valid = _fm._block_mask(bq, bk, iq * bq, ik * bk, s_real,
+                                    causal, with_rows=True)
+            valid = jnp.logical_and(valid, _expand_mask(lt, bq, bk, lb))
+            s = jnp.where(valid, s, NEG_INF)
+            p = jnp.exp(s - lse)
+            p = jnp.where(valid, p, 0.0)
+            dv_scr[...] += jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta) * sm_scale
+            dk_scr[...] += jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    pred = jnp.max(lt_all) > 0
+    if causal:
+        pred = jnp.logical_and(pred, iq * bq + bq - 1 >= ik * bk)
+    pl.when(pred)(compute)
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+# ----------------------------------------------------------------------
+# pallas_call plumbing
+# ----------------------------------------------------------------------
+def _prep(q, layout, lb):
+    b, hq, s_real, d = q.shape
+    bq = bk = _kernel_block(lb)
+    s_pad = -(-s_real // bq) * bq
+    nb_pad = s_pad // lb
+    lay = _pad_layout(np.asarray(layout), nb_pad)
+    tq, tk = max(1, bq // lb), max(1, bk // lb)
+    return bq, bk, s_pad, lay, tq, tk
+
+
+def _fwd_impl(q, k, v, layout, lb, causal, sm_scale):
+    b, hq, s_real, d = q.shape
+    hkv = k.shape[1]
+    group = hq // hkv
+    bq, bk, s_pad, lay, tq, tk = _prep(q, layout, lb)
+    qp = _fm._pad_seq(q, s_pad)
+    kp = _fm._pad_seq(k, s_pad)
+    vp = _fm._pad_seq(v, s_pad)
+    nq, nk = s_pad // bq, s_pad // bk
+    live = _tile_live(lay, bq, bk, lb, causal)
+    pick = jnp.asarray(_kv_pick(live, inner_is_k=True))
+    lay_j = jnp.asarray(lay)
+
+    grid = (b, hq, nq, nk)
+    q_blk = pl.BlockSpec((1, 1, bq, d),
+                         lambda ib, ih, iq, ik, pick_ref: (ib, ih, iq, 0))
+    kv_blk = pl.BlockSpec(
+        (1, 1, bk, d),
+        lambda ib, ih, iq, ik, pick_ref: (ib, ih // group,
+                                          pick_ref[ih, iq, ik], 0))
+    lt_blk = pl.BlockSpec((1, tq, tk),
+                          lambda ib, ih, iq, ik, pick_ref: (ih, iq, ik))
+    lse_blk = pl.BlockSpec((1, 1, bq, 128),
+                           lambda ib, ih, iq, ik, pick_ref: (ib, ih, iq, 0))
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
+                          bq=bq, bk=bk, lb=lb, s_real=s_real),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[q_blk, kv_blk, kv_blk, lt_blk],
+            out_specs=[q_blk, lse_blk],
+            scratch_shapes=[
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, d), jnp.float32),
+            ]),
+        interpret=_fm.INTERPRET,
+        out_shape=[jax.ShapeDtypeStruct((b, hq, s_pad, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, hq, s_pad, 128), jnp.float32)],
+    )(pick, qp, kp, vp, lay_j)
+    return o[:, :, :s_real], lse[:, :, :s_real, 0]
+
+
+def _bwd_impl(q, k, v, o, lse, g, layout, lb, causal, sm_scale):
+    b, hq, s_real, d = q.shape
+    hkv = k.shape[1]
+    group = hq // hkv
+    bq, bk, s_pad, lay, tq, tk = _prep(q, layout, lb)
+    nq, nk = s_pad // bq, s_pad // bk
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    qp = _fm._pad_seq(q, s_pad)
+    kp = _fm._pad_seq(k, s_pad)
+    vp = _fm._pad_seq(v, s_pad)
+    gp = _fm._pad_seq(g, s_pad)
+    lsep = _fm._lanes(lse, s_pad)
+    deltap = _fm._lanes(delta, s_pad)
+    live = _tile_live(lay, bq, bk, lb, causal)
+    pick_k = jnp.asarray(_kv_pick(live, inner_is_k=True))
+    lay_j = jnp.asarray(lay)
+
+    q_blk = pl.BlockSpec((1, 1, bq, d),
+                         lambda ib, ih, iq, ik, pref: (ib, ih, iq, 0))
+    kv_blk = pl.BlockSpec(
+        (1, 1, bk, d),
+        lambda ib, ih, iq, ik, pref: (ib, ih // group,
+                                      pref[ih, iq, ik], 0))
+    lt_blk = pl.BlockSpec((1, tq, tk),
+                          lambda ib, ih, iq, ik, pref: (ih, iq, ik))
+    lane_blk = pl.BlockSpec((1, 1, bq, 128),
+                            lambda ib, ih, iq, ik, pref: (ib, ih, iq, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          bq=bq, bk=bk, lb=lb, s_real=s_real),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, hq, nq, nk),
+            in_specs=[q_blk, kv_blk, kv_blk, q_blk, lane_blk, lane_blk,
+                      lt_blk],
+            out_specs=q_blk,
+            scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)]),
+        interpret=_fm.INTERPRET,
+        out_shape=jax.ShapeDtypeStruct((b, hq, s_pad, d), q.dtype),
+    )(pick_k, qp, kp, vp, gp, lsep, deltap, lay_j)
+
+    # dkv: grid (b, hkv, nk, nq), q-side clamped by the any-over-group
+    # liveness (transposed walk); the (group, ...)-sized blocks cover the
+    # kv-head's query heads directly on the head axis
+    live_any = live.reshape(hkv, group, nq, nk).max(1) > 0
+    pick_q = jnp.asarray(_kv_pick(live_any, inner_is_k=False))
+
+    def q_idx(ib, ihkv, ik, iq, pref):
+        return (ib, ihkv, pref[ihkv, ik, iq], 0)
+
+    grp_blk = pl.BlockSpec((1, group, bq, d), q_idx)
+    grp_lane = pl.BlockSpec((1, group, bq, 128), q_idx)
+    kv_own = pl.BlockSpec((1, 1, bk, d),
+                          lambda ib, ihkv, ik, iq, pref: (ib, ihkv, ik, 0))
+    # the layout tile MUST use the true (unclamped) q index: the skip
+    # predicate reads it, and a clamped-to-live tile here would re-run a
+    # live tile's compute on a dead step (double counting)
+    lt_grp = pl.BlockSpec(
+        (group, tq, tk),
+        lambda ib, ihkv, ik, iq, pref: (ihkv, iq, ik))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
+                          bq=bq, bk=bk, lb=lb, s_real=s_real, group=group),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, hkv, nk, nq),
+            in_specs=[grp_blk, kv_own, kv_own, grp_blk, grp_lane, grp_lane,
+                      lt_grp],
+            out_specs=[kv_own, kv_own],
+            scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                            pltpu.VMEM((bk, d), jnp.float32)]),
+        interpret=_fm.INTERPRET,
+        out_shape=[jax.ShapeDtypeStruct((b, hkv, s_pad, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, hkv, s_pad, d), v.dtype)],
+    )(pick_q, qp, kp, vp, gp, lsep, deltap, lay_j)
+    return dq[:, :, :s_real], dk[:, :, :s_real], dv[:, :, :s_real]
+
+
+def block_sparse_mha(q, k, v, layout, block: int, causal: bool = False,
+                     sm_scale=None):
+    """Block-sparse attention over ``q [B, Hq, S, D]``, ``k/v [B, Hkv, S,
+    D]`` with a DeepSpeed block ``layout [Hq, S/block, S/block]``.
+    Differentiable (custom VJP mirroring flash_mha's saved-residual
+    backward); dead layout tiles cost neither FLOPs nor K/V DMA."""
+    layout = np.asarray(layout)
+    if layout.shape[0] != q.shape[1]:
+        raise ValueError(
+            f"layout has {layout.shape[0]} heads but q has {q.shape[1]} — "
+            "a mismatched layout would silently clamp head indices on TPU")
+    scale = 1.0 / math.sqrt(q.shape[-1]) if sm_scale is None else sm_scale
+    lb = int(block)
+
+    @jax.custom_vjp
+    def f(q, k, v):
+        o, _ = _fwd_impl(q, k, v, layout, lb, causal, scale)
+        return o
+
+    def f_fwd(q, k, v):
+        o, lse = _fwd_impl(q, k, v, layout, lb, causal, scale)
+        return o, (q, k, v, o, lse)
+
+    def f_bwd(res, g):
+        q, k, v, o, lse = res
+        return _bwd_impl(q, k, v, o, lse, g, layout, lb, causal, scale)
+
+    f.defvjp(f_fwd, f_bwd)
+    return f(q, k, v)
+
+
+def supports(s: int, d: int, block: int, num_heads: int,
+             layout_heads: int | None = None) -> bool:
+    """Applicability: layout blocks must tile the kernel blocks, the score
+    tile must fit the documented VMEM budget, and (when given) the layout's
+    head count must match the query heads (a mismatch would clamp head
+    indices silently on TPU)."""
+    if layout_heads is not None and layout_heads != num_heads:
+        return False
+    bq = _kernel_block(block)
+    if block <= 128 and 128 % block != 0:
+        return False
+    return bq * bq * 4 <= (1 << 22) and d <= 256

@@ -3,12 +3,12 @@
 Analog of ``deepspeed/ops/sparse_attention/`` (``sparsity_config.py``
 configs, ``sparse_self_attention.py``, Triton ``matmul.py``/``softmax.py``).
 The reference builds a per-head block *layout* [H, nb, nb] and runs
-Triton block-sparse kernels.  Here the same configs build the same layouts;
-:func:`sparse_attention` lowers to a dense attention masked at block
-granularity — on TPU, XLA folds the mask into the fused softmax, and the
-FLOP savings of true block skipping belong to the Pallas flash kernel
-(ops/flash_attention) which accepts the same layouts via
-:func:`layout_to_token_mask`.
+Triton block-sparse kernels.  Here the same configs build the same
+layouts; :func:`sparse_attention` dispatches to the Pallas block-sparse
+kernel (ops/pallas/block_sparse_mha.py) on TPU — dead layout tiles are
+skipped at the grid level, costing neither FLOPs nor K/V bandwidth, the
+analog of the reference's Triton SDD/DSD block skipping — and falls back
+to a dense attention masked at block granularity elsewhere.
 """
 
 from __future__ import annotations
@@ -192,16 +192,48 @@ def layout_to_token_mask(layout: np.ndarray, block: int) -> jnp.ndarray:
 
 def sparse_attention(q, k, v, sparsity_config: SparsityConfig,
                      causal: bool = False,
-                     sm_scale: Optional[float] = None) -> jnp.ndarray:
+                     sm_scale: Optional[float] = None,
+                     impl: str = "auto") -> jnp.ndarray:
     """Block-sparse attention (ref SparseSelfAttention forward).
 
-    q/k/v: [B, S, H, D] → [B, S, H, D].  The block layout masks the score
-    matrix; causal composes a lower-triangular mask on top.
+    q/k/v: [B, S, H, D] → [B, S, H, D] (GQA: k/v may carry fewer heads).
+    The block layout masks the score matrix; causal composes a
+    lower-triangular mask on top.  ``impl='auto'`` takes the Pallas
+    block-skipping kernel on TPU (ops/pallas/block_sparse_mha.py — dead
+    layout tiles cost neither FLOPs nor K/V DMA, the reference's Triton
+    matmul.py behavior); ``'xla'`` forces the dense-masked lowering.
     """
     if sm_scale is None:
         sm_scale = q.shape[-1] ** -0.5
     s = q.shape[1]
     layout = sparsity_config.make_layout(s)
+
+    if impl in ("auto", "pallas"):
+        import importlib
+
+        bsm = importlib.import_module(
+            "deepspeed_tpu.ops.pallas.block_sparse_mha")
+        fm = importlib.import_module("deepspeed_tpu.ops.pallas.flash_mha")
+        on_tpu = jax.default_backend() == "tpu"
+        lb = sparsity_config.block
+        ok = (s % lb == 0 and bsm.supports(s, q.shape[-1], lb, q.shape[2],
+                                           layout_heads=layout.shape[0]))
+        if impl == "pallas" and not ok:
+            raise ValueError(
+                f"impl='pallas' but the block-sparse kernel does not apply "
+                f"(seq {s}, block {lb}, heads {q.shape[2]} vs layout "
+                f"{layout.shape[0]}) — fix the config or use impl='auto'")
+        if (on_tpu or fm.INTERPRET or impl == "pallas") and ok:
+            out = bsm.block_sparse_mha(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3), layout, lb, causal=causal,
+                sm_scale=sm_scale)
+            return out.transpose(0, 2, 1, 3)
+
+    if k.shape[2] != q.shape[2]:  # GQA: expand kv heads for the dense path
+        g = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, g, axis=2)
+        v = jnp.repeat(v, g, axis=2)
     mask = layout_to_token_mask(layout, sparsity_config.block)  # [H, S, S]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32) * sm_scale,
                         k.astype(jnp.float32))

@@ -911,11 +911,20 @@ class DeepSpeedEngine:
 
     def destroy(self) -> None:
         """Release background resources (swap worker pool, in-flight
-        prefetches).  Ref DeepSpeedEngine.destroy."""
+        prefetches).  Call when done training — the last step always
+        leaves one speculative store read in flight (whose NVMe buffer
+        stays pinned until consumed).  Ref DeepSpeedEngine.destroy."""
         self._cancel_prefetch()
         if self._swap_pool is not None:
             self._swap_pool.shutdown(wait=True)
             self._swap_pool = None
+
+    def __del__(self):  # best-effort: destroy() is the real API
+        try:
+            if getattr(self, "_swap_pool", None) is not None:
+                self._swap_pool.shutdown(wait=False)
+        except Exception:
+            pass
 
     def _swap_in_opt_state(self):
         if self._opt_store is None:
