@@ -10,6 +10,15 @@ and relaunch overhead don't exist under single-controller JAX.
 Flow (mirrors Autotuner.tune): estimate per-device memory for each ZeRO
 stage → prune stages that can't fit → sweep micro-batch sizes (power-of-2
 "model-based" ordering) → run short timed trials → pick best throughput.
+
+Caveat (trial fidelity): trials time the CURRENT backend.  On a real TPU
+the ranking is authoritative; on the virtual CPU mesh (CI, or a down
+tunnel) the memory-model pruning is still sound, but the throughput
+ORDERING reflects the CPU interpreter's cost model, not the chip's — MXU
+tiling, ICI bandwidth, and HBM pressure differences do not register.
+Treat CPU-mesh tuning results as feasibility screening and re-run the
+final sweep on hardware (``bin/dstpu_autotune`` on the pod) before
+committing a launch config.
 """
 
 from __future__ import annotations

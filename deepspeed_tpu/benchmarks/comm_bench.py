@@ -89,6 +89,43 @@ def run_bench(sizes_mb: Optional[List[float]] = None, trials: int = 5,
                 "algbw_gbps": round(algbw, 2),
                 "busbw_gbps": round(algbw * bw_factor(op, n), 2),
             })
+
+    # qgZ row: int8 block-quantized gradient reduce (ZeRO++ transport) vs
+    # the fp32 reduce-scatter above — wire traffic is s8 + 1/256 scales,
+    # so effective bandwidth should approach 4x (ref qgZ claim; the HLO
+    # test pins that the payload really is s8)
+    from deepspeed_tpu.comm.coalesced_collectives import (
+        all_to_all_quant_reduce)
+
+    for mb in sizes_mb:
+        itemsize = 4
+        elems = int(mb * 1e6 / itemsize)
+        elems = max(n * n * 256, elems - elems % (n * n * 256))
+        x = jnp.ones((elems,), jnp.float32)
+        x = jax.device_put(x, NamedSharding(mesh, P()))
+
+        def qfn(a):
+            shard, _ = all_to_all_quant_reduce(
+                {"g": a}, axis, axis, inner_size=n, outer_size=1)
+            return shard
+
+        jitted = jax.jit(shard_map(qfn, mesh=mesh, in_specs=(P(),),
+                                   out_specs=P(axis), check_rep=False))
+        out = jitted(x)
+        np.asarray(jax.device_get(out)).ravel()[:1]
+        t0 = time.perf_counter()
+        for _ in range(trials):
+            out = jitted(x)
+        np.asarray(jax.device_get(out)).ravel()[:1]
+        dt = (time.perf_counter() - t0) / trials
+        nbytes = elems * itemsize
+        algbw = nbytes / dt / 1e9  # logical fp32 bytes reduced per second
+        results.append({
+            "op": "qgz_quant_reduce", "size_mb": round(nbytes / 1e6, 2),
+            "axis": axis, "world": n, "time_ms": round(dt * 1e3, 3),
+            "algbw_gbps": round(algbw, 2),
+            "busbw_gbps": round(algbw * bw_factor("reduce_scatter", n), 2),
+        })
     return results
 
 

@@ -142,7 +142,16 @@ def get_rank(group: Optional[AxisName] = None) -> int:
 
 
 def get_local_rank() -> int:
-    return 0  # one process per host on TPU; local device ids via jax.local_devices()
+    """Rank within this host (ref dist.get_local_rank / LOCAL_RANK env).
+
+    One process per host is the TPU norm (→ 0), but per-chip process
+    layouts launched by the runner (hostfile slots, --num_procs_per_host)
+    export LOCAL_RANK / DSTPU_LOCAL_RANK — honor them when present."""
+    for var in ("DSTPU_LOCAL_RANK", "LOCAL_RANK"):
+        v = os.environ.get(var)
+        if v is not None:
+            return int(v)
+    return 0
 
 
 # ----------------------------------------------------------------------

@@ -117,6 +117,40 @@ class CompressionManager:
             x, bits=int(gp.get("bits", 8)),
             symmetric=gp.get("quantization_type", "asymmetric") == "symmetric")
 
+    def active_signature(self, step: int) -> Tuple[str, ...]:
+        """Techniques active at ``step`` — callers re-jit when this tuple
+        changes (the step gate is python-static inside apply())."""
+        return tuple(sorted(
+            t for t in self.scheduler.techniques
+            if self.scheduler.active(t, step)))
+
+    def reduce_layers(self, params: Any) -> Any:
+        """Teacher params → layer-reduced student params (keeps the
+        ``teacher_layer`` rows of each stacked [L, ...] param — ref
+        student_initialization, compression/helper.py)."""
+        lr = self.layer_reduction
+        if not lr.enabled:
+            return params
+        keep = lr.teacher_layer
+        if keep is None and lr.keep_number_layer:
+            keep = list(range(lr.keep_number_layer))
+        if not keep:
+            return params
+        keep_idx = np.asarray(keep)
+
+        def cut(path, w):
+            p = path_str(path)
+            if lr.module_name_prefix and not p.startswith(lr.module_name_prefix):
+                return w
+            if np.ndim(w) >= 1 and np.shape(w)[0] > keep_idx.max() \
+                    and "layers" in p:
+                return w[keep_idx]
+            return w
+
+        out = jax.tree_util.tree_map_with_path(cut, params)
+        logger.info(f"layer_reduction: kept layers {keep}")
+        return out
+
     # ------------------------------------------------------------------
     def redundancy_clean(self, params: Any, num_heads: int = 0) -> Any:
         """Permanently bake all active masks/quant into the weights (ref
@@ -130,24 +164,17 @@ def init_compression(params: Any, config: Dict[str, Any]
     layer reduction eagerly (student keeps ``teacher_layer`` rows of each
     stacked [L, ...] param) and returns (params, manager)."""
     mgr = CompressionManager(config)
-    lr = mgr.layer_reduction
-    if lr.enabled:
-        keep = lr.teacher_layer
-        if keep is None and lr.keep_number_layer:
-            keep = list(range(lr.keep_number_layer))
-        if keep:
-            keep_idx = np.asarray(keep)
+    return mgr.reduce_layers(params), mgr
 
-            def cut(path, w):
-                p = path_str(path)
-                if lr.module_name_prefix and not p.startswith(lr.module_name_prefix):
-                    return w
-                # stacked per-layer params: leading dim == num teacher layers
-                if np.ndim(w) >= 1 and np.shape(w)[0] > keep_idx.max():
-                    if "layers" in p:
-                        return w[keep_idx]
-                return w
 
-            params = jax.tree_util.tree_map_with_path(cut, params)
-            logger.info(f"layer_reduction: kept layers {keep}")
-    return params, mgr
+def student_initialization(student_params: Any, teacher_params: Any,
+                           config: Dict[str, Any]) -> Any:
+    """Initialise a layer-reduced student from its teacher (ref
+    ``deepspeed.compression.helper.student_initialization``): the student
+    takes the teacher's ``teacher_layer`` rows of every stacked per-layer
+    param and the teacher's non-layer params verbatim."""
+    mgr = CompressionManager(config)
+    cut = mgr.reduce_layers(teacher_params)
+    return jax.tree_util.tree_map(lambda s, t: np.asarray(t).astype(s.dtype)
+                                  if np.shape(s) == np.shape(t) else s,
+                                  student_params, cut)

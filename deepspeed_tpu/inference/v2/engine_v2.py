@@ -50,6 +50,9 @@ class RaggedInferenceEngineConfig:
         self.block_size = int(self.memory_config.get("block_size", 16))
         self.max_context = int(d.get("max_context", 2048))
         self.dtype = d.get("dtype", "bfloat16")
+        ep = d.get("expert_parallel", {})
+        self.ep_size = int(ep.get("ep_size", 1) if isinstance(ep, dict)
+                           else ep)
 
 
 class InferenceEngineV2:
@@ -59,8 +62,12 @@ class InferenceEngineV2:
         self.cfg = RaggedInferenceEngineConfig(config, **kw)
         dt = jnp.bfloat16 if "bf" in str(self.cfg.dtype) else jnp.float32
         self.model_config = model.replace(dtype=dt)
-        mesh_sizes = {"tensor": self.cfg.tp_size} if self.cfg.tp_size > 1 else None
-        self.topology = MeshTopology(mesh_sizes)
+        mesh_sizes = {}
+        if self.cfg.tp_size > 1:
+            mesh_sizes["tensor"] = self.cfg.tp_size
+        if self.cfg.ep_size > 1:
+            mesh_sizes["expert"] = self.cfg.ep_size
+        self.topology = MeshTopology(mesh_sizes or None)
         set_topology(self.topology)
         self.rules = ShardingRules(self.topology, zero_stage=0)
 

@@ -157,9 +157,31 @@ def _ragged_layer(x, lp, k_pages, v_pages, meta, cfg: TransformerConfig,
     if "moe" not in lp:
         return x + _mlp_block(h2, lp["mlp"], cfg), k_pages, v_pages
 
-    from deepspeed_tpu.moe.sharded_moe import moe_forward
+    from deepspeed_tpu.moe.sharded_moe import moe_forward, moe_forward_ep
+    from deepspeed_tpu.parallel.topology import get_topology
 
     def moe_branch(hh):
+        topo = get_topology()
+        tt = hh.shape[0]
+        # expert-parallel ragged step: tokens split over the expert axis,
+        # explicit all_to_all dispatch (ref mixtral model_implementations +
+        # _AllToAll).  Needs a static branch (shard_map under lax.cond is
+        # unsafe), hence the moe_every == 1 static selection above.
+        if (isinstance(layer_is_moe, bool) and topo is not None
+                and topo.ep_size > 1 and tt % topo.ep_size == 0):
+            ep = topo.ep_size
+            out, _ = moe_forward_ep(hh.reshape(ep, tt // ep, hh.shape[1]),
+                                    lp["moe"], cfg, topo)
+            return out.reshape(tt, -1)
+        if topo is not None and topo.ep_size > 1:
+            from deepspeed_tpu.utils.logging import log_dist
+
+            log_dist(
+                f"expert_parallel requested (ep={topo.ep_size}) but the "
+                f"ragged step fell back to the single-group MoE "
+                f"(tokens={tt} not divisible, or moe_layer_freq > 1 makes "
+                "the selection traced) — dispatch will be auto-partitioned",
+                level="warning")
         out, _ = moe_forward(hh[None], lp["moe"], cfg)
         return out[0]
 
@@ -200,10 +222,14 @@ def ragged_forward(params, cache_k, cache_v, token_ids, token_slot, token_pos,
 
     def body(h, scanned):
         lp, ck_l, cv_l, idx = scanned
-        if cfg.is_moe:
-            is_moe_layer = (idx % moe_every) == (moe_every - 1)
-        else:
+        if not cfg.is_moe:
             is_moe_layer = False
+        elif moe_every == 1:
+            # static: every layer is MoE — keeps the selection out of
+            # lax.cond so the expert-parallel shard_map path can apply
+            is_moe_layer = True
+        else:
+            is_moe_layer = (idx % moe_every) == (moe_every - 1)
         h, ck_l, cv_l = _ragged_layer(h, lp, ck_l, cv_l, meta, cfg,
                                       layer_is_moe=is_moe_layer)
         return h, (ck_l, cv_l)

@@ -64,6 +64,53 @@ def config_from_hf(hf_config) -> TransformerConfig:
             sliding_window=getattr(hf_config, "sliding_window", None)
             if mt == "mistral" else None,
             layernorm_eps=hf_config.rms_norm_eps)
+    if mt == "mixtral":
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+            max_seq_len=hf_config.max_position_embeddings,
+            arch="llama", norm="rmsnorm", activation="swiglu",
+            use_rope=True,
+            rope_theta=getattr(hf_config, "rope_theta", 1e6),
+            tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
+                                        False)),
+            num_experts=hf_config.num_local_experts,
+            top_k=hf_config.num_experts_per_tok,
+            moe_layer_freq=1, moe_norm_topk=True,
+            # dropless: C = cf*k*T/E = T exactly at cf = E/k (HF blocks
+            # never drop tokens; larger cf just inflates [E,C,H] buffers)
+            capacity_factor=float(hf_config.num_local_experts
+                                  / hf_config.num_experts_per_tok),
+            layernorm_eps=hf_config.rms_norm_eps)
+    if mt == "qwen2_moe":
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+            max_seq_len=hf_config.max_position_embeddings,
+            arch="llama", norm="rmsnorm", activation="swiglu",
+            use_rope=True, qkv_bias=True,
+            rope_theta=getattr(hf_config, "rope_theta", 1e6),
+            tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
+                                        False)),
+            num_experts=hf_config.num_experts,
+            top_k=hf_config.num_experts_per_tok,
+            moe_layer_freq=int(getattr(hf_config, "decoder_sparse_step", 1)
+                               or 1),
+            moe_norm_topk=bool(getattr(hf_config, "norm_topk_prob", False)),
+            moe_intermediate_size=hf_config.moe_intermediate_size,
+            moe_shared_expert_size=getattr(
+                hf_config, "shared_expert_intermediate_size", 0),
+            capacity_factor=float(hf_config.num_experts
+                                  / hf_config.num_experts_per_tok),
+            layernorm_eps=hf_config.rms_norm_eps)
     if mt == "opt":
         return TransformerConfig(
             vocab_size=hf_config.vocab_size,
@@ -193,14 +240,52 @@ def _convert_llama(sd, cfg):
             attn["bq"] = sd[p + "self_attn.q_proj.bias"]
             attn["bk"] = sd[p + "self_attn.k_proj.bias"]
             attn["bv"] = sd[p + "self_attn.v_proj.bias"]
-        layers.append({
+        block = {
             "attn": attn,
-            "mlp": {"wg": sd[p + "mlp.gate_proj.weight"].T,
-                    "wi": sd[p + "mlp.up_proj.weight"].T,
-                    "wo": sd[p + "mlp.down_proj.weight"].T},
             "ln1": {"scale": sd[p + "input_layernorm.weight"]},
             "ln2": {"scale": sd[p + "post_attention_layernorm.weight"]},
-        })
+        }
+        if p + "block_sparse_moe.gate.weight" in sd:
+            # Mixtral: w1=gate, w3=up, w2=down per expert (ref
+            # inference/v2/model_implementations/mixtral)
+            ep = p + "block_sparse_moe.experts."
+            e = cfg.num_experts
+            block["moe"] = {
+                "router": sd[p + "block_sparse_moe.gate.weight"].T,
+                "wg": np.stack([sd[f"{ep}{j}.w1.weight"].T
+                                for j in range(e)]),
+                "wi": np.stack([sd[f"{ep}{j}.w3.weight"].T
+                                for j in range(e)]),
+                "wo": np.stack([sd[f"{ep}{j}.w2.weight"].T
+                                for j in range(e)]),
+            }
+        elif p + "mlp.gate.weight" in sd:
+            # Qwen2-MoE: routed experts + gated shared expert
+            ep = p + "mlp.experts."
+            e = cfg.num_experts
+            block["moe"] = {
+                "router": sd[p + "mlp.gate.weight"].T,
+                "wg": np.stack([sd[f"{ep}{j}.gate_proj.weight"].T
+                                for j in range(e)]),
+                "wi": np.stack([sd[f"{ep}{j}.up_proj.weight"].T
+                                for j in range(e)]),
+                "wo": np.stack([sd[f"{ep}{j}.down_proj.weight"].T
+                                for j in range(e)]),
+                "shared": {
+                    "wg": sd[p + "mlp.shared_expert.gate_proj.weight"].T,
+                    "wi": sd[p + "mlp.shared_expert.up_proj.weight"].T,
+                    "wo": sd[p + "mlp.shared_expert.down_proj.weight"].T},
+                "shared_gate": sd[p + "mlp.shared_expert_gate.weight"].T,
+            }
+        else:
+            block["mlp"] = {"wg": sd[p + "mlp.gate_proj.weight"].T,
+                            "wi": sd[p + "mlp.up_proj.weight"].T,
+                            "wo": sd[p + "mlp.down_proj.weight"].T}
+        layers.append(block)
+    if cfg.is_moe and any("moe" not in b for b in layers):
+        raise NotImplementedError(
+            "mixed dense/MoE layer stacks (decoder_sparse_step > 1 or "
+            "mlp_only_layers) are not supported by the stacked-layer scan")
     out = {"embed": {"tokens": sd["model.embed_tokens.weight"]},
            "layers": _stack(layers),
            "final_norm": {"scale": sd["model.norm.weight"]}}

@@ -63,6 +63,22 @@ register("mixtral-tiny", TransformerConfig(
     activation="swiglu", use_rope=True, tie_embeddings=False,
     num_experts=4, top_k=2, moe_layer_freq=1))
 
+# Qwen2-MoE style: narrower routed experts + a gated shared expert
+register("qwen2moe-tiny", TransformerConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=256, num_layers=2,
+    num_heads=4, num_kv_heads=2, max_seq_len=256, arch="llama",
+    norm="rmsnorm", activation="swiglu", use_rope=True,
+    tie_embeddings=False, qkv_bias=True, num_experts=4, top_k=2,
+    moe_layer_freq=1, moe_intermediate_size=64, moe_shared_expert_size=128))
+
+register("qwen2moe-a14b", TransformerConfig(  # Qwen2-57B-A14B geometry
+    vocab_size=151936, hidden_size=3584, intermediate_size=18944,
+    num_layers=28, num_heads=28, num_kv_heads=4, max_seq_len=32768,
+    arch="llama", norm="rmsnorm", activation="swiglu", use_rope=True,
+    tie_embeddings=False, qkv_bias=True, num_experts=64, top_k=8,
+    moe_layer_freq=1, moe_intermediate_size=2560,
+    moe_shared_expert_size=20480))
+
 register("mixtral-8x7b", TransformerConfig(
     vocab_size=32000, hidden_size=4096, intermediate_size=14336, num_layers=32,
     num_heads=32, num_kv_heads=8, max_seq_len=8192, arch="llama", norm="rmsnorm",

@@ -67,6 +67,15 @@ class TransformerConfig:
     num_experts: int = 0
     top_k: int = 2
     capacity_factor: float = 1.25
+    # routed-expert FFN width when it differs from the dense layers'
+    # intermediate_size (HF qwen2_moe moe_intermediate_size); None → same
+    moe_intermediate_size: Optional[int] = None
+    # Qwen2-MoE shared expert: a dense FFN of this width added to the
+    # routed output, gated by sigmoid(x @ shared_gate); 0 = none
+    moe_shared_expert_size: int = 0
+    # True: renormalize top-k weights to sum to 1 (HF mixtral
+    # norm_topk_prob); False: deepspeed top2gating drop-aware scaling
+    moe_norm_topk: bool = False
     # "auto" | "einsum" | "sorted": [T,E,C] one-hot einsum dispatch vs
     # argsort-by-expert gather dispatch (auto switches on one-hot size)
     moe_dispatch: str = "auto"
@@ -181,20 +190,39 @@ def init_layer_params(cfg: TransformerConfig, key) -> Params:
             mlp["bo"] = jnp.zeros((h,), pd)
         return mlp
 
-    block: Params = {"attn": attn, "mlp": mlp_params(keys[4], keys[5], keys[6])}
+    block: Params = {"attn": attn}
+    if not (cfg.is_moe and cfg.moe_layer_freq == 1):
+        # all-MoE stacks (freq 1, mixtral/qwen2moe style) carry no dense
+        # FFN at all — a zero/random filler would cost real HBM and
+        # optimizer state (e.g. ~22GB of dead fp32 on mixtral-8x7b)
+        block["mlp"] = mlp_params(keys[4], keys[5], keys[6])
 
     if cfg.is_moe:
         # Expert weights stacked on a leading expert axis (sharded over the
         # "expert" mesh axis); router is replicated. Ref: moe/experts.py +
         # sharded_moe.py TopKGate.
-        ek = jax.random.split(keys[7], 4)
+        ek = jax.random.split(keys[7], 8)
         e = cfg.num_experts
+        mffn = cfg.moe_intermediate_size or ffn
         block["moe"] = {
             "router": _dense_init(ek[0], (h, e), scale, pd),
-            "wi": _dense_init(ek[1], (e, h, ffn), scale, pd),
-            "wg": _dense_init(ek[2], (e, h, ffn), scale, pd) if cfg.activation == "swiglu" else None,
-            "wo": _dense_init(ek[3], (e, ffn, h), out_scale, pd),
+            "wi": _dense_init(ek[1], (e, h, mffn), scale, pd),
+            "wg": _dense_init(ek[2], (e, h, mffn), scale, pd) if cfg.activation == "swiglu" else None,
+            "wo": _dense_init(ek[3], (e, mffn, h), out_scale, pd),
         }
+        if cfg.moe_shared_expert_size:
+            sf = cfg.moe_shared_expert_size
+            block["moe"]["shared"] = {
+                "wi": _dense_init(ek[4], (h, sf), scale, pd),
+                "wg": _dense_init(ek[5], (h, sf), scale, pd)
+                if cfg.activation == "swiglu" else None,
+                "wo": _dense_init(ek[6], (sf, h), out_scale, pd),
+            }
+            block["moe"]["shared"] = {k: v for k, v
+                                      in block["moe"]["shared"].items()
+                                      if v is not None}
+            block["moe"]["shared_gate"] = _dense_init(ek[7], (h, 1), scale,
+                                                      pd)
         block["moe"] = {k: v for k, v in block["moe"].items() if v is not None}
 
     def norm_params():

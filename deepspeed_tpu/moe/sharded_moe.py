@@ -49,7 +49,8 @@ def _capacity(num_tokens: int, num_experts: int, capacity_factor: float, k: int,
 
 
 def top_k_gating(logits: jnp.ndarray, k: int, capacity_factor: float,
-                 min_capacity: int = 4) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                 min_capacity: int = 4, norm_topk: bool = False
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Top-k gating with capacity. ``logits``: [T, E] (fp32).
 
     Returns (l_aux, combine_weights [T, E, C], dispatch_mask [T, E, C]).
@@ -87,15 +88,21 @@ def top_k_gating(logits: jnp.ndarray, k: int, capacity_factor: float,
         occupancy = occupancy + jnp.sum(onehot * keep[:, None], axis=0)
         masked = masked * (1 - onehot)
 
-    # renormalise combine weights over selected experts (ref top2gating denom)
-    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
-    combine = combine / jnp.maximum(denom, 1e-9) * jnp.minimum(denom, 1.0) \
-        if k > 1 else combine
+    # renormalise combine weights over selected experts: norm_topk (HF
+    # mixtral norm_topk_prob) always sums kept weights to 1; the default
+    # is the drop-aware top2gating scaling (ref top2gating denom)
+    if k > 1:
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        if norm_topk:
+            combine = combine / jnp.maximum(denom, 1e-9)
+        else:
+            combine = combine / jnp.maximum(denom, 1e-9) \
+                * jnp.minimum(denom, 1.0)
     return l_aux, combine, dispatch
 
 
 def top_k_gating_sorted(logits: jnp.ndarray, k: int, capacity_factor: float,
-                        min_capacity: int = 4):
+                        min_capacity: int = 4, norm_topk: bool = False):
     """Sort-based top-k gating: no [T, E, C] one-hot.
 
     Returns (l_aux, slot [T·k] int32 in [0, E·C] with E·C = dropped,
@@ -131,10 +138,15 @@ def top_k_gating_sorted(logits: jnp.ndarray, k: int, capacity_factor: float,
     kept = slot < e * c
     gate = g_flat * kept
     if k > 1:
-        # renormalise over a token's kept choices (ref top2gating denom)
+        # renormalise over a token's kept choices (ref top2gating denom;
+        # norm_topk = HF mixtral norm_topk_prob semantics)
         per_tok = gate.reshape(k, t)
         denom = jnp.sum(per_tok, axis=0, keepdims=True)
-        per_tok = per_tok / jnp.maximum(denom, 1e-9) * jnp.minimum(denom, 1.0)
+        if norm_topk:
+            per_tok = per_tok / jnp.maximum(denom, 1e-9)
+        else:
+            per_tok = per_tok / jnp.maximum(denom, 1e-9) \
+                * jnp.minimum(denom, 1.0)
         gate = per_tok.reshape(-1)
     return l_aux, slot, gate, c
 
@@ -163,8 +175,9 @@ def _resolve_dispatch(cfg, t: int, e: int, c: int) -> str:
 
 def _dispatch_combine_einsum(tokens, logits, cfg, dt):
     """Einsum formulation: returns (dispatched [E,C,H], combine_fn, aux)."""
-    l_aux, combine, dispatch = top_k_gating(logits, cfg.top_k,
-                                            cfg.capacity_factor)
+    l_aux, combine, dispatch = top_k_gating(
+        logits, cfg.top_k, cfg.capacity_factor,
+        norm_topk=getattr(cfg, "moe_norm_topk", False))
     dispatched = jnp.einsum("tec,th->ech", dispatch.astype(dt), tokens)
 
     def combine_fn(expert_out):
@@ -178,7 +191,9 @@ def _dispatch_combine_sorted(tokens, logits, cfg, dt):
     t, h = tokens.shape
     e = logits.shape[1]
     k = cfg.top_k
-    l_aux, slot, gate, c = top_k_gating_sorted(logits, k, cfg.capacity_factor)
+    l_aux, slot, gate, c = top_k_gating_sorted(
+        logits, k, cfg.capacity_factor,
+        norm_topk=getattr(cfg, "moe_norm_topk", False))
     token_of = jnp.tile(jnp.arange(t, dtype=jnp.int32), k)     # choice-major
     # slot → source token (E·C+1 wide so the trash slot can't clip-corrupt;
     # empty slots keep the out-of-range sentinel t, gathered as zeros below)
@@ -219,7 +234,26 @@ def moe_forward(x: jnp.ndarray, p: Dict[str, jnp.ndarray], cfg) -> Tuple[jnp.nda
     dispatched, combine_fn, l_aux = _DISPATCHERS[mode](tokens, logits, cfg, dt)
     expert_out = _expert_ffn(dispatched, p, dt)
     out = combine_fn(expert_out)
+    out = out + _shared_expert_out(tokens, p, dt)
     return out.reshape(b, s, h), l_aux.astype(jnp.float32)
+
+
+def _shared_expert_out(tokens: jnp.ndarray, p: Dict[str, jnp.ndarray], dt):
+    """Qwen2-MoE shared expert: a dense FFN over every token, scaled by
+    sigmoid(x @ shared_gate) and added to the routed output (HF
+    Qwen2MoeSparseMoeBlock).  Zero when the params carry no 'shared'."""
+    if "shared" not in p:
+        return jnp.zeros((), dt)
+    sp = p["shared"]
+    if "wg" in sp:
+        hdn = jax.nn.silu(tokens @ sp["wg"].astype(dt)) \
+            * (tokens @ sp["wi"].astype(dt))
+    else:
+        hdn = jax.nn.gelu(tokens @ sp["wi"].astype(dt))
+    y = hdn @ sp["wo"].astype(dt)
+    gate = jax.nn.sigmoid(
+        tokens.astype(jnp.float32) @ p["shared_gate"].astype(jnp.float32))
+    return y * gate.astype(dt)
 
 
 def moe_forward_ep(x: jnp.ndarray, p: Dict[str, jnp.ndarray], cfg,
@@ -273,9 +307,12 @@ def moe_forward_ep(x: jnp.ndarray, p: Dict[str, jnp.ndarray], cfg,
 
     # tokens' batch dim is sharded over the expert axis (it is part of the
     # data-parallel product); expert weights over their leading expert dim;
-    # the router is replicated
+    # the router is replicated.  The shared expert (dense, every token) is
+    # computed outside the manual region under the auto partitioner.
+    routed_p = {k: v for k, v in p.items()
+                if k not in ("shared", "shared_gate")}
     p_specs = {key: P(EXPERT_AXIS) if key != "router" else P()
-               for key in p}
+               for key in routed_p}
     # inside another shard_map (e.g. the pipeline's manual "pipe" axis) the
     # inner shard_map must be built on the *context* mesh, whose outer axes
     # are already marked Manual — passing the raw device mesh is rejected
@@ -285,4 +322,8 @@ def moe_forward_ep(x: jnp.ndarray, p: Dict[str, jnp.ndarray], cfg,
         body, mesh=mesh, axis_names={EXPERT_AXIS},
         in_specs=(P(EXPERT_AXIS), p_specs),
         out_specs=(P(EXPERT_AXIS), P()))
-    return mapped(x, p)
+    out, l_aux = mapped(x, routed_p)
+    if "shared" in p:
+        out = out + _shared_expert_out(x.reshape(b * s, h), p,
+                                       dt).reshape(x.shape)
+    return out, l_aux

@@ -61,6 +61,10 @@ DEFAULT_RULES: List[Tuple[str, Tuple[Optional[str], ...]]] = [
     (r"moe/router$", ("layer", "embed", None)),
     (r"moe/w[ig]$", ("layer", "expert", "embed", "mlp")),
     (r"moe/wo$", ("layer", "expert", "mlp", "embed")),
+    # Qwen2-MoE shared expert: dense FFN shapes (no expert dim)
+    (r"moe/shared/w[ig]$", ("layer", "embed", "mlp")),
+    (r"moe/shared/wo$", ("layer", "mlp", "embed")),
+    (r"moe/shared_gate$", ("layer", "embed", None)),
     (r"ln\d/(scale|bias)$", ("layer", "norm")),
     (r"final_norm/(scale|bias)$", ("norm",)),
     (r"lm_head$", ("embed", "vocab")),

@@ -69,6 +69,19 @@ class SchedulerConfig:
 
 
 @dataclass
+class TorchAutocastConfig:
+    """Ref: runtime/torch_autocast.py — per-op mixed precision.  On TPU
+    the functional model already keeps the precision-sensitive ops
+    (norms, softmax, router, loss) in fp32 while matmuls run in the
+    compute dtype, so enabling this selects the compute dtype exactly
+    like bf16/fp16 blocks do; ``lower_precision_safe_modules`` is
+    accepted for config parity (the safe set is the built-in policy)."""
+    enabled: bool = False
+    dtype: str = "bfloat16"
+    lower_precision_safe_modules: Optional[List[str]] = None
+
+
+@dataclass
 class FP16Config:
     """Reference: ``runtime/fp16`` config block. ``loss_scale == 0`` means
     dynamic loss scaling (DynamicLossScaler, ref loss_scaler.py:99)."""
@@ -400,6 +413,26 @@ class DeepSpeedConfig:
         self.fp16 = _from_dict(FP16Config, d.get(C.FP16), "fp16")
         bf16_dict = d.get(C.BFLOAT16, d.get(C.BFLOAT16_OLD))
         self.bf16 = _from_dict(BF16Config, bf16_dict, "bf16")
+        self.torch_autocast = _from_dict(TorchAutocastConfig,
+                                         d.get("torch_autocast"),
+                                         "torch_autocast")
+        if self.torch_autocast.enabled:
+            if self.fp16.enabled or self.bf16.enabled:
+                raise DeepSpeedConfigError(
+                    "torch_autocast cannot be combined with an explicit "
+                    "fp16/bf16 block (ref runtime/torch_autocast.py)")
+            # autocast selects the compute dtype (per-op fp32 islands are
+            # the built-in policy of the functional model)
+            dt = self.torch_autocast.dtype
+            if dt in ("bfloat16", "bf16"):
+                self.bf16 = BF16Config(enabled=True)
+            elif dt in ("float16", "fp16", "half"):
+                self.fp16 = _from_dict(FP16Config, {"enabled": True},
+                                       "fp16")
+            else:
+                raise DeepSpeedConfigError(
+                    f"torch_autocast.dtype must be bfloat16 or float16, "
+                    f"got {dt!r}")
         if self.fp16.enabled and self.bf16.enabled:
             raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
         self.zero_config = _from_dict(ZeroConfig, d.get(C.ZERO_OPTIMIZATION), "zero_optimization")

@@ -171,6 +171,26 @@ class DeepSpeedEngine:
                 % (" — device='nvme' degrades to host RAM"
                    if off_param.device == "nvme" else ""))
 
+        # -- compression (ref deepspeed/compression/compress.py) --------
+        # init_compression semantics built into the engine: layer
+        # reduction shrinks the model BEFORE params exist; the per-step
+        # technique masks are applied inside the jitted loss (see
+        # _compile_steps) and re-jit when the active set changes.
+        self._compression = None
+        cc = cfg.to_dict().get("compression_training")
+        if cc:
+            from deepspeed_tpu.compression.compress import CompressionManager
+
+            self._compression = CompressionManager(
+                {"compression_training": cc})
+            self._compression_sig = None
+            lr_cfg = self._compression.layer_reduction
+            if lr_cfg.enabled and isinstance(model, TransformerConfig):
+                keep = lr_cfg.teacher_layer or list(
+                    range(lr_cfg.keep_number_layer or model.num_layers))
+                model = model.replace(num_layers=len(keep))
+                log_dist(f"layer_reduction: student has {len(keep)} layers")
+
         # -- model ------------------------------------------------------
         self.model_config: Optional[TransformerConfig] = None
         if isinstance(model, TransformerConfig):
@@ -221,6 +241,9 @@ class DeepSpeedEngine:
             log_dist(msg, level="warning")
 
         if model_params is not None:
+            if self._compression is not None:
+                # teacher checkpoint → layer-reduced student rows
+                model_params = self._compression.reduce_layers(model_params)
             self.params = jax.device_put(model_params, self.param_shardings)
         else:
             init_jit = jax.jit(self._init_fn, out_shardings=self.param_shardings)
@@ -563,6 +586,12 @@ class DeepSpeedEngine:
                                            grad_clip=cfg.gradient_clipping)
             self._onebit_state = self._onebit.init_state(self.params)
 
+        if self._onebit is not None and self._compression is not None:
+            raise DeepSpeedConfigError(
+                "compression_training is not supported with 1-bit/qgZ "
+                "compressed-DP optimizers (their step wraps the raw loss, "
+                "so compression masks would silently not apply)")
+
         self._compile_steps()
 
     # ------------------------------------------------------------------
@@ -583,6 +612,20 @@ class DeepSpeedEngine:
         gas = self.gradient_accumulation_steps_value
         opt = self.optimizer
         loss_fn = self._loss_fn
+        if self._compression is not None:
+            # per-step compression view of the params inside the jitted
+            # loss (masks fuse with the matmuls); the step gate is python-
+            # static — train_batch re-compiles when the active set changes
+            mgr = self._compression
+            comp_step = self.global_steps
+            nh = self.model_config.num_heads if self.model_config else 0
+            inner_loss = loss_fn
+
+            def loss_fn(params, batch, **kw):  # noqa: F811
+                return inner_loss(mgr.apply(params, comp_step,
+                                            num_heads=nh), batch, **kw)
+
+            self._compression_sig = mgr.active_signature(comp_step)
         grad_shardings = self.grad_shardings
         ls_dynamic = self._ls_dynamic
         ls_window, ls_min = self._ls_window, self._ls_min
@@ -597,10 +640,13 @@ class DeepSpeedEngine:
         # the dp reduction is an all_gather of O(tokens·H) bytes, not a
         # dense [V,H] scatter+psum. See runtime/sparse.py.
         mc = self.model_config
+        # compression masks the embed table inside loss_fn, which the
+        # sparse path's hoisted lookup would bypass — keep dense grads
         sparse_grads = (cfg.sparse_gradients_enabled and mc is not None
                         and not mc.tie_embeddings
                         and self.topology.pp_size == 1
-                        and not self._param_stream and not qwz)
+                        and not self._param_stream and not qwz
+                        and self._compression is None)
         if cfg.sparse_gradients_enabled and not sparse_grads:
             logger.warning(
                 "sparse_gradients: unsupported with this configuration "
@@ -1027,6 +1073,16 @@ class DeepSpeedEngine:
             return type(data)(trunc(b) if isinstance(b, dict) else b for b in data)
         return data
 
+    def _maybe_recompile_compression(self) -> None:
+        """Re-jit when the compression schedule flips a technique on/off
+        (the step gate inside apply() is python-static; ref
+        compression/scheduler.py schedule_offset)."""
+        if self._compression is None:
+            return
+        if self._compression.active_signature(self.global_steps) \
+                != self._compression_sig:
+            self._compile_steps()
+
     def _maybe_update_random_ltd(self) -> None:
         """Raise the model's kept-token count per the LTD schedule; a value
         change swaps the model config and re-jits the step (the bounded
@@ -1072,6 +1128,7 @@ class DeepSpeedEngine:
             return self._train_batch_super(data)
         data = self._apply_curriculum(data)
         self._maybe_update_random_ltd()
+        self._maybe_recompile_compression()
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         batch_stack = self._stack_micro_batches(data)
@@ -1126,6 +1183,7 @@ class DeepSpeedEngine:
         window additionally allows post-hoc recovery via engine.rollback)."""
         data = self._apply_curriculum(data)
         self._maybe_update_random_ltd()
+        self._maybe_recompile_compression()
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         batch_stack = self._stack_micro_batches(data)

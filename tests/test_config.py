@@ -98,3 +98,19 @@ def test_deprecated_cpu_offload_alias():
     cfg = DeepSpeedConfig({"train_batch_size": 8,
                            "zero_optimization": {"stage": 2, "cpu_offload": True}})
     assert cfg.zero_config.offload_optimizer_device == "cpu"
+
+
+def test_torch_autocast_selects_compute_dtype():
+    """ref runtime/torch_autocast.py config surface: enabling autocast
+    picks the compute dtype; per-op fp32 islands (norms/softmax/router)
+    are the built-in model policy."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    c = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                         "torch_autocast": {"enabled": True,
+                                            "dtype": "bfloat16"}})
+    assert c.bf16.enabled and not c.fp16.enabled
+    c2 = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                          "torch_autocast": {"enabled": True,
+                                             "dtype": "float16"}})
+    assert c2.fp16.enabled and not c2.bf16.enabled

@@ -167,3 +167,31 @@ def test_converted_model_trains():
     from deepspeed_tpu.parallel import topology
 
     topology._GLOBAL_TOPOLOGY = None
+
+
+def test_mixtral_parity():
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    torch.manual_seed(0)
+    m = MixtralForCausalLM(MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, num_local_experts=4,
+        num_experts_per_tok=2, tie_word_embeddings=False))
+    # ample capacity (set by config_from_hf) ⇒ no token drops ⇒ exact
+    # top-2 routing parity with HF's dropless block
+    _compare(m, atol=4e-3)
+
+
+def test_qwen2_moe_parity():
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+
+    torch.manual_seed(0)
+    m = Qwen2MoeForCausalLM(Qwen2MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, num_experts=4, num_experts_per_tok=2,
+        moe_intermediate_size=48, shared_expert_intermediate_size=96,
+        decoder_sparse_step=1, norm_topk_prob=False,
+        tie_word_embeddings=False))
+    _compare(m, atol=4e-3)

@@ -1,0 +1,96 @@
+"""MoE serving (ref VERDICT r3 Missing #6): InferenceEngineV2 with
+expert parallelism — EP all_to_all inside the ragged step, token parity
+with the single-group path, and the mixtral/qwen2moe model zoo entries.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import get_model_config
+from deepspeed_tpu.models import transformer as tf_model
+
+
+def _reset_topo():
+    from deepspeed_tpu.parallel import topology
+
+    topology._GLOBAL_TOPOLOGY = None
+
+
+@pytest.mark.parametrize("name", ["mixtral-tiny", "qwen2moe-tiny"])
+def test_v2_ep_serving_matches_single_group(name):
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+
+    # ample capacity: with token drops, per-shard (EP) and global capacity
+    # budgets legitimately differ — parity is exact only dropless
+    model = get_model_config(name, capacity_factor=16.0)
+    eng1 = InferenceEngineV2(model, {"dtype": "float32"})
+    params = eng1.params
+    rng = np.random.default_rng(5)
+    prompts = [list(map(int, rng.integers(1, model.vocab_size, size=(6,))))
+               for _ in range(2)]
+    out1 = eng1.generate(prompts, max_new_tokens=6)
+    _reset_topo()
+
+    eng2 = InferenceEngineV2(model, {"dtype": "float32",
+                                     "expert_parallel": {"ep_size": 2}},
+                             model_params=params)
+    assert eng2.topology.ep_size == 2
+    out2 = eng2.generate(prompts, max_new_tokens=6)
+    assert out1 == out2, (out1, out2)
+    _reset_topo()
+
+
+def test_ep_ragged_step_compiles_all_to_all():
+    """The expert-parallel ragged decode must carry the explicit expert
+    all_to_all dispatch (ref moe/sharded_moe.py:96 _AllToAll)."""
+    from deepspeed_tpu.inference.v2.model import ragged_forward
+    from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+
+    model = get_model_config("mixtral-tiny", dtype=jnp.float32)
+    topo = MeshTopology({"expert": 2})
+    set_topology(topo)
+    try:
+        params = jax.jit(lambda k: tf_model.init_params(model, k))(
+            jax.random.PRNGKey(0))
+        bs, t, nb = 16, 8, 4
+        cache = jnp.zeros((model.num_layers, model.kv_heads, nb * bs,
+                           model.dim_per_head), jnp.float32)
+        tables = jnp.arange(nb, dtype=jnp.int32).reshape(2, 2)
+        args = (params, cache, cache + 0, jnp.zeros((t,), jnp.int32),
+                jnp.zeros((t,), jnp.int32),
+                jnp.arange(t, dtype=jnp.int32) % 4,
+                jnp.arange(t, dtype=jnp.int32),
+                tables, jnp.full((2,), 4, jnp.int32),
+                jnp.zeros((2,), jnp.int32))
+        import functools
+
+        hlo = jax.jit(functools.partial(ragged_forward, cfg=model,
+                                        block_size=bs)).lower(
+            *args).compile().as_text()
+        assert "all-to-all" in hlo, "EP dispatch missing from ragged step"
+    finally:
+        set_topology(None)
+        _reset_topo()
+
+
+def test_shared_expert_moe_trains():
+    """qwen2moe-style shared-expert model trains end-to-end."""
+    import deepspeed_tpu as ds
+
+    model = get_model_config("qwen2moe-tiny")
+    cfg = {"train_micro_batch_size_per_gpu": 4,
+           "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "mesh": {"data": 2, "expert": 2}}
+    engine, _, _, _ = ds.initialize(model=model, config=cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, model.vocab_size, size=(16, 33), dtype=np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
+    losses = [float(np.asarray(engine.train_batch(batch)))
+              for _ in range(5)]
+    assert losses[-1] < losses[0] - 0.5, losses
+    _reset_topo()

