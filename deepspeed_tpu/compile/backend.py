@@ -107,6 +107,34 @@ class OffloadOptStatesPass(CompilePass):
         return {"offload_optimizer": True}
 
 
+class SelectiveUnshardPass(CompilePass):
+    """With memory headroom under the budget, raise the param-persistence
+    threshold so small ZeRO-3 params stay gathered — trading spare HBM for
+    fewer per-use all-gathers (ref passes/selective_gather + the
+    prefetch/unshard decisions of DeepCompile's list schedule; under XLA
+    the *prefetch* half is the latency-hiding scheduler's job, so the
+    remaining decision is what to stop sharding at all)."""
+
+    name = "selective_unshard"
+    LADDER = [0, 100_000, 1_000_000, 10_000_000]
+    HEADROOM = 0.85  # only spend memory while peak < 85% of budget
+
+    def run(self, report, config):
+        budget = config.get("memory_budget_bytes")
+        peak = report.profile.get("peak_memory_bytes")
+        if not budget or peak is None or peak > budget * self.HEADROOM:
+            return None
+        cur = int(report.knobs.get("persist_threshold", 0))
+        idx = self.LADDER.index(cur) if cur in self.LADDER else 0
+        if idx + 1 >= len(self.LADDER):
+            return None
+        new = self.LADDER[idx + 1]
+        report.decisions.append(
+            f"selective_unshard: peak {peak:.3e}B < {self.HEADROOM:.0%} of "
+            f"budget → persist_threshold {cur} → {new}")
+        return {"persist_threshold": new}
+
+
 def deepspeed_compile(fn_factory: Callable[[Dict[str, Any]], Callable],
                       args: Tuple, config: Optional[Dict[str, Any]] = None,
                       max_rounds: int = 4
@@ -121,7 +149,8 @@ def deepspeed_compile(fn_factory: Callable[[Dict[str, Any]], Callable],
     report = CompileReport(knobs={"remat_policy": config.get(
         "remat_policy", "none")})
     profile = ProfilePass(fn_factory, args)
-    passes: List[CompilePass] = [RematPass(), OffloadOptStatesPass()]
+    passes: List[CompilePass] = [RematPass(), OffloadOptStatesPass(),
+                                 SelectiveUnshardPass()]
     for _ in range(max_rounds):
         profile.run(report, config)
         changed = False

@@ -8,8 +8,10 @@ converter*: ``config_from_hf`` maps an HF config to a
 the stacked functional param tree, after which every subsystem (engine,
 AutoTP, ZeRO, inference v1/v2) consumes the model like any other.
 
-Supported families: gpt2, llama, mistral, qwen2, opt, falcon, phi — the
-same set as the reference's v2 model implementations.
+Supported families: gpt2, llama, mistral, qwen2, mixtral, qwen2_moe, opt,
+falcon, phi — the same set as the reference's v2 model implementations
+(MoE included); :func:`register_converter` adds new families without
+touching this module (the analog of the v2 registry).
 
 Conventions handled per family:
 * HF ``nn.Linear`` stores [out, in] → transposed to our [in, out];
@@ -48,7 +50,26 @@ def config_from_hf(hf_config) -> TransformerConfig:
             max_seq_len=hf_config.n_positions, arch="gpt2",
             norm="layernorm", activation="gelu",
             layernorm_eps=hf_config.layer_norm_epsilon)
-    if mt in ("llama", "mistral", "qwen2"):
+    if mt in ("llama", "mistral", "qwen2", "mixtral", "qwen2_moe"):
+        # one llama-family block; MoE variants add routing fields.
+        # Dropless capacity: C = cf*k*T/E = T exactly at cf = E/k (HF MoE
+        # blocks never drop tokens; larger cf inflates [E,C,H] buffers).
+        moe_kw = {}
+        if mt == "mixtral":
+            e, k = hf_config.num_local_experts, hf_config.num_experts_per_tok
+            moe_kw = dict(num_experts=e, top_k=k, moe_layer_freq=1,
+                          moe_norm_topk=True, capacity_factor=float(e / k))
+        elif mt == "qwen2_moe":
+            e, k = hf_config.num_experts, hf_config.num_experts_per_tok
+            moe_kw = dict(
+                num_experts=e, top_k=k, capacity_factor=float(e / k),
+                moe_layer_freq=int(getattr(hf_config, "decoder_sparse_step",
+                                           1) or 1),
+                moe_norm_topk=bool(getattr(hf_config, "norm_topk_prob",
+                                           False)),
+                moe_intermediate_size=hf_config.moe_intermediate_size,
+                moe_shared_expert_size=getattr(
+                    hf_config, "shared_expert_intermediate_size", 0))
         return TransformerConfig(
             vocab_size=hf_config.vocab_size,
             hidden_size=hf_config.hidden_size,
@@ -57,60 +78,14 @@ def config_from_hf(hf_config) -> TransformerConfig:
             num_heads=hf_config.num_attention_heads,
             num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
             max_seq_len=hf_config.max_position_embeddings,
-            arch=mt, norm="rmsnorm", activation="swiglu", use_rope=True,
+            arch="llama" if mt in ("mixtral", "qwen2_moe") else mt,
+            norm="rmsnorm", activation="swiglu", use_rope=True,
             rope_theta=getattr(hf_config, "rope_theta", 10000.0),
             tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings", False)),
-            qkv_bias=(mt == "qwen2"),
+            qkv_bias=(mt in ("qwen2", "qwen2_moe")),
             sliding_window=getattr(hf_config, "sliding_window", None)
             if mt == "mistral" else None,
-            layernorm_eps=hf_config.rms_norm_eps)
-    if mt == "mixtral":
-        return TransformerConfig(
-            vocab_size=hf_config.vocab_size,
-            hidden_size=hf_config.hidden_size,
-            intermediate_size=hf_config.intermediate_size,
-            num_layers=hf_config.num_hidden_layers,
-            num_heads=hf_config.num_attention_heads,
-            num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
-            max_seq_len=hf_config.max_position_embeddings,
-            arch="llama", norm="rmsnorm", activation="swiglu",
-            use_rope=True,
-            rope_theta=getattr(hf_config, "rope_theta", 1e6),
-            tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
-                                        False)),
-            num_experts=hf_config.num_local_experts,
-            top_k=hf_config.num_experts_per_tok,
-            moe_layer_freq=1, moe_norm_topk=True,
-            # dropless: C = cf*k*T/E = T exactly at cf = E/k (HF blocks
-            # never drop tokens; larger cf just inflates [E,C,H] buffers)
-            capacity_factor=float(hf_config.num_local_experts
-                                  / hf_config.num_experts_per_tok),
-            layernorm_eps=hf_config.rms_norm_eps)
-    if mt == "qwen2_moe":
-        return TransformerConfig(
-            vocab_size=hf_config.vocab_size,
-            hidden_size=hf_config.hidden_size,
-            intermediate_size=hf_config.intermediate_size,
-            num_layers=hf_config.num_hidden_layers,
-            num_heads=hf_config.num_attention_heads,
-            num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
-            max_seq_len=hf_config.max_position_embeddings,
-            arch="llama", norm="rmsnorm", activation="swiglu",
-            use_rope=True, qkv_bias=True,
-            rope_theta=getattr(hf_config, "rope_theta", 1e6),
-            tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
-                                        False)),
-            num_experts=hf_config.num_experts,
-            top_k=hf_config.num_experts_per_tok,
-            moe_layer_freq=int(getattr(hf_config, "decoder_sparse_step", 1)
-                               or 1),
-            moe_norm_topk=bool(getattr(hf_config, "norm_topk_prob", False)),
-            moe_intermediate_size=hf_config.moe_intermediate_size,
-            moe_shared_expert_size=getattr(
-                hf_config, "shared_expert_intermediate_size", 0),
-            capacity_factor=float(hf_config.num_experts
-                                  / hf_config.num_experts_per_tok),
-            layernorm_eps=hf_config.rms_norm_eps)
+            layernorm_eps=hf_config.rms_norm_eps, **moe_kw)
     if mt == "opt":
         return TransformerConfig(
             vocab_size=hf_config.vocab_size,
@@ -166,6 +141,17 @@ def config_from_hf(hf_config) -> TransformerConfig:
 
 
 # ----------------------------------------------------------------------
+#: arch → converter registry (the analog of inference/v2's pluggable
+#: model-implementation registry, engine_factory.py:69 — register a new
+#: family without touching this module)
+_CONVERTERS: Dict[str, Any] = {}
+
+
+def register_converter(arch: str, fn) -> None:
+    """Register ``fn(state_dict, cfg) -> param tree`` for ``cfg.arch``."""
+    _CONVERTERS[arch] = fn
+
+
 def params_from_hf(model_or_state_dict, cfg: TransformerConfig,
                    dtype=None) -> Dict[str, Any]:
     """HF model / state dict → stacked functional param tree."""
@@ -173,11 +159,10 @@ def params_from_hf(model_or_state_dict, cfg: TransformerConfig,
           else model_or_state_dict.state_dict())
     sd = {k: _np(v) for k, v in sd.items()}
     dt = dtype or cfg.param_dtype
-    conv = {"gpt2": _convert_gpt2, "llama": _convert_llama,
-            "mistral": _convert_llama, "qwen2": _convert_llama,
-            "opt": _convert_opt, "falcon": _convert_falcon,
-            "phi": _convert_phi}[cfg.arch]
-    params = conv(sd, cfg)
+    if cfg.arch not in _CONVERTERS:
+        raise KeyError(f"no converter for arch {cfg.arch!r}; known: "
+                       f"{sorted(_CONVERTERS)} (register_converter to add)")
+    params = _CONVERTERS[cfg.arch](sd, cfg)
     return {k: _cast_tree(v, dt) for k, v in params.items()}
 
 
@@ -418,3 +403,10 @@ def load_hf_model(name_or_model, dtype=None):
         model = name_or_model
     cfg = config_from_hf(model.config)
     return cfg, params_from_hf(model, cfg, dtype=dtype)
+
+
+for _arch, _fn in (("gpt2", _convert_gpt2), ("llama", _convert_llama),
+                   ("mistral", _convert_llama), ("qwen2", _convert_llama),
+                   ("opt", _convert_opt), ("falcon", _convert_falcon),
+                   ("phi", _convert_phi)):
+    register_converter(_arch, _fn)

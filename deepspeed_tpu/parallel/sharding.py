@@ -88,7 +88,8 @@ class ShardingRules:
 
     def __init__(self, topology: MeshTopology, zero_stage: int = 0,
                  rules: Optional[List[Tuple[str, Tuple[Optional[str], ...]]]] = None,
-                 shard_norms: bool = True, secondary_mode: str = "none"):
+                 shard_norms: bool = True, secondary_mode: str = "none",
+                 persist_threshold: int = 0):
         """``secondary_mode``: hierarchical partitioning over the factored
         (data=outer, subdata=inner) DP world —
           "hpz"  — ZeRO++ secondary partition: PARAMS shard only over the
@@ -107,6 +108,9 @@ class ShardingRules:
         if secondary_mode not in ("none", "hpz", "mics"):
             raise ValueError(f"secondary_mode {secondary_mode!r}")
         self.secondary_mode = secondary_mode
+        # params with fewer elements than this stay gathered under ZeRO-3
+        # (ref param_persistence_threshold, runtime/zero/config.py)
+        self.persist_threshold = int(persist_threshold)
 
     # ------------------------------------------------------------------
     def _fsdp_axes(self, is_expert_param: bool,
@@ -149,6 +153,19 @@ class ShardingRules:
         is_expert = "expert" in dims
         fsdp_axes = self._fsdp_axes(is_expert, param_style)
         apply_fsdp = bool(fsdp_axes) and (not param_style or self.zero_stage >= 3)
+        if apply_fsdp and param_style and self.persist_threshold:
+            # persistent small params (ref param_persistence_threshold,
+            # runtime/zero/parameter_offload.py persistent-param set):
+            # keeping norms/biases gathered avoids a per-use all-gather
+            # whose latency dwarfs its bytes; optimizer state
+            # (param_style=False) stays partitioned like the reference.
+            # The threshold is PER PARAMETER — divide out the stacked
+            # layer dim, or every norm crosses it via L alone.
+            elems = int(np.prod(shape)) if shape else 1
+            if dims[0] == "layer" and shape:
+                elems //= max(1, shape[0])
+            if elems < self.persist_threshold:
+                apply_fsdp = False
         tp = self.topo.tp_size > 1
 
         spec: List[Any] = [None] * ndim

@@ -217,8 +217,10 @@ class DeepSpeedEngine:
             self._loss_fn = model.loss
 
         # -- sharding rules --------------------------------------------
-        self.rules = ShardingRules(topology, zero_stage=self.zero_stage,
-                                   secondary_mode=self._secondary_mode)
+        self.rules = ShardingRules(
+            topology, zero_stage=self.zero_stage,
+            secondary_mode=self._secondary_mode,
+            persist_threshold=cfg.zero_config.param_persistence_threshold)
         rng = jax.random.PRNGKey(self.seed)
 
         params_shape = jax.eval_shape(self._init_fn, rng)

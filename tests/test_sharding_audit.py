@@ -91,3 +91,33 @@ def test_audit_silent_on_single_device_world():
     finally:
         set_topology(None)
         _reset_topo()
+
+
+def test_param_persistence_threshold_keeps_small_params_gathered():
+    """ref param_persistence_threshold (runtime/zero/config.py): under
+    ZeRO-3, params below the element threshold stay gathered (no per-use
+    all-gather) while their optimizer state stays partitioned."""
+    # full 32-layer llama3-8b depth: stacked norm scales are [32, 4096] =
+    # 131,072 elements — ABOVE the threshold as a stacked array but 4,096
+    # per parameter, so this catches a per-array (rather than
+    # per-parameter) comparison
+    cfg = get_model_config("llama3-8b")
+    topo = MeshTopology({"data": 8})
+    set_topology(topo)
+    try:
+        rules = ShardingRules(topo, zero_stage=3, persist_threshold=100_000)
+        shapes = jax.eval_shape(partial(init_params, cfg),
+                                jax.random.PRNGKey(0))
+        specs = rules.tree_specs(shapes)
+        # norms (1024 elems) persist; big matrices stay fsdp-sharded
+        assert all(s is None for s in
+                   specs["layers"]["ln1"]["scale"]), specs["layers"]["ln1"]
+        assert any(s is not None for s in
+                   specs["layers"]["mlp"]["wi"])
+        # optimizer-state view still partitions the small params
+        opt_specs = rules.tree_specs(shapes, param_style=False)
+        assert any(s is not None for s in
+                   opt_specs["layers"]["ln1"]["scale"])
+    finally:
+        set_topology(None)
+        _reset_topo()
