@@ -369,12 +369,14 @@ def _attn_block(x, p, positions, cfg: TransformerConfig):
 
     if cfg.attn_impl == "sparse":
         out = _sparse_attn(q, k, v, cfg)
-    elif cfg.attn_impl in ("pallas_flash", "auto") and not cfg.sliding_window:
+    elif cfg.attn_impl in ("pallas_flash", "auto"):
         # flash_attention dispatches: Pallas kernel on TPU (tiled online
-        # softmax, no [S,S] materialisation), equivalent XLA math elsewhere.
+        # softmax, no [S,S] materialisation; sliding windows skip dead
+        # tiles at the grid level), equivalent XLA math elsewhere.
         from deepspeed_tpu.ops.flash_attention import flash_attention
 
-        out = flash_attention(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True,
+                              window=cfg.sliding_window or None)
     else:
         out = _attention_scores(q, k, v, cfg)
     out = ulysses_output_constraint(out.reshape(b, s, nh * d))

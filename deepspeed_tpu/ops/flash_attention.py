@@ -36,13 +36,21 @@ def _repeat_kv(q, k, v):
     return k, v
 
 
-def _xla_attention(q, k, v, causal: bool, sm_scale: float):
+def _xla_attention(q, k, v, causal: bool, sm_scale: float,
+                   window: int | None = None):
     b, s_q, h, d = q.shape
     k, v = _repeat_kv(q, k, v)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * sm_scale
+    s_k = k.shape[1]
+    mask = None
     if causal:
-        s_k = k.shape[1]
         mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+    if window is not None:
+        qpos = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0) + (s_k - s_q)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+        wm = qpos - kpos < window
+        mask = wm if mask is None else mask & wm
+    if mask is not None:
         scores = jnp.where(mask[None, None], scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
@@ -83,33 +91,41 @@ def _lib_flash(q, k, v, causal, sm_scale, blk):
     return out.swapaxes(1, 2)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "impl"))
+@functools.partial(jax.jit, static_argnames=("causal", "sm_scale", "impl",
+                                             "window"))
 def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None,
-                    impl: str = "auto"):
+                    impl: str = "auto", window: int | None = None):
     """Multi-head attention over [B, S, H, D] tensors.
 
     ``impl``: "auto" (repo Pallas kernel on TPU, XLA elsewhere) | "pallas"
     (repo kernel) | "pallas_lib" (upstream library kernel) | "xla".
     """
     global _warned_fallback
+    if window is not None and window <= 0:
+        raise ValueError(f"window must be positive (got {window}); pass "
+                         "None to disable sliding-window masking")
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
 
     if impl == "xla" or not (impl in ("auto", "pallas", "pallas_lib")
                              and _on_tpu()):
-        return _xla_attention(q, k, v, causal, sm_scale)
+        return _xla_attention(q, k, v, causal, sm_scale, window=window)
 
     if impl == "pallas_lib":
-        blk = _block_for(q.shape[1])
-        if blk is None:
-            if not _warned_fallback:
-                logger.warning(
-                    "flash_attention: seq %d has no 128-aligned divisor; "
-                    "library kernel unavailable, using XLA attention",
-                    q.shape[1])
-                _warned_fallback = True
-            return _xla_attention(q, k, v, causal, sm_scale)
-        return _lib_flash(q, k, v, causal, sm_scale, blk)
+        if window is not None:  # library kernel has no window support
+            impl = "pallas"
+        else:
+            blk = _block_for(q.shape[1])
+            if blk is None:
+                if not _warned_fallback:
+                    logger.warning(
+                        "flash_attention: seq %d has no 128-aligned divisor; "
+                        "library kernel unavailable, using XLA attention",
+                        q.shape[1])
+                    _warned_fallback = True
+                return _xla_attention(q, k, v, causal, sm_scale,
+                                      window=window)
+            return _lib_flash(q, k, v, causal, sm_scale, blk)
 
     from deepspeed_tpu.ops.pallas import flash_mha
     from deepspeed_tpu.ops.pallas.flash_mha import supports
@@ -117,8 +133,8 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None,
     if not supports(q.shape[1], q.shape[-1]):
         # beyond even the KV-blocked path's ceiling (S·D > 2^25) — shard
         # the sequence (Ulysses/FPDT) at such lengths. Last resorts: the
-        # library kernel (repeats KV), then XLA.
-        blk = _block_for(q.shape[1])
+        # library kernel (repeats KV, no window), then XLA.
+        blk = _block_for(q.shape[1]) if window is None else None
         if blk is not None:
             return _lib_flash(q, k, v, causal, sm_scale, blk)
         if not _warned_fallback:
@@ -126,8 +142,8 @@ def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None,
                 "flash_attention: seq %d (head_dim %d) exceeds kernel "
                 "budgets; using XLA attention", q.shape[1], q.shape[-1])
             _warned_fallback = True
-        return _xla_attention(q, k, v, causal, sm_scale)
+        return _xla_attention(q, k, v, causal, sm_scale, window=window)
 
     out = flash_mha(q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2),
-                    causal, sm_scale)
+                    causal, sm_scale, window)
     return out.swapaxes(1, 2)

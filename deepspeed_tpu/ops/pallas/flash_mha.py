@@ -96,20 +96,21 @@ def _scores(q, k, sm_scale):
     return s * sm_scale
 
 
-def _mask(scores, q0, bq, s_pad, s_real, causal):
-    return jnp.where(_block_mask(bq, s_pad, q0, 0, s_real, causal),
+def _mask(scores, q0, bq, s_pad, s_real, causal, window=None):
+    return jnp.where(_block_mask(bq, s_pad, q0, 0, s_real, causal,
+                                 window=window),
                      scores, NEG_INF)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
-                sm_scale, causal, bq, s_pad, s_real):
+                sm_scale, causal, bq, s_pad, s_real, window=None):
     lse_ref = rest[0] if rest else None
     iq = pl.program_id(2)
     q = q_ref[0, 0]
     k = k_ref[0, 0]
     v = v_ref[0, 0]
     s = _scores(q, k, sm_scale)
-    s = _mask(s, iq * bq, bq, s_pad, s_real, causal)
+    s = _mask(s, iq * bq, bq, s_pad, s_real, causal, window=window)
     m = jnp.max(s, axis=1, keepdims=True)                      # [bq, 1]
     p = jnp.exp(s - m)                                          # fp32
     l = jnp.sum(p, axis=1, keepdims=True)
@@ -127,7 +128,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               sm_scale, causal, bq, s_pad, s_real):
+               sm_scale, causal, bq, s_pad, s_real, window=None):
     iq = pl.program_id(2)
     q = q_ref[0, 0]
     k = k_ref[0, 0]
@@ -136,7 +137,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     lse = lse_ref[0, 0, :, 0:1]                                 # [bq, 1]
     delta = delta_ref[0, 0, :, 0:1]
     s = _scores(q, k, sm_scale)
-    s = _mask(s, iq * bq, bq, s_pad, s_real, causal)
+    s = _mask(s, iq * bq, bq, s_pad, s_real, causal, window=window)
     p = jnp.exp(s - lse)                                        # [bq, s]
     dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
@@ -147,7 +148,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, sm_scale, causal, bk, s_pad, s_real, group):
+                dk_ref, dv_ref, *, sm_scale, causal, bk, s_pad, s_real,
+                group, window=None):
     ik = pl.program_id(2)
     k = k_ref[0, 0]                                             # [bk, d]
     v = v_ref[0, 0]
@@ -165,6 +167,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         valid = (cols < s_real) & (rows < s_real)
         if causal:
             valid &= cols <= rows
+        if window is not None:
+            valid &= rows - cols < window
         s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse)                                    # [s, bk]
         # pad query rows have lse = 0 from masked fwd rows; kill them
@@ -186,7 +190,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # KV-blocked kernels (long context): grid (B, H, nq, nk) with nk (or nq
 # for dkv) innermost-sequential; online-softmax state in VMEM scratch.
 # ----------------------------------------------------------------------
-def _block_mask(bq, bk, q0, k0, s_real, causal, with_rows=False):
+def _block_mask(bq, bk, q0, k0, s_real, causal, with_rows=False,
+                window=None):
     rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + q0
     cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + k0
     valid = cols < s_real
@@ -194,11 +199,30 @@ def _block_mask(bq, bk, q0, k0, s_real, causal, with_rows=False):
         valid &= rows < s_real
     if causal:
         valid &= cols <= rows
+    if window is not None:
+        # Mistral sliding window: key within the last `window` positions
+        valid &= rows - cols < window
     return valid
 
 
+def _tile_alive(iq, ik, bq, bk, causal, window):
+    """Grid-level skip predicate: None when every tile is live (dense
+    non-causal, no window); else a traced bool.  A tile is dead when the
+    causal triangle or the sliding window excludes every (q, k) pair in
+    it — dead tiles cost no FLOPs (on the causal paths their DMA is also
+    clamped away by _clamped_kv_index; non-causal windows skip compute
+    only)."""
+    pred = None
+    if causal:
+        pred = ik * bk <= iq * bq + bq - 1
+    if window is not None:
+        wa = iq * bq - ik * bk - bk + 1 < window
+        pred = wa if pred is None else jnp.logical_and(pred, wa)
+    return pred
+
+
 def _fwd_kernel_blocked(q_ref, k_ref, v_ref, o_ref, *rest,
-                        sm_scale, causal, bq, bk, s_real):
+                        sm_scale, causal, bq, bk, s_real, window=None):
     if len(rest) == 4:
         lse_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -218,7 +242,8 @@ def _fwd_kernel_blocked(q_ref, k_ref, v_ref, o_ref, *rest,
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         s = _scores(q, k, sm_scale)
-        valid = _block_mask(bq, bk, iq * bq, ik * bk, s_real, causal)
+        valid = _block_mask(bq, bk, iq * bq, ik * bk, s_real, causal,
+                            window=window)
         s = jnp.where(valid, s, NEG_INF)
         m_prev = m_scr[:, 0:1]
         l_prev = l_scr[:, 0:1]
@@ -236,10 +261,8 @@ def _fwd_kernel_blocked(q_ref, k_ref, v_ref, o_ref, *rest,
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    if causal:
-        pl.when(ik * bk <= iq * bq + bq - 1)(compute)
-    else:
-        compute()
+    pred = _tile_alive(iq, ik, bq, bk, causal, window)
+    compute() if pred is None else pl.when(pred)(compute)
 
     @pl.when(ik == nk - 1)
     def _():
@@ -252,7 +275,8 @@ def _fwd_kernel_blocked(q_ref, k_ref, v_ref, o_ref, *rest,
 
 
 def _dq_kernel_blocked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                       dq_ref, dq_scr, *, sm_scale, causal, bq, bk, s_real):
+                       dq_ref, dq_scr, *, sm_scale, causal, bq, bk, s_real,
+                       window=None):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -269,7 +293,8 @@ def _dq_kernel_blocked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, 0][:, 0:1]
         delta = delta_ref[0, 0][:, 0:1]
         s = _scores(q, k, sm_scale)
-        valid = _block_mask(bq, bk, iq * bq, ik * bk, s_real, causal)
+        valid = _block_mask(bq, bk, iq * bq, ik * bk, s_real, causal,
+                            window=window)
         s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -279,10 +304,8 @@ def _dq_kernel_blocked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                            (((1,), (0,)), ((), ())),
                                            preferred_element_type=jnp.float32)
 
-    if causal:
-        pl.when(ik * bk <= iq * bq + bq - 1)(compute)
-    else:
-        compute()
+    pred = _tile_alive(iq, ik, bq, bk, causal, window)
+    compute() if pred is None else pl.when(pred)(compute)
 
     @pl.when(ik == nk - 1)
     def _():
@@ -291,7 +314,8 @@ def _dq_kernel_blocked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _dkv_kernel_blocked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                         dk_ref, dv_ref, dk_scr, dv_scr, *,
-                        sm_scale, causal, bq, bk, s_real, group):
+                        sm_scale, causal, bq, bk, s_real, group,
+                        window=None):
     ik = pl.program_id(2)
     iq = pl.program_id(3)
     nq = pl.num_programs(3)
@@ -311,7 +335,7 @@ def _dkv_kernel_blocked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             delta = delta_ref[0, g][:, 0:1]
             s = _scores(q, k, sm_scale)                     # [bq, bk]
             valid = _block_mask(bq, bk, iq * bq, ik * bk, s_real, causal,
-                                with_rows=True)
+                                with_rows=True, window=window)
             s = jnp.where(valid, s, NEG_INF)
             p = jnp.exp(s - lse)
             # pad query rows carry garbage lse; kill them with the mask
@@ -326,10 +350,8 @@ def _dkv_kernel_blocked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
 
-    if causal:
-        pl.when(iq * bq + bq - 1 >= ik * bk)(compute)
-    else:
-        compute()
+    pred = _tile_alive(iq, ik, bq, bk, causal, window)
+    compute() if pred is None else pl.when(pred)(compute)
 
     @pl.when(iq == nq - 1)
     def _():
@@ -347,7 +369,7 @@ def _pad_seq(x, s_pad):
     return jnp.pad(x, ((0, 0), (0, 0), (0, s_pad - s), (0, 0)))
 
 
-def _fwd(q, k, v, causal, sm_scale, need_lse=True):
+def _fwd(q, k, v, causal, sm_scale, need_lse=True, window=None):
     b, hq, s_real, d = q.shape
     if not _supports_resident(s_real, d):
         if not supports(s_real, d):
@@ -355,7 +377,8 @@ def _fwd(q, k, v, causal, sm_scale, need_lse=True):
                 f"flash_mha: S={s_real}, D={d} exceeds the KV-blocked "
                 f"ceiling (S_pad*D <= {_MAX_BLOCKED_ELEMS}); shard the "
                 "sequence (Ulysses/FPDT) before attention")
-        return _fwd_blocked(q, k, v, causal, sm_scale, need_lse=need_lse)
+        return _fwd_blocked(q, k, v, causal, sm_scale, need_lse=need_lse,
+                            window=window)
     hkv = k.shape[1]
     group = hq // hkv
     s_pad = -(-s_real // 128) * 128
@@ -370,7 +393,7 @@ def _fwd(q, k, v, causal, sm_scale, need_lse=True):
     lse_blk = pl.BlockSpec((1, 1, bq, 128), lambda ib, ih, iq: (ib, ih, iq, 0))
     out = pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                          bq=bq, s_pad=s_pad, s_real=s_real),
+                          bq=bq, s_pad=s_pad, s_real=s_real, window=window),
         grid=grid,
         interpret=INTERPRET,
         in_specs=[q_blk, kv_spec, kv_spec],
@@ -385,17 +408,25 @@ def _fwd(q, k, v, causal, sm_scale, need_lse=True):
     return o[:, :, :s_real], lse[:, :, :s_real, 0]
 
 
-def _clamped_kv_index(group, causal):
+def _clamped_kv_index(group, causal, window=None, bq=None, bk=None):
     """K/V block index for grid (ib, ih, iq, ik). Under causal masking,
     blocks with ik > iq are fully dead: clamp their index to the last live
     block so the Pallas pipeline sees an unchanged index and skips the
-    DMA — dead blocks cost neither compute (pl.when) nor bandwidth."""
+    DMA — dead blocks cost neither compute (pl.when) nor bandwidth.  A
+    sliding window additionally kills leading blocks (keys older than the
+    window): clamp those up to the first live one."""
+    if causal and window is not None:
+        def idx(ib, ih, iq, ik):
+            lo = jnp.maximum((iq * bq - (window - 1)) // bk, 0)
+            return (ib, ih // group, jnp.clip(ik, lo, iq), 0)
+
+        return idx
     if causal:
         return lambda ib, ih, iq, ik: (ib, ih // group, jnp.minimum(ik, iq), 0)
     return lambda ib, ih, iq, ik: (ib, ih // group, ik, 0)
 
 
-def _fwd_blocked(q, k, v, causal, sm_scale, need_lse=True):
+def _fwd_blocked(q, k, v, causal, sm_scale, need_lse=True, window=None):
     b, hq, s_real, d = q.shape
     hkv = k.shape[1]
     group = hq // hkv
@@ -404,13 +435,14 @@ def _fwd_blocked(q, k, v, causal, sm_scale, need_lse=True):
     qp, kp, vp = _pad_seq(q, s_pad), _pad_seq(k, s_pad), _pad_seq(v, s_pad)
     grid = (b, hq, s_pad // bq, s_pad // bk)
 
-    kv_idx = _clamped_kv_index(group, causal)
+    kv_idx = _clamped_kv_index(group, causal, window=window, bq=bq, bk=bk)
     q_blk = pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0))
     lse_blk = pl.BlockSpec((1, 1, bq, 128),
                            lambda ib, ih, iq, ik: (ib, ih, iq, 0))
     out = pl.pallas_call(
         functools.partial(_fwd_kernel_blocked, sm_scale=sm_scale,
-                          causal=causal, bq=bq, bk=bk, s_real=s_real),
+                          causal=causal, bq=bq, bk=bk, s_real=s_real,
+                          window=window),
         grid=grid,
         interpret=INTERPRET,
         in_specs=[
@@ -440,7 +472,7 @@ def _lanes(x, s_pad):  # [B, H, S] -> [B, H, s_pad, 128] lane-broadcast
     return jnp.broadcast_to(x[..., None], x.shape + (128,))
 
 
-def _bwd_blocked(q, k, v, o, lse, g, causal, sm_scale):
+def _bwd_blocked(q, k, v, o, lse, g, causal, sm_scale, window=None):
     b, hq, s_real, d = q.shape
     hkv = k.shape[1]
     group = hq // hkv
@@ -453,12 +485,15 @@ def _bwd_blocked(q, k, v, o, lse, g, causal, sm_scale):
     lsep, deltap = _lanes(lse, s_pad), _lanes(delta, s_pad)
 
     q_spec = pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0))
-    kv_spec = pl.BlockSpec((1, 1, bk, d), _clamped_kv_index(group, causal))
+    kv_spec = pl.BlockSpec((1, 1, bk, d),
+                           _clamped_kv_index(group, causal, window=window,
+                                             bq=bq, bk=bk))
     lane_spec = pl.BlockSpec((1, 1, bq, 128),
                              lambda ib, ih, iq, ik: (ib, ih, iq, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel_blocked, sm_scale=sm_scale,
-                          causal=causal, bq=bq, bk=bk, s_real=s_real),
+                          causal=causal, bq=bq, bk=bk, s_real=s_real,
+                          window=window),
         grid=(b, hq, s_pad // bq, s_pad // bk),
         interpret=INTERPRET,
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, lane_spec, lane_spec],
@@ -468,8 +503,14 @@ def _bwd_blocked(q, k, v, o, lse, g, causal, sm_scale):
     )(qp, kp, vp, gp, lsep, deltap)
 
     # dead (iq < ik) steps clamp the q-side index to the diagonal so their
-    # DMA is the first live step's prefetch rather than a wasted fetch
-    if causal:
+    # DMA is the first live step's prefetch rather than a wasted fetch; a
+    # sliding window also kills trailing q blocks (queries past the
+    # window) — clamp those down to the last live one
+    if causal and window is not None:
+        def q_idx(ib, ihkv, ik, iq):
+            hi = (ik * bk + bk - 1 + window - 1) // bq
+            return (ib, ihkv, jnp.clip(iq, ik, hi), 0)
+    elif causal:
         def q_idx(ib, ihkv, ik, iq):
             return (ib, ihkv, jnp.maximum(iq, ik), 0)
     else:
@@ -482,7 +523,7 @@ def _bwd_blocked(q, k, v, o, lse, g, causal, sm_scale):
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel_blocked, sm_scale=sm_scale,
                           causal=causal, bq=bq, bk=bk, s_real=s_real,
-                          group=group),
+                          group=group, window=window),
         grid=(b, hkv, s_pad // bk, s_pad // bq),
         interpret=INTERPRET,
         in_specs=[grp_spec, kv_own_spec, kv_own_spec, grp_spec,
@@ -498,10 +539,11 @@ def _bwd_blocked(q, k, v, o, lse, g, causal, sm_scale):
     return dq[:, :, :s_real], dk[:, :, :s_real], dv[:, :, :s_real]
 
 
-def _bwd_impl(q, k, v, o, lse, g, causal, sm_scale):
+def _bwd_impl(q, k, v, o, lse, g, causal, sm_scale, window=None):
     b, hq, s_real, d = q.shape
     if not _supports_resident(s_real, d):
-        return _bwd_blocked(q, k, v, o, lse, g, causal, sm_scale)
+        return _bwd_blocked(q, k, v, o, lse, g, causal, sm_scale,
+                            window=window)
     hkv = k.shape[1]
     group = hq // hkv
     s_pad = -(-s_real // 128) * 128
@@ -517,7 +559,7 @@ def _bwd_impl(q, k, v, o, lse, g, causal, sm_scale):
                            lambda ib, ih, iq: (ib, ih // group, 0, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          bq=bq, s_pad=s_pad, s_real=s_real),
+                          bq=bq, s_pad=s_pad, s_real=s_real, window=window),
         grid=(b, hq, s_pad // bq),
         interpret=INTERPRET,
         in_specs=[
@@ -540,7 +582,8 @@ def _bwd_impl(q, k, v, o, lse, g, causal, sm_scale):
                                  lambda ib, ihkv, ik: (ib, ihkv, 0, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          bk=bk, s_pad=s_pad, s_real=s_real, group=group),
+                          bk=bk, s_pad=s_pad, s_real=s_real, group=group,
+                          window=window),
         grid=(b, hkv, s_pad // bk),
         interpret=INTERPRET,
         in_specs=[
@@ -566,12 +609,16 @@ def _bwd_impl(q, k, v, o, lse, g, causal, sm_scale):
 # ----------------------------------------------------------------------
 # custom_vjp wrapper
 # ----------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_mha(q, k, v, causal: bool = True, sm_scale: float | None = None):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_mha(q, k, v, causal: bool = True, sm_scale: float | None = None,
+              window: int | None = None):
     """Flash attention over ``q [B, Hq, S, D]``, ``k/v [B, Hkv, S, D]``
     (Hq a multiple of Hkv — GQA handled in the kernel's index maps).
-    Returns ``o [B, Hq, S, D]``."""
-    o, _ = _fwd(q, k, v, causal, _resolve_scale(sm_scale, q), need_lse=False)
+    ``window``: Mistral sliding-window width (key visible iff
+    ``qpos - kpos < window``, on top of causal); tiles fully outside the
+    window are skipped at the grid level.  Returns ``o [B, Hq, S, D]``."""
+    o, _ = _fwd(q, k, v, causal, _resolve_scale(sm_scale, q),
+                need_lse=False, window=window)
     return o
 
 
@@ -579,18 +626,19 @@ def _resolve_scale(sm_scale, q):
     return 1.0 / math.sqrt(q.shape[-1]) if sm_scale is None else sm_scale
 
 
-def _flash_fwd_rule(q, k, v, causal, sm_scale):
+def _flash_fwd_rule(q, k, v, causal, sm_scale, window):
     scale = _resolve_scale(sm_scale, q)
-    o, lse = _fwd(q, k, v, causal, scale)
+    o, lse = _fwd(q, k, v, causal, scale, window=window)
     o = checkpoint_name(o, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(causal, sm_scale, res, g):
+def _flash_bwd_rule(causal, sm_scale, window, res, g):
     q, k, v, o, lse = res
     scale = _resolve_scale(sm_scale, q)
-    dq, dk, dv = _bwd_impl(q, k, v, o, lse, g, causal, scale)
+    dq, dk, dv = _bwd_impl(q, k, v, o, lse, g, causal, scale,
+                           window=window)
     return dq, dk, dv
 
 
