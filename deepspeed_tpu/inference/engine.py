@@ -72,7 +72,8 @@ class InferenceEngine:
     __call__ = forward
 
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 temperature: float = 0.0, seed: int = 0) -> np.ndarray:
+                 temperature: float = 0.0, seed: int = 0, top_k: int = 0,
+                 top_p: float = 1.0) -> np.ndarray:
         """KV-cached paged generation — O(S) per emitted token: one ragged
         prefill writes the prompt into KV pages, then a fused on-device
         decode loop samples the rest (shares inference/v2's model path; ref
@@ -83,4 +84,5 @@ class InferenceEngine:
 
             self._kv_gen = KVCachedGenerator(self.model_config)
         return self._kv_gen.generate(self.params, input_ids, max_new_tokens,
-                                     temperature=temperature, seed=seed)
+                                     temperature=temperature, seed=seed,
+                                     top_k=top_k, top_p=top_p)

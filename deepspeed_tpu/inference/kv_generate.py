@@ -41,13 +41,16 @@ class KVCachedGenerator:
         self._prefill = jax.jit(
             partial(ragged_forward_sampled, cfg=cfg,
                     block_size=self.block_size),
-            static_argnames=("greedy",), donate_argnums=(1, 2))
+            static_argnames=("greedy", "top_k"),
+            donate_argnums=(1, 2))
         self._decode = jax.jit(
             partial(ragged_decode_loop, cfg=cfg, block_size=self.block_size),
-            static_argnames=("n_steps", "greedy"), donate_argnums=(1, 2))
+            static_argnames=("n_steps", "greedy", "top_k"),
+            donate_argnums=(1, 2))
 
     def generate(self, params: Any, input_ids, max_new_tokens: int,
-                 temperature: float = 0.0, seed: int = 0) -> np.ndarray:
+                 temperature: float = 0.0, seed: int = 0, top_k: int = 0,
+                 top_p: float = 1.0) -> np.ndarray:
         cfg, bs = self.cfg, self.block_size
         ids = np.asarray(input_ids, dtype=np.int32)
         if ids.ndim == 1:
@@ -79,6 +82,10 @@ class KVCachedGenerator:
                       + token_pos % bs).astype(np.int32)
         ctx_lens = np.full((b,), s0, dtype=np.int32)
         logits_idx = (np.arange(b, dtype=np.int32) * s0 + s0 - 1)
+        from deepspeed_tpu.inference.v2.model import check_sampling_params
+
+        top_k = check_sampling_params(top_k, top_p, cfg.vocab_size)
+        tp = None if float(top_p) >= 1.0 else jnp.float32(top_p)
         greedy = temperature <= 0.0
         temp = jnp.float32(max(temperature, 1e-6))
         key = jax.random.PRNGKey(seed)
@@ -87,7 +94,8 @@ class KVCachedGenerator:
             params, cache_k, cache_v, jnp.asarray(ids.reshape(-1)),
             jnp.asarray(token_slot), jnp.asarray(token_pos),
             jnp.asarray(token_dest), tables, jnp.asarray(ctx_lens),
-            jnp.asarray(logits_idx), kp, temp, greedy=greedy)
+            jnp.asarray(logits_idx), kp, temp, greedy=greedy,
+            top_k=int(top_k or 0), top_p=tp)
 
         n_rest = max_new_tokens - 1
         if n_rest == 0:
@@ -96,6 +104,7 @@ class KVCachedGenerator:
         active = jnp.ones((b,), dtype=bool)
         sampled, _, cache_k, cache_v = self._decode(
             params, cache_k, cache_v, first, jnp.asarray(ctx_lens),
-            active, tables, kd, temp, n_steps=n_rest, greedy=greedy)
+            active, tables, kd, temp, n_steps=n_rest, greedy=greedy,
+            top_k=int(top_k or 0), top_p=tp)
         return np.concatenate(
             [ids, np.asarray(first)[:, None], np.asarray(sampled).T], axis=1)

@@ -107,10 +107,12 @@ class InferenceEngineV2:
         self._step_sampled = jax.jit(
             partial(ragged_forward_sampled, cfg=mc,
                     block_size=self.cfg.block_size),
-            static_argnames=("greedy",), donate_argnums=(1, 2))
+            static_argnames=("greedy", "top_k"),
+            donate_argnums=(1, 2))
         self._decode_loop = jax.jit(
             partial(ragged_decode_loop, cfg=mc, block_size=self.cfg.block_size),
-            static_argnames=("n_steps", "greedy"), donate_argnums=(1, 2))
+            static_argnames=("n_steps", "greedy", "top_k"),
+            donate_argnums=(1, 2))
         log_dist(f"InferenceEngineV2: budget={self.cfg.max_ragged_batch_size} "
                  f"blocks={self.cfg.num_blocks}×{self.cfg.block_size} "
                  f"max_seqs={self.cfg.max_tracked_sequences} tp={self.cfg.tp_size}")
@@ -172,7 +174,10 @@ class InferenceEngineV2:
         toks, self.cache_k, self.cache_v = self._step_sampled(
             *args, key=sample["key"],
             temperature=jnp.float32(max(sample["temperature"], 1e-6)),
-            greedy=(sample["temperature"] <= 0))
+            greedy=(sample["temperature"] <= 0),
+            top_k=int(sample.get("top_k", 0) or 0),
+            top_p=(None if float(sample.get("top_p", 1.0)) >= 1.0
+                   else jnp.float32(sample["top_p"])))
         return rb, toks
 
     def put(self, batch_uids: Sequence[int],
@@ -205,8 +210,16 @@ class InferenceEngineV2:
     # ------------------------------------------------------------------
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
                  temperature: float = 0.0, seed: int = 0,
-                 eos_token_id: Optional[int] = None) -> List[List[int]]:
-        """Continuous-batching generation loop over token prompts."""
+                 eos_token_id: Optional[int] = None, top_k: int = 0,
+                 top_p: float = 1.0) -> List[List[int]]:
+        """Continuous-batching generation loop over token prompts.
+        ``top_k``/``top_p`` restrict temperature sampling to the top-k
+        logits / the top-p nucleus (ref FastGen logits processors);
+        0 / 1.0 disable them."""
+        from deepspeed_tpu.inference.v2.model import check_sampling_params
+
+        top_k = check_sampling_params(top_k, top_p,
+                                      self.model_config.vocab_size)
         uids = list(range(len(prompts)))
         remaining = {u: max_new_tokens for u in uids}
         outputs: Dict[int, List[int]] = {u: [] for u in uids}
@@ -228,7 +241,8 @@ class InferenceEngineV2:
                             for u in active_uids)):
                 decode_key, sub = jax.random.split(decode_key)
                 self._fused_decode(active_uids, remaining, outputs,
-                                   temperature, sub, eos_token_id)
+                                   temperature, sub, eos_token_id,
+                                   top_k=top_k, top_p=top_p)
                 continue
             admit_uids, admit_toks = [], []
             # Active sequences will still claim pages as they decode: reserve
@@ -264,7 +278,8 @@ class InferenceEngineV2:
             step_key, sub = jax.random.split(step_key)
             rb, toks = self._ragged_step(
                 admit_uids, admit_toks,
-                sample={"key": sub, "temperature": temperature})
+                sample={"key": sub, "temperature": temperature,
+                        "top_k": top_k, "top_p": top_p})
             toks_np = np.asarray(toks) if rb is not None else None
             results = ({} if rb is None
                        else {uid: int(toks_np[slot])
@@ -283,7 +298,8 @@ class InferenceEngineV2:
     # ------------------------------------------------------------------
     def _fused_decode(self, uids: List[int], remaining: Dict[int, int],
                       outputs: Dict[int, List[int]], temperature: float,
-                      key, eos_token_id: Optional[int]) -> None:
+                      key, eos_token_id: Optional[int], top_k: int = 0,
+                      top_p: float = 1.0) -> None:
         """One fused on-device decode chunk for all live sequences
         (ragged_decode_loop): chunk sizes are power-of-two bucketed so a
         generation run compiles at most a handful of loop lengths."""
@@ -316,7 +332,9 @@ class InferenceEngineV2:
             self.params, self.cache_k, self.cache_v,
             jnp.asarray(tokens0), jnp.asarray(ctx0), jnp.asarray(active),
             jnp.asarray(tables), key, jnp.float32(max(temperature, 1e-6)),
-            n_steps=chunk, greedy=(temperature <= 0))
+            n_steps=chunk, greedy=(temperature <= 0),
+            top_k=int(top_k or 0),
+            top_p=None if float(top_p) >= 1.0 else jnp.float32(top_p))
         sampled = np.asarray(sampled)  # [chunk, s_rows]
         for u in uids:
             seq = mgr.get(u)

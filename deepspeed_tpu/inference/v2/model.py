@@ -247,34 +247,69 @@ def ragged_forward(params, cache_k, cache_v, token_ids, token_slot, token_pos,
     return logits.astype(jnp.float32), cache_k, cache_v
 
 
+def check_sampling_params(top_k: int, top_p, vocab_size: int) -> int:
+    """API-boundary validation (outside jit): reject degenerate values
+    that would silently emit token 0 (top_p <= 0) or crash deep inside
+    lax.top_k (top_k > vocab).  Returns the clamped top_k."""
+    if top_p is not None and not (0.0 < float(top_p) <= 1.0):
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    return min(int(top_k), vocab_size)
+
+
+def sample_tokens(logits, key, temperature, greedy: bool,
+                  top_k: int = 0, top_p=None) -> jnp.ndarray:
+    """On-device token sampling with FastGen-style logit processing
+    (ref inference/v2/model_implementations sampler + logits processors):
+    greedy argmax, or temperature categorical restricted to the top-k
+    logits and/or the top-p nucleus.  ``top_k`` is static per compile
+    (0 disables); ``top_p`` is a TRACED scalar (None disables) so
+    per-request nucleus values never recompile."""
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    if top_p is not None:
+        # nucleus: keep the smallest prefix of desc-sorted tokens whose
+        # cumulative probability reaches top_p (first always kept)
+        order = jnp.argsort(-logits, axis=-1)
+        sorted_p = jax.nn.softmax(
+            jnp.take_along_axis(logits, order, axis=-1), axis=-1)
+        keep_sorted = (jnp.cumsum(sorted_p, axis=-1) - sorted_p) < top_p
+        inv = jnp.argsort(order, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, inv, axis=-1)
+        logits = jnp.where(keep, logits, -jnp.inf)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
 def ragged_forward_sampled(params, cache_k, cache_v, token_ids, token_slot,
                            token_pos, token_dest, block_tables, ctx_lens,
                            logits_idx, key, temperature,
                            cfg: TransformerConfig, block_size: int,
-                           greedy: bool
+                           greedy: bool, top_k: int = 0, top_p=None
                            ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Ragged step + ON-DEVICE sampling: the host receives [S+1] int32
     tokens instead of [S+1, V] logits.  Same sampling semantics as the
-    fused decode loop (greedy argmax / temperature categorical), so a
-    generation that alternates prefill and decode phases stays consistent.
+    fused decode loop (greedy argmax / temperature categorical with
+    optional top-k/top-p), so a generation that alternates prefill and
+    decode phases stays consistent.
     """
     logits, cache_k, cache_v = ragged_forward(
         params, cache_k, cache_v, token_ids, token_slot, token_pos,
         token_dest, block_tables, ctx_lens, logits_idx, cfg=cfg,
         block_size=block_size)
-    if greedy:
-        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    else:
-        nxt = jax.random.categorical(
-            key, logits / jnp.maximum(temperature, 1e-6),
-            axis=-1).astype(jnp.int32)
+    nxt = sample_tokens(logits, key, temperature, greedy, top_k, top_p)
     return nxt, cache_k, cache_v
 
 
 def ragged_decode_loop(params, cache_k, cache_v, tokens0, ctx_lens0,
                        active, block_tables, key, temperature,
                        cfg: TransformerConfig, block_size: int,
-                       n_steps: int, greedy: bool
+                       n_steps: int, greedy: bool, top_k: int = 0,
+                       top_p=None
                        ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
                                   jnp.ndarray]:
     """Fused multi-step decode: ``lax.scan`` over ``n_steps`` single-token
@@ -302,12 +337,8 @@ def ragged_decode_loop(params, cache_k, cache_v, tokens0, ctx_lens0,
         logits, ck, cv = ragged_forward(
             params, ck, cv, tokens, slots, pos, dest, block_tables,
             ctx_after, slots, cfg=cfg, block_size=block_size)
-        if greedy:
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        else:
-            nxt = jax.random.categorical(
-                step_key, logits / jnp.maximum(temperature, 1e-6),
-                axis=-1).astype(jnp.int32)
+        nxt = sample_tokens(logits, step_key, temperature, greedy, top_k,
+                            top_p)
         nxt = jnp.where(active, nxt, 0)
         return (nxt, ctx_after, ck, cv), nxt
 

@@ -68,7 +68,8 @@ class DeepSpeedHybridEngine:
 
     # -- generation ------------------------------------------------------
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 temperature: float = 0.0, seed: int = 0) -> np.ndarray:
+                 temperature: float = 0.0, seed: int = 0, top_k: int = 0,
+                 top_p: float = 1.0) -> np.ndarray:
         """KV-cached rollout on the live training weights (ref generate,
         hybrid_engine.py:30: the reference shares ZeRO-3 weights with
         kernel-injected inference containers precisely so RLHF rollouts get
@@ -87,7 +88,7 @@ class DeepSpeedHybridEngine:
             self._kv_gen = KVCachedGenerator(self.model_config)
         ids = self._kv_gen.generate(self.engine.params, input_ids,
                                     max_new_tokens, temperature=temperature,
-                                    seed=seed)
+                                    seed=seed, top_k=top_k, top_p=top_p)
         self._generate_latency += time.perf_counter() - t0
         self._generate_tokens += max_new_tokens * ids.shape[0]
         return ids
