@@ -84,8 +84,7 @@ class KVCachedGenerator:
         logits_idx = (np.arange(b, dtype=np.int32) * s0 + s0 - 1)
         from deepspeed_tpu.inference.v2.model import check_sampling_params
 
-        top_k = check_sampling_params(top_k, top_p, cfg.vocab_size)
-        tp = None if float(top_p) >= 1.0 else jnp.float32(top_p)
+        top_k, tp = check_sampling_params(top_k, top_p, cfg.vocab_size)
         greedy = temperature <= 0.0
         temp = jnp.float32(max(temperature, 1e-6))
         key = jax.random.PRNGKey(seed)
@@ -95,7 +94,7 @@ class KVCachedGenerator:
             jnp.asarray(token_slot), jnp.asarray(token_pos),
             jnp.asarray(token_dest), tables, jnp.asarray(ctx_lens),
             jnp.asarray(logits_idx), kp, temp, greedy=greedy,
-            top_k=int(top_k or 0), top_p=tp)
+            top_k=top_k, top_p=tp)
 
         n_rest = max_new_tokens - 1
         if n_rest == 0:
@@ -105,6 +104,6 @@ class KVCachedGenerator:
         sampled, _, cache_k, cache_v = self._decode(
             params, cache_k, cache_v, first, jnp.asarray(ctx_lens),
             active, tables, kd, temp, n_steps=n_rest, greedy=greedy,
-            top_k=int(top_k or 0), top_p=tp)
+            top_k=top_k, top_p=tp)
         return np.concatenate(
             [ids, np.asarray(first)[:, None], np.asarray(sampled).T], axis=1)

@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from deepspeed_tpu.inference.v2.model import (ragged_decode_loop,
+from deepspeed_tpu.inference.v2.model import (check_sampling_params,
+                                              ragged_decode_loop,
                                               ragged_forward,
                                               ragged_forward_sampled)
 from deepspeed_tpu.inference.v2.ragged import DSStateManager, build_ragged_batch
@@ -123,7 +124,8 @@ class InferenceEngineV2:
                      sample: Optional[Dict[str, Any]] = None):
         """Admit prompts and run ONE ragged step; returns (rb, result) where
         result is the full logits array (sample=None) or on-device-sampled
-        tokens [max_seqs] (sample={'key','temperature'})."""
+        tokens [max_seqs] (sample={'key','temperature'} with optional
+        'top_k'/'top_p' — see check_sampling_params for their contract)."""
         # Validate the whole batch before touching any state, so a bad entry
         # cannot leave earlier prompts half-admitted.
         if len(batch_uids) != len(batch_tokens):
@@ -175,9 +177,8 @@ class InferenceEngineV2:
             *args, key=sample["key"],
             temperature=jnp.float32(max(sample["temperature"], 1e-6)),
             greedy=(sample["temperature"] <= 0),
-            top_k=int(sample.get("top_k", 0) or 0),
-            top_p=(None if float(sample.get("top_p", 1.0)) >= 1.0
-                   else jnp.float32(sample["top_p"])))
+            top_k=sample.get("top_k", 0),
+            top_p=sample.get("top_p"))
         return rb, toks
 
     def put(self, batch_uids: Sequence[int],
@@ -216,10 +217,8 @@ class InferenceEngineV2:
         ``top_k``/``top_p`` restrict temperature sampling to the top-k
         logits / the top-p nucleus (ref FastGen logits processors);
         0 / 1.0 disable them."""
-        from deepspeed_tpu.inference.v2.model import check_sampling_params
-
-        top_k = check_sampling_params(top_k, top_p,
-                                      self.model_config.vocab_size)
+        top_k, top_p = check_sampling_params(top_k, top_p,
+                                             self.model_config.vocab_size)
         uids = list(range(len(prompts)))
         remaining = {u: max_new_tokens for u in uids}
         outputs: Dict[int, List[int]] = {u: [] for u in uids}
@@ -333,8 +332,7 @@ class InferenceEngineV2:
             jnp.asarray(tokens0), jnp.asarray(ctx0), jnp.asarray(active),
             jnp.asarray(tables), key, jnp.float32(max(temperature, 1e-6)),
             n_steps=chunk, greedy=(temperature <= 0),
-            top_k=int(top_k or 0),
-            top_p=None if float(top_p) >= 1.0 else jnp.float32(top_p))
+            top_k=top_k, top_p=top_p)
         sampled = np.asarray(sampled)  # [chunk, s_rows]
         for u in uids:
             seq = mgr.get(u)

@@ -95,8 +95,7 @@ def _paged_attention(q, k_pages, v_pages, gather_idx, token_pos, token_ctx_len,
     no [T, C, ...] gather materialisation); elsewhere the XLA gather path.
     Ref kernel: inference/v2/kernels/ragged_ops/blocked_flash.
     """
-    if (block_tables is not None and _on_tpu()
-            and cfg.sliding_window is None):
+    if block_tables is not None and _on_tpu():
         from deepspeed_tpu.ops.pallas.paged_attention import (
             paged_decode_attention, supports as paged_supports)
 
@@ -105,7 +104,7 @@ def _paged_attention(q, k_pages, v_pages, gather_idx, token_pos, token_ctx_len,
             scale = 1.0 / math.sqrt(cfg.dim_per_head)
             return paged_decode_attention(
                 q, k_pages, v_pages, pages, token_pos, token_ctx_len,
-                block_size, scale)
+                block_size, scale, window=cfg.sliding_window or None)
     return _paged_attention_xla(q, k_pages, v_pages, gather_idx, token_pos,
                                 token_ctx_len, cfg)
 
@@ -247,15 +246,19 @@ def ragged_forward(params, cache_k, cache_v, token_ids, token_slot, token_pos,
     return logits.astype(jnp.float32), cache_k, cache_v
 
 
-def check_sampling_params(top_k: int, top_p, vocab_size: int) -> int:
-    """API-boundary validation (outside jit): reject degenerate values
-    that would silently emit token 0 (top_p <= 0) or crash deep inside
-    lax.top_k (top_k > vocab).  Returns the clamped top_k."""
+def check_sampling_params(top_k: int, top_p, vocab_size: int):
+    """API-boundary validation + normalization (outside jit): rejects
+    degenerate values that would silently emit token 0 (top_p <= 0) or
+    crash deep inside lax.top_k (top_k > vocab).  Returns the
+    ``(top_k_static, top_p_traced)`` pair the jitted samplers take —
+    top_k clamped to vocab, top_p None when disabled (>= 1.0) else a
+    traced fp32 scalar (so per-request values never recompile)."""
     if top_p is not None and not (0.0 < float(top_p) <= 1.0):
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if top_k < 0:
         raise ValueError(f"top_k must be >= 0, got {top_k}")
-    return min(int(top_k), vocab_size)
+    tp = None if top_p is None or float(top_p) >= 1.0 else jnp.float32(top_p)
+    return min(int(top_k), vocab_size), tp
 
 
 def sample_tokens(logits, key, temperature, greedy: bool,

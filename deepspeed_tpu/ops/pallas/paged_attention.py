@@ -47,7 +47,7 @@ def supports(block_size: int, d: int) -> bool:
 
 
 def _kernel(pages_ref, pos_ref, clen_ref, q_ref, k_ref, v_ref, o_ref,
-            m_scr, l_scr, acc_scr, *, bs, group, sm_scale):
+            m_scr, l_scr, acc_scr, *, bs, group, sm_scale, window=None):
     t = pl.program_id(0)
     j = pl.program_id(2)
     nb = pl.num_programs(2)
@@ -61,9 +61,14 @@ def _kernel(pages_ref, pos_ref, clen_ref, q_ref, k_ref, v_ref, o_ref,
     pos = pos_ref[t]
     clen = clen_ref[t]
 
-    # Pages beyond the causal frontier contribute nothing; skip their math
-    # (their DMA already happened — it is the pipeline's prefetch slot).
-    @pl.when(j * bs <= pos)
+    # Pages beyond the causal frontier — or wholly before the sliding
+    # window — contribute nothing; skip their math (their DMA already
+    # happened: it is the pipeline's prefetch slot).
+    alive = j * bs <= pos
+    if window is not None:
+        alive = jnp.logical_and(alive, pos - (j * bs + bs - 1) < window)
+
+    @pl.when(alive)
     def _():
         q = q_ref[0, 0]                                  # [group, d]
         k = k_ref[0]                                     # [bs, d]
@@ -74,6 +79,8 @@ def _kernel(pages_ref, pos_ref, clen_ref, q_ref, k_ref, v_ref, o_ref,
         s = s * sm_scale
         c = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bs
         valid = (c <= pos) & (c < clen)
+        if window is not None:
+            valid &= pos - c < window
         s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_scr[:, 0:1]                           # [group, 1]
@@ -97,11 +104,14 @@ def _kernel(pages_ref, pos_ref, clen_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block_size", "sm_scale"))
+@functools.partial(jax.jit, static_argnames=("block_size", "sm_scale",
+                                             "window"))
 def paged_decode_attention(q, k_pages, v_pages, pages, token_pos,
-                           token_ctx_len, block_size: int, sm_scale: float):
+                           token_ctx_len, block_size: int, sm_scale: float,
+                           window: int | None = None):
     """q: [T, nh, d]; k_pages/v_pages: [nkv, P, d]; pages: [T, NB] page ids
-    per token; token_pos/token_ctx_len: [T]. Returns [T, nh, d]."""
+    per token; token_pos/token_ctx_len: [T]; ``window``: Mistral sliding
+    window (key visible iff qpos - kpos < window).  Returns [T, nh, d]."""
     t, nh, d = q.shape
     nkv = k_pages.shape[0]
     group = nh // nkv
@@ -131,7 +141,8 @@ def paged_decode_attention(q, k_pages, v_pages, pages, token_pos,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, bs=bs, group=group, sm_scale=sm_scale),
+        functools.partial(_kernel, bs=bs, group=group, sm_scale=sm_scale,
+                          window=window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t, nkv, group, d), q.dtype),
         interpret=INTERPRET,

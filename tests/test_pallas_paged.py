@@ -156,3 +156,32 @@ def test_dispatch_uses_pallas_kernel(monkeypatch):
     err = float(jnp.max(jnp.abs(out.astype(jnp.float32)
                                 - ref.astype(jnp.float32))))
     assert err < 0.05, err
+
+
+def test_paged_sliding_window_parity():
+    """Mistral sliding-window masking in the paged kernel (pages wholly
+    before the window are grid-skipped; partial pages masked per-row)."""
+    t, nh, nkv, d, n_pages, nb, bs, window = 5, 4, 2, 64, 16, 4, 16, 24
+    q, kp, vp, tbl, pos, clen = _make_case(
+        jax.random.PRNGKey(5), t, nh, nkv, d, n_pages, nb, bs)
+    scale = 1.0 / np.sqrt(d)
+    out = _decode_fn(q, kp, vp, tbl, pos, clen, block_size=bs,
+                     sm_scale=scale, window=window)
+
+    # reference with window mask
+    nbk = tbl.shape[1]
+    c_idx = jnp.arange(nbk * bs)
+    rows = tbl[:, c_idx // bs] * bs + (c_idx % bs)[None, :]
+    k_ctx = kp[:, rows].astype(jnp.float32)
+    v_ctx = vp[:, rows].astype(jnp.float32)
+    g = nh // nkv
+    qg = q.reshape(t, nkv, g, d).astype(jnp.float32)
+    s = jnp.einsum("tkgd,ktcd->tkgc", qg, k_ctx) * scale
+    valid = ((c_idx[None, :] <= pos[:, None])
+             & (c_idx[None, :] < clen[:, None])
+             & (pos[:, None] - c_idx[None, :] < window))
+    s = jnp.where(valid[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    ref = jnp.einsum("tkgc,ktcd->tkgd", p, v_ctx).reshape(t, nh, d)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+    assert err < 0.05, err
