@@ -10,7 +10,11 @@ producer and the dequantize into its consumer.
 
 Swizzled layouts (swizzled_quantize.cu) exist on CUDA to coalesce the
 subsequent NCCL transfer; XLA's layout assignment owns tiling on TPU, so no
-swizzle variant is needed — noted here for parity auditing.
+swizzle variant is needed — noted here for parity auditing.  The claim
+that the TRANSPORT really moves int8 (the whole point of qwZ/qgZ) is
+pinned at the compiled-HLO level by tests/test_quant_transport.py: the
+ZeRO++ all-gather and both qgZ all-to-all hops carry s8 payloads with no
+full-size float collective remaining.
 
 All functions are symmetric-by-default blockwise: the last axis is grouped
 into ``group_size`` blocks, each with its own scale (and zero-point when

@@ -219,7 +219,16 @@ class ZeroConfig:
 @dataclass
 class ActivationCheckpointingConfig:
     """Ref: runtime/activation_checkpointing/config. On TPU this selects the
-    ``jax.checkpoint`` (remat) policy applied to each transformer block."""
+    ``jax.checkpoint`` (remat) policy applied to each transformer block.
+
+    ``partition_activations`` needs no dedicated machinery here: the
+    reference splits each saved activation across TP ranks by hand
+    (checkpointing.py partition_activations) because torch saves full
+    replicas per rank; under GSPMD the saved residuals inherit the
+    sharding of the computation that produced them (batch/seq/tensor
+    axes), so checkpointed activations are already partitioned whenever
+    the activations themselves are.  ``cpu_checkpointing``'s analog is
+    the ``offload_dots`` remat policy (pinned-host saved residuals)."""
     partition_activations: bool = False
     cpu_checkpointing: bool = False
     contiguous_memory_optimization: bool = False
