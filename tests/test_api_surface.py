@@ -108,3 +108,37 @@ def test_mpu_adapter_and_initialize():
         assert topo.tp_size == 2 and topo.pp_size == 2
     finally:
         _reset_topo()
+
+
+def test_round4_api_surface_importable():
+    """Round-4 additions are part of the public surface: converter
+    registry, sampling helpers, block-sparse kernel, KV generator,
+    compression student init, pipelined-swap engine hooks."""
+    from deepspeed_tpu.compression.compress import student_initialization
+    from deepspeed_tpu.inference.kv_generate import KVCachedGenerator
+    from deepspeed_tpu.inference.v2.model import (check_sampling_params,
+                                                  sample_tokens)
+    from deepspeed_tpu.models.hf_loader import register_converter
+    from deepspeed_tpu.ops.pallas.block_sparse_mha import block_sparse_mha
+    from deepspeed_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention)
+
+    assert all(callable(f) for f in (
+        student_initialization, KVCachedGenerator, check_sampling_params,
+        sample_tokens, register_converter, block_sparse_mha,
+        paged_decode_attention))
+    # config keys of the round parse cleanly
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    c = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        "zero_optimization": {
+            "stage": 3, "strict_sharding": False,
+            "param_persistence_threshold": 50_000,
+            "offload_optimizer": {"device": "nvme", "pipeline_read": True,
+                                  "nvme_path": "/tmp/x"}},
+        "compression_training": {"sparse_pruning": {
+            "shared_parameters": {"enabled": True}}},
+    })
+    assert c.zero_config.param_persistence_threshold == 50_000
+    assert c.zero_config.offload_optimizer.pipeline_read
