@@ -38,9 +38,14 @@ def test_factor_data_axis():
 
 def _make_engine(zero_extra, mesh=None):
     model = get_model_config("gpt2-tiny", num_layers=2)
+    # threshold 0: the tiny model's params would all be persistent under
+    # the reference-default param_persistence_threshold (1e5 elements),
+    # hiding the sharding structure these tests pin
     cfg = {"train_micro_batch_size_per_gpu": 1, "gradient_accumulation_steps": 1,
            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
-           "zero_optimization": {"stage": 3, **zero_extra}}
+           "zero_optimization": {"stage": 3,
+                                 "param_persistence_threshold": 0,
+                                 **zero_extra}}
     if mesh:
         cfg["mesh"] = mesh
     engine, *_ = ds.initialize(model=model, config=cfg)
