@@ -133,8 +133,10 @@ def row_llama8b_class_zero3():
     else:
         layers = 4  # 8B is 32 layers; 4 fit one v5e with remat
         batch_size, gas, seq, steps = 4, 4, 1024, 4
+        # tiled loss: [4, 1024, 128256] fp32 logits are ~2.1GB; sequence
+        # tiles keep the head+NLL within HBM headroom (numerically equal)
         model = get_model_config("llama3-8b", num_layers=layers,
-                                 max_seq_len=seq)
+                                 max_seq_len=seq, loss_tiles=8)
     config = {
         "train_micro_batch_size_per_gpu": batch_size,
         "gradient_accumulation_steps": gas,
