@@ -149,3 +149,27 @@ def test_checkpoint_save_joins_prefetch(tmp_path):
     assert np.isfinite(loss1)
     engine.destroy()
     _reset_topo()
+
+
+def test_trio_step_api_with_pipelined_store(tmp_path):
+    """The manual forward/backward/step trio must work in pipelined store
+    mode too (step() queues the next prefetch; the next step consumes
+    it), with numerics matching train_batch."""
+    rng = np.random.default_rng(13)
+    batch = make_lm_batch(rng, 2, 32, 512)
+    eng_a, _ = _nvme_engine(tmp_path / "a", True)
+    ref = [float(np.asarray(eng_a.train_batch(batch))) for _ in range(3)]
+    eng_a.destroy()
+    _reset_topo()
+
+    eng_b, _ = _nvme_engine(tmp_path / "b", True)
+    got = []
+    for _ in range(3):
+        loss = eng_b.forward(batch)
+        eng_b.backward()
+        eng_b.step()
+        got.append(float(np.asarray(loss)))
+    assert eng_b._opt_fut is not None  # step() queued the prefetch
+    eng_b.destroy()
+    np.testing.assert_allclose(ref, got, rtol=1e-5, atol=1e-6)
+    _reset_topo()
