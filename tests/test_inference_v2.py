@@ -191,3 +191,37 @@ def test_build_ragged_batch_checks_budget_first():
     with pytest.raises(RuntimeError, match="budget"):
         build_ragged_batch([(seq, 10)], mgr, token_budget=8)
     assert seq.num_cached == 0  # state untouched
+
+
+def test_soak_staggered_eos_and_sampling_allocator_clean():
+    """Soak: three generate() waves with eos cut-offs, varying lengths and
+    nucleus sampling — the allocator must return to fully-free after every
+    wave (no leaked pages/slots across waves; ref flush/retire paths)."""
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import get_model_config
+
+    model = get_model_config("gpt2-tiny")
+    eng = InferenceEngineV2(model, {"dtype": "float32",
+                                    "memory_config": {"num_blocks": 64,
+                                                      "block_size": 16},
+                                    "max_context": 128})
+    free0 = eng.free_blocks
+    rng = np.random.default_rng(21)
+    for wave, (n, temp, tp) in enumerate([(6, 0.0, 1.0), (4, 0.9, 0.8),
+                                          (8, 0.7, 1.0)]):
+        prompts = [list(map(int, rng.integers(
+            1, model.vocab_size, size=(int(rng.integers(2, 24)),))))
+            for _ in range(n)]
+        outs = eng.generate(prompts, max_new_tokens=int(rng.integers(3, 12)),
+                            temperature=temp, top_p=tp,
+                            eos_token_id=7)
+        assert len(outs) == n
+        for o in outs:
+            assert len(o) >= 1
+            if 7 in o:  # eos respected: nothing after it
+                assert o[o.index(7):] == [7]
+        assert eng.free_blocks == free0, (wave, eng.free_blocks, free0)
+        assert eng.state_manager.n_active == 0
+    from deepspeed_tpu.parallel import topology
+
+    topology._GLOBAL_TOPOLOGY = None
