@@ -4,7 +4,8 @@ Ref: ``InferenceEngineV2`` (inference/v2/engine_v2.py:30) +
 ``build_hf_engine`` (engine_factory.py:69). The engine owns the paged KV
 cache, the sequence state manager and the SplitFuse scheduler; ``put()``
 schedules one ragged step; ``generate()`` runs full continuous-batching
-text generation with per-sequence sampling params.
+text generation with per-call sampling params (greedy / temperature /
+top-k / top-p, sampled on device).
 
 TPU specifics: the ragged step is ONE jitted function with donated KV-cache
 buffers (no copies between steps) and fixed shapes — every prefill/decode
