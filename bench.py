@@ -128,7 +128,9 @@ def row_llama8b_class_zero3():
     from deepspeed_tpu.models import get_model_config
 
     if SMOKE:
-        model = get_model_config("llama-tiny")
+        # loss_tiles mirrors the real row so the ZeRO-3 + tiled-loss
+        # combination smoke-compiles before the driver's on-chip run
+        model = get_model_config("llama-tiny", loss_tiles=4)
         batch_size, gas, seq, steps, layers = 2, 1, 64, 2, 2
     else:
         layers = 4  # 8B is 32 layers; 4 fit one v5e with remat
