@@ -51,6 +51,13 @@ class RaggedInferenceEngineConfig:
         self.num_blocks = int(self.memory_config.get("num_blocks", 512))
         self.block_size = int(self.memory_config.get("block_size", 16))
         self.max_context = int(d.get("max_context", 2048))
+        # longest fused multi-step decode dispatch (one host round-trip
+        # runs up to this many steps on device); latency-sensitive hosts
+        # raise it to amortize dispatch overhead.  Rounded down to a power
+        # of two so the chunk round-up in _fused_decode can never exceed
+        # the configured bound (chunk sizes are pow2 compile buckets).
+        mdc = max(1, int(d.get("max_decode_chunk", 32)))
+        self.max_decode_chunk = 1 << (mdc.bit_length() - 1)
         self.dtype = d.get("dtype", "bfloat16")
         ep = d.get("expert_parallel", {})
         self.ep_size = int(ep.get("ep_size", 1) if isinstance(ep, dict)
@@ -304,9 +311,20 @@ class InferenceEngineV2:
         (ragged_decode_loop): chunk sizes are power-of-two bucketed so a
         generation run compiles at most a handful of loop lengths."""
         mgr = self.state_manager
-        chunk = min(min(remaining[u] for u in uids), 32)
-        if chunk > 1:  # round down to a power of two (compile-cache bound)
-            chunk = 1 << (chunk.bit_length() - 1)
+        chunk = min(min(remaining[u] for u in uids),
+                    self.cfg.max_decode_chunk)
+        if chunk > 1:  # round UP to a power of two (compile-cache bound).
+            # Up, not down: a 31-token budget then costs one 32-step
+            # dispatch instead of a 16/8/4/2/1 ladder — each dispatch is a
+            # host round-trip, and overshot tokens are just masked off
+            # below (their KV writes die with the flushed sequence).
+            chunk = 1 << (chunk - 1).bit_length()
+        # ...but the overshoot must stay within every sequence's block
+        # table: a prompt near max_context has fewer than `chunk` KV slots
+        # left, and ensure_capacity raises rather than clamps.
+        cap_tokens = mgr.max_blocks_per_seq * mgr.block_size
+        headroom = min(cap_tokens - mgr.get(u).num_cached for u in uids)
+        chunk = max(1, min(chunk, headroom))
         s_rows = mgr.max_seqs
         tokens0 = np.zeros((s_rows,), np.int32)
         ctx0 = np.zeros((s_rows,), np.int32)
@@ -338,14 +356,15 @@ class InferenceEngineV2:
         for u in uids:
             seq = mgr.get(u)
             toks = [int(x) for x in sampled[:, seq.slot]]
-            cut = chunk
-            if eos_token_id is not None and eos_token_id in toks:
+            take = min(chunk, remaining[u])  # overshoot from round-up
+            cut = take
+            if eos_token_id is not None and eos_token_id in toks[:take]:
                 cut = toks.index(eos_token_id) + 1
             seq.tokens.extend(toks)
             seq.num_cached += chunk
             outputs[u].extend(toks[:cut])
             remaining[u] -= cut
-            if cut < chunk or remaining[u] <= 0:
+            if cut < take or remaining[u] <= 0:
                 self.flush(u)
 
 

@@ -477,6 +477,11 @@ _REMAT_POLICIES = {
     # dots + the repo flash kernel's named residuals (flash_out/flash_lse):
     # the backward then never re-runs the attention forward kernel.
     "dots_flash_saveable": "dots_flash_saveable",
+    # ONLY the flash residuals: at long sequence the per-layer matmul
+    # outputs dots_saveable keeps are O(S·ffn) and dominate HBM (seq 32k:
+    # ~640MB/layer); saving just flash_out/flash_lse keeps the backward
+    # from re-running the attention kernel while everything else remats.
+    "flash_saveable": "flash_saveable",
     "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
     # CPU activation checkpointing (ref checkpointing.py:474): matmul
     # outputs are saved to pinned host memory instead of rematerialised —
@@ -500,6 +505,9 @@ def _maybe_remat(fn, cfg: TransformerConfig):
             jax.checkpoint_policies.dots_saveable,
             jax.checkpoint_policies.save_only_these_names(
                 "flash_out", "flash_lse"))
+    elif name == "flash_saveable":
+        policy = jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse")
     elif name:
         policy = getattr(jax.checkpoint_policies, name)
     return jax.checkpoint(fn, policy=policy, prevent_cse=False)

@@ -539,14 +539,31 @@ def _bwd_blocked(q, k, v, o, lse, g, causal, sm_scale, window=None):
     return dq[:, :, :s_real], dk[:, :, :s_real], dv[:, :, :s_real]
 
 
+def _resident_bwd_fits(s_pad: int, d: int, group: int, bq: int) -> bool:
+    """Whether the grouped resident dkv kernel fits scoped VMEM (16 MB).
+
+    It holds the whole [group, s_pad] q-side per program — q and do in
+    bf16 plus the 128-lane-replicated fp32 lse/delta — double-buffered by
+    the Pallas pipeline, with ~3 live [s_pad, bq] fp32 score
+    intermediates.  GQA multiplies the q-side by `group`, so e.g.
+    group=4, S=1024, D=128 (Llama-3 geometry) overruns the limit even
+    though S·D is within the resident budget; fall back to the
+    KV-blocked backward there."""
+    blocks = group * s_pad * (2 * d * 2 + 2 * 128 * 4)  # q+do, lse+delta
+    interm = 3 * s_pad * bq * 4
+    return 2 * blocks + interm <= 12 * (1 << 20)
+
+
 def _bwd_impl(q, k, v, o, lse, g, causal, sm_scale, window=None):
     b, hq, s_real, d = q.shape
-    if not _supports_resident(s_real, d):
-        return _bwd_blocked(q, k, v, o, lse, g, causal, sm_scale,
-                            window=window)
     hkv = k.shape[1]
     group = hq // hkv
-    s_pad = -(-s_real // 128) * 128
+    s_pad128 = -(-s_real // 128) * 128
+    if not _supports_resident(s_real, d) or not _resident_bwd_fits(
+            s_pad128, d, group, _choose_bq(s_pad128)):
+        return _bwd_blocked(q, k, v, o, lse, g, causal, sm_scale,
+                            window=window)
+    s_pad = s_pad128
     bq = _choose_bq(s_pad)
     s_pad = -(-s_real // bq) * bq
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)

@@ -94,6 +94,45 @@ def test_supports_budget():
     assert not fm._supports_resident(16384, 128)
 
 
+def test_resident_bwd_vmem_budget():
+    """The grouped resident dkv kernel holds group× the q-side in VMEM;
+    Llama-3 geometry (group=4, S=1024, D=128) measured 17.55M against the
+    16M scoped-vmem limit on a real v5e (r04), so the backward must route
+    to the KV-blocked path there while the r02-tuned MHA d=64 config
+    keeps the resident fast path."""
+    assert not fm._resident_bwd_fits(1024, 128, 4, fm._choose_bq(1024))
+    assert fm._resident_bwd_fits(1024, 64, 1, fm._choose_bq(1024))
+
+
+def test_gqa_d128_grad_parity_blocked_fallback():
+    """Grad parity through the footprint-driven blocked-backward fallback
+    (forward stays resident, backward goes KV-blocked): the exact
+    llama3-8b head geometry that VMEM-OOMed on hardware in r04."""
+    b, hq, hkv, s, d = 1, 8, 2, 1024, 128
+    assert fm._supports_resident(s, d)  # fwd resident...
+    assert not fm._resident_bwd_fits(   # ...bwd must fall back
+        s, d, hq // hkv, fm._choose_bq(s))
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q = jax.random.normal(ks[0], (b, hq, s, d), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, hkv, s, d), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, hkv, s, d), jnp.bfloat16)
+    w = jnp.linspace(0.0, 1.0, d)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v).astype(jnp.float32) * w).sum()
+
+    scale = 1.0 / np.sqrt(d)
+    g1 = jax.grad(loss(lambda q, k, v: fm.flash_mha(q, k, v, True)),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(lambda q, k, v: _ref_attn(q, k, v, True, scale)),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        a32, b32 = a.astype(jnp.float32), b_.astype(jnp.float32)
+        rel = float(jnp.linalg.norm((a32 - b32).ravel())
+                    / (jnp.linalg.norm(b32.ravel()) + 1e-9))
+        assert rel < 0.02, rel
+
+
 BLOCKED_CASES = [
     # b, hq, hkv, s, d, causal
     (1, 4, 2, 1024, 64, True),    # GQA, 2x2 blocks

@@ -1,0 +1,138 @@
+"""ZenFlow async-host-step stress test.
+
+The overlap contract (runtime/zenflow.py:15-18): the cold host Adam runs
+on a worker thread, producing a *pending delta* that lands at the start
+of a later step; ``wait()`` joins the worker before ANY read of shared
+state.  The invariant under test: with identical gradient streams, the
+``overlap=True`` trajectory is bit-identical to ``overlap=False`` — no
+delta may be lost, doubled, or torn regardless of thread timing.
+
+Stressors: many steps (enough cold cycles for a lost delta to compound
+visibly), randomized worker latency (monkeypatched sleep inside
+``_cold_update`` widens the race window beyond what tiny shapes give),
+and mid-run ``state_dict``/``load_state_dict`` round-trips at arbitrary
+points relative to in-flight workers.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.zenflow import ZenFlowOptimizer
+
+
+def _params():
+    k = jax.random.split(jax.random.PRNGKey(7), 3)
+    return {
+        "w1": jax.random.normal(k[0], (16, 32), jnp.float32),
+        "w2": jax.random.normal(k[1], (32, 8), jnp.float32),
+        "b": jax.random.normal(k[2], (8,), jnp.float32),
+    }
+
+
+def _grad_stream(n, params):
+    keys = jax.random.split(jax.random.PRNGKey(11), n)
+    return [jax.tree.map(
+        lambda p, i=i: jax.random.normal(
+            jax.random.fold_in(keys[i], hash(p.shape) % 997), p.shape,
+            jnp.float32), params) for i in range(n)]
+
+
+def _run(overlap, n_steps, latency=None, checkpoint_at=()):
+    params = _params()
+    opt = ZenFlowOptimizer(params, lr=0.02, topk_ratio=0.25,
+                           update_interval=3, overlap=overlap)
+    if latency is not None:
+        orig = opt._cold_update
+
+        def slow_cold(n):
+            time.sleep(latency())
+            orig(n)
+
+        opt._cold_update = slow_cold
+    saved = None
+    for i, g in enumerate(_grad_stream(n_steps, params)):
+        if i in checkpoint_at:
+            # snapshot possibly WHILE a worker is in flight, restore into
+            # a fresh optimizer, and continue from the snapshot
+            saved = (jax.tree.map(np.asarray, params), opt.state_dict())
+            params = jax.tree.map(jnp.asarray, saved[0])
+            opt2 = ZenFlowOptimizer(params, lr=0.02, topk_ratio=0.25,
+                                    update_interval=3, overlap=overlap)
+            if latency is not None:
+                orig2 = opt2._cold_update
+
+                def slow_cold2(n, _o=opt2):
+                    time.sleep(latency())
+                    ZenFlowOptimizer._cold_update(_o, n)
+
+                opt2._cold_update = slow_cold2
+            opt2.load_state_dict(saved[1])
+            opt = opt2
+        params = opt.step(params, g)
+    params = opt.flush(params)
+    return jax.tree.map(np.asarray, params)
+
+
+def test_overlap_matches_serial_many_cycles():
+    """60 steps / 20 cold cycles: one lost or doubled pending delta would
+    diverge the trees."""
+    serial = _run(False, 60)
+    overlapped = _run(True, 60)
+    jax.tree.map(np.testing.assert_array_equal, serial, overlapped)
+
+
+def test_overlap_matches_serial_with_jittered_latency():
+    """Randomized host-step latency (0–15 ms) shifts worker completion
+    past step boundaries in both directions."""
+    rng = np.random.default_rng(3)
+    serial = _run(False, 45)
+    overlapped = _run(True, 45, latency=lambda: float(rng.uniform(0, 0.015)))
+    jax.tree.map(np.testing.assert_array_equal, serial, overlapped)
+
+
+@pytest.mark.parametrize("ckpt_step", [4, 5, 17])
+def test_checkpoint_mid_flight_preserves_trajectory(ckpt_step):
+    """state_dict/load_state_dict at arbitrary phase (incl. right after a
+    worker launch at steps ≡ 0 mod 3, and mid-accumulation) must continue
+    the exact serial trajectory."""
+    serial = _run(False, 30)
+    resumed = _run(True, 30, latency=lambda: 0.01,
+                   checkpoint_at=(ckpt_step,))
+    jax.tree.map(np.testing.assert_array_equal, serial, resumed)
+
+
+def test_no_concurrent_mutation_window():
+    """Instrument the worker with an in-critical-section flag: step() must
+    never touch shared host state while the worker is inside
+    _cold_update (wait() must have joined it first)."""
+    params = _params()
+    opt = ZenFlowOptimizer(params, lr=0.02, topk_ratio=0.25,
+                           update_interval=2, overlap=True)
+    in_cold = threading.Event()
+    violations = []
+    orig = opt._cold_update
+
+    def guarded_cold(n):
+        in_cold.set()
+        time.sleep(0.02)
+        orig(n)
+        in_cold.clear()
+
+    opt._cold_update = guarded_cold
+    orig_step = opt.step
+
+    def guarded_step(params, grads):
+        opt.wait()
+        if in_cold.is_set():
+            violations.append("step entered while cold update running")
+        return orig_step(params, grads)
+
+    for g in _grad_stream(20, params):
+        params = guarded_step(params, g)
+    opt.flush(params)
+    assert not violations, violations
