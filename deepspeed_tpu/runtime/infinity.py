@@ -56,9 +56,29 @@ def memory_kinds_supported() -> bool:
             _MEMORY_KINDS[plat] = False
         else:
             try:
-                jax.jit(lambda x: lax.dynamic_update_index_in_dim(
-                    jax.device_put(x, HOST), jax.device_put(x[0], HOST), 0,
-                    axis=0))(jnp.ones((4, 8)))[0].block_until_ready()
+                # Probe the exact patterns streaming uses: a host-space
+                # DUS accumulation in a scan carry (park_slice) and a
+                # host-arg slice read (fetch_slice).  BOTH DUS operands
+                # must be host-placed — libtpu's host offloader rejects a
+                # device-resident update operand, and a probe written that
+                # way reads as "unsupported" on runtimes where the real
+                # pattern is fine (r04: that false negative silently
+                # degraded every Infinity placement to device and OOM'd
+                # the 6.7B streaming ladder entry).
+                def probe(w):
+                    z = jax.device_put(jnp.zeros(w.shape, w.dtype), HOST)
+
+                    def body(c, i):
+                        u = jax.device_put(
+                            lax.dynamic_index_in_dim(w, i, keepdims=False)
+                            * 2.0, HOST)
+                        return lax.dynamic_update_index_in_dim(
+                            c, u, i, axis=0), None
+
+                    out, _ = lax.scan(body, z, jnp.arange(w.shape[0]))
+                    return out
+
+                jax.jit(probe)(jnp.ones((2, 256)))[0].block_until_ready()
                 _MEMORY_KINDS[plat] = True
             except Exception:
                 _MEMORY_KINDS[plat] = False
@@ -252,9 +272,12 @@ def streamed_update(update_fn: Callable, grads_host, state_host, params_host,
     p0 = jax.tree.map(
         lambda p: _put(jnp.zeros(p.shape, p.dtype), HOST), params_host)
     # carry types must be stable: stacked state leaves live in host space
-    # throughout the scan
+    # throughout the scan, and non-stacked ones (adam's scalar count) on
+    # device — update_fn returns device scalars, so a host-typed input
+    # would flip memory space across the carry
     state_host = jax.tree.map(
-        lambda x: _put(x, HOST) if is_stacked(x) else x, state_host)
+        lambda x: _put(x, HOST) if is_stacked(x) else _put(x, DEVICE),
+        state_host)
     (new_params, new_state), _ = lax.scan(
         body, (p0, state_host), jnp.arange(steps))
     return new_params, new_state
