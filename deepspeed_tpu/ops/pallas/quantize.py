@@ -1,0 +1,181 @@
+"""Blockwise int8/int4 quantization as Pallas TPU kernels.
+
+Device-kernel analog of the reference's quantization kernel set
+(``csrc/quantization/quantize.cu``, ``dequantize.cu``,
+``fake_quantizer.cu``, ``swizzled_quantize.cu`` — SURVEY §2.6).  The jnp
+path (``ops/quantizer.py``) is numerically identical and XLA usually fuses
+it into neighbours; these kernels pin the one-HBM-pass guarantee for the
+bandwidth-sensitive call sites (qwZ weight gather, qgZ gradient
+all-to-all):
+
+* ``quantize``: reads the float tensor ONCE, writes int8 payload + fp32
+  scales — no intermediate absmax/scale round-trip can be materialised.
+* ``dequantize``: reads int8+scales once, writes float once.
+* ``fake_quantize``: QAT round-trip without ever materialising the int8
+  payload in HBM.
+
+Layout: the tensor is viewed as [M, N] rows with the last axis split into
+``group_size``-wide groups.  The Pallas grid tiles rows × groups, so every
+kernel block is ``[block_m, group_size]`` — each *row* of a block is one
+quantization group, absmax reduces over lanes, and no in-kernel reshapes
+are needed (lane-dim reshapes are the thing Mosaic dislikes).  Scales come
+out as ``[M, n_groups]``; their block spans the full group axis with an
+index map that ignores the group step (Mosaic requires the minor block
+dim be 128-divisible or the whole axis — a [bm, 1] block is rejected on
+hardware), so the block persists across the inner grid steps and each
+step writes only its own column.
+
+Constraints (callers fall back to the jnp path otherwise — see
+``ops.quantizer.quantize_blockwise(backend=...)``): last dim divisible by
+``group_size``, ``group_size`` a multiple of 128, symmetric mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# flipped by tests to run kernels on the CPU interpreter
+INTERPRET = False
+
+
+def supports(shape: Tuple[int, ...], group_size: int, symmetric: bool,
+             num_bits: int) -> bool:
+    """Whether the Pallas path can serve this call."""
+    if not symmetric or num_bits not in (4, 8):
+        return False
+    if len(shape) == 0 or group_size <= 0:  # <=0 means whole-tensor group
+        return False
+    n = shape[-1]
+    return n >= group_size and n % group_size == 0 and group_size % 128 == 0
+
+
+def _view_2d(x: jnp.ndarray) -> Tuple[jnp.ndarray, Tuple[int, ...]]:
+    shape = x.shape
+    m = 1
+    for d in shape[:-1]:
+        m *= d
+    return x.reshape(m, shape[-1]), shape
+
+
+def _block_m(m: int, itemdtype) -> int:
+    # int8 tiles want >=32 sublanes; cap block height so a block stays
+    # well under VMEM (block_m * group_size * 4B, group_size <= 1024)
+    bm = 256
+    while bm > m and bm > 8:
+        bm //= 2
+    return max(bm, 8)
+
+
+def _quant_kernel(x_ref, q_ref, s_ref, *, qmax: float):
+    # the scales block spans all groups and persists across the inner (j)
+    # grid steps; a width-1 dynamic lane store does not lower on TPU, so
+    # each step folds its column in via a one-hot select (VMEM-local)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        s_ref[...] = jnp.zeros_like(s_ref)
+
+    x = x_ref[...].astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = absmax / qmax
+    scale = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(x / scale), -qmax - 1.0, qmax)
+    q_ref[...] = q.astype(jnp.int8)
+    lane = jax.lax.broadcasted_iota(jnp.int32, s_ref.shape, 1)
+    s_ref[...] += jnp.where(lane == j, scale, 0.0)
+
+
+def quantize(x: jnp.ndarray, num_bits: int = 8,
+             group_size: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric blockwise quantize; returns ``(q_int8, scales)`` with
+    ``scales.shape == x.shape[:-1] + (n // group_size,)``."""
+    x2, shape = _view_2d(x)
+    m, n = x2.shape
+    ng = n // group_size
+    bm = _block_m(m, x2.dtype)
+    qmax = float(2 ** (num_bits - 1) - 1)
+    grid = (pl.cdiv(m, bm), ng)
+    q, s = pl.pallas_call(
+        functools.partial(_quant_kernel, qmax=qmax),
+        grid=grid,
+        in_specs=[pl.BlockSpec((bm, group_size), lambda i, j: (i, j))],
+        out_specs=[
+            pl.BlockSpec((bm, group_size), lambda i, j: (i, j)),
+            pl.BlockSpec((bm, ng), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, n), jnp.int8),
+            jax.ShapeDtypeStruct((m, ng), jnp.float32),
+        ],
+        interpret=INTERPRET,
+    )(x2)
+    return q.reshape(shape), s.reshape(shape[:-1] + (ng,))
+
+
+def _dequant_kernel(q_ref, s_ref, o_ref, *, dtype):
+    j = pl.program_id(1)
+    q = q_ref[...].astype(jnp.float32)
+    s = s_ref[...]
+    lane = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    scale = jnp.sum(jnp.where(lane == j, s, 0.0), axis=1, keepdims=True)
+    o_ref[...] = (q * scale).astype(dtype)
+
+
+def dequantize(q: jnp.ndarray, scales: jnp.ndarray,
+               dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of :func:`quantize`."""
+    q2, shape = _view_2d(q)
+    m, n = q2.shape
+    ng = scales.shape[-1]
+    group_size = n // ng
+    s2 = scales.reshape(m, ng)
+    bm = _block_m(m, q2.dtype)
+    grid = (pl.cdiv(m, bm), ng)
+    out = pl.pallas_call(
+        functools.partial(_dequant_kernel, dtype=dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, group_size), lambda i, j: (i, j)),
+            pl.BlockSpec((bm, ng), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, group_size), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), dtype),
+        interpret=INTERPRET,
+    )(q2, s2)
+    return out.reshape(shape)
+
+
+def _fake_quant_kernel(x_ref, o_ref, *, qmax: float):
+    x = x_ref[...].astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = absmax / qmax
+    scale = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(x / scale), -qmax - 1.0, qmax)
+    o_ref[...] = (q * scale).astype(o_ref.dtype)
+
+
+def fake_quantize(x: jnp.ndarray, num_bits: int = 8,
+                  group_size: int = 256) -> jnp.ndarray:
+    """Quantize-dequantize round-trip (QAT) in one HBM pass — the int8
+    payload never leaves VMEM (ref fake_quantizer.cu)."""
+    x2, shape = _view_2d(x)
+    m, n = x2.shape
+    bm = _block_m(m, x2.dtype)
+    qmax = float(2 ** (num_bits - 1) - 1)
+    grid = (pl.cdiv(m, bm), n // group_size)
+    out = pl.pallas_call(
+        functools.partial(_fake_quant_kernel, qmax=qmax),
+        grid=grid,
+        in_specs=[pl.BlockSpec((bm, group_size), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((bm, group_size), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        interpret=INTERPRET,
+    )(x2)
+    return out.reshape(shape)

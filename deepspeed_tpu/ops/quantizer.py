@@ -29,6 +29,22 @@ import jax
 import jax.numpy as jnp
 
 
+def _pallas_ok(x: jnp.ndarray, num_bits: int, group_size: int,
+               symmetric: bool, backend: str) -> bool:
+    """Route to the Pallas kernels (ops/pallas/quantize.py) when requested
+    and servable: 'pallas' forces them, 'auto' uses them on TPU only (the
+    CPU interpreter is test-grade), 'jnp' never."""
+    if backend == "jnp":
+        return False
+    from deepspeed_tpu.ops.pallas import quantize as pq
+
+    if not pq.supports(x.shape, group_size, symmetric, num_bits):
+        return False
+    if backend == "pallas" or pq.INTERPRET:
+        return True
+    return jax.default_backend() not in ("cpu",)
+
+
 def _group(x: jnp.ndarray, group_size: int) -> Tuple[jnp.ndarray, int]:
     n = x.shape[-1]
     if group_size <= 0 or group_size > n:
@@ -39,14 +55,22 @@ def _group(x: jnp.ndarray, group_size: int) -> Tuple[jnp.ndarray, int]:
 
 
 def quantize_blockwise(x: jnp.ndarray, num_bits: int = 8, group_size: int = 256,
-                       symmetric: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray,
-                                                        Optional[jnp.ndarray]]:
+                       symmetric: bool = True,
+                       backend: str = "auto") -> Tuple[jnp.ndarray, jnp.ndarray,
+                                                       Optional[jnp.ndarray]]:
     """Quantize to ``num_bits`` integers with per-group scales.
 
     Returns ``(q, scale, zero_point)``; ``zero_point`` is None when
     symmetric.  q is int8 (int4 values occupy the low nibble range).
+    ``backend``: 'auto' (Pallas on TPU when servable, else jnp),
+    'pallas', or 'jnp'.
     Ref: csrc/quantization/quantize.cu / pt_binding quantize.
     """
+    if _pallas_ok(x, num_bits, group_size, symmetric, backend):
+        from deepspeed_tpu.ops.pallas import quantize as pq
+
+        q, s = pq.quantize(x, num_bits, group_size)
+        return q, s, None
     g, group_size = _group(x.astype(jnp.float32), group_size)
     qmax = float(2 ** (num_bits - 1) - 1)
     if symmetric:
@@ -68,8 +92,14 @@ def quantize_blockwise(x: jnp.ndarray, num_bits: int = 8, group_size: int = 256,
 def dequantize_blockwise(q: jnp.ndarray, scale: jnp.ndarray,
                          zero_point: Optional[jnp.ndarray] = None,
                          num_bits: int = 8,
-                         dtype=jnp.float32) -> jnp.ndarray:
+                         dtype=jnp.float32,
+                         backend: str = "auto") -> jnp.ndarray:
     """Inverse of :func:`quantize_blockwise` (ref dequantize.cu)."""
+    if zero_point is None and _pallas_ok(
+            q, num_bits, q.shape[-1] // scale.shape[-1], True, backend):
+        from deepspeed_tpu.ops.pallas import quantize as pq
+
+        return pq.dequantize(q, scale, dtype=dtype)
     shape = q.shape
     group_size = shape[-1] // scale.shape[-1]
     g = q.astype(jnp.float32).reshape(shape[:-1] + (scale.shape[-1], group_size))
@@ -81,10 +111,17 @@ def dequantize_blockwise(q: jnp.ndarray, scale: jnp.ndarray,
 
 
 def fake_quantize(x: jnp.ndarray, num_bits: int = 8, group_size: int = 256,
-                  symmetric: bool = True) -> jnp.ndarray:
-    """Quantize-dequantize roundtrip for QAT (ref fake_quantizer.cu)."""
-    q, s, z = quantize_blockwise(x, num_bits, group_size, symmetric)
-    return dequantize_blockwise(q, s, z, num_bits, dtype=x.dtype)
+                  symmetric: bool = True, backend: str = "auto") -> jnp.ndarray:
+    """Quantize-dequantize roundtrip for QAT (ref fake_quantizer.cu).  The
+    Pallas route does it in one HBM pass (payload stays in VMEM)."""
+    if _pallas_ok(x, num_bits, group_size, symmetric, backend):
+        from deepspeed_tpu.ops.pallas import quantize as pq
+
+        return pq.fake_quantize(x, num_bits, group_size)
+    q, s, z = quantize_blockwise(x, num_bits, group_size, symmetric,
+                                 backend="jnp")
+    return dequantize_blockwise(q, s, z, num_bits, dtype=x.dtype,
+                                backend="jnp")
 
 
 def pack_int4(q: jnp.ndarray) -> jnp.ndarray:

@@ -64,11 +64,18 @@ def qwz_weight_gather(params: Any, rules: ShardingRules,
 
         spec = g_rules.spec_for(path_str(path), p.shape, param_style=True)
         gs = group_size if p.shape[-1] % group_size == 0 else p.shape[-1]
-        q, s, _ = quantize_blockwise(p.astype(jnp.float32), num_bits, gs)
+        # backend="jnp" is load-bearing: this runs in-jit on SHARDED
+        # params — GSPMD partitions the jnp ops and fuses them into the
+        # int8 all-gather, while a pallas_call here would not partition
+        # automatically (it would force a gather of the bf16 payload,
+        # exactly what qwZ exists to avoid)
+        q, s, _ = quantize_blockwise(p.astype(jnp.float32), num_bits, gs,
+                                     backend="jnp")
         q = lax.with_sharding_constraint(q, NamedSharding(mesh, spec))
         s_spec = P(*(list(spec)[:-1] + [None])) if len(spec) else P()
         s = lax.with_sharding_constraint(s, NamedSharding(mesh, s_spec))
-        w = dequantize_blockwise(q, s, num_bits=num_bits).astype(p.dtype)
+        w = dequantize_blockwise(q, s, num_bits=num_bits,
+                                 backend="jnp").astype(p.dtype)
         # straight-through: forward sees quantized-gathered weights, grads
         # flow to the master param untouched
         return p + lax.stop_gradient(w - p)

@@ -69,6 +69,122 @@ def _adam(params_cfg: Dict[str, Any], adam_w_mode: bool) -> Optimizer:
                                dict(betas=betas, eps=eps, weight_decay=wd))
 
 
+class _FusedResult:
+    """Opaque per-leaf result wrapper for the fused update maps: a plain
+    tuple would be ambiguous with structural tuple nodes in the params
+    pytree (is_leaf by tuple length misfires on e.g. a (w, b, scale)
+    triple), while this class is never a pytree node."""
+
+    __slots__ = ("vals",)
+
+    def __init__(self, *vals):
+        self.vals = vals
+
+
+def _fused_leaf_ok(p) -> bool:
+    from deepspeed_tpu.ops.pallas import fused_optimizer as fo
+
+    if not fo.supports(p.shape):
+        return False
+    if fo.INTERPRET:
+        return True
+    return jax.default_backend() not in ("cpu",)
+
+
+def _fused_adam(params_cfg: Dict[str, Any], adam_w_mode: bool) -> Optimizer:
+    """AdamW with the Pallas fused-step kernel (ops/pallas/fused_optimizer)
+    on servable leaves; jnp math (bit-identical to the optax chain) on the
+    rest.  State layout mirrors the optax chain exactly, so checkpoints are
+    interchangeable with the default path.  Opt in via optimizer params
+    ``{"pallas_fused": true}`` — measured at parity with the optax path on
+    v5e (both bandwidth-bound; see ops/pallas/fused_optimizer.py)."""
+    from deepspeed_tpu.ops.pallas import fused_optimizer as fo
+
+    betas = params_cfg.get("betas", (0.9, 0.999))
+    b1, b2 = float(betas[0]), float(betas[1])
+    eps = float(params_cfg.get("eps", 1e-8))
+    wd = float(params_cfg.get("weight_decay", 0.01 if adam_w_mode else 0.0))
+    # decoupled decay only (AdamW); plain-Adam L2 keeps the optax path.
+    # Always chain (even length-1): _adam does, and chain state is a tuple
+    # regardless of length — keeps the two layouts interchangeable.
+    txs = [optax.scale_by_adam(b1=b1, b2=b2, eps=eps)]
+    if wd:
+        txs.append(optax.add_decayed_weights(wd))
+    tx = optax.chain(*txs)
+
+    def _jnp_leaf(p, g, m, v, lr, t):
+        g = g.astype(jnp.float32)
+        m = b1 * m + (1.0 - b1) * g
+        v = b2 * v + (1.0 - b2) * g * g
+        u = (m / (1.0 - b1 ** t)) / (jnp.sqrt(v / (1.0 - b2 ** t)) + eps)
+        if wd:
+            u = u + wd * p.astype(jnp.float32)
+        return (p - lr * u).astype(p.dtype), m, v
+
+    def update_fn(grads, state, params, lr):
+        adam_state = state[0]  # chain state: (ScaleByAdamState, [EmptyState])
+        t = (adam_state.count + 1).astype(jnp.float32)
+
+        def leaf(p, g, m, v):
+            if _fused_leaf_ok(p):
+                return _FusedResult(*fo.fused_adamw_leaf(
+                    p, g, m, v, lr, adam_state.count, b1, b2, eps, wd))
+            return _FusedResult(*_jnp_leaf(p, g, m, v, lr, t))
+
+        out = jax.tree.map(leaf, params, grads, adam_state.mu, adam_state.nu)
+        is_res = lambda x: isinstance(x, _FusedResult)
+        new_p = jax.tree.map(lambda o: o.vals[0], out, is_leaf=is_res)
+        new_m = jax.tree.map(lambda o: o.vals[1], out, is_leaf=is_res)
+        new_v = jax.tree.map(lambda o: o.vals[2], out, is_leaf=is_res)
+        new_adam = adam_state._replace(count=adam_state.count + 1,
+                                       mu=new_m, nu=new_v)
+        return new_p, (new_adam,) + tuple(state[1:])
+
+    name = "fused_adamw" if adam_w_mode else "fused_adam"
+    return Optimizer(name=name, init_fn=tx.init, update_fn=update_fn,
+                     defaults=dict(betas=betas, eps=eps, weight_decay=wd))
+
+
+def _fused_lion(params_cfg: Dict[str, Any]) -> Optimizer:
+    """Lion with the Pallas fused-step kernel on servable leaves (see
+    :func:`_fused_adam` for routing/state-compat notes)."""
+    from deepspeed_tpu.ops.pallas import fused_optimizer as fo
+
+    betas = params_cfg.get("betas", (0.9, 0.99))
+    b1, b2 = float(betas[0]), float(betas[1])
+    wd = float(params_cfg.get("weight_decay", 0.0))
+    txs = [optax.scale_by_lion(b1=b1, b2=b2)]
+    if wd:
+        txs.append(optax.add_decayed_weights(wd))
+    tx = optax.chain(*txs)
+
+    def _jnp_leaf(p, g, m, lr):
+        g = g.astype(jnp.float32)
+        u = jnp.sign(b1 * m + (1.0 - b1) * g)
+        if wd:
+            u = u + wd * p.astype(jnp.float32)
+        return (p - lr * u).astype(p.dtype), b2 * m + (1.0 - b2) * g
+
+    def update_fn(grads, state, params, lr):
+        lion_state = state[0]
+
+        def leaf(p, g, m):
+            if _fused_leaf_ok(p):
+                return _FusedResult(*fo.fused_lion_leaf(p, g, m, lr, b1,
+                                                        b2, wd))
+            return _FusedResult(*_jnp_leaf(p, g, m, lr))
+
+        out = jax.tree.map(leaf, params, grads, lion_state.mu)
+        is_res = lambda x: isinstance(x, _FusedResult)
+        new_p = jax.tree.map(lambda o: o.vals[0], out, is_leaf=is_res)
+        new_m = jax.tree.map(lambda o: o.vals[1], out, is_leaf=is_res)
+        new_lion = lion_state._replace(count=lion_state.count + 1, mu=new_m)
+        return new_p, (new_lion,) + tuple(state[1:])
+
+    return Optimizer(name="fused_lion", init_fn=tx.init, update_fn=update_fn,
+                     defaults=dict(betas=betas, weight_decay=wd))
+
+
 def _lion(params_cfg: Dict[str, Any]) -> Optimizer:
     betas = params_cfg.get("betas", (0.9, 0.99))
     wd = float(params_cfg.get("weight_decay", 0.0))
@@ -124,13 +240,20 @@ def build_optimizer(opt_type: str, params_cfg: Optional[Dict[str, Any]] = None) 
     params_cfg = dict(params_cfg or {})
     params_cfg.pop("lr", None)  # lr flows through update_fn
     t = opt_type.lower()
+    pallas_fused = bool(params_cfg.pop("pallas_fused", False))
     if t in (C.ADAM_OPTIMIZER, C.FUSED_ADAM_OPTIMIZER):
         adam_w_mode = bool(params_cfg.pop("adam_w_mode", True))
+        if pallas_fused and adam_w_mode:
+            return _fused_adam(params_cfg, True)
         return _adam(params_cfg, adam_w_mode)
     if t == C.ADAMW_OPTIMIZER:
         params_cfg.pop("adam_w_mode", None)
+        if pallas_fused:
+            return _fused_adam(params_cfg, True)
         return _adam(params_cfg, True)
     if t in (C.LION_OPTIMIZER, "fusedlion"):
+        if pallas_fused:
+            return _fused_lion(params_cfg)
         return _lion(params_cfg)
     if t in (C.LAMB_OPTIMIZER, "fusedlamb"):
         return _lamb(params_cfg)
