@@ -109,6 +109,17 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16  # compute dtype
     param_dtype: Any = jnp.float32  # master dtype
     layernorm_eps: float = 1e-5
+    # per-op autocast policy (ref runtime/torch_autocast.py): which op
+    # classes stay fp32 regardless of the compute dtype.  None → the safe
+    # default below.  Configured via the "torch_autocast" config block
+    # ("fp32_ops"); dropping entries is the aggressive full-low-precision
+    # mode.  NOTE: the Pallas flash kernels always accumulate softmax in
+    # fp32 (hardware-right on TPU) — "softmax" here gates the XLA path.
+    fp32_ops: Optional[Tuple[str, ...]] = None
+    # module classes allowed to run in the low compute dtype; None → all.
+    # Modules NOT listed are promoted to fp32 (the torch autocast
+    # "lower_precision_safe_modules" contract).
+    autocast_safe_modules: Optional[Tuple[str, ...]] = None
     # remat policy name: none|full|nothing_saveable|dots_saveable|dots_with_no_batch_dims_saveable
     remat_policy: str = "nothing_saveable"
     attn_impl: str = "auto"  # "auto" | "xla" | "pallas_flash" | "sparse"
@@ -116,6 +127,9 @@ class TransformerConfig:
     # configs): {"mode": "fixed"|"bigbird"|"bslongformer"|"variable",
     # "block": 16, ...mode kwargs}; selected when attn_impl == "sparse"
     sparse_attention: Optional[Any] = None
+    # inference-v2 module overrides as (kind, name) pairs — resolved via
+    # inference/v2/modules.py (ref inference/v2/modules/heuristics.py)
+    v2_modules: Optional[Tuple[Tuple[str, str], ...]] = None
 
     @property
     def kv_heads(self) -> int:
@@ -267,17 +281,38 @@ def count_params(params: Params) -> int:
 # ----------------------------------------------------------------------
 # Forward pieces
 # ----------------------------------------------------------------------
+_DEFAULT_FP32_OPS = ("layernorm", "softmax", "rope", "router", "loss")
+
+
+def op_fp32(cfg, op: str) -> bool:
+    """Whether op class ``op`` runs in fp32 under the autocast policy.
+    getattr: callers (moe/sharded_moe) pass duck-typed configs in tests."""
+    ops = getattr(cfg, "fp32_ops", None)
+    return op in (ops if ops is not None else _DEFAULT_FP32_OPS)
+
+
+def _module_dtype(cfg: TransformerConfig, name: str, default_dt):
+    """Compute dtype for module class ``name``: safe-listed (or no list →
+    everything) runs in the low dtype, the rest is promoted to fp32."""
+    if cfg.autocast_safe_modules is None:
+        return default_dt
+    if any(pat in name for pat in cfg.autocast_safe_modules):
+        return default_dt
+    return jnp.float32
+
+
 def _norm(x, p, cfg: TransformerConfig):
     dt = x.dtype
-    x32 = x.astype(jnp.float32)
+    ct = jnp.float32 if op_fp32(cfg, "layernorm") else dt
+    xc = x.astype(ct)
     if cfg.norm == "rmsnorm":
-        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
-        out = x32 * lax.rsqrt(var + cfg.layernorm_eps) * p["scale"].astype(jnp.float32)
+        var = jnp.mean(jnp.square(xc), axis=-1, keepdims=True)
+        out = xc * lax.rsqrt(var + cfg.layernorm_eps) * p["scale"].astype(ct)
     else:
-        mean = jnp.mean(x32, axis=-1, keepdims=True)
-        var = jnp.var(x32, axis=-1, keepdims=True)
-        out = (x32 - mean) * lax.rsqrt(var + cfg.layernorm_eps)
-        out = out * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+        mean = jnp.mean(xc, axis=-1, keepdims=True)
+        var = jnp.var(xc, axis=-1, keepdims=True)
+        out = (xc - mean) * lax.rsqrt(var + cfg.layernorm_eps)
+        out = out * p["scale"].astype(ct) + p["bias"].astype(ct)
     return out.astype(dt)
 
 
@@ -292,8 +327,11 @@ def _rope(q, k, positions, cfg: TransformerConfig):
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
 
+    ct = jnp.float32 if op_fp32(cfg, "rope") else q.dtype
+    cos, sin = cos.astype(ct), sin.astype(ct)
+
     def rot(x):
-        xf = x.astype(jnp.float32)
+        xf = x.astype(ct)
         xr, x_pass = xf[..., :rot_d], xf[..., rot_d:]
         x1, x2 = jnp.split(xr, 2, axis=-1)
         xr = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
@@ -319,7 +357,8 @@ def _attention_scores(q, k, v, cfg: TransformerConfig, segment_pos=None):
         kpos = lax.broadcasted_iota(jnp.int32, (s, s), 1)
         mask = mask & (qpos - kpos < cfg.sliding_window)
     scores = jnp.where(mask[None, None, :, :], scores, jnp.finfo(jnp.float32).min)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    ct = jnp.float32 if op_fp32(cfg, "softmax") else scores.dtype
+    probs = jax.nn.softmax(scores.astype(ct), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
@@ -346,7 +385,9 @@ def _sparse_attn(q, k, v, cfg: TransformerConfig):
 def _attn_block(x, p, positions, cfg: TransformerConfig):
     b, s, h = x.shape
     nh, nkv, d = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
-    dt = x.dtype
+    dt0 = x.dtype  # residual-stream dtype: restored at the block boundary
+    dt = _module_dtype(cfg, "attn", dt0)
+    x = x.astype(dt)
 
     def proj(w, b_, out_dim):
         y = x @ w.astype(dt)
@@ -383,15 +424,17 @@ def _attn_block(x, p, positions, cfg: TransformerConfig):
     out = out @ p["wo"].astype(dt)
     if p.get("bo") is not None:
         out = out + p["bo"].astype(dt)
-    return out
+    return out.astype(dt0)
 
 
 def _mlp_block(x, p, cfg: TransformerConfig):
-    dt = x.dtype
+    dt0 = x.dtype
+    dt = _module_dtype(cfg, "mlp", dt0)
+    x = x.astype(dt)
     if cfg.activation == "swiglu":
         gate = jax.nn.silu(x @ p["wg"].astype(dt))
         up = x @ p["wi"].astype(dt)
-        return (gate * up) @ p["wo"].astype(dt)
+        return ((gate * up) @ p["wo"].astype(dt)).astype(dt0)
     y = x @ p["wi"].astype(dt)
     if p.get("bi") is not None:
         y = y + p["bi"].astype(dt)
@@ -400,7 +443,7 @@ def _mlp_block(x, p, cfg: TransformerConfig):
     y = y @ p["wo"].astype(dt)
     if p.get("bo") is not None:
         y = y + p["bo"].astype(dt)
-    return y
+    return y.astype(dt0)
 
 
 def _moe_block(x, p, cfg: TransformerConfig, allow_ep: bool = True):
@@ -798,11 +841,12 @@ def _embed(params: Params, input_ids, positions, cfg: TransformerConfig,
     ``token_embeds``: precomputed table rows [B,S,H] — the sparse-gradient
     path (runtime/sparse.py) hoists the lookup out of the differentiated
     function so the table cotangent stays (ids, values)-sparse."""
-    x = (params["embed"]["tokens"].astype(cfg.dtype)[input_ids]
-         if token_embeds is None else token_embeds.astype(cfg.dtype))
+    et = _module_dtype(cfg, "embed", cfg.dtype)
+    x = (params["embed"]["tokens"].astype(et)[input_ids]
+         if token_embeds is None else token_embeds.astype(et))
     if cfg.has_learned_positions:
-        x = x + params["embed"]["positions"].astype(cfg.dtype)[positions]
-    return x
+        x = x + params["embed"]["positions"].astype(et)[positions]
+    return x.astype(cfg.dtype)
 
 
 def _pipeline_1f1b_loss(params, batch, cfg: TransformerConfig, topo,
@@ -821,7 +865,8 @@ def _pipeline_1f1b_loss(params, batch, cfg: TransformerConfig, topo,
         h = _norm(h, tp["final_norm"], cfg)
         w = tp["w"].astype(dt)
         logits = h @ (w.T if cfg.tie_embeddings else w)
-        return _nll_sum(logits.astype(jnp.float32), labels_mb)
+        lt = jnp.float32 if op_fp32(cfg, "loss") else logits.dtype
+        return _nll_sum(logits.astype(lt), labels_mb)
 
     def embed_fn(ep, ids_mb, pos_mb):
         # runs inside the pipelined region: stage 0 embeds per microbatch
@@ -893,7 +938,8 @@ def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfi
                                     jnp.where(mask, labels, -100),
                                     cfg.loss_tiles)
     else:
-        loss = _nll_sum(out.astype(jnp.float32),
+        lt = jnp.float32 if op_fp32(cfg, "loss") else out.dtype
+        loss = _nll_sum(out.astype(lt),
                         jnp.where(mask, labels, -100)) \
             / jnp.maximum(mask.sum(), 1)
     if cfg.is_moe:

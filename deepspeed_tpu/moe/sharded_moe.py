@@ -225,9 +225,13 @@ def moe_forward(x: jnp.ndarray, p: Dict[str, jnp.ndarray], cfg) -> Tuple[jnp.nda
     b, s, h = x.shape
     dt = x.dtype
     tokens = x.reshape(b * s, h)
-    # router always in fp32 (routing decisions are precision-sensitive; the
-    # reference keeps gate logits fp32 too, sharded_moe.py:452)
-    logits = tokens.astype(jnp.float32) @ p["router"].astype(jnp.float32)
+    # router defaults to fp32 (routing decisions are precision-sensitive;
+    # the reference keeps gate logits fp32 too, sharded_moe.py:452) —
+    # overridable through the autocast policy's fp32_ops
+    from deepspeed_tpu.models.transformer import op_fp32
+
+    rt = jnp.float32 if op_fp32(cfg, "router") else dt
+    logits = (tokens.astype(rt) @ p["router"].astype(rt)).astype(jnp.float32)
     t, e = logits.shape
     c = _capacity(t, e, cfg.capacity_factor, cfg.top_k)
     mode = _resolve_dispatch(cfg, t, e, c)

@@ -70,14 +70,19 @@ class SchedulerConfig:
 
 @dataclass
 class TorchAutocastConfig:
-    """Ref: runtime/torch_autocast.py — per-op mixed precision.  On TPU
-    the functional model already keeps the precision-sensitive ops
-    (norms, softmax, router, loss) in fp32 while matmuls run in the
-    compute dtype, so enabling this selects the compute dtype exactly
-    like bf16/fp16 blocks do; ``lower_precision_safe_modules`` is
-    accepted for config parity (the safe set is the built-in policy)."""
+    """Ref: runtime/torch_autocast.py — per-op mixed precision.  Enabling
+    selects the compute dtype like bf16/fp16 blocks do, plus two policy
+    knobs the model consults per op (models/transformer.py op_fp32):
+
+    * ``fp32_ops``: op classes kept in fp32 (default
+      layernorm/softmax/rope/router/loss — the built-in safe set).
+      Removing entries is the aggressive full-low-precision mode.
+    * ``lower_precision_safe_modules``: module classes ("attn", "mlp")
+      allowed in the low dtype; when set, unlisted modules are promoted
+      to fp32 (the torch autocast contract)."""
     enabled: bool = False
     dtype: str = "bfloat16"
+    fp32_ops: Optional[List[str]] = None
     lower_precision_safe_modules: Optional[List[str]] = None
 
 

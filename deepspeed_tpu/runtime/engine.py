@@ -81,6 +81,37 @@ def _all_finite(tree) -> jnp.ndarray:
     return jnp.all(jnp.stack(leaves))
 
 
+def _translate_safe_modules(entries):
+    """Map torch_autocast ``lower_precision_safe_modules`` entries (torch
+    class names like "torch.nn.Linear" in reference configs) onto this
+    model's module classes ("attn"/"mlp"/"embed"/"lm_head").  Unknown
+    names are warned about and dropped; if nothing survives, return None
+    (= every module low-precision, the pre-policy behavior) rather than
+    silently promoting the whole model to fp32."""
+    if entries is None:
+        return None
+    table = {"linear": ("attn", "mlp", "embed", "lm_head"),
+             "attention": ("attn",), "attn": ("attn",),
+             "mlp": ("mlp",), "ffn": ("mlp",),
+             "embedding": ("embed",), "embed": ("embed",),
+             "lm_head": ("lm_head",), "conv": ()}
+    out = []
+    for e in entries:
+        key = str(e).rsplit(".", 1)[-1].lower()
+        if key in table:
+            out.extend(table[key])
+        else:
+            logger.warning(
+                f"torch_autocast.lower_precision_safe_modules: unknown "
+                f"module class '{e}' ignored (known: {sorted(table)})")
+    if not out:
+        logger.warning(
+            "torch_autocast.lower_precision_safe_modules matched no model "
+            "module classes; keeping every module in the low dtype")
+        return None
+    return tuple(dict.fromkeys(out))
+
+
 def _match_state_shardings(state_shape_tree, params_treedef, param_shardings, replicated):
     """Map optimizer-state pytrees to shardings: any subtree whose structure
     equals the params tree reuses the param sharding tree; other leaves are
@@ -201,6 +232,14 @@ class DeepSpeedEngine:
                 mc = mc.replace(dtype=jnp.float16)
             else:
                 mc = mc.replace(dtype=jnp.float32)
+            if cfg.torch_autocast.enabled:
+                ac = cfg.torch_autocast
+                if ac.fp32_ops is not None:
+                    mc = mc.replace(fp32_ops=tuple(ac.fp32_ops))
+                safe = _translate_safe_modules(
+                    ac.lower_precision_safe_modules)
+                if safe is not None:
+                    mc = mc.replace(autocast_safe_modules=safe)
             mc = mc.replace(remat_policy=cfg.activation_checkpointing.remat_policy
                             if cfg.activation_checkpointing.partition_activations
                             or cfg.activation_checkpointing.remat_policy != "nothing_saveable"

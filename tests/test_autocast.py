@@ -1,0 +1,101 @@
+"""Per-op autocast policy (ref runtime/torch_autocast.py): the
+"torch_autocast" config block's fp32_ops / lower_precision_safe_modules
+reach the model and change which ops run in the low dtype."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import get_model_config
+from deepspeed_tpu.models import transformer as tf
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def _batch(cfg, b=2, s=32, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, cfg.vocab_size, size=(b, s + 1), dtype=np.int32)
+    return {"input_ids": jnp.asarray(ids[:, :-1]),
+            "labels": jnp.asarray(ids[:, 1:])}
+
+
+def _loss(cfg, batch):
+    params = tf.init_params(cfg, jax.random.PRNGKey(0))
+    return float(np.asarray(tf.loss_fn(params, batch, cfg)))
+
+
+def test_default_policy_is_current_behavior():
+    cfg = get_model_config("gpt2-tiny")
+    assert cfg.fp32_ops is None
+    for op in ("layernorm", "softmax", "rope", "router", "loss"):
+        assert tf.op_fp32(cfg, op)
+    cfg2 = cfg.replace(fp32_ops=("layernorm",))
+    assert tf.op_fp32(cfg2, "layernorm") and not tf.op_fp32(cfg2, "softmax")
+
+
+def test_aggressive_policy_trains_and_diverges_in_low_precision():
+    """Dropping every fp32 island still yields a finite loss, and the
+    result differs from the safe policy (proof the gates are live)."""
+    base = get_model_config("gpt2-tiny", attn_impl="xla")
+    batch = _batch(base)
+    safe = _loss(base, batch)
+    aggressive = _loss(base.replace(fp32_ops=()), batch)
+    assert np.isfinite(aggressive)
+    assert abs(safe - aggressive) > 1e-7  # bf16 softmax/norm shifts numerics
+
+
+def _matmul_dtypes(fn, *args):
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    dts = set()
+    for eqn in jaxpr.jaxpr.eqns:
+        if eqn.primitive.name == "dot_general":
+            dts.add(str(eqn.invars[0].aval.dtype))
+    return dts
+
+
+def test_safe_modules_promote_unlisted_to_fp32():
+    """With an empty safe list the mlp matmuls run on fp32 operands; with
+    "mlp" listed (or no list) they stay in the compute dtype.  The block
+    restores the residual-stream dtype at its boundary either way."""
+    cfg = get_model_config("gpt2-tiny", attn_impl="xla")
+    params = tf.init_params(cfg, jax.random.PRNGKey(0))
+    mlp_p = jax.tree.map(lambda x: x, params["layers"]["mlp"])
+    mlp_p = {k: v[0] for k, v in mlp_p.items() if v is not None}
+    x = jnp.ones((2, 8, cfg.hidden_size), jnp.bfloat16)
+
+    promoted = cfg.replace(autocast_safe_modules=())
+    dts = _matmul_dtypes(lambda t: tf._mlp_block(t, mlp_p, promoted), x)
+    assert dts == {"float32"}
+    assert tf._mlp_block(x, mlp_p, promoted).dtype == jnp.bfloat16
+
+    listed = cfg.replace(autocast_safe_modules=("mlp",))
+    dts = _matmul_dtypes(lambda t: tf._mlp_block(t, mlp_p, listed), x)
+    assert dts == {"bfloat16"}
+
+
+def test_config_block_reaches_model():
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel import topology
+
+    model = get_model_config("gpt2-tiny")
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "torch_autocast": {"enabled": True, "dtype": "bfloat16",
+                           "fp32_ops": ["layernorm", "loss"],
+                           "lower_precision_safe_modules": ["attn", "mlp"]},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+    mc = engine.model_config
+    assert mc.dtype == jnp.bfloat16
+    assert mc.fp32_ops == ("layernorm", "loss")
+    assert mc.autocast_safe_modules == ("attn", "mlp")
+    topology._GLOBAL_TOPOLOGY = None
+
+
+def test_autocast_conflicts_with_explicit_bf16():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                         "bf16": {"enabled": True},
+                         "torch_autocast": {"enabled": True}})
