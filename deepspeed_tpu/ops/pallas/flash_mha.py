@@ -221,6 +221,26 @@ def _tile_alive(iq, ik, bq, bk, causal, window):
     return pred
 
 
+def _tile_interior(iq, ik, bq, bk, s_real, causal, window,
+                   check_rows=False):
+    """Whether a tile needs NO masking at all: every column in-range and
+    (under causal/window) every (q, k) pair valid.  The masking chain
+    (two iotas + compares + selects) is pure VPU work that at d=64
+    rivals the tile's MXU time — interior tiles skip it entirely; only
+    diagonal/edge tiles pay (the fwd/dq kernels run one of two bodies
+    under complementary ``pl.when`` predicates)."""
+    interior = ik * bk + bk <= s_real
+    if check_rows:
+        interior &= iq * bq + bq <= s_real
+    if causal:
+        # strictly below the diagonal: max col <= min row
+        interior &= ik * bk + bk - 1 <= iq * bq
+    if window is not None:
+        # max (row - col) inside the window
+        interior &= (iq * bq + bq - 1) - ik * bk < window
+    return interior
+
+
 def _fwd_kernel_blocked(q_ref, k_ref, v_ref, o_ref, *rest,
                         sm_scale, causal, bq, bk, s_real, window=None):
     if len(rest) == 4:
@@ -237,22 +257,24 @@ def _fwd_kernel_blocked(q_ref, k_ref, v_ref, o_ref, *rest,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    def compute():
+    def compute(masked):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         s = _scores(q, k, sm_scale)
-        valid = _block_mask(bq, bk, iq * bq, ik * bk, s_real, causal,
-                            window=window)
-        s = jnp.where(valid, s, NEG_INF)
+        if masked:
+            valid = _block_mask(bq, bk, iq * bq, ik * bk, s_real, causal,
+                                window=window)
+            s = jnp.where(valid, s, NEG_INF)
         m_prev = m_scr[:, 0:1]
         l_prev = l_scr[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        # fully-masked block rows: m_new stays NEG_INF, so exp(s - m_new)
-        # would be exp(0)=1 on the masked entries — kill them explicitly
-        p = jnp.where(valid, p, 0.0)
+        if masked:
+            # fully-masked block rows: m_new stays NEG_INF, so exp(s-m_new)
+            # would be exp(0)=1 on the masked entries — kill them explicitly
+            p = jnp.where(valid, p, 0.0)
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(p.astype(v.dtype), v,
                                  (((1,), (0,)), ((), ())),
@@ -262,7 +284,12 @@ def _fwd_kernel_blocked(q_ref, k_ref, v_ref, o_ref, *rest,
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
     pred = _tile_alive(iq, ik, bq, bk, causal, window)
-    compute() if pred is None else pl.when(pred)(compute)
+    interior = _tile_interior(iq, ik, bq, bk, s_real, causal, window)
+    live = interior if pred is None else jnp.logical_and(pred, interior)
+    pl.when(live)(lambda: compute(False))
+    edge = jnp.logical_not(interior) if pred is None \
+        else jnp.logical_and(pred, jnp.logical_not(interior))
+    pl.when(edge)(lambda: compute(True))
 
     @pl.when(ik == nk - 1)
     def _():
@@ -285,7 +312,7 @@ def _dq_kernel_blocked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    def compute():
+    def compute(masked):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
@@ -293,9 +320,10 @@ def _dq_kernel_blocked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0, 0][:, 0:1]
         delta = delta_ref[0, 0][:, 0:1]
         s = _scores(q, k, sm_scale)
-        valid = _block_mask(bq, bk, iq * bq, ik * bk, s_real, causal,
-                            window=window)
-        s = jnp.where(valid, s, NEG_INF)
+        if masked:
+            valid = _block_mask(bq, bk, iq * bq, ik * bk, s_real, causal,
+                                window=window)
+            s = jnp.where(valid, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -305,7 +333,12 @@ def _dq_kernel_blocked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                            preferred_element_type=jnp.float32)
 
     pred = _tile_alive(iq, ik, bq, bk, causal, window)
-    compute() if pred is None else pl.when(pred)(compute)
+    interior = _tile_interior(iq, ik, bq, bk, s_real, causal, window)
+    live = interior if pred is None else jnp.logical_and(pred, interior)
+    pl.when(live)(lambda: compute(False))
+    edge = jnp.logical_not(interior) if pred is None \
+        else jnp.logical_and(pred, jnp.logical_not(interior))
+    pl.when(edge)(lambda: compute(True))
 
     @pl.when(ik == nk - 1)
     def _():
@@ -325,7 +358,7 @@ def _dkv_kernel_blocked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    def compute():
+    def compute(masked):
         k = k_ref[0, 0]                                     # [bk, d]
         v = v_ref[0, 0]
         for g in range(group):                              # static loop
@@ -334,12 +367,14 @@ def _dkv_kernel_blocked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             lse = lse_ref[0, g][:, 0:1]
             delta = delta_ref[0, g][:, 0:1]
             s = _scores(q, k, sm_scale)                     # [bq, bk]
-            valid = _block_mask(bq, bk, iq * bq, ik * bk, s_real, causal,
-                                with_rows=True, window=window)
-            s = jnp.where(valid, s, NEG_INF)
+            if masked:
+                valid = _block_mask(bq, bk, iq * bq, ik * bk, s_real,
+                                    causal, with_rows=True, window=window)
+                s = jnp.where(valid, s, NEG_INF)
             p = jnp.exp(s - lse)
-            # pad query rows carry garbage lse; kill them with the mask
-            p = jnp.where(valid, p, 0.0)
+            if masked:
+                # pad query rows carry garbage lse; kill them with the mask
+                p = jnp.where(valid, p, 0.0)
             dv_scr[...] += jax.lax.dot_general(
                 p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
@@ -351,7 +386,13 @@ def _dkv_kernel_blocked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 preferred_element_type=jnp.float32)
 
     pred = _tile_alive(iq, ik, bq, bk, causal, window)
-    compute() if pred is None else pl.when(pred)(compute)
+    interior = _tile_interior(iq, ik, bq, bk, s_real, causal, window,
+                              check_rows=True)
+    live = interior if pred is None else jnp.logical_and(pred, interior)
+    pl.when(live)(lambda: compute(False))
+    edge = jnp.logical_not(interior) if pred is None \
+        else jnp.logical_and(pred, jnp.logical_not(interior))
+    pl.when(edge)(lambda: compute(True))
 
     @pl.when(iq == nq - 1)
     def _():

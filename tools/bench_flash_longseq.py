@@ -1,0 +1,64 @@
+"""On-chip micro-benchmark of the Pallas flash attention kernels at long
+sequence (the KV-blocked path): fwd and fwd+bwd achieved TFLOP/s vs the
+causal-attention flop count.  Quantifies kernel-level MFU separately from
+the end-to-end longseq bench row (which folds in dense matmuls + remat).
+Not part of the suite."""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def timeit(f, *args, iters=8):
+    r = f(*args)
+    np.asarray(jax.tree_util.tree_leaves(r)[0]).ravel()[:1]
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = f(*args)
+    np.asarray(jax.tree_util.tree_leaves(r)[0]).ravel()[:1]
+    return (time.perf_counter() - t0) / iters
+
+
+def attn_flops(b, h, s, d, causal=True):
+    # scores + pv matmuls: 2 * 2 * B*H*S^2*D, halved by causal skipping
+    f = 4 * b * h * s * s * d
+    return f / 2 if causal else f
+
+
+def main():
+    from deepspeed_tpu.ops.pallas.flash_mha import flash_mha
+
+    for (b, h, s, d) in [(1, 16, 32768, 64), (1, 8, 32768, 128),
+                         (1, 16, 8192, 64)]:
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((b, h, s, d)), jnp.bfloat16)
+
+        fwd = jax.jit(lambda q, k, v: flash_mha(q, k, v, causal=True))
+        t_f = timeit(fwd, q, k, v)
+        fl = attn_flops(b, h, s, d)
+        print(f"S={s} D={d} H={h}: fwd {t_f*1e3:.2f} ms "
+              f"= {fl/t_f/1e12:.1f} TF/s ({fl/t_f/197e12:.1%} of peak)")
+
+        grad = jax.jit(jax.grad(
+            lambda q, k, v: flash_mha(q, k, v, causal=True)
+            .astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+        t_g = timeit(grad, q, k, v)
+        fl_g = fl * 3.5  # bwd ≈ 2.5x fwd (dq + dkv recompute scores)
+        print(f"            fwd+bwd {t_g*1e3:.2f} ms "
+              f"= {fl_g/t_g/1e12:.1f} TF/s ({fl_g/t_g/197e12:.1%} of peak)")
+
+
+if __name__ == "__main__":
+    print(f"devices: {jax.devices()}")
+    main()
