@@ -107,6 +107,41 @@ class OffloadOptStatesPass(CompilePass):
         return {"offload_optimizer": True}
 
 
+class PrefetchPass(CompilePass):
+    """Widen the parameter-prefetch window when offload/streaming is
+    active and memory headroom allows (ref passes/prefetch.py —
+    DeepCompile hoists allgathers ahead of use; under XLA the hoisting is
+    the latency-hiding scheduler's job, and the *distance* it can hoist a
+    host→device layer fetch across is bounded by the unrolled window of
+    the streamed layer scan, cfg.scan_unroll).  Each ladder step doubles
+    the window: layer i+1's H2D fetch can overlap layer i's compute
+    (runtime/infinity.py streams per dynamic_slice of the host buffer)."""
+
+    name = "prefetch"
+    LADDER = [1, 2, 4]
+    HEADROOM = 0.7  # prefetch buffers cost HBM; keep a wide margin
+
+    def run(self, report, config):
+        streaming = bool(report.knobs.get("offload_optimizer")
+                         or report.knobs.get("param_stream")
+                         or config.get("param_stream"))
+        if not streaming:
+            return None
+        budget = config.get("memory_budget_bytes")
+        peak = report.profile.get("peak_memory_bytes")
+        if not budget or peak is None or peak > budget * self.HEADROOM:
+            return None
+        cur = int(report.knobs.get("scan_unroll", 1))
+        idx = self.LADDER.index(cur) if cur in self.LADDER else 0
+        if idx + 1 >= len(self.LADDER):
+            return None
+        new = self.LADDER[idx + 1]
+        report.decisions.append(
+            f"prefetch: streaming active, peak {peak:.3e}B < "
+            f"{self.HEADROOM:.0%} of budget → scan_unroll {cur} → {new}")
+        return {"scan_unroll": new}
+
+
 class SelectiveUnshardPass(CompilePass):
     """With memory headroom under the budget, raise the param-persistence
     threshold so small ZeRO-3 params stay gathered — trading spare HBM for
@@ -150,7 +185,7 @@ def deepspeed_compile(fn_factory: Callable[[Dict[str, Any]], Callable],
         "remat_policy", "none")})
     profile = ProfilePass(fn_factory, args)
     passes: List[CompilePass] = [RematPass(), OffloadOptStatesPass(),
-                                 SelectiveUnshardPass()]
+                                 PrefetchPass(), SelectiveUnshardPass()]
     for _ in range(max_rounds):
         profile.run(report, config)
         changed = False

@@ -62,6 +62,12 @@ class RaggedInferenceEngineConfig:
         ep = d.get("expert_parallel", {})
         self.ep_size = int(ep.get("ep_size", 1) if isinstance(ep, dict)
                            else ep)
+        # module-implementation overrides, e.g. {"attention": "paged_xla"}
+        # (ref inference/v2/modules: ConfigBundle names); resolved through
+        # inference/v2/modules.py at each attention call
+        from deepspeed_tpu.inference.v2.modules import module_overrides
+
+        self.modules = module_overrides(d)
 
 
 class InferenceEngineV2:
@@ -71,6 +77,9 @@ class InferenceEngineV2:
         self.cfg = RaggedInferenceEngineConfig(config, **kw)
         dt = jnp.bfloat16 if "bf" in str(self.cfg.dtype) else jnp.float32
         self.model_config = model.replace(dtype=dt)
+        if self.cfg.modules:
+            self.model_config = self.model_config.replace(
+                v2_modules=tuple(sorted(self.cfg.modules.items())))
         mesh_sizes = {}
         if self.cfg.tp_size > 1:
             mesh_sizes["tensor"] = self.cfg.tp_size

@@ -28,8 +28,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from deepspeed_tpu.inference.v2.modules import register_module, resolve
 from deepspeed_tpu.models.transformer import (TransformerConfig, _mlp_block,
                                               _norm)
+from deepspeed_tpu.ops.pallas.paged_attention import paged_decode_attention
 
 
 def _rope_tok(x, positions, cfg: TransformerConfig):
@@ -85,28 +87,56 @@ def _paged_attention_xla(q, k_pages, v_pages, gather_idx, token_pos,
     return out.reshape(t, nh, d)
 
 
+def _pallas_attn_default(block_size=0, head_dim=0, on_tpu=False,
+                         has_tables=False, **_):
+    if not (has_tables and on_tpu):
+        return False
+    from deepspeed_tpu.ops.pallas.paged_attention import supports
+
+    return supports(block_size, head_dim)
+
+
+@register_module("attention", "paged_pallas",
+                 default_for=_pallas_attn_default)
+def _attn_impl_pallas(q, k_pages, v_pages, gather_idx, token_pos,
+                      token_ctx_len, cfg, block_tables, token_slot,
+                      block_size):
+    """Pallas block-table kernel (ops/pallas/paged_attention.py: page walk
+    with online softmax — no [T, C, ...] gather materialisation).
+    Ref kernel: inference/v2/kernels/ragged_ops/blocked_flash."""
+    if block_tables is None:
+        raise ValueError(
+            "attention='paged_pallas' needs block tables (the prefill "
+            "mixed path carries none) — use 'auto' or 'paged_xla'")
+    pages = block_tables[token_slot]  # [T, NB]
+    scale = 1.0 / math.sqrt(cfg.dim_per_head)
+    return paged_decode_attention(
+        q, k_pages, v_pages, pages, token_pos, token_ctx_len,
+        block_size, scale, window=cfg.sliding_window or None)
+
+
+@register_module("attention", "paged_xla")
+def _attn_impl_xla(q, k_pages, v_pages, gather_idx, token_pos,
+                   token_ctx_len, cfg, block_tables, token_slot,
+                   block_size):
+    return _paged_attention_xla(q, k_pages, v_pages, gather_idx, token_pos,
+                                token_ctx_len, cfg)
+
+
 def _paged_attention(q, k_pages, v_pages, gather_idx, token_pos, token_ctx_len,
                      cfg: TransformerConfig, block_tables=None, token_slot=None,
                      block_size: int = 0):
-    """Attention of T query tokens against their sequences' KV pages.
-
-    On TPU this dispatches to the repo-owned Pallas kernel
-    (ops/pallas/paged_attention.py: block-table walk with online softmax —
-    no [T, C, ...] gather materialisation); elsewhere the XLA gather path.
-    Ref kernel: inference/v2/kernels/ragged_ops/blocked_flash.
-    """
-    if block_tables is not None and _on_tpu():
-        from deepspeed_tpu.ops.pallas.paged_attention import (
-            paged_decode_attention, supports as paged_supports)
-
-        if paged_supports(block_size, cfg.dim_per_head):
-            pages = block_tables[token_slot]  # [T, NB]
-            scale = 1.0 / math.sqrt(cfg.dim_per_head)
-            return paged_decode_attention(
-                q, k_pages, v_pages, pages, token_pos, token_ctx_len,
-                block_size, scale, window=cfg.sliding_window or None)
-    return _paged_attention_xla(q, k_pages, v_pages, gather_idx, token_pos,
-                                token_ctx_len, cfg)
+    """Attention of T query tokens against their sequences' KV pages,
+    resolved through the module registry (modules.py — ref
+    inference/v2/modules/heuristics.py): 'auto' picks the Pallas
+    block-table kernel on TPU when the geometry is servable, the XLA
+    gather path elsewhere; ``cfg.v2_modules`` pins a name explicitly."""
+    name = dict(cfg.v2_modules or ()).get("attention", "auto")
+    impl = resolve("attention", name, block_size=block_size,
+                   head_dim=cfg.dim_per_head, on_tpu=_on_tpu(),
+                   has_tables=block_tables is not None)
+    return impl(q, k_pages, v_pages, gather_idx, token_pos, token_ctx_len,
+                cfg, block_tables, token_slot, block_size)
 
 
 def _ragged_layer(x, lp, k_pages, v_pages, meta, cfg: TransformerConfig,

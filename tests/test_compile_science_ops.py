@@ -57,6 +57,21 @@ def test_compile_escalates_remat_under_budget():
     np.testing.assert_allclose(float(fn(x)), float(base(x)), rtol=1e-6)
 
 
+def test_compile_prefetch_widens_stream_window():
+    """With streaming active and memory headroom, the prefetch pass
+    raises scan_unroll (the H2D overlap window); without streaming it
+    never fires (ref passes/prefetch.py)."""
+    x = jnp.ones((8, 64), jnp.float32)
+    budget = {"memory_budget_bytes": 1 << 40, "param_stream": True}
+    fn, report = deepspeed_compile(_mlp_factory, (x,), budget)
+    assert report.knobs.get("scan_unroll") == 4  # 1 → 2 → 4, ladder top
+    assert any("prefetch" in d for d in report.decisions)
+    _, no_stream = deepspeed_compile(_mlp_factory, (x,),
+                                     {"memory_budget_bytes": 1 << 40})
+    assert "scan_unroll" not in no_stream.knobs
+    assert np.isfinite(float(fn(x)))
+
+
 def test_evoformer_attention_matches_reference():
     rng = np.random.default_rng(0)
     b, s, h, d = 2, 16, 4, 8
