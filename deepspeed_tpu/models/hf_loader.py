@@ -8,8 +8,8 @@ converter*: ``config_from_hf`` maps an HF config to a
 the stacked functional param tree, after which every subsystem (engine,
 AutoTP, ZeRO, inference v1/v2) consumes the model like any other.
 
-Supported families: gpt2, llama, mistral, qwen2, mixtral, qwen2_moe, opt,
-falcon, phi — the same set as the reference's v2 model implementations
+Supported families: gpt2, llama, mistral, qwen, qwen2, mixtral, qwen2_moe,
+opt, falcon, phi, phi3 — the same set as the reference's v2 model implementations
 (MoE included); :func:`register_converter` adds new families without
 touching this module (the analog of the v2 registry).
 
@@ -86,6 +86,39 @@ def config_from_hf(hf_config) -> TransformerConfig:
             sliding_window=getattr(hf_config, "sliding_window", None)
             if mt == "mistral" else None,
             layernorm_eps=hf_config.rms_norm_eps, **moe_kw)
+    if mt == "phi3":
+        # llama-family numerics with fused qkv_proj / gate_up_proj weights
+        # (ref inference/v2/model_implementations/phi3)
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=getattr(hf_config, "num_key_value_heads", None),
+            max_seq_len=hf_config.max_position_embeddings,
+            arch="phi3", norm="rmsnorm", activation="swiglu", use_rope=True,
+            rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+            tie_embeddings=bool(getattr(hf_config, "tie_word_embeddings",
+                                        False)),
+            sliding_window=getattr(hf_config, "sliding_window", None),
+            layernorm_eps=hf_config.rms_norm_eps)
+    if mt == "qwen":
+        # Qwen v1 (remote-code modeling_qwen.py; ref
+        # inference/v2/model_implementations/qwen): fused biased c_attn,
+        # RMSNorm, SwiGLU where w2 gates and the HF intermediate_size is
+        # 2x the actual FFN width (the modeling code splits it in half)
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size // 2,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            max_seq_len=getattr(hf_config, "seq_length", 2048),
+            arch="qwen", norm="rmsnorm", activation="swiglu", use_rope=True,
+            rope_theta=getattr(hf_config, "rotary_emb_base", 10000.0),
+            qkv_bias=True, tie_embeddings=False,
+            layernorm_eps=getattr(hf_config, "layer_norm_epsilon", 1e-6))
     if mt == "opt":
         return TransformerConfig(
             vocab_size=hf_config.vocab_size,
@@ -392,6 +425,64 @@ def _convert_phi(sd, cfg):
     return out
 
 
+def _convert_phi3(sd, cfg):
+    """Phi-3: fused qkv_proj ([q;k;v] rows) and gate_up_proj ([gate;up])
+    split into the functional layout (ref phi3 layer containers)."""
+    nh, nkv, d = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
+    ffn = cfg.intermediate_size
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        qkv = sd[p + "self_attn.qkv_proj.weight"]        # [(nh+2nkv)d, h]
+        wq = qkv[:nh * d].T
+        wk = qkv[nh * d:nh * d + nkv * d].T
+        wv = qkv[nh * d + nkv * d:].T
+        gu = sd[p + "mlp.gate_up_proj.weight"]           # [2*ffn, h]
+        layers.append({
+            "attn": {"wq": wq, "wk": wk, "wv": wv,
+                     "wo": sd[p + "self_attn.o_proj.weight"].T},
+            "mlp": {"wg": gu[:ffn].T, "wi": gu[ffn:].T,
+                    "wo": sd[p + "mlp.down_proj.weight"].T},
+            "ln1": {"scale": sd[p + "input_layernorm.weight"]},
+            "ln2": {"scale": sd[p + "post_attention_layernorm.weight"]},
+        })
+    out = {"embed": {"tokens": sd["model.embed_tokens.weight"]},
+           "layers": _stack(layers),
+           "final_norm": {"scale": sd["model.norm.weight"]}}
+    if not cfg.tie_embeddings:
+        out["lm_head"] = sd.get("lm_head.weight",
+                                sd["model.embed_tokens.weight"]).T
+    return out
+
+
+def _convert_qwen(sd, cfg):
+    """Qwen v1 (remote-code modeling_qwen.py layout): transformer.h.*,
+    fused biased c_attn, and the w1/w2/c_proj MLP where out =
+    c_proj(w1(x) * silu(w2(x))) — w2 is the gate, w1 the up projection."""
+    h = cfg.hidden_size
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        ca_w = sd[p + "attn.c_attn.weight"].T            # [h, 3h]
+        ca_b = sd[p + "attn.c_attn.bias"]
+        wq, wk, wv = np.split(ca_w, 3, axis=1)
+        bq, bk, bv = np.split(ca_b, 3, axis=0)
+        layers.append({
+            "attn": {"wq": wq, "wk": wk, "wv": wv,
+                     "bq": bq, "bk": bk, "bv": bv,
+                     "wo": sd[p + "attn.c_proj.weight"].T},
+            "mlp": {"wg": sd[p + "mlp.w2.weight"].T,
+                    "wi": sd[p + "mlp.w1.weight"].T,
+                    "wo": sd[p + "mlp.c_proj.weight"].T},
+            "ln1": {"scale": sd[p + "ln_1.weight"]},
+            "ln2": {"scale": sd[p + "ln_2.weight"]},
+        })
+    return {"embed": {"tokens": sd["transformer.wte.weight"]},
+            "layers": _stack(layers),
+            "final_norm": {"scale": sd["transformer.ln_f.weight"]},
+            "lm_head": sd["lm_head.weight"].T}
+
+
 def load_hf_model(name_or_model, dtype=None):
     """AutoModel / checkpoint path → (TransformerConfig, params).  The
     one-call porting path for reference users (ref build_hf_engine)."""
@@ -408,5 +499,6 @@ def load_hf_model(name_or_model, dtype=None):
 for _arch, _fn in (("gpt2", _convert_gpt2), ("llama", _convert_llama),
                    ("mistral", _convert_llama), ("qwen2", _convert_llama),
                    ("opt", _convert_opt), ("falcon", _convert_falcon),
-                   ("phi", _convert_phi)):
+                   ("phi", _convert_phi), ("phi3", _convert_phi3),
+                   ("qwen", _convert_qwen)):
     register_converter(_arch, _fn)

@@ -142,6 +142,72 @@ def test_phi_parity():
     _compare(m, zero_lm_head_bias=True)
 
 
+def test_phi3_parity():
+    from transformers import Phi3Config, Phi3ForCausalLM
+
+    torch.manual_seed(0)
+    m = Phi3ForCausalLM(Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        pad_token_id=0))
+    _compare(m)
+
+
+def test_qwen_v1_parity():
+    """Qwen v1 is a remote-code model (no transformers class), but its
+    math is Qwen2's (rmsnorm + biased-qkv + swiglu, no GQA) in a
+    different state-dict layout: fused transformer.h.*.attn.c_attn,
+    mlp.w1 (up) / w2 (gate) / c_proj, intermediate_size doubled.  Relay a
+    tiny Qwen2 checkpoint into the v1 layout and require logits parity
+    against the torch forward — this pins the converter's fused splits
+    and gate/up mapping against real numerics."""
+    from types import SimpleNamespace
+
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    torch.manual_seed(0)
+    m = Qwen2ForCausalLM(Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=64, tie_word_embeddings=False))
+    m.eval()
+    sd2 = {k: v for k, v in m.state_dict().items()}
+    sd1 = {"transformer.wte.weight": sd2["model.embed_tokens.weight"],
+           "transformer.ln_f.weight": sd2["model.norm.weight"],
+           "lm_head.weight": sd2["lm_head.weight"]}
+    for i in range(2):
+        p2, p1 = f"model.layers.{i}.", f"transformer.h.{i}."
+        sd1[p1 + "attn.c_attn.weight"] = torch.cat(
+            [sd2[p2 + "self_attn.q_proj.weight"],
+             sd2[p2 + "self_attn.k_proj.weight"],
+             sd2[p2 + "self_attn.v_proj.weight"]], dim=0)
+        sd1[p1 + "attn.c_attn.bias"] = torch.cat(
+            [sd2[p2 + "self_attn.q_proj.bias"],
+             sd2[p2 + "self_attn.k_proj.bias"],
+             sd2[p2 + "self_attn.v_proj.bias"]], dim=0)
+        sd1[p1 + "attn.c_proj.weight"] = sd2[p2 + "self_attn.o_proj.weight"]
+        sd1[p1 + "mlp.w2.weight"] = sd2[p2 + "mlp.gate_proj.weight"]
+        sd1[p1 + "mlp.w1.weight"] = sd2[p2 + "mlp.up_proj.weight"]
+        sd1[p1 + "mlp.c_proj.weight"] = sd2[p2 + "mlp.down_proj.weight"]
+        sd1[p1 + "ln_1.weight"] = sd2[p2 + "input_layernorm.weight"]
+        sd1[p1 + "ln_2.weight"] = sd2[p2 + "post_attention_layernorm.weight"]
+    hf_cfg = SimpleNamespace(model_type="qwen", vocab_size=128,
+                             hidden_size=64, intermediate_size=256,
+                             num_hidden_layers=2, num_attention_heads=4,
+                             seq_length=64, rotary_emb_base=10000.0,
+                             layer_norm_epsilon=1e-6)
+    cfg = config_from_hf(hf_cfg).replace(dtype=jnp.float32)
+    params = params_from_hf(sd1, cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 12), dtype=np.int64)
+    with torch.no_grad():
+        ref = m(torch.tensor(ids)).logits.float().numpy()
+    out = tf.forward(params, jnp.asarray(ids, jnp.int32), cfg)
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref,
+                               atol=2e-3, rtol=1e-3)
+
+
 def test_converted_model_trains():
     """End-to-end: HF GPT-2 weights → engine → loss decreases."""
     from transformers import GPT2Config, GPT2LMHeadModel
