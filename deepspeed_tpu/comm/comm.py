@@ -223,6 +223,61 @@ def axis_index(group: AxisName):
     return lax.axis_index(group)
 
 
+def send_recv(x, src: int, dst: int, group: AxisName):
+    """One p2p edge src→dst (ref pipe p2p send/recv pair,
+    runtime/pipe/p2p.py:46/67): rank ``dst`` returns rank ``src``'s value,
+    everyone else zeros.  Under SPMD the reference's rank-local
+    ``send``/``recv`` pair collapses into ONE collective permute whose
+    edge set must be static — both endpoints are parameters."""
+    _log_op("send_recv", x, group)
+    return lax.ppermute(x, group, [(src, dst)])
+
+
+def send(x, dst: int, group: AxisName, src: int = 0):
+    """Reference-parity wrapper over :func:`send_recv` (ref dist.send,
+    comm.py:369).  SPMD note: the matching receiver is part of the same
+    compiled collective, so the source rank must be named too."""
+    return send_recv(x, src, dst, group)
+
+
+def recv(x, src: int, group: AxisName, dst: Optional[int] = None):
+    """Reference-parity wrapper over :func:`send_recv` (ref dist.recv,
+    comm.py:375); ``dst`` defaults to the next rank after ``src``."""
+    if dst is None:
+        dst = (src + 1) % get_world_size(group)
+    return send_recv(x, src, dst, group)
+
+
+def reduce(x, dst: int = 0, op: str = ReduceOp.SUM,
+           group: AxisName = ZERO_AXES):
+    """Reduce-to-root (ref dist.reduce, comm.py:591).  SPMD note: the
+    reduction is an all-reduce — every rank holds the result, which is a
+    superset of the reference's root-only contract."""
+    return all_reduce(x, op=op, group=group)
+
+
+def gather(x, dst: int = 0, group: AxisName = ZERO_AXES, axis: int = 0):
+    """Gather-to-root (ref dist.gather, comm.py:393).  SPMD note: lowers
+    to all-gather — every rank holds the concatenation."""
+    return all_gather(x, group=group, axis=axis)
+
+
+def scatter(x, src: int = 0, group: AxisName = ZERO_AXES, axis: int = 0):
+    """Scatter from root (ref dist.scatter, comm.py:406): rank i takes
+    slice i of rank-``src``'s tensor along ``axis``."""
+    _log_op("scatter", x, group)
+    full = broadcast(x, src=src, group=group)
+    n = lax.axis_size(group)
+    if full.shape[axis] % n != 0:
+        raise ValueError(
+            f"scatter: axis {axis} (size {full.shape[axis]}) must divide "
+            f"evenly over the {n}-rank group (ref dist.scatter requires "
+            "equal chunks)")
+    i = lax.axis_index(group)
+    size = full.shape[axis] // n
+    return lax.dynamic_slice_in_dim(full, i * size, size, axis=axis)
+
+
 # ----------------------------------------------------------------------
 # Eager wrappers (setup / tests): run a collective on concrete arrays
 # ----------------------------------------------------------------------
@@ -247,6 +302,91 @@ def barrier(group: Optional[AxisName] = None) -> None:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("dstpu_barrier")
+
+
+def monitored_barrier(group: Optional[AxisName] = None,
+                      timeout: Optional[float] = None,
+                      wait_all_ranks: bool = False) -> None:
+    """Barrier that logs when the wait exceeds ``timeout`` seconds (ref
+    dist.monitored_barrier, comm.py:425 — there it raises on straggler
+    detection; the DCN sync here cannot attribute blame to a rank, so a
+    breach is logged with this process's identity instead)."""
+    import time as _time
+
+    t0 = _time.perf_counter()
+    barrier(group)
+    waited = _time.perf_counter() - t0
+    if timeout is not None and waited > timeout:
+        from deepspeed_tpu.utils.logging import logger
+
+        logger.warning(
+            f"monitored_barrier: process {jax.process_index()} waited "
+            f"{waited:.1f}s (> timeout {timeout:.1f}s) — straggler among "
+            f"the other {jax.process_count() - 1} process(es)")
+
+
+def broadcast_object_list(object_list: list, src: int = 0,
+                          group=None, device=None) -> None:
+    """In-place host-object broadcast across processes (ref
+    dist.broadcast_object_list, comm.py:229): every process's
+    ``object_list`` is overwritten with ``src``'s.  Rides the DCN via
+    :func:`all_gather_object` — every process must call (see its
+    transport note); with ``group`` set, ``src`` indexes within the
+    group.  Single-process runs are the identity."""
+    if jax.process_count() <= 1:
+        return
+    object_list[:] = all_gather_object(list(object_list), group=group)[src]
+
+
+def all_gather_object(obj, group=None) -> list:
+    """Gather arbitrary picklable objects from every process (ref
+    dist.all_gather_object, comm.py:247).  Pickle → padded uint8 rows →
+    process_allgather → unpickle.
+
+    TRANSPORT IS GLOBAL: every process must call (the DCN gather is a
+    whole-job collective; an in-group-only call would hang).  ``group``
+    (a :func:`new_group` rank tuple) only selects whose values are
+    returned, in group-rank order."""
+    if jax.process_count() <= 1:
+        return [obj]
+    import pickle
+
+    import numpy as _np
+    from jax.experimental import multihost_utils
+
+    payload = _np.frombuffer(pickle.dumps(obj), dtype=_np.uint8)
+    sizes = _np.asarray(multihost_utils.process_allgather(
+        _np.asarray([payload.size], _np.int32))).reshape(-1)
+    n = int(sizes.max())
+    row = _np.zeros((n,), _np.uint8)
+    row[:payload.size] = payload
+    rows = _np.asarray(multihost_utils.process_allgather(row))
+    rows = rows.reshape(jax.process_count(), n)
+    members = range(jax.process_count()) if group is None else group
+    return [pickle.loads(rows[i, :sizes[i]].tobytes()) for i in members]
+
+
+def destroy_process_group(group=None) -> None:
+    """Tear down distributed state (ref dist.destroy_process_group,
+    comm.py:177): drop the cached topology and shut down jax.distributed
+    when it was initialized."""
+    from deepspeed_tpu.parallel import topology as _topo
+
+    _topo._GLOBAL_TOPOLOGY = None
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass  # not initialized (single-process) — nothing to tear down
+
+
+def new_group(ranks):
+    """Ref dist.new_group (comm.py:182).  In-jit groups are mesh axes —
+    construct the topology with the factorization you need and pass the
+    axis name as ``group`` to the collectives.  The returned rank tuple is
+    accepted by the host-object collectives as a RESULT FILTER only:
+    their transport stays whole-job (every process must still call), and
+    ``src`` indexes within the group."""
+    return tuple(sorted(int(r) for r in ranks))
 
 
 # DeepSpeed exposes these at package level; re-export-friendly aliases.

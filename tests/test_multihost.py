@@ -50,6 +50,15 @@ WORKER = textwrap.dedent("""
     assert comm.get_rank() == rank     # host-level rank = process index
     comm.barrier()
 
+    # host-object collectives across REAL processes (ref
+    # dist.all_gather_object/broadcast_object_list, comm.py:247/:229)
+    gathered = comm.all_gather_object({"rank": rank, "tag": "x" * (rank + 1)})
+    assert gathered == [{"rank": 0, "tag": "x"}, {"rank": 1, "tag": "xx"}], gathered
+    objs = [f"from-{rank}", rank * 10]
+    comm.broadcast_object_list(objs, src=1)
+    assert objs == ["from-1", 10], objs
+    comm.monitored_barrier(timeout=60.0)
+
     model = get_model_config("gpt2-tiny")
     cfg = {
         "train_micro_batch_size_per_gpu": 2,
