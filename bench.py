@@ -237,6 +237,14 @@ _PEAK_LADDER = [
           num_heads=32, max_seq_len=512),
      {"stage": 3, "offload_param": {"device": "cpu"},
       "offload_optimizer": {"device": "cpu"}}),
+    # 6.7B needs ~120GB of remote-host RAM for the fp32 masters+moments
+    # (observed r04: compiles and streams, dies RESOURCE_EXHAUSTED at
+    # runtime) — the 4B rung fits a ~80GB host
+    ("gpt2-4b-stream", "gpt2-1.3b",
+     dict(hidden_size=3072, intermediate_size=12288, num_layers=36,
+          num_heads=24, max_seq_len=512),
+     {"stage": 3, "offload_param": {"device": "cpu"},
+      "offload_optimizer": {"device": "cpu"}}),
     ("gpt2-2.7b-stream", "gpt2-1.3b",
      dict(hidden_size=2560, intermediate_size=10240, num_layers=32,
           num_heads=32, max_seq_len=512),
@@ -302,7 +310,7 @@ def row_peak_params():
                 proc = subprocess.run(
                     [sys.executable, __file__, "--peak-entry", str(i)],
                     capture_output=True, text=True,
-                    timeout=700.0 if i == 0 else 420.0)
+                    timeout=700.0 if i == 0 else 600.0)
             except subprocess.TimeoutExpired:
                 continue
             for line in reversed(proc.stdout.strip().splitlines()):
@@ -431,7 +439,7 @@ def _run_row_subprocess(name: str, timeout_s: float = 900.0) -> dict:
             "error": ("no result line; " + " | ".join(tail[-3:]))[:300]}
 
 
-_ROW_TIMEOUTS = {"peak_params": 2100.0}
+_ROW_TIMEOUTS = {"peak_params": 3000.0}
 
 
 def main() -> None:

@@ -64,10 +64,19 @@ class RaggedInferenceEngineConfig:
                            else ep)
         # module-implementation overrides, e.g. {"attention": "paged_xla"}
         # (ref inference/v2/modules: ConfigBundle names); resolved through
-        # inference/v2/modules.py at each attention call
-        from deepspeed_tpu.inference.v2.modules import module_overrides
+        # inference/v2/modules.py at each attention call.  Validate names
+        # NOW — a typo surfacing as a KeyError inside jit tracing at the
+        # first generate() would point nowhere near the config
+        from deepspeed_tpu.inference.v2 import model as _model  # registers
+        from deepspeed_tpu.inference.v2.modules import (available,
+                                                        module_overrides)
 
         self.modules = module_overrides(d)
+        for kind, name in self.modules.items():
+            if name != "auto" and name not in available(kind):
+                raise ValueError(
+                    f"unknown {kind} implementation '{name}' "
+                    f"(available: {', '.join(available(kind)) or 'none'})")
 
 
 class InferenceEngineV2:
