@@ -55,8 +55,34 @@ _MAX_KV_ELEMS = 1 << 20  # S * D
 # KV-blocked path ceiling: bounded by the fp32 [B, H, S, 128]
 # lane-replicated lse/delta residuals in HBM, not VMEM (256K at d=128)
 _MAX_BLOCKED_ELEMS = 1 << 25  # S * D
-# q/k block edge for the blocked path (scores tile = 512×512×4 B = 1 MB)
-_BLK = 512
+# q/k block edges for the KV-blocked path (scores tile = bq×bk×4 B in
+# VMEM).  None → per-call heuristic (_choose_blocks); tools/
+# bench_flash_longseq.py sweeps explicit values on-chip.  Measured r04
+# (v5e, S=32k MHA, full fwd+bwd with dk/dv live): 1024×1024 runs 57.8
+# TF/s (d=64) / 113.4 TF/s (d=128) vs 37.4 / 72.2 for 512×512 — ~1.55×;
+# bigger tiles amortize the per-tile online-softmax state updates and
+# masking work.
+_BLK_Q = None
+_BLK_K = None
+
+
+def _choose_blocks(group: int):
+    """1024² tiles for MHA; 512² under GQA, whose grouped dkv kernel holds
+    the whole [group, bq(, 128-lane fp32 lse/delta)] q-side per program —
+    at group 4, d=128 the 1024-edge blocks overrun scoped VMEM.
+
+    Overrides: setting either _BLK_Q/_BLK_K fills the other from it.
+    Both must be powers of two — s_pad uses max(bq, bk) as the common
+    block multiple, which is only the lcm for powers of two (a 384-edge
+    override would silently leave tail query rows uncomputed)."""
+    if _BLK_Q is not None or _BLK_K is not None:
+        bq = _BLK_Q or _BLK_K
+        bk = _BLK_K or _BLK_Q
+        if (bq & (bq - 1)) or (bk & (bk - 1)):
+            raise ValueError(
+                f"_BLK_Q/_BLK_K must be powers of two, got ({bq}, {bk})")
+        return bq, bk
+    return (1024, 1024) if group == 1 else (512, 512)
 
 # Set True (tests/conftest or CI) to run the kernels through the Pallas
 # interpreter so numerics are checkable on the CPU mesh.
@@ -72,12 +98,24 @@ def _choose_bq(s_pad: int, scores_budget: int = 1 << 20) -> int:
     return 128
 
 
+# Resident-path sequence ceiling.  Measured r04 (v5e, d=64, MHA): past
+# ~2k the KV-blocked kernels overtake the one-shot-softmax resident path
+# (fwd+bwd 1.5x faster at 4k, 1.8x at 8k) — the resident bwd's grouped
+# full-sequence q-side stops paying for itself once the score matrix
+# spans many 128-row strips.  Below 2k the two are equal and resident
+# keeps the smaller launch graph.
+_RESIDENT_MAX_SEQ = 2048
+
+
 def _supports_resident(s: int, d: int) -> bool:
     """Whether the VMEM-resident strategy applies: K+V resident within
     budget AND a q-block exists whose score matrix fits (so _choose_bq's
-    fallback can never exceed the documented bound)."""
+    fallback can never exceed the documented bound) AND the sequence is
+    short enough that the one-shot softmax still beats the blocked path
+    (see _RESIDENT_MAX_SEQ)."""
     s_pad = -(-s // 128) * 128
-    return s_pad * d <= _MAX_KV_ELEMS and 128 * s_pad <= (1 << 20)
+    return (s_pad * d <= _MAX_KV_ELEMS and 128 * s_pad <= (1 << 20)
+            and s_pad <= _RESIDENT_MAX_SEQ)
 
 
 def supports(s: int, d: int) -> bool:
@@ -459,11 +497,13 @@ def _clamped_kv_index(group, causal, window=None, bq=None, bk=None):
     if causal and window is not None:
         def idx(ib, ih, iq, ik):
             lo = jnp.maximum((iq * bq - (window - 1)) // bk, 0)
-            return (ib, ih // group, jnp.clip(ik, lo, iq), 0)
+            hi = (iq * bq + bq - 1) // bk  # last k block on the diagonal
+            return (ib, ih // group, jnp.clip(ik, lo, hi), 0)
 
         return idx
     if causal:
-        return lambda ib, ih, iq, ik: (ib, ih // group, jnp.minimum(ik, iq), 0)
+        return lambda ib, ih, iq, ik: (
+            ib, ih // group, jnp.minimum(ik, (iq * bq + bq - 1) // bk), 0)
     return lambda ib, ih, iq, ik: (ib, ih // group, ik, 0)
 
 
@@ -471,8 +511,9 @@ def _fwd_blocked(q, k, v, causal, sm_scale, need_lse=True, window=None):
     b, hq, s_real, d = q.shape
     hkv = k.shape[1]
     group = hq // hkv
-    bq = bk = _BLK
-    s_pad = -(-s_real // _BLK) * _BLK
+    bq, bk = _choose_blocks(group)
+    step = max(bq, bk)  # powers of two: lcm == max
+    s_pad = -(-s_real // step) * step
     qp, kp, vp = _pad_seq(q, s_pad), _pad_seq(k, s_pad), _pad_seq(v, s_pad)
     grid = (b, hq, s_pad // bq, s_pad // bk)
 
@@ -517,8 +558,9 @@ def _bwd_blocked(q, k, v, o, lse, g, causal, sm_scale, window=None):
     b, hq, s_real, d = q.shape
     hkv = k.shape[1]
     group = hq // hkv
-    bq = bk = _BLK
-    s_pad = -(-s_real // _BLK) * _BLK
+    bq, bk = _choose_blocks(group)
+    step = max(bq, bk)
+    s_pad = -(-s_real // step) * step
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
 
     qp, kp, vp = _pad_seq(q, s_pad), _pad_seq(k, s_pad), _pad_seq(v, s_pad)
@@ -549,11 +591,12 @@ def _bwd_blocked(q, k, v, o, lse, g, causal, sm_scale, window=None):
     # window) — clamp those down to the last live one
     if causal and window is not None:
         def q_idx(ib, ihkv, ik, iq):
+            lo = (ik * bk) // bq  # first q block the diagonal touches
             hi = (ik * bk + bk - 1 + window - 1) // bq
-            return (ib, ihkv, jnp.clip(iq, ik, hi), 0)
+            return (ib, ihkv, jnp.clip(iq, lo, hi), 0)
     elif causal:
         def q_idx(ib, ihkv, ik, iq):
-            return (ib, ihkv, jnp.maximum(iq, ik), 0)
+            return (ib, ihkv, jnp.maximum(iq, (ik * bk) // bq), 0)
     else:
         def q_idx(ib, ihkv, ik, iq):
             return (ib, ihkv, iq, 0)

@@ -90,7 +90,11 @@ def test_supports_budget():
     assert fm.supports(65536, 128)          # KV-blocked long-context path
     assert fm.supports(262144, 128)
     assert not fm.supports(1 << 20, 128)
-    assert fm._supports_resident(8192, 64)
+    assert fm._supports_resident(1024, 64)
+    assert fm._supports_resident(2048, 128)
+    # past _RESIDENT_MAX_SEQ the blocked kernels are measured faster
+    # (r04 crossover study) even though 8192x64 fits the VMEM budget
+    assert not fm._supports_resident(8192, 64)
     assert not fm._supports_resident(16384, 128)
 
 
@@ -320,6 +324,65 @@ def test_sliding_window_resident_grads():
                                                         scale, window)),
                   argnums=(0, 1, 2))(q, k, v)
     for a, b_ in zip(g1, g2):
+        rel = float(jnp.linalg.norm((a - b_).ravel())
+                    / (jnp.linalg.norm(b_.ravel()) + 1e-9))
+        assert rel < 1e-4, rel
+
+
+@pytest.mark.parametrize("bq,bk", [(256, 512), (512, 256)])
+def test_blocked_asymmetric_blocks_parity(bq, bk, _force_blocked,
+                                          monkeypatch):
+    """bq != bk exercises the generalized diagonal clamps
+    (_clamped_kv_index / the dkv q-side clamp use block-unit division,
+    not equality) — fwd and grads must match the dense reference."""
+    monkeypatch.setattr(fm, "_BLK_Q", bq)
+    monkeypatch.setattr(fm, "_BLK_K", bk)
+    ks = jax.random.split(jax.random.PRNGKey(9), 3)
+    b, hq, hkv, s, d = 1, 2, 1, 1280, 64  # ragged tail vs 512-step pad
+    q = jax.random.normal(ks[0], (b, hq, s, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, hkv, s, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, hkv, s, d), jnp.float32)
+    out = fm.flash_mha(q, k, v, True)
+    ref = _ref_attn(q, k, v, True, 1.0 / np.sqrt(d))
+    assert float(jnp.max(jnp.abs(out - ref))) < 5e-5
+    w = jnp.linspace(0.0, 1.0, d)
+
+    def loss(fn):
+        return lambda q, k, v: (fn(q, k, v).astype(jnp.float32) * w).sum()
+
+    g1 = jax.grad(loss(lambda q, k, v: fm.flash_mha(q, k, v, True)),
+                  argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(lambda q, k, v: _ref_attn(q, k, v, True,
+                                                 1.0 / np.sqrt(d))),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g1, g2):
+        rel = float(jnp.linalg.norm((a - b_).ravel())
+                    / (jnp.linalg.norm(b_.ravel()) + 1e-9))
+        assert rel < 1e-4, rel
+
+
+@pytest.mark.parametrize("bq,bk", [(256, 512), (512, 256)])
+def test_blocked_asymmetric_window_parity(bq, bk, _force_blocked,
+                                          monkeypatch):
+    """Sliding window + asymmetric blocks: the window clamp's lo/hi block
+    arithmetic must not drop live tiles."""
+    monkeypatch.setattr(fm, "_BLK_Q", bq)
+    monkeypatch.setattr(fm, "_BLK_K", bk)
+    ks = jax.random.split(jax.random.PRNGKey(10), 3)
+    b, hq, hkv, s, d, window = 1, 2, 1, 1536, 64, 700
+    q = jax.random.normal(ks[0], (b, hq, s, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, hkv, s, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, hkv, s, d), jnp.float32)
+    out = fm.flash_mha(q, k, v, True, None, window)
+    ref = _ref_attn_window(q, k, v, True, 1.0 / np.sqrt(d), window)
+    assert float(jnp.max(jnp.abs(out - ref))) < 5e-5
+    g = jax.grad(lambda q, k, v: fm.flash_mha(
+        q, k, v, True, None, window).astype(jnp.float32).sum(),
+        argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: _ref_attn_window(
+        q, k, v, True, 1.0 / np.sqrt(d), window)
+        .astype(jnp.float32).sum(), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g, gr):
         rel = float(jnp.linalg.norm((a - b_).ravel())
                     / (jnp.linalg.norm(b_.ravel()) + 1e-9))
         assert rel < 1e-4, rel
