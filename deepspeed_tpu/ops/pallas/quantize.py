@@ -3,10 +3,13 @@
 Device-kernel analog of the reference's quantization kernel set
 (``csrc/quantization/quantize.cu``, ``dequantize.cu``,
 ``fake_quantizer.cu``, ``swizzled_quantize.cu`` — SURVEY §2.6).  The jnp
-path (``ops/quantizer.py``) is numerically identical and XLA usually fuses
-it into neighbours; these kernels pin the one-HBM-pass guarantee for the
-bandwidth-sensitive call sites (qwZ weight gather, qgZ gradient
-all-to-all):
+path (``ops/quantizer.py``) is numerically identical; these kernels pin
+the one-HBM-pass guarantee and measurably beat XLA's fusion of the jnp
+form — r04 on v5e (8192² bf16, in-jit scan, tools/bench_kernels.py):
+quant+dequant 2.94 ms vs 5.0 ms (138 vs 82 GB/s effective), QAT
+fake-quantize 3.3 ms vs 6.2 ms — because XLA materialises the
+absmax/scale intermediates between its loop fusions while the kernel
+keeps them in VMEM:
 
 * ``quantize``: reads the float tensor ONCE, writes int8 payload + fp32
   scales — no intermediate absmax/scale round-trip can be materialised.

@@ -24,11 +24,14 @@ ITERS = 30
 
 
 def timeit(f, *args):
+    """f must iterate ITERS times inside one jit AND return a scalar —
+    fetching any full-size array ships it through the axon tunnel and the
+    download (~25 ms per 100 MB) swamps the kernel time."""
     r = f(*args)
-    np.asarray(jax.tree_util.tree_leaves(r)[0]).ravel()[:1]
+    assert getattr(r, "ndim", 0) == 0, "bench fns must reduce to a scalar"
+    float(np.asarray(r))
     t0 = time.perf_counter()
-    r = f(*args)
-    np.asarray(jax.tree_util.tree_leaves(r)[0]).ravel()[:1]
+    float(np.asarray(f(*args)))
     return (time.perf_counter() - t0) / ITERS
 
 
@@ -58,7 +61,7 @@ def bench_adamw():
                 return (p2, s2), ()
 
             (p, s), _ = lax.scan(body, (p, s), None, length=ITERS)
-            return p
+            return sum(jnp.sum(x) for x in jax.tree.leaves(p))
 
         dt = timeit(run, grads, state, params)
         print(f"adamw/{label}: {dt*1e3:.2f} ms/step  "
@@ -84,7 +87,7 @@ def bench_quantize():
                     q, s, dtype=jnp.bfloat16, backend=backend), ()
 
             out, _ = lax.scan(body, t, None, length=ITERS)
-            return out
+            return jnp.sum(out.astype(jnp.float32))
 
         dt = timeit(roundtrip, x)
         print(f"quant+dequant/{label}: {dt*1e3:.2f} ms/iter  "
@@ -97,7 +100,7 @@ def bench_quantize():
                 return qz.fake_quantize(cur, 8, 256, backend=backend), ()
 
             out, _ = lax.scan(body, t, None, length=ITERS)
-            return out
+            return jnp.sum(out.astype(jnp.float32))
 
         dt = timeit(fq, x)
         print(f"fake_quantize/{label}: {dt*1e3:.2f} ms/iter", flush=True)
