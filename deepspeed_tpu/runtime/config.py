@@ -289,6 +289,18 @@ class MonitorBackendConfig:
 
 
 @dataclass
+class ProfilerConfig:
+    """``"profiler"`` block — windowed XPlane trace capture (the TPU
+    analog of the reference's pytorch-profiler integration; see
+    utils/trace.py).  The capture brackets train steps
+    [start_step, start_step + num_steps)."""
+    enabled: bool = False
+    output_dir: str = "./dstpu_profile"
+    start_step: int = 1
+    num_steps: int = 3
+
+
+@dataclass
 class FlopsProfilerConfig:
     enabled: bool = False
     recompute_fwd_factor: float = 0.0
@@ -457,6 +469,7 @@ class DeepSpeedConfig:
         self.csv_monitor = _from_dict(MonitorBackendConfig, d.get(C.CSV_MONITOR), "csv_monitor")
         self.comet = _from_dict(MonitorBackendConfig, d.get(C.COMET), "comet")
         self.flops_profiler = _from_dict(FlopsProfilerConfig, d.get(C.FLOPS_PROFILER), "flops_profiler")
+        self.profiler = _from_dict(ProfilerConfig, d.get(C.PROFILER), "profiler")
         self.comms_logger = _from_dict(CommsLoggerConfig, d.get(C.COMMS_LOGGER), "comms_logger")
         self.tensor_parallel = _from_dict(TensorParallelConfig, d.get(C.TENSOR_PARALLEL), "tensor_parallel")
         self.pipeline = _from_dict(PipelineConfig, d.get(C.PIPELINE), "pipeline")

@@ -89,6 +89,7 @@ WANDB = "wandb"
 CSV_MONITOR = "csv_monitor"
 COMET = "comet"
 FLOPS_PROFILER = "flops_profiler"
+PROFILER = "profiler"
 COMMS_LOGGER = "comms_logger"
 
 #############################################

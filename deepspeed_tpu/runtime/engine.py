@@ -589,6 +589,15 @@ class DeepSpeedEngine:
 
             self._flops_profiler = FlopsProfiler(cfg.flops_profiler)
 
+        # -- XPlane trace capture (ref pytorch-profiler integration) -----
+        self._trace_profiler = None
+        if cfg.profiler.enabled:
+            from deepspeed_tpu.utils.trace import TraceProfiler
+
+            self._trace_profiler = TraceProfiler(
+                cfg.profiler.output_dir, cfg.profiler.start_step,
+                cfg.profiler.num_steps)
+
         # grad accumulation buffer for the forward/backward/step trio
         self._grad_buffer = None
         self._micro_in_step = 0
@@ -1002,6 +1011,8 @@ class DeepSpeedEngine:
         leaves one speculative store read in flight (whose NVMe buffer
         stays pinned until consumed).  Ref DeepSpeedEngine.destroy."""
         self._cancel_prefetch()
+        if self._trace_profiler is not None:
+            self._trace_profiler.close()  # flush a capture cut short
         if self._swap_pool is not None:
             self._swap_pool.shutdown(wait=True)
             self._swap_pool = None
@@ -1163,6 +1174,16 @@ class DeepSpeedEngine:
     def train_batch(self, data) -> jnp.ndarray:
         """Run one full train batch (gas micro-batches + optimizer step).
         Ref: PipelineEngine.train_batch / engine forward+backward+step."""
+        if self._trace_profiler is not None:
+            step = self.global_steps + 1
+            self._trace_profiler.maybe_start(step)
+            with self._trace_profiler.step(step):
+                loss = self._train_batch_traced_body(data)
+            self._trace_profiler.maybe_stop(self.global_steps + 1)
+            return loss
+        return self._train_batch_traced_body(data)
+
+    def _train_batch_traced_body(self, data) -> jnp.ndarray:
         if self._onebit is not None:
             return self._train_batch_onebit(data)
         if self._super_opt is not None:
