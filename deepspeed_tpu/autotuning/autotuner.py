@@ -77,7 +77,14 @@ def estimate_memory_per_device(model_info: ModelInfo, zero_stage: int,
     act = (model_info.num_layers * micro_batch * seq_len
            * max(1, model_info.hidden_size) * 2 * 16
            // max(1, sp_size * tp_size))
-    return int(params_mem + grads_mem + opt_mem + act)
+    # fp32 [B, S, V] logits + their cotangent: dominates small models with
+    # big vocabs (r04 on-chip validation: the estimator passed gpt2-125m
+    # mb=64 at 11.6GB est but the 6.6GB logits buffer OOM'd the trial —
+    # AUTOTUNE_TPU.json).  Sequence-tiled loss (loss_tiles) avoids the
+    # buffer, but the tuner prices the default untiled path.
+    logits = (micro_batch * seq_len * max(1, model_info.vocab_size) * 4 * 2
+              // max(1, sp_size * tp_size))
+    return int(params_mem + grads_mem + opt_mem + act + logits)
 
 
 def enumerate_meshes(n_devices: int, model_cfg) -> "List[Dict[str, int]]":
