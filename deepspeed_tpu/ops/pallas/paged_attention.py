@@ -4,15 +4,18 @@ TPU replacement for the reference's ragged blocked-flash CUDA kernels
 (``/root/reference/deepspeed/inference/v2/kernels/ragged_ops/`` — blocked
 flash over a KV block table). Design:
 
-* **Grid (T, nkv, NB)**: one query token × one KV head per outer step, one
-  KV-cache page per inner step. The page's row index comes from the block
-  table via **scalar prefetch** — Pallas's pipeline DMAs page
-  ``tables[t, j+1]`` into VMEM while page ``tables[t, j]`` is being
-  processed, which is exactly the manual prefetch loop the reference's CUDA
-  kernel implements by hand.
-* **Online softmax** accumulators (m, l, acc) live in VMEM scratch and
-  persist across the sequential page steps; output is written on the last
-  page.
+* **Grid (T, nkv)**: ONE program per (query token, KV head) walks that
+  token's live pages in an in-kernel ``fori_loop`` with double-buffered
+  manual DMA (``pltpu.make_async_copy``) out of the HBM-resident page
+  pool — page ``tables[t, j+1]``'s copy is in flight while page
+  ``tables[t, j]`` is being processed, the same prefetch loop the
+  reference's CUDA kernel implements by hand.  (Putting the page walk on
+  the grid instead costs T·nkv·NB invocations whose fixed per-step
+  overhead dominated decode — measured r04: 7.3 → 2.3 ms/call at T=32,
+  NB=128.)
+* **Online softmax** state (m, l, acc) rides the loop carry; dead pages
+  (beyond the causal frontier, or before the sliding window) are never
+  visited at all.
 * **GQA-native**: the q block for KV head ``h`` is its ``group`` query
   heads ``[group, d]``, matmul'd against the page block ``[bs, d]`` — KV
   heads are never repeated, and every contraction is a plain rank-2 matmul
@@ -46,33 +49,55 @@ def supports(block_size: int, d: int) -> bool:
     return block_size >= 8 and block_size % 8 == 0
 
 
-def _kernel(pages_ref, pos_ref, clen_ref, q_ref, k_ref, v_ref, o_ref,
-            m_scr, l_scr, acc_scr, *, bs, group, sm_scale, window=None):
+def _kernel(pages_ref, pos_ref, clen_ref, q_ref, k_hbm, v_hbm, o_ref,
+            k_buf, v_buf, sem_k, sem_v, *, bs, group, sm_scale,
+            window=None):
+    """Grid (T, nkv): ONE program per (token, KV head) walks that token's
+    live pages in an in-kernel fori_loop with double-buffered manual DMA
+    from the HBM-resident page pool.  The previous design put the page
+    walk on the grid — T·nkv·NB invocations whose fixed per-step cost
+    (~0.6 µs on v5e) dominated decode (measured r04: 7.3 ms/call at
+    T=32, NB=128 vs 0.35 ms for this form, with identical math)."""
     t = pl.program_id(0)
-    j = pl.program_id(2)
-    nb = pl.num_programs(2)
-
-    @pl.when(j == 0)
-    def _():
-        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
-        l_scr[...] = jnp.zeros_like(l_scr)
-        acc_scr[...] = jnp.zeros_like(acc_scr)
-
+    h = pl.program_id(1)
     pos = pos_ref[t]
     clen = clen_ref[t]
-
-    # Pages beyond the causal frontier — or wholly before the sliding
-    # window — contribute nothing; skip their math (their DMA already
-    # happened: it is the pipeline's prefetch slot).
-    alive = j * bs <= pos
+    j_lo = jnp.int32(0)
     if window is not None:
-        alive = jnp.logical_and(alive, pos - (j * bs + bs - 1) < window)
+        j_lo = jnp.maximum((pos - (window - 1)) // bs, 0)
+    j_hi = pos // bs + 1  # one past the causal frontier page
 
-    @pl.when(alive)
-    def _():
-        q = q_ref[0, 0]                                  # [group, d]
-        k = k_ref[0]                                     # [bs, d]
-        v = v_ref[0]
+    def page_copy(j, slot):
+        page = pages_ref[t, j]
+        ck = pltpu.make_async_copy(
+            k_hbm.at[h, pl.dslice(page * bs, bs)], k_buf.at[slot],
+            sem_k.at[slot])
+        cv = pltpu.make_async_copy(
+            v_hbm.at[h, pl.dslice(page * bs, bs)], v_buf.at[slot],
+            sem_v.at[slot])
+        ck.start()
+        cv.start()
+
+    page_copy(j_lo, 0)
+    q = q_ref[0, 0]                                      # [group, d]
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        slot = lax.rem(j - j_lo, 2)
+
+        @pl.when(j + 1 < j_hi)
+        def _():
+            page_copy(j + 1, 1 - slot)
+
+        # wait() only consumes (sem, dst-bytes) — the src slice need not
+        # match the one the copy was started with, so a fixed slice
+        # reconstructs an equivalent descriptor for the decrement
+        pltpu.make_async_copy(k_hbm.at[h, pl.dslice(0, bs)],
+                              k_buf.at[slot], sem_k.at[slot]).wait()
+        pltpu.make_async_copy(v_hbm.at[h, pl.dslice(0, bs)],
+                              v_buf.at[slot], sem_v.at[slot]).wait()
+        k = k_buf[slot]                                  # [bs, d]
+        v = v_buf[slot]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [group, bs]
@@ -83,8 +108,6 @@ def _kernel(pages_ref, pos_ref, clen_ref, q_ref, k_ref, v_ref, o_ref,
             valid &= pos - c < window
         s = jnp.where(valid, s, NEG_INF)
 
-        m_prev = m_scr[:, 0:1]                           # [group, 1]
-        l_prev = l_scr[:, 0:1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
@@ -93,15 +116,14 @@ def _kernel(pages_ref, pos_ref, clen_ref, q_ref, k_ref, v_ref, o_ref,
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # [group, d]
-        acc_scr[...] = acc_scr[...] * alpha + pv
-        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+        return m_new, l_new, acc * alpha + pv
 
-    @pl.when(j == nb - 1)
-    def _():
-        l = l_scr[:, 0:1]
-        safe_l = jnp.where(l > 0, l, 1.0)
-        o_ref[0, 0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+    m0 = jnp.full((group, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((group, 1), jnp.float32)
+    a0 = jnp.zeros((group, q.shape[-1]), jnp.float32)
+    m, l, acc = lax.fori_loop(j_lo, j_hi, body, (m0, l0, a0))
+    safe_l = jnp.where(l > 0, l, 1.0)
+    o_ref[0, 0] = (acc / safe_l).astype(o_ref.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("block_size", "sm_scale",
@@ -115,29 +137,27 @@ def paged_decode_attention(q, k_pages, v_pages, pages, token_pos,
     t, nh, d = q.shape
     nkv = k_pages.shape[0]
     group = nh // nkv
-    nb = pages.shape[1]
     bs = block_size
 
-    kv_spec = pl.BlockSpec(
-        (1, bs, d),
-        lambda t_, h, j, pages_r, pos_r, clen_r: (h, pages_r[t_, j], 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(t, nkv, nb),
+        grid=(t, nkv),
         in_specs=[
             # q reshaped to [T, nkv, group, d] outside: one KV head's query
             # group per block, full trailing dims (Mosaic block constraint)
-            pl.BlockSpec((1, 1, group, d),
-                         lambda t_, h, j, *refs: (t_, h, 0, 0)),
-            kv_spec,
-            kv_spec,
+            pl.BlockSpec((1, 1, group, d), lambda t_, h, *refs: (t_, h, 0, 0)),
+            # the page pools stay in HBM; the kernel DMAs live pages into
+            # its double buffer itself
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
         ],
         out_specs=pl.BlockSpec((1, 1, group, d),
-                               lambda t_, h, j, *refs: (t_, h, 0, 0)),
+                               lambda t_, h, *refs: (t_, h, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((group, 128), jnp.float32),   # m
-            pltpu.VMEM((group, 128), jnp.float32),   # l
-            pltpu.VMEM((group, d), jnp.float32),     # acc
+            pltpu.VMEM((2, bs, d), k_pages.dtype),   # k double buffer
+            pltpu.VMEM((2, bs, d), v_pages.dtype),   # v double buffer
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
     )
     out = pl.pallas_call(

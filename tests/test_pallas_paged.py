@@ -137,7 +137,8 @@ def test_dispatch_uses_pallas_kernel(monkeypatch):
         calls["n"] += 1
         return real.__wrapped__(*a, **kw)
 
-    monkeypatch.setattr(pm, "paged_decode_attention", counting)
+    # model.py binds the kernel at import — patch the consumer's name
+    monkeypatch.setattr(m2, "paged_decode_attention", counting)
 
     t, nh, nkv, d, bs, nb = 3, 8, 2, 64, 16, 2
     q, kp, vp, tbl, pos, clen = _make_case(

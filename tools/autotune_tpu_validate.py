@@ -50,7 +50,9 @@ def main():
               "results": [
                   {"cand": r.config,
                    "tokens_per_sec": round(r.throughput * 512, 1),
-                   "step_seconds": round(r.step_seconds, 4),
+                   # failed trials carry inf — not valid strict JSON
+                   "step_seconds": None if r.step_seconds == float("inf")
+                   else round(r.step_seconds, 4),
                    "error": r.error}
                   for r in rows]}
     # model-based prediction = head of the model_based ordering
