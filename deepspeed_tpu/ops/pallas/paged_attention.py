@@ -148,8 +148,8 @@ def paged_decode_attention(q, k_pages, v_pages, pages, token_pos,
             pl.BlockSpec((1, 1, group, d), lambda t_, h, *refs: (t_, h, 0, 0)),
             # the page pools stay in HBM; the kernel DMAs live pages into
             # its double buffer itself
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((1, 1, group, d),
                                lambda t_, h, *refs: (t_, h, 0, 0)),
