@@ -84,6 +84,20 @@ def test_scatter_slices_root_tensor():
                                np.arange(8, dtype=np.float32))
 
 
+def test_scatter_rejects_nondivisible_axis():
+    import pytest
+
+    topo = _topo()
+    rows = jnp.ones((8, 10), jnp.float32)
+
+    def f(xs):
+        return comm.scatter(xs[0], src=0, group=DATA_AXIS)[None]
+
+    with pytest.raises(ValueError, match="divide evenly"):
+        shard_map(f, mesh=topo.mesh, in_specs=P(DATA_AXIS),
+                  out_specs=P(DATA_AXIS))(rows)
+
+
 def test_object_collectives_single_process_identity():
     objs = [{"a": 1}, "two"]
     comm.broadcast_object_list(objs, src=0)

@@ -114,3 +114,32 @@ def test_torch_autocast_selects_compute_dtype():
                           "torch_autocast": {"enabled": True,
                                              "dtype": "float16"}})
     assert c2.fp16.enabled and not c2.bf16.enabled
+
+
+def test_config_fuzz_never_crashes():
+    """Malformed-but-dict-shaped configs must produce DeepSpeedConfigError
+    or parse with warnings — never an unhandled exception (the reference's
+    pydantic layer gives the same guarantee)."""
+    import random
+
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+
+    rng = random.Random(0)
+    blocks = ["optimizer", "scheduler", "fp16", "bf16", "zero_optimization",
+              "torch_autocast", "profiler", "activation_checkpointing",
+              "flops_profiler", "pipeline", "tensor_parallel", "mesh"]
+    junk_values = [None, 0, -3, 1.5, "x", [], [1, 2], {}, {"bogus": 1},
+                   {"enabled": "yes"}, {"stage": 99}]
+    for trial in range(60):
+        cfg = {"train_micro_batch_size_per_gpu": 1}
+        for b in rng.sample(blocks, rng.randint(1, 4)):
+            cfg[b] = rng.choice(junk_values)
+        try:
+            DeepSpeedConfig(cfg)
+        except DeepSpeedConfigError:
+            pass  # typed rejection is the contract
+        except (TypeError, ValueError) as e:
+            # dataclass coercion failures are acceptable only when they
+            # carry the offending context in the message
+            assert str(e), f"silent {type(e).__name__} for {cfg}"
