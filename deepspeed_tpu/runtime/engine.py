@@ -1426,6 +1426,13 @@ class DeepSpeedEngine:
                     ("Train/Samples/train_loss", m.get("loss", 0.0), self.global_steps),
                     ("Train/Samples/lr", self.lr_scheduler(self.global_steps - 1), self.global_steps),
                 ])
+        if self.config.memory_breakdown:
+            # independent of steps_per_print (ref memory_breakdown logs
+            # around every step); deferred import so tests can patch it
+            from deepspeed_tpu.runtime import utils as _rt_utils
+
+            _rt_utils.see_memory_usage(f"after step {self.global_steps}",
+                                       force=True)
 
     def get_global_grad_norm(self) -> float:
         gn = self._last_metrics.get("grad_norm")

@@ -1,0 +1,91 @@
+"""Runtime utility surface (ref ``deepspeed/runtime/utils.py``).
+
+The reference module is 1,471 lines because eager torch needs hand-rolled
+bucketing/overflow/clip machinery; under XLA those live inside the
+compiled step (engine.py `_global_norm`/`_all_finite`/clip).  What remains
+user-facing — and what reference scripts import — is kept here with the
+same names:
+
+* :func:`see_memory_usage` (ref :815) — device HBM + host RSS snapshot.
+* :func:`get_global_norm_of_tensors` / :func:`get_global_norm`
+  (ref :878) — eager global L2 norm over a pytree/list.
+* :func:`clip_grad_norm_` (ref :359) — eager clip-by-global-norm
+  (returns the pre-clip norm like torch's).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.utils.logging import logger
+
+
+def see_memory_usage(message: str, force: bool = False) -> dict:
+    """Log device + host memory (ref see_memory_usage, runtime/utils.py:815:
+    MA/Max_MA/CA cuda stats + virtual-memory percent).  Returns the stats
+    dict so tests/tools can consume it without parsing logs."""
+    if not force and not logger.isEnabledFor(20):  # INFO
+        return {}
+    from deepspeed_tpu.accelerator import get_accelerator
+
+    acc = get_accelerator()
+    try:
+        stats = acc.memory_stats() or {}
+    except Exception:
+        stats = {}
+    used = stats.get("bytes_in_use", 0)
+    peak = stats.get("peak_bytes_in_use", stats.get("largest_alloc_size", 0))
+    limit = stats.get("bytes_limit", 0)
+    try:
+        import resource
+
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    except Exception:  # non-POSIX
+        rss = 0
+    ga = 1 << 30
+    logger.info(
+        f"{message} | device MA {used / ga:.2f} GB "
+        f"Max_MA {peak / ga:.2f} GB "
+        f"limit {limit / ga:.2f} GB | host peak RSS {rss / ga:.2f} GB")
+    return {"bytes_in_use": used, "peak_bytes_in_use": peak,
+            "bytes_limit": limit, "host_peak_rss": rss}
+
+
+def _leaves(tensors: Any) -> Iterable[jnp.ndarray]:
+    return jax.tree_util.tree_leaves(tensors)
+
+
+def get_global_norm_of_tensors(tensors: Any, norm_type: float = 2.0):
+    """Global norm over a pytree/list (ref get_global_norm_of_tensors,
+    runtime/utils.py:878).  Jit-safe."""
+    leaves = _leaves(tensors)
+    if not leaves:
+        return jnp.float32(0.0)
+    if norm_type == float("inf"):
+        return jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(x.astype(jnp.float32))) for x in leaves]))
+    acc = sum(jnp.sum(jnp.abs(x.astype(jnp.float32)) ** norm_type)
+              for x in leaves)
+    return acc ** (1.0 / norm_type)
+
+
+def get_global_norm(norm_list: Iterable[float]) -> float:
+    """sqrt of sum of squares of per-group norms (ref get_global_norm)."""
+    import math
+
+    return math.sqrt(sum(float(n) ** 2 for n in norm_list))
+
+
+def clip_grad_norm_(parameters: Any, max_norm: float,
+                    norm_type: float = 2.0):
+    """Clip a gradient pytree by global norm (ref clip_grad_norm_,
+    runtime/utils.py:359).  Returns ``(clipped_tree, pre_clip_norm)`` —
+    functional arrays cannot be mutated in place, so unlike torch the
+    clipped tree is returned rather than written through."""
+    norm = get_global_norm_of_tensors(parameters, norm_type)
+    coef = jnp.minimum(max_norm / (norm + 1e-6), 1.0)
+    clipped = jax.tree.map(lambda x: (x * coef).astype(x.dtype), parameters)
+    return clipped, norm

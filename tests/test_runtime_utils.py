@@ -1,0 +1,63 @@
+"""Runtime utility surface (ref deepspeed/runtime/utils.py):
+see_memory_usage, global norms, clip_grad_norm_, and the
+memory_breakdown engine flag."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.utils import (clip_grad_norm_, get_global_norm,
+                                         get_global_norm_of_tensors,
+                                         see_memory_usage)
+
+
+def test_see_memory_usage_returns_stats(caplog):
+    stats = see_memory_usage("unit-test", force=True)
+    assert set(stats) >= {"bytes_in_use", "peak_bytes_in_use",
+                          "host_peak_rss"}
+    assert stats["host_peak_rss"] > 0  # POSIX RSS always available here
+
+
+def test_global_norms_match_numpy():
+    tree = {"a": jnp.asarray([[3.0, 4.0]]), "b": jnp.asarray([12.0])}
+    n2 = float(get_global_norm_of_tensors(tree))
+    np.testing.assert_allclose(n2, np.sqrt(9 + 16 + 144), rtol=1e-6)
+    ninf = float(get_global_norm_of_tensors(tree, float("inf")))
+    assert ninf == 12.0
+    assert abs(get_global_norm([3.0, 4.0]) - 5.0) < 1e-12
+
+
+def test_clip_grad_norm_scales_and_reports():
+    tree = {"w": jnp.asarray([6.0, 8.0])}  # norm 10
+    clipped, pre = clip_grad_norm_(tree, max_norm=5.0)
+    np.testing.assert_allclose(float(pre), 10.0, rtol=1e-5)
+    np.testing.assert_allclose(
+        float(get_global_norm_of_tensors(clipped)), 5.0, rtol=1e-4)
+    # under the max: unchanged
+    same, pre2 = clip_grad_norm_(tree, max_norm=100.0)
+    np.testing.assert_allclose(np.asarray(same["w"]),
+                               np.asarray(tree["w"]), rtol=1e-6)
+
+
+def test_engine_memory_breakdown_calls_see_memory_usage(monkeypatch):
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import get_model_config
+    from deepspeed_tpu.parallel import topology
+    from deepspeed_tpu.runtime import utils as rt_utils
+
+    calls = []
+    monkeypatch.setattr(rt_utils, "see_memory_usage",
+                        lambda msg, force=False: calls.append((msg, force)))
+    model = get_model_config("gpt2-tiny")
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "memory_breakdown": True,
+        "steps_per_print": 1,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, model.vocab_size, size=(8, 17), dtype=np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
+    engine.train_batch(batch)
+    assert calls == [("after step 1", True)]
+    topology._GLOBAL_TOPOLOGY = None
