@@ -44,11 +44,9 @@ def main(argv=None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     # honor the parent's platform choice even when a platform plugin pinned
     # the config (env vars alone don't override a sitecustomize plugin)
-    import jax
+    from deepspeed_tpu.utils.platform import honor_jax_platforms_env
 
-    if os.environ.get("JAX_PLATFORMS"):
-        plats = os.environ["JAX_PLATFORMS"].split(",")
-        jax.config.update("jax_platforms", plats[0].strip())
+    honor_jax_platforms_env()
     with open(argv[0], "rb") as f:
         p = pickle.load(f)
     r = run_timed_trial(p["model_cfg"], p["config"], p["seq_len"], p["steps"])

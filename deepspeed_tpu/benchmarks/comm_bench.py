@@ -135,17 +135,9 @@ def main(argv=None) -> int:
     p.add_argument("--trials", type=int, default=5)
     p.add_argument("--axis", type=str, default="data")
     args = p.parse_args(argv)
-    # honor JAX_PLATFORMS before any backend touch: the axon TPU plugin
-    # pins jax_platforms via jax.config, so the env var alone is ignored
-    # — and with the tunnel down the default backend probe blocks forever
-    # (same guard as bench.py --smoke / trial_runner.main)
-    import os
+    from deepspeed_tpu.utils.platform import honor_jax_platforms_env
 
-    if os.environ.get("JAX_PLATFORMS"):
-        import jax
-
-        jax.config.update("jax_platforms",
-                          os.environ["JAX_PLATFORMS"].split(",")[0].strip())
+    honor_jax_platforms_env()
     for row in run_bench(args.sizes_mb, args.trials, args.axis):
         print(json.dumps(row))
     return 0

@@ -91,13 +91,12 @@ def report_lines() -> "list[str]":
 def main() -> int:
     # honor JAX_PLATFORMS even when a platform plugin pinned the config
     # (e.g. forced-CPU reporting on a machine whose TPU is held elsewhere)
-    if os.environ.get("JAX_PLATFORMS"):
-        try:
-            import jax
+    try:
+        from deepspeed_tpu.utils.platform import honor_jax_platforms_env
 
-            jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-        except Exception:
-            pass
+        honor_jax_platforms_env()
+    except Exception:
+        pass
     print("\n".join(report_lines()))
     return 0
 

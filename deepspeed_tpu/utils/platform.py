@@ -1,0 +1,26 @@
+"""Platform pinning helper.
+
+The axon TPU plugin pins ``jax_platforms`` via ``jax.config`` at import,
+so the ``JAX_PLATFORMS`` env var alone is silently ignored — and with the
+TPU tunnel down, any default-backend touch blocks forever.  Every CLI
+entry point that must respect the env (dstpu_bench, the autotuner trial
+runner, dstpu_report) calls this ONE helper before touching a backend;
+the full comma-separated list is passed through so JAX's fallback
+semantics (e.g. ``tpu,cpu``) keep working.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms_env() -> None:
+    """Re-pin ``jax_platforms`` from ``$JAX_PLATFORMS`` if set (no-op
+    otherwise).  Call BEFORE any backend touch."""
+    val = os.environ.get("JAX_PLATFORMS")
+    if not val:
+        return
+    import jax
+
+    jax.config.update("jax_platforms",
+                      ",".join(p.strip() for p in val.split(",") if p.strip()))
