@@ -1,7 +1,12 @@
 """deepspeed_tpu.comm — collectives façade (ref: deepspeed/comm)."""
 
-from deepspeed_tpu.comm.comm import (ReduceOp, all_gather, all_reduce, all_to_all, allgather,
+from deepspeed_tpu.comm.comm import (ReduceOp, all_gather, all_gather_object,
+                                     all_reduce, all_to_all, allgather,
                                      allreduce, axis_index, barrier, broadcast,
+                                     broadcast_object_list,
+                                     destroy_process_group, gather,
                                      get_local_rank, get_rank, get_world_size,
-                                     init_distributed, is_initialized, ppermute,
-                                     reduce_scatter)
+                                     init_distributed, is_initialized,
+                                     monitored_barrier, new_group, ppermute,
+                                     recv, reduce, reduce_scatter, scatter,
+                                     send, send_recv)
