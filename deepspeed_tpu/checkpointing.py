@@ -93,3 +93,93 @@ class CheckpointFunction:
     @staticmethod
     def apply(function, *args):
         return checkpoint(function, *args)
+
+
+# ----------------------------------------------------------------------
+# RNG state tracker (ref CudaRNGStatesTracker, activation_checkpointing/
+# checkpointing.py:124 + get_cuda_rng_tracker/model_parallel_cuda_
+# manual_seed).  The reference maintains named CUDA RNG states so
+# tensor-parallel ranks draw different dropout masks inside TP regions
+# and identical ones outside, and so recompute replays the same masks.
+# Under JAX, keys are VALUES: recompute-consistency is automatic (the
+# model threads explicit keys — see models/transformer dropout), and this
+# tracker provides the named-stream API for ported Megatron-style code.
+# ----------------------------------------------------------------------
+class _ForkedKey:
+    """A forked subkey usable BOTH as a key value (``np.asarray``/
+    ``.key``) and as the reference's context-manager idiom
+    (``with tracker.fork(): ...`` — Megatron code ported unchanged)."""
+
+    __slots__ = ("key",)
+
+    def __init__(self, key):
+        self.key = key
+
+    def __array__(self, dtype=None):
+        import numpy as _np
+
+        a = _np.asarray(self.key)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __enter__(self):
+        return self.key
+
+    def __exit__(self, *exc):
+        return False
+
+
+class RNGStatesTracker:
+    """Named jax.random key streams with fork semantics."""
+
+    def __init__(self):
+        self._states: Dict[str, Any] = {}
+
+    def reset(self) -> None:
+        self._states.clear()
+
+    def add(self, name: str, seed: int) -> None:
+        if name in self._states:
+            raise ValueError(f"rng state '{name}' already exists")
+        self._states[name] = jax.random.PRNGKey(int(seed))
+
+    def get_states(self) -> Dict[str, Any]:
+        return dict(self._states)
+
+    def set_states(self, states: Dict[str, Any]) -> None:
+        self._states = dict(states)
+
+    def fork(self, name: str = "model-parallel-rng"):
+        """Split the named stream and return a fresh subkey.
+
+        Dual-use for ported code: the reference forks inside a
+        ``with get_cuda_rng_tracker().fork():`` block, so the returned
+        object is also a no-op context manager (functionally the caller
+        passes the key — or the yielded value — to its dropout)."""
+        if name not in self._states:
+            raise KeyError(f"rng state '{name}' not added")
+        self._states[name], sub = jax.random.split(self._states[name])
+        return _ForkedKey(sub)
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    """Ref get_cuda_rng_tracker (checkpointing.py:225)."""
+    return _RNG_TRACKER
+
+
+# reference-name alias for ported code
+get_cuda_rng_tracker = get_rng_state_tracker
+
+
+def model_parallel_rng_seed(seed: int, tp_rank: int = 0) -> None:
+    """Ref model_parallel_cuda_manual_seed (checkpointing.py:235): the
+    default stream is identical across TP ranks; the model-parallel stream
+    is offset per rank so TP shards draw different dropout masks."""
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add("default", seed)
+    _RNG_TRACKER.add("model-parallel-rng", seed + 2718 + int(tp_rank))
+
+
+model_parallel_cuda_manual_seed = model_parallel_rng_seed

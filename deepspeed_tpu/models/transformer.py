@@ -105,6 +105,15 @@ class TransformerConfig:
     loss_tiles: int = 0
     # layer-scan unroll factor (XLA overlaps across unrolled iterations)
     scan_unroll: int = 1
+    # residual/embedding dropout rate (GPT-2/BERT-class training; llama
+    # pretraining leaves it 0).  Applied when the engine threads a
+    # per-step PRNG key through the batch ("dropout_key"); inference and
+    # eval paths pass no key, so dropout is identically off there.
+    # Attention-probability dropout is folded into the residual drops
+    # (the flash kernel keeps its probabilities in VMEM).  Under remat,
+    # explicit keys make the recompute bitwise-identical — the property
+    # the reference's CudaRNGStatesTracker exists to enforce.
+    dropout: float = 0.0
     # numerics
     dtype: Any = jnp.bfloat16  # compute dtype
     param_dtype: Any = jnp.float32  # master dtype
@@ -488,15 +497,26 @@ def _select_ffn(h, layer_params, cfg: TransformerConfig, layer_is_moe):
     return lax.cond(layer_is_moe, moe_branch, dense_branch, h)
 
 
+def _dropout(x, rate: float, key):
+    """Inverted dropout; identity when no key is threaded (eval/serve)."""
+    if key is None or rate <= 0.0:
+        return x
+    keep = jax.random.bernoulli(key, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
 def transformer_layer(x, layer_params, positions, cfg: TransformerConfig,
-                      layer_is_moe=False):
+                      layer_is_moe=False, dropout_key=None):
     """One pre-norm transformer block. Returns (x, moe_aux_loss).
 
     ``layer_is_moe`` may be a traced bool (layer index inside a scan): the
     MoE-vs-dense choice then lowers to ``lax.cond``, which is how the
     reference's per-layer MoE placement (PR-MoE, moe_layer_freq) maps onto a
-    uniform scan-over-layers body.
+    uniform scan-over-layers body.  ``dropout_key``: this layer's PRNG key
+    for residual dropout (None → off).
     """
+    dk = (lambda i: jax.random.fold_in(dropout_key, i)) \
+        if dropout_key is not None else (lambda i: None)
     if cfg.parallel_block:
         # Falcon/Phi residual form: shared (or, with parallel_norms, per-
         # branch) input norms feed attention and MLP in parallel (ref
@@ -505,11 +525,14 @@ def transformer_layer(x, layer_params, positions, cfg: TransformerConfig,
         n_mlp = _norm(x, layer_params["ln2"], cfg) if cfg.parallel_norms else n
         attn_out = _attn_block(n, layer_params["attn"], positions, cfg)
         y, aux = _select_ffn(n_mlp, layer_params, cfg, layer_is_moe)
-        return x + attn_out + y, aux
-    x = x + _attn_block(_norm(x, layer_params["ln1"], cfg), layer_params["attn"], positions, cfg)
+        return x + _dropout(attn_out, cfg.dropout, dk(0)) \
+            + _dropout(y, cfg.dropout, dk(1)), aux
+    attn_out = _attn_block(_norm(x, layer_params["ln1"], cfg),
+                           layer_params["attn"], positions, cfg)
+    x = x + _dropout(attn_out, cfg.dropout, dk(0))
     h = _norm(x, layer_params["ln2"], cfg)
     y, aux = _select_ffn(h, layer_params, cfg, layer_is_moe)
-    return x + y, aux
+    return x + _dropout(y, cfg.dropout, dk(1)), aux
 
 
 _REMAT_POLICIES = {
@@ -613,17 +636,25 @@ def make_pipeline_stage_fn(cfg: TransformerConfig, topo):
 
 def forward(params: Params, input_ids, cfg: TransformerConfig,
             positions=None, pld_theta=None,
-            return_hidden: bool = False, token_embeds=None) -> jnp.ndarray:
+            return_hidden: bool = False, token_embeds=None,
+            dropout_key=None) -> jnp.ndarray:
     """Token ids [B, S] → logits [B, S, V]. lax.scan over stacked layers.
     ``pld_theta``: progressive-layer-drop keep prob (traced scalar or None).
     ``return_hidden``: final-norm hidden states instead of logits (tiled
-    loss path)."""
+    loss path).  ``dropout_key``: per-step PRNG key enabling
+    ``cfg.dropout`` (None → dropout off, the eval/serve contract)."""
     b, s = input_ids.shape
     dt = cfg.dtype
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+    if dropout_key is not None and cfg.dropout > 0 and cfg.param_stream:
+        raise NotImplementedError(
+            "dropout + param streaming not supported (the streamed scan's "
+            "custom VJP does not thread per-layer keys)")
 
     x = _embed(params, input_ids, positions, cfg, token_embeds)
+    if dropout_key is not None and cfg.dropout > 0:
+        x = _dropout(x, cfg.dropout, jax.random.fold_in(dropout_key, 10_000))
 
     moe_every = max(1, cfg.moe_layer_freq)
 
@@ -644,6 +675,10 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
             raise NotImplementedError(
                 "param streaming + pipeline parallelism not supported "
                 "(the pipe axis already partitions layers pp-ways)")
+        if dropout_key is not None and cfg.dropout > 0:
+            raise NotImplementedError(
+                "dropout + pipeline parallelism not supported (stage fns "
+                "do not thread per-layer keys)")
         from deepspeed_tpu.parallel.pipeline import spmd_pipeline
 
         stage_fn = make_pipeline_stage_fn(cfg, topo)
@@ -668,8 +703,11 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
                 return x, jnp.zeros((), jnp.float32)
 
             def apply_layer(h, aux_acc, lp, layer_idx, is_moe_layer):
+                lk = jax.random.fold_in(dropout_key, layer_idx) \
+                    if dropout_key is not None and cfg.dropout > 0 else None
                 h2, aux = transformer_layer(h, lp, pos, cfg,
-                                            layer_is_moe=is_moe_layer)
+                                            layer_is_moe=is_moe_layer,
+                                            dropout_key=lk)
                 if pld_theta is not None:
                     # progressive layer drop (ref progressive_layer_drop.py
                     # + stochastic depth): deeper layers drop more; batch
@@ -924,7 +962,8 @@ def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfi
                                    denom)
     out = forward(params, batch["input_ids"], cfg,
                   pld_theta=batch.get("pld_theta"), return_hidden=bool(tiled),
-                  token_embeds=token_embeds)
+                  token_embeds=token_embeds,
+                  dropout_key=batch.get("dropout_key"))
     moe_aux = jnp.zeros((), jnp.float32)
     if isinstance(out, tuple):
         out, moe_aux = out

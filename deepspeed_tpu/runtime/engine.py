@@ -1079,8 +1079,16 @@ class DeepSpeedEngine:
         return NamedSharding(self.topology.mesh, P(*spec))
 
     def _put_batch(self, batch: Batch, stacked: bool) -> Batch:
-        return {k: jax.device_put(np.asarray(v), self._batch_sharding_for(v, stacked))
-                for k, v in batch.items()}
+        out = {}
+        for k, v in batch.items():
+            if k == "dropout_key":
+                # [gas, 2] PRNG keys: replicated (the [gas] axis is the
+                # accumulation scan, dim 1 is key data — not batch rows)
+                sh = NamedSharding(self.topology.mesh, P())
+            else:
+                sh = self._batch_sharding_for(v, stacked)
+            out[k] = jax.device_put(np.asarray(v), sh)
+        return out
 
     def _stack_micro_batches(self, data) -> Batch:
         """Accept a stacked batch dict [gas*dp*micro, ...], a dict already
@@ -1165,8 +1173,32 @@ class DeepSpeedEngine:
             return batch_stack
         theta = self.progressive_layer_drop.update_state(self.global_steps)
         gas = next(iter(batch_stack.values())).shape[0]
-        batch_stack["pld_theta"] = np.full((gas,), theta, np.float32)
-        return batch_stack
+        # copy: _stack_micro_batches can return the caller's own dict
+        return {**batch_stack,
+                "pld_theta": np.full((gas,), theta, np.float32)}
+
+    def _maybe_add_dropout_key(self, batch_stack):
+        """Attach per-micro-batch PRNG keys when the model trains with
+        dropout (cfg.dropout > 0).  Keys are data, not trace constants —
+        every step reuses the one compiled program.  Inference/eval paths
+        never thread a key, so dropout is identically off there.
+        Returns a COPY: _stack_micro_batches can hand back the caller's
+        own dict, which must not grow a dropout_key entry."""
+        mc = self.model_config
+        if mc is None or getattr(mc, "dropout", 0.0) <= 0.0:
+            return batch_stack
+        if self.topology.pp_size > 1:
+            raise DeepSpeedConfigError(
+                "dropout + pipeline parallelism is not supported (pipeline "
+                "stage fns do not thread per-layer keys)")
+        if not hasattr(self, "_dropout_base_key"):
+            self._dropout_base_key = jax.random.PRNGKey(self.seed + 7919)
+        step_key = jax.random.fold_in(self._dropout_base_key,
+                                      self.global_steps)
+        gas = next(iter(batch_stack.values())).shape[0]
+        keys = np.asarray(jax.vmap(jax.random.fold_in, (None, 0))(
+            step_key, np.arange(gas)))  # one dispatch, one fetch
+        return {**batch_stack, "dropout_key": keys}
 
     # ------------------------------------------------------------------
     # Public API (DeepSpeed parity)
@@ -1195,6 +1227,7 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).start()
         batch_stack = self._stack_micro_batches(data)
         batch_stack = self._maybe_add_pld(batch_stack)
+        batch_stack = self._maybe_add_dropout_key(batch_stack)
         batch_stack = self._put_batch(batch_stack, stacked=True)
         lr = jnp.float32(self.lr_scheduler(self.global_steps))
         profiling = (self._flops_profiler is not None
@@ -1250,6 +1283,7 @@ class DeepSpeedEngine:
         self.timers(TRAIN_BATCH_TIMER).start()
         batch_stack = self._stack_micro_batches(data)
         batch_stack = self._maybe_add_pld(batch_stack)
+        batch_stack = self._maybe_add_dropout_key(batch_stack)
         batch_stack = self._put_batch(batch_stack, stacked=True)
         lr = float(self.lr_scheduler(self.global_steps))
         gas = self.gradient_accumulation_steps_value
@@ -1342,10 +1376,12 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
         batch_stack = self._stack_micro_batches(data)
+        batch_stack = self._maybe_add_dropout_key(batch_stack)
         batch_stack = self._put_batch(batch_stack, stacked=True)
         if not self._onebit._built:
             batch_specs = {
-                k: P(*([None, BATCH_AXES] + [None] * (np.ndim(v) - 2)))
+                k: (P() if k == "dropout_key"  # replicated keys, not rows
+                    else P(*([None, BATCH_AXES] + [None] * (np.ndim(v) - 2))))
                 for k, v in batch_stack.items()}
             self._onebit.build(self.param_shardings, batch_specs)
         lr = jnp.float32(self.lr_scheduler(self.global_steps))
@@ -1369,6 +1405,19 @@ class DeepSpeedEngine:
         if self._grad_buffer is None:
             zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32), self.params)
             self._grad_buffer = jax.device_put(zeros, self.grad_shardings)
+        mc = self.model_config
+        if mc is not None and getattr(mc, "dropout", 0.0) > 0.0:
+            # trio path gets its own per-micro key (train_batch's stacked
+            # path attaches [gas, 2] keys via _maybe_add_dropout_key)
+            if self.topology.pp_size > 1:
+                raise DeepSpeedConfigError(
+                    "dropout + pipeline parallelism is not supported")
+            if not hasattr(self, "_dropout_base_key"):
+                self._dropout_base_key = jax.random.PRNGKey(self.seed + 7919)
+            k = jax.random.fold_in(
+                jax.random.fold_in(self._dropout_base_key, self.global_steps),
+                100_000 + self._micro_in_step)
+            batch = {**batch, "dropout_key": np.asarray(k)}
         batch = self._put_batch(batch, stacked=False)
         loss, self._grad_buffer = self._micro_step_jit(
             self.params, self._grad_buffer, batch, self.loss_scale_state["scale"])

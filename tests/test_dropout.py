@@ -1,0 +1,165 @@
+"""Dropout (GPT-2/BERT-class training): engine-threaded PRNG keys, off at
+eval/serve, bitwise-consistent under rematerialisation — the property the
+reference's CudaRNGStatesTracker (activation_checkpointing/
+checkpointing.py:124) exists to enforce."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import get_model_config
+from deepspeed_tpu.models import transformer as tf
+
+
+def _batch(cfg, b=2, s=32, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, cfg.vocab_size, size=(b, s + 1), dtype=np.int32)
+    return {"input_ids": jnp.asarray(ids[:, :-1]),
+            "labels": jnp.asarray(ids[:, 1:])}
+
+
+def test_dropout_changes_loss_and_is_keyed():
+    cfg = get_model_config("gpt2-tiny", dropout=0.2)
+    params = tf.init_params(cfg, jax.random.PRNGKey(0))
+    batch = _batch(cfg)
+    base = float(np.asarray(tf.loss_fn(params, batch, cfg)))
+    k1 = dict(batch, dropout_key=jax.random.PRNGKey(1))
+    k2 = dict(batch, dropout_key=jax.random.PRNGKey(2))
+    l1 = float(np.asarray(tf.loss_fn(params, k1, cfg)))
+    l1b = float(np.asarray(tf.loss_fn(params, k1, cfg)))
+    l2 = float(np.asarray(tf.loss_fn(params, k2, cfg)))
+    assert np.isfinite([base, l1, l2]).all()
+    assert l1 == l1b                       # same key → deterministic
+    assert l1 != base and l1 != l2         # dropout live, key-dependent
+
+
+def test_no_key_means_identity_even_with_rate_set():
+    cfg = get_model_config("gpt2-tiny", dropout=0.5)
+    params = tf.init_params(cfg, jax.random.PRNGKey(0))
+    batch = _batch(cfg)
+    cfg0 = cfg.replace(dropout=0.0)
+    np.testing.assert_array_equal(
+        np.asarray(tf.forward(params, batch["input_ids"], cfg)),
+        np.asarray(tf.forward(params, batch["input_ids"], cfg0)))
+
+
+def test_dropout_grads_consistent_under_remat():
+    """Explicit keys make the remat recompute replay identical masks: the
+    grads under full rematerialisation equal the no-remat grads."""
+    cfg = get_model_config("gpt2-tiny", dropout=0.3, attn_impl="xla")
+    params = tf.init_params(cfg, jax.random.PRNGKey(0))
+    batch = dict(_batch(cfg), dropout_key=jax.random.PRNGKey(5))
+
+    g_remat = jax.grad(lambda p: tf.loss_fn(
+        p, batch, cfg.replace(remat_policy="nothing_saveable")))(params)
+    g_plain = jax.grad(lambda p: tf.loss_fn(
+        p, batch, cfg.replace(remat_policy="none")))(params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        g_remat, g_plain)
+
+
+def test_engine_trains_with_dropout_and_eval_is_deterministic():
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel import topology
+
+    model = get_model_config("gpt2-tiny", dropout=0.1)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config, seed=3)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, model.vocab_size, size=(32, 33), dtype=np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
+    losses = [float(np.asarray(engine.train_batch(batch)))
+              for _ in range(5)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # training must not leak a dropout_key into the caller's batch dict
+    assert "dropout_key" not in batch
+    # eval through the model surface with the trained params: no key →
+    # dropout off → bitwise deterministic
+    e1 = np.asarray(tf.forward(engine.params, batch["input_ids"][:4],
+                               engine.model_config))
+    e2 = np.asarray(tf.forward(engine.params, batch["input_ids"][:4],
+                               engine.model_config))
+    np.testing.assert_array_equal(e1, e2)
+    topology._GLOBAL_TOPOLOGY = None
+
+
+def test_dropout_trio_forward_applies_key():
+    """The forward/backward/step trio threads a per-micro key too (the
+    r04 review caught it silently skipping dropout)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel import topology
+
+    losses = {}
+    for label, rate in (("drop", 0.5), ("nodrop", 0.0)):
+        model = get_model_config("gpt2-tiny", dropout=rate)
+        config = {
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "steps_per_print": 10_000,
+        }
+        engine, _, _, _ = ds.initialize(model=model, config=config, seed=3)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, model.vocab_size, size=(8, 33), dtype=np.int32)
+        batch = {"input_ids": ids[:, :-1],
+                 "labels": ids[:, 1:].astype(np.int32)}
+        losses[label] = float(np.asarray(engine.forward(batch)))
+        topology._GLOBAL_TOPOLOGY = None
+    # same params/seed/data: a live 0.5 dropout must move the loss
+    assert losses["drop"] != losses["nodrop"]
+
+
+def test_dropout_rejects_pipeline_parallelism():
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel import topology
+    from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+
+    model = get_model_config("gpt2-tiny", dropout=0.1)
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "mesh": {"pipe": 2, "data": 4},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, model.vocab_size, size=(4, 33), dtype=np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
+    with pytest.raises(DeepSpeedConfigError, match="pipeline"):
+        engine.train_batch(batch)
+    topology._GLOBAL_TOPOLOGY = None
+
+
+def test_rng_tracker_parity_surface():
+    """Megatron-style named RNG streams (ref CudaRNGStatesTracker)."""
+    from deepspeed_tpu.checkpointing import (get_cuda_rng_tracker,
+                                             model_parallel_rng_seed)
+
+    model_parallel_rng_seed(123, tp_rank=0)
+    t = get_cuda_rng_tracker()
+    k1 = t.fork()
+    k2 = t.fork()
+    assert not np.array_equal(np.asarray(k1), np.asarray(k2))  # advances
+    # same seed replays the same stream
+    model_parallel_rng_seed(123, tp_rank=0)
+    np.testing.assert_array_equal(np.asarray(t.fork()), np.asarray(k1))
+    # different tp rank → different model-parallel stream, same default
+    model_parallel_rng_seed(123, tp_rank=1)
+    assert not np.array_equal(np.asarray(t.fork()), np.asarray(k1))
+    st = t.get_states()
+    t.fork("default")
+    t.set_states(st)  # restore round-trip
+    # reference context-manager idiom ports unchanged
+    with t.fork() as key:
+        assert np.asarray(key).shape == (2,)
+    import pytest as _pytest
+
+    with _pytest.raises(KeyError):
+        t.fork("nope")
