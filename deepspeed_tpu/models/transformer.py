@@ -100,6 +100,10 @@ class TransformerConfig:
     ltd_kept: int = 0
     ltd_start: int = 1
     ltd_end: Optional[int] = None
+    # reference noisy gating (TopKGate noisy_gate_policy): 'RSample' |
+    # 'Jitter' | None; active only while training threads a dropout/noise
+    # key through the batch
+    moe_noisy_gate_policy: Optional[str] = None
     # sequence-tiled logits+loss (ALST, sequence/alst.py): never
     # materialises [B, S, V]; 0 = full logits
     loss_tiles: int = 0
@@ -455,7 +459,8 @@ def _mlp_block(x, p, cfg: TransformerConfig):
     return y.astype(dt0)
 
 
-def _moe_block(x, p, cfg: TransformerConfig, allow_ep: bool = True):
+def _moe_block(x, p, cfg: TransformerConfig, allow_ep: bool = True,
+               noise_key=None):
     """MoE block used inside the scan.  With an expert mesh axis of size
     > 1 the explicit shard_map + all_to_all expert-parallel path runs
     (deepspeed_tpu/moe/sharded_moe.moe_forward_ep — the reference's
@@ -471,11 +476,12 @@ def _moe_block(x, p, cfg: TransformerConfig, allow_ep: bool = True):
 
     topo = get_topology()
     if allow_ep and topo is not None and topo.ep_size > 1:
-        return moe_forward_ep(x, p, cfg, topo)
-    return moe_forward(x, p, cfg)
+        return moe_forward_ep(x, p, cfg, topo, noise_key=noise_key)
+    return moe_forward(x, p, cfg, noise_key=noise_key)
 
 
-def _select_ffn(h, layer_params, cfg: TransformerConfig, layer_is_moe):
+def _select_ffn(h, layer_params, cfg: TransformerConfig, layer_is_moe,
+                noise_key=None):
     """MoE-vs-dense FFN selection on normed input ``h`` → (y, aux).
 
     A static ``layer_is_moe`` keeps the choice out of the compiled graph
@@ -488,11 +494,12 @@ def _select_ffn(h, layer_params, cfg: TransformerConfig, layer_is_moe):
     if "moe" not in layer_params:
         return dense_branch(h)
     if isinstance(layer_is_moe, bool):
-        return (_moe_block(h, layer_params["moe"], cfg) if layer_is_moe
-                else dense_branch(h))
+        return (_moe_block(h, layer_params["moe"], cfg, noise_key=noise_key)
+                if layer_is_moe else dense_branch(h))
 
     def moe_branch(h):
-        return _moe_block(h, layer_params["moe"], cfg, allow_ep=False)
+        return _moe_block(h, layer_params["moe"], cfg, allow_ep=False,
+                          noise_key=noise_key)
 
     return lax.cond(layer_is_moe, moe_branch, dense_branch, h)
 
@@ -524,14 +531,16 @@ def transformer_layer(x, layer_params, positions, cfg: TransformerConfig,
         n = _norm(x, layer_params["ln1"], cfg)
         n_mlp = _norm(x, layer_params["ln2"], cfg) if cfg.parallel_norms else n
         attn_out = _attn_block(n, layer_params["attn"], positions, cfg)
-        y, aux = _select_ffn(n_mlp, layer_params, cfg, layer_is_moe)
+        y, aux = _select_ffn(n_mlp, layer_params, cfg, layer_is_moe,
+                             noise_key=dk(2))
         return x + _dropout(attn_out, cfg.dropout, dk(0)) \
             + _dropout(y, cfg.dropout, dk(1)), aux
     attn_out = _attn_block(_norm(x, layer_params["ln1"], cfg),
                            layer_params["attn"], positions, cfg)
     x = x + _dropout(attn_out, cfg.dropout, dk(0))
     h = _norm(x, layer_params["ln2"], cfg)
-    y, aux = _select_ffn(h, layer_params, cfg, layer_is_moe)
+    y, aux = _select_ffn(h, layer_params, cfg, layer_is_moe,
+                         noise_key=dk(2))
     return x + _dropout(y, cfg.dropout, dk(1)), aux
 
 
@@ -647,10 +656,11 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
     dt = cfg.dtype
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
-    if dropout_key is not None and cfg.dropout > 0 and cfg.param_stream:
+    if dropout_key is not None and cfg.param_stream:
         raise NotImplementedError(
-            "dropout + param streaming not supported (the streamed scan's "
-            "custom VJP does not thread per-layer keys)")
+            "dropout / noisy MoE gating + param streaming not supported "
+            "(the streamed scan's custom VJP does not thread per-layer "
+            "keys)")
 
     x = _embed(params, input_ids, positions, cfg, token_embeds)
     if dropout_key is not None and cfg.dropout > 0:
@@ -675,10 +685,10 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
             raise NotImplementedError(
                 "param streaming + pipeline parallelism not supported "
                 "(the pipe axis already partitions layers pp-ways)")
-        if dropout_key is not None and cfg.dropout > 0:
+        if dropout_key is not None:
             raise NotImplementedError(
-                "dropout + pipeline parallelism not supported (stage fns "
-                "do not thread per-layer keys)")
+                "dropout / noisy MoE gating + pipeline parallelism not "
+                "supported (stage fns do not thread per-layer keys)")
         from deepspeed_tpu.parallel.pipeline import spmd_pipeline
 
         stage_fn = make_pipeline_stage_fn(cfg, topo)
@@ -703,8 +713,11 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
                 return x, jnp.zeros((), jnp.float32)
 
             def apply_layer(h, aux_acc, lp, layer_idx, is_moe_layer):
+                # keys serve dropout AND noisy MoE gating — thread whenever
+                # one is present (each consumer no-ops when its rate/policy
+                # is off)
                 lk = jax.random.fold_in(dropout_key, layer_idx) \
-                    if dropout_key is not None and cfg.dropout > 0 else None
+                    if dropout_key is not None else None
                 h2, aux = transformer_layer(h, lp, pos, cfg,
                                             layer_is_moe=is_moe_layer,
                                             dropout_key=lk)

@@ -26,7 +26,7 @@ formulations, one capacity/FCFS semantics:
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -49,20 +49,25 @@ def _capacity(num_tokens: int, num_experts: int, capacity_factor: float, k: int,
 
 
 def top_k_gating(logits: jnp.ndarray, k: int, capacity_factor: float,
-                 min_capacity: int = 4, norm_topk: bool = False
+                 min_capacity: int = 4, norm_topk: bool = False,
+                 select_logits: Optional[jnp.ndarray] = None
                  ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Top-k gating with capacity. ``logits``: [T, E] (fp32).
 
     Returns (l_aux, combine_weights [T, E, C], dispatch_mask [T, E, C]).
     Implements the same load-balancing auxiliary loss as the reference
     (mean(token-fraction-per-expert · router-prob-per-expert) · E).
+    ``select_logits``: when given (RSample noisy gating), expert CHOICE
+    uses these noisy logits while gate values and the aux loss stay on
+    the clean ``logits`` — the reference's split (sharded_moe.py:202).
     """
     t, e = logits.shape
     c = _capacity(t, e, capacity_factor, k, min_capacity)
     probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
 
     # Iteratively pick top-k experts per token (static k, unrolled).
-    masked = probs
+    masked = jax.nn.softmax(select_logits, axis=-1) \
+        if select_logits is not None else probs
     combine = jnp.zeros((t, e, c), dtype=logits.dtype)
     dispatch = jnp.zeros((t, e, c), dtype=bool)
     # occupancy[e] tracked via cumsum of one-hot selections across tokens
@@ -102,7 +107,8 @@ def top_k_gating(logits: jnp.ndarray, k: int, capacity_factor: float,
 
 
 def top_k_gating_sorted(logits: jnp.ndarray, k: int, capacity_factor: float,
-                        min_capacity: int = 4, norm_topk: bool = False):
+                        min_capacity: int = 4, norm_topk: bool = False,
+                        select_logits: Optional[jnp.ndarray] = None):
     """Sort-based top-k gating: no [T, E, C] one-hot.
 
     Returns (l_aux, slot [T·k] int32 in [0, E·C] with E·C = dropped,
@@ -116,7 +122,12 @@ def top_k_gating_sorted(logits: jnp.ndarray, k: int, capacity_factor: float,
     c = _capacity(t, e, capacity_factor, k, min_capacity)
     probs = jax.nn.softmax(logits, axis=-1)
 
-    top_p, top_i = jax.lax.top_k(probs, k)           # [T, k]
+    if select_logits is not None:
+        # RSample: choose experts by the noisy logits, keep clean gates
+        _, top_i = jax.lax.top_k(select_logits, k)   # [T, k]
+        top_p = jnp.take_along_axis(probs, top_i, axis=-1)
+    else:
+        top_p, top_i = jax.lax.top_k(probs, k)       # [T, k]
     # aux loss from the first-choice assignment, via scatter-add counts
     # (no [T, E] one-hot)
     counts0 = jnp.zeros((e,), probs.dtype).at[top_i[:, 0]].add(1.0)
@@ -173,11 +184,12 @@ def _resolve_dispatch(cfg, t: int, e: int, c: int) -> str:
     return mode
 
 
-def _dispatch_combine_einsum(tokens, logits, cfg, dt):
+def _dispatch_combine_einsum(tokens, logits, cfg, dt, select_logits=None):
     """Einsum formulation: returns (dispatched [E,C,H], combine_fn, aux)."""
     l_aux, combine, dispatch = top_k_gating(
         logits, cfg.top_k, cfg.capacity_factor,
-        norm_topk=getattr(cfg, "moe_norm_topk", False))
+        norm_topk=getattr(cfg, "moe_norm_topk", False),
+        select_logits=select_logits)
     dispatched = jnp.einsum("tec,th->ech", dispatch.astype(dt), tokens)
 
     def combine_fn(expert_out):
@@ -186,14 +198,15 @@ def _dispatch_combine_einsum(tokens, logits, cfg, dt):
     return dispatched, combine_fn, l_aux
 
 
-def _dispatch_combine_sorted(tokens, logits, cfg, dt):
+def _dispatch_combine_sorted(tokens, logits, cfg, dt, select_logits=None):
     """Sort formulation: gather into [E,C,H] and its transpose for combine."""
     t, h = tokens.shape
     e = logits.shape[1]
     k = cfg.top_k
     l_aux, slot, gate, c = top_k_gating_sorted(
         logits, k, cfg.capacity_factor,
-        norm_topk=getattr(cfg, "moe_norm_topk", False))
+        norm_topk=getattr(cfg, "moe_norm_topk", False),
+        select_logits=select_logits)
     token_of = jnp.tile(jnp.arange(t, dtype=jnp.int32), k)     # choice-major
     # slot → source token (E·C+1 wide so the trash slot can't clip-corrupt;
     # empty slots keep the out-of-range sentinel t, gathered as zeros below)
@@ -215,7 +228,33 @@ _DISPATCHERS = {"einsum": _dispatch_combine_einsum,
                 "sorted": _dispatch_combine_sorted}
 
 
-def moe_forward(x: jnp.ndarray, p: Dict[str, jnp.ndarray], cfg) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def _validate_noisy_policy(cfg) -> Optional[str]:
+    """Reference noisy_gate_policy (sharded_moe.py:193-202) — one
+    validation point for every gating path."""
+    policy = getattr(cfg, "moe_noisy_gate_policy", None)
+    if policy not in (None, "RSample", "Jitter"):
+        raise ValueError(f"noisy_gate_policy={policy!r}: expected "
+                         "'RSample', 'Jitter', or None")
+    return policy
+
+
+def _jitter_tokens(tokens, key):
+    """'Jitter': multiply the ROUTER's input by uniform(1±1e-2); experts
+    still see the clean tokens."""
+    eps = 1e-2
+    jit = jax.random.uniform(key, tokens.shape,
+                             minval=1.0 - eps, maxval=1.0 + eps)
+    return tokens * jit.astype(tokens.dtype)
+
+
+def _rsample_logits(logits, key):
+    """'RSample': gumbel-noised logits for expert CHOICE only (gates and
+    the aux loss stay on the clean logits)."""
+    return logits + jax.random.gumbel(key, logits.shape)
+
+
+def moe_forward(x: jnp.ndarray, p: Dict[str, jnp.ndarray], cfg,
+                noise_key=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """MoE FFN over [B, S, H] activations (single expert group / no manual
     expert axis — expert weights may still be auto-sharded by the mesh).
 
@@ -231,11 +270,17 @@ def moe_forward(x: jnp.ndarray, p: Dict[str, jnp.ndarray], cfg) -> Tuple[jnp.nda
     from deepspeed_tpu.models.transformer import op_fp32
 
     rt = jnp.float32 if op_fp32(cfg, "router") else dt
-    logits = (tokens.astype(rt) @ p["router"].astype(rt)).astype(jnp.float32)
+    policy = _validate_noisy_policy(cfg)
+    gate_in = _jitter_tokens(tokens, noise_key) \
+        if noise_key is not None and policy == "Jitter" else tokens
+    logits = (gate_in.astype(rt) @ p["router"].astype(rt)).astype(jnp.float32)
+    select = _rsample_logits(logits, noise_key) \
+        if noise_key is not None and policy == "RSample" else None
     t, e = logits.shape
     c = _capacity(t, e, cfg.capacity_factor, cfg.top_k)
     mode = _resolve_dispatch(cfg, t, e, c)
-    dispatched, combine_fn, l_aux = _DISPATCHERS[mode](tokens, logits, cfg, dt)
+    dispatched, combine_fn, l_aux = _DISPATCHERS[mode](tokens, logits, cfg,
+                                                       dt, select)
     expert_out = _expert_ffn(dispatched, p, dt)
     out = combine_fn(expert_out)
     out = out + _shared_expert_out(tokens, p, dt)
@@ -261,7 +306,7 @@ def _shared_expert_out(tokens: jnp.ndarray, p: Dict[str, jnp.ndarray], dt):
 
 
 def moe_forward_ep(x: jnp.ndarray, p: Dict[str, jnp.ndarray], cfg,
-                   topo=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                   topo=None, noise_key=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Expert-parallel MoE with explicit all-to-all over the "expert" mesh
     axis (manual shard_map axis; data/tensor/seq stay automatic).
 
@@ -288,16 +333,24 @@ def moe_forward_ep(x: jnp.ndarray, p: Dict[str, jnp.ndarray], cfg,
     def body(xs, ps):
         bl = xs.shape[0]
         tokens = xs.reshape(bl * s, h)
+        # per-shard decorrelated noise key (tokens differ per shard)
+        nk = jax.random.fold_in(noise_key, lax.axis_index(EXPERT_AXIS)) \
+            if noise_key is not None else None
+        policy = _validate_noisy_policy(cfg)
+        gate_in = _jitter_tokens(tokens, nk) \
+            if nk is not None and policy == "Jitter" else tokens
         # fp32 router matmul: routing precision, and the replicated router's
         # backward psum must not be bf16 (XLA CPU's AllReducePromotion
         # aborts on the bf16 all-reduce that shard_map's transpose of a
         # replicated input otherwise emits)
-        logits = tokens.astype(jnp.float32) @ ps["router"].astype(jnp.float32)
+        logits = gate_in.astype(jnp.float32) @ ps["router"].astype(jnp.float32)
+        select = _rsample_logits(logits, nk) \
+            if nk is not None and policy == "RSample" else None
         t, e = logits.shape
         c = _capacity(t, e, cfg.capacity_factor, cfg.top_k)
         mode = _resolve_dispatch(cfg, t, e, c)
         dispatched, combine_fn, l_aux = _DISPATCHERS[mode](tokens, logits,
-                                                           cfg, dt)
+                                                           cfg, dt, select)
         # [E, C_loc, H] → [E/ep, ep·C_loc, H]: shard i keeps experts
         # [i·E/ep, (i+1)·E/ep) and receives their queues from every peer
         dispatched = lax.all_to_all(dispatched, EXPERT_AXIS, split_axis=0,

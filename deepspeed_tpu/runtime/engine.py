@@ -1178,19 +1178,24 @@ class DeepSpeedEngine:
                 "pld_theta": np.full((gas,), theta, np.float32)}
 
     def _maybe_add_dropout_key(self, batch_stack):
-        """Attach per-micro-batch PRNG keys when the model trains with
-        dropout (cfg.dropout > 0).  Keys are data, not trace constants —
+        """Attach per-micro-batch PRNG keys when the model needs training
+        randomness (cfg.dropout > 0 or a noisy MoE gate policy).  Keys
+        are data, not trace constants —
         every step reuses the one compiled program.  Inference/eval paths
         never thread a key, so dropout is identically off there.
         Returns a COPY: _stack_micro_batches can hand back the caller's
         own dict, which must not grow a dropout_key entry."""
         mc = self.model_config
-        if mc is None or getattr(mc, "dropout", 0.0) <= 0.0:
+        needs_key = mc is not None and (
+            getattr(mc, "dropout", 0.0) > 0.0
+            or getattr(mc, "moe_noisy_gate_policy", None))
+        if not needs_key:
             return batch_stack
         if self.topology.pp_size > 1:
             raise DeepSpeedConfigError(
-                "dropout + pipeline parallelism is not supported (pipeline "
-                "stage fns do not thread per-layer keys)")
+                "dropout / noisy MoE gating + pipeline parallelism is not "
+                "supported (pipeline stage fns do not thread per-layer "
+                "keys)")
         if not hasattr(self, "_dropout_base_key"):
             self._dropout_base_key = jax.random.PRNGKey(self.seed + 7919)
         step_key = jax.random.fold_in(self._dropout_base_key,
@@ -1406,12 +1411,14 @@ class DeepSpeedEngine:
             zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32), self.params)
             self._grad_buffer = jax.device_put(zeros, self.grad_shardings)
         mc = self.model_config
-        if mc is not None and getattr(mc, "dropout", 0.0) > 0.0:
+        if mc is not None and (getattr(mc, "dropout", 0.0) > 0.0
+                               or getattr(mc, "moe_noisy_gate_policy", None)):
             # trio path gets its own per-micro key (train_batch's stacked
             # path attaches [gas, 2] keys via _maybe_add_dropout_key)
             if self.topology.pp_size > 1:
                 raise DeepSpeedConfigError(
-                    "dropout + pipeline parallelism is not supported")
+                    "dropout / noisy MoE gating + pipeline parallelism "
+                    "is not supported")
             if not hasattr(self, "_dropout_base_key"):
                 self._dropout_base_key = jax.random.PRNGKey(self.seed + 7919)
             k = jax.random.fold_in(

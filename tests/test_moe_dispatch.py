@@ -124,6 +124,17 @@ def test_ep_path_matches_single_group(mode):
         # aux: per-shard mean of local stats vs global stats — equal when
         # shards see identical token counts and the router is shared
         assert np.isfinite(float(aux_ep))
+        # noisy gating through the EP shard_map (per-shard fold_in key):
+        # compiles, deterministic per key, finite
+        cfg.moe_noisy_gate_policy = "RSample"
+        nk = jax.random.PRNGKey(11)
+        n1, _ = jax.jit(lambda x, p: sm.moe_forward_ep(
+            x, p, cfg, topo, noise_key=nk))(x, p)
+        n2, _ = jax.jit(lambda x, p: sm.moe_forward_ep(
+            x, p, cfg, topo, noise_key=nk))(x, p)
+        np.testing.assert_array_equal(np.asarray(n1), np.asarray(n2))
+        assert np.isfinite(np.asarray(n1)).all()
+        cfg.moe_noisy_gate_policy = None
     finally:
         set_topology(None)
 
@@ -187,3 +198,81 @@ def test_ep_path_grads_finite():
             assert float(jnp.abs(v).sum()) > 0, kk
     finally:
         set_topology(None)
+
+
+@pytest.mark.parametrize("dispatch", ["einsum", "sorted"])
+def test_noisy_gate_policies(dispatch):
+    """Reference noisy_gate_policy (sharded_moe.py:193-202): RSample
+    perturbs expert CHOICE only (gates from clean probs), Jitter perturbs
+    the router input; both require a threaded key and are exact no-ops
+    without one (eval determinism).  Covers both dispatch formulations'
+    select_logits branches."""
+    from deepspeed_tpu.moe.sharded_moe import moe_forward
+
+    class NCfg(Cfg):
+        def __init__(self, policy, **kw):
+            super().__init__(**kw)
+            self.moe_noisy_gate_policy = policy
+
+    key = jax.random.PRNGKey(0)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, 8), jnp.float32)
+    p = _params(jax.random.PRNGKey(2), e=4, h=8, f=16)
+
+    base, _ = moe_forward(x, p, NCfg(None, moe_dispatch=dispatch))
+    for policy in ("RSample", "Jitter"):
+        cfg = NCfg(policy, moe_dispatch=dispatch)
+        # no key → identical to the clean path even with the policy set
+        off, _ = moe_forward(x, p, cfg)
+        np.testing.assert_array_equal(np.asarray(off), np.asarray(base))
+        # keyed → deterministic per key, different across keys, finite
+        n1, _ = moe_forward(x, p, cfg, noise_key=key)
+        n1b, _ = moe_forward(x, p, cfg, noise_key=key)
+        n2, _ = moe_forward(x, p, cfg, noise_key=jax.random.PRNGKey(9))
+        np.testing.assert_array_equal(np.asarray(n1), np.asarray(n1b))
+        assert np.isfinite(np.asarray(n1)).all()
+        assert not np.array_equal(np.asarray(n1), np.asarray(n2))
+
+    with pytest.raises(ValueError, match="noisy_gate_policy"):
+        moe_forward(x, p, NCfg("bogus"), noise_key=key)
+
+
+def test_rsample_einsum_sorted_agree():
+    """Both dispatch formulations make the SAME noisy choices from the
+    same select logits (shared gumbel key) and combine identically."""
+    from deepspeed_tpu.moe.sharded_moe import moe_forward
+
+    class NCfg(Cfg):
+        def __init__(self, policy, **kw):
+            super().__init__(**kw)
+            self.moe_noisy_gate_policy = policy
+
+    key = jax.random.PRNGKey(6)
+    x = jax.random.normal(jax.random.PRNGKey(7), (2, 16, 8), jnp.float32)
+    p = _params(jax.random.PRNGKey(8), e=4, h=8, f=16)
+    a, _ = moe_forward(x, p, NCfg("RSample", moe_dispatch="einsum"),
+                       noise_key=key)
+    b, _ = moe_forward(x, p, NCfg("RSample", moe_dispatch="sorted"),
+                       noise_key=key)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_rsample_keeps_clean_gate_values():
+    """RSample changes which experts are chosen, never the probability
+    mass used as combine weights: every nonzero combine weight must equal
+    the clean softmax prob of that (token, expert) pair."""
+    from deepspeed_tpu.moe.sharded_moe import top_k_gating
+
+    logits = jax.random.normal(jax.random.PRNGKey(3), (32, 8), jnp.float32)
+    noisy = logits + jax.random.gumbel(jax.random.PRNGKey(4), logits.shape)
+    _, combine, dispatch = top_k_gating(logits, k=1, capacity_factor=4.0,
+                                        select_logits=noisy)
+    probs = np.asarray(jax.nn.softmax(logits, axis=-1))
+    comb = np.asarray(combine).sum(axis=2)  # [T, E]
+    nz = comb > 0
+    t_idx, e_idx = np.nonzero(nz)
+    np.testing.assert_allclose(comb[nz], probs[t_idx, e_idx], rtol=1e-5)
+    # and the choices really differ from the clean argmax somewhere
+    clean_choice = probs.argmax(-1)
+    noisy_choice = np.asarray(noisy).argmax(-1)
+    assert (clean_choice != noisy_choice).any()
