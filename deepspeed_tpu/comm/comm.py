@@ -331,10 +331,19 @@ def broadcast_object_list(object_list: list, src: int = 0,
     dist.broadcast_object_list, comm.py:229): every process's
     ``object_list`` is overwritten with ``src``'s.  Rides the DCN via
     :func:`all_gather_object` — every process must call (see its
-    transport note); with ``group`` set, ``src`` indexes within the
-    group.  Single-process runs are the identity."""
+    transport note).  ``src`` is a GLOBAL rank, matching the reference:
+    with ``group`` set it must be a member of the group and is mapped to
+    its position in the group's rank tuple.  Single-process runs are the
+    identity."""
     if jax.process_count() <= 1:
         return
+    if group is not None:
+        ranks = tuple(group)
+        if src not in ranks:
+            raise ValueError(
+                f"broadcast_object_list: src={src} is a global rank and is "
+                f"not a member of group {ranks}")
+        src = ranks.index(src)
     object_list[:] = all_gather_object(list(object_list), group=group)[src]
 
 

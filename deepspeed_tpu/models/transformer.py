@@ -864,10 +864,13 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
     x = _norm(x, params["final_norm"], cfg)
     if return_hidden:
         return (x, moe_aux) if cfg.is_moe else x
+    # honor the autocast safe-module list for the output head: an unlisted
+    # lm_head is promoted to fp32 like any other module class.
+    ht = _module_dtype(cfg, "lm_head", dt)
     if cfg.tie_embeddings:
-        logits = x @ params["embed"]["tokens"].astype(dt).T
+        logits = x.astype(ht) @ params["embed"]["tokens"].astype(ht).T
     else:
-        logits = x @ params["lm_head"].astype(dt)
+        logits = x.astype(ht) @ params["lm_head"].astype(ht)
     if cfg.is_moe:
         # stash aux loss on the fwd for the engine loss fn via closure return
         return logits, moe_aux
@@ -914,8 +917,9 @@ def _pipeline_1f1b_loss(params, batch, cfg: TransformerConfig, topo,
 
     def tail_fn(tp, h, labels_mb):
         h = _norm(h, tp["final_norm"], cfg)
-        w = tp["w"].astype(dt)
-        logits = h @ (w.T if cfg.tie_embeddings else w)
+        ht = _module_dtype(cfg, "lm_head", dt)
+        w = tp["w"].astype(ht)
+        logits = h.astype(ht) @ (w.T if cfg.tie_embeddings else w)
         lt = jnp.float32 if op_fp32(cfg, "loss") else logits.dtype
         return _nll_sum(logits.astype(lt), labels_mb)
 

@@ -298,10 +298,20 @@ class DeepSpeedEngine:
         if optimizer is not None:
             self.optimizer = optimizer
         else:
+            # sharded when ZeRO partitions opt state (stage≥1), any param
+            # sharding is non-replicated (tensor parallel), or the update
+            # runs host-streamed — in all of these the pallas_fused kernel
+            # path must be downgraded (see build_optimizer).
+            any_sharded = any(
+                any(ax is not None for ax in getattr(sh, "spec", P()))
+                for sh in jax.tree.leaves(self.param_shardings))
+            sharded = (self.zero_stage >= 1 or any_sharded
+                       or bool(self._param_stream))
             if cfg.optimizer is not None:
-                self.optimizer = build_optimizer(cfg.optimizer.type, cfg.optimizer.params)
+                self.optimizer = build_optimizer(cfg.optimizer.type, cfg.optimizer.params,
+                                                 sharded_params=sharded)
             else:
-                self.optimizer = build_optimizer("adamw", {})
+                self.optimizer = build_optimizer("adamw", {}, sharded_params=sharded)
         self.base_lr = (cfg.optimizer.lr if cfg.optimizer else 1e-3)
 
         params_treedef = jax.tree_util.tree_structure(params_shape)

@@ -86,6 +86,13 @@ def _fused_leaf_ok(p) -> bool:
 
     if not fo.supports(p.shape):
         return False
+    # fp32 leaves only: the kernels declare fp32 out_shape for m/v and
+    # alias them onto the optax-initialized mu/nu (whose dtype follows
+    # params) — a non-fp32 leaf would fail the alias at trace time, and
+    # letting the jnp fallback silently flip state dtype would break the
+    # "checkpoints interchangeable with the optax chain" contract.
+    if p.dtype != jnp.float32:
+        return False
     if fo.INTERPRET:
         return True
     return jax.default_backend() not in ("cpu",)
@@ -114,13 +121,23 @@ def _fused_adam(params_cfg: Dict[str, Any], adam_w_mode: bool) -> Optimizer:
     tx = optax.chain(*txs)
 
     def _jnp_leaf(p, g, m, v, lr, t):
-        g = g.astype(jnp.float32)
+        # every intermediate stays in the STATE dtype, exactly as the
+        # optax chain computes (scale_by_adam accumulates moments in
+        # mu/nu's native dtype; weak-typed python scalars don't promote)
+        # — so fp32 leaves are bit-identical to the chain and non-fp32
+        # leaves follow the same trajectory with a stable state dtype,
+        # keeping checkpoints interchangeable between the two paths.
+        md = m.dtype
+        g = g.astype(md)
         m = b1 * m + (1.0 - b1) * g
         v = b2 * v + (1.0 - b2) * g * g
-        u = (m / (1.0 - b1 ** t)) / (jnp.sqrt(v / (1.0 - b2 ** t)) + eps)
+        mh = m / (1.0 - b1 ** t).astype(md)
+        vh = v / (1.0 - b2 ** t).astype(md)
+        u = mh / (jnp.sqrt(vh) + eps)
         if wd:
-            u = u + wd * p.astype(jnp.float32)
-        return (p - lr * u).astype(p.dtype), m, v
+            u = u + wd * p.astype(md)
+        step = (-lr * u).astype(md)
+        return (p + step).astype(p.dtype), m, v
 
     def update_fn(grads, state, params, lr):
         adam_state = state[0]  # chain state: (ScaleByAdamState, [EmptyState])
@@ -160,11 +177,15 @@ def _fused_lion(params_cfg: Dict[str, Any]) -> Optimizer:
     tx = optax.chain(*txs)
 
     def _jnp_leaf(p, g, m, lr):
-        g = g.astype(jnp.float32)
+        # state-dtype math mirroring the optax chain (see the AdamW
+        # fallback's note) so the two paths stay interchangeable.
+        md = m.dtype
+        g = g.astype(md)
         u = jnp.sign(b1 * m + (1.0 - b1) * g)
         if wd:
-            u = u + wd * p.astype(jnp.float32)
-        return (p - lr * u).astype(p.dtype), b2 * m + (1.0 - b2) * g
+            u = u + wd * p.astype(md)
+        step = (-lr * u).astype(md)
+        return (p + step).astype(p.dtype), b2 * m + (1.0 - b2) * g
 
     def update_fn(grads, state, params, lr):
         lion_state = state[0]
@@ -237,11 +258,26 @@ def _muon(params_cfg: Dict[str, Any]) -> Optimizer:
     return build_muon(params_cfg)
 
 
-def build_optimizer(opt_type: str, params_cfg: Optional[Dict[str, Any]] = None) -> Optimizer:
+def build_optimizer(opt_type: str, params_cfg: Optional[Dict[str, Any]] = None,
+                    *, sharded_params: bool = False) -> Optimizer:
+    """``sharded_params=True`` means the caller will run ``update`` on
+    GSPMD-partitioned params/state (ZeRO≥1, tensor-parallel, or the
+    host-streamed path).  A ``pallas_call`` does not partition under
+    GSPMD — XLA would replicate p/g/m/v per leaf (all-gathers inside the
+    step), defeating ZeRO — so ``pallas_fused`` is downgraded to the
+    optax chain there (same numerics, partitionable)."""
     params_cfg = dict(params_cfg or {})
     params_cfg.pop("lr", None)  # lr flows through update_fn
     t = opt_type.lower()
     pallas_fused = bool(params_cfg.pop("pallas_fused", False))
+    if pallas_fused and sharded_params:
+        logger.warning(
+            "pallas_fused requested with sharded params/optimizer state: "
+            "a pallas_call is unpartitionable under GSPMD, so the fused "
+            "kernel would force per-leaf replication (all-gathers inside "
+            "the step). Downgrading to the optax chain (identical "
+            "numerics, GSPMD-partitionable).")
+        pallas_fused = False
     if t in (C.ADAM_OPTIMIZER, C.FUSED_ADAM_OPTIMIZER):
         adam_w_mode = bool(params_cfg.pop("adam_w_mode", True))
         if pallas_fused and adam_w_mode:

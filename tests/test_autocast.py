@@ -73,6 +73,28 @@ def test_safe_modules_promote_unlisted_to_fp32():
     assert dts == {"bfloat16"}
 
 
+def test_lm_head_promotion_honored():
+    """Omitting lm_head from the safe list promotes the logits matmul to
+    fp32 (the documented 'unlisted modules are promoted' contract); listing
+    it keeps the low dtype.  Covers both tied and untied heads."""
+    for tie in (True, False):
+        cfg = get_model_config("gpt2-tiny", attn_impl="xla").replace(
+            dtype=jnp.bfloat16, tie_embeddings=tie)
+        params = tf.init_params(cfg, jax.random.PRNGKey(0))
+        ids = jnp.zeros((1, 8), jnp.int32)
+
+        promoted = cfg.replace(
+            autocast_safe_modules=("attn", "mlp", "embed"))
+        dts = _matmul_dtypes(
+            lambda p: tf.forward(p, ids, promoted), params)
+        assert "float32" in dts, (tie, dts)
+
+        listed = cfg.replace(
+            autocast_safe_modules=("attn", "mlp", "embed", "lm_head"))
+        dts = _matmul_dtypes(lambda p: tf.forward(p, ids, listed), params)
+        assert dts == {"bfloat16"}, (tie, dts)
+
+
 def test_config_block_reaches_model():
     import deepspeed_tpu as ds
     from deepspeed_tpu.parallel import topology

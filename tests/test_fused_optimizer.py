@@ -90,6 +90,43 @@ def test_fused_adamw_checkpoint_interchange():
                                    rtol=2e-6, atol=2e-7)
 
 
+def test_sharded_params_downgrades_pallas_fused():
+    """With sharded params/opt-state the fused kernel path is refused at
+    build time (a pallas_call is unpartitionable under GSPMD — it would
+    replicate p/g/m/v per leaf, defeating ZeRO partitioning)."""
+    opt = build_optimizer("adamw", {"pallas_fused": True},
+                          sharded_params=True)
+    assert opt.name == "adamw"  # not fused_adamw
+    opt = build_optimizer("lion", {"pallas_fused": True},
+                          sharded_params=True)
+    assert opt.name == "lion"
+
+
+@pytest.mark.parametrize("opt", ["adamw", "lion"])
+def test_fused_state_dtype_stable_nonfp32(opt):
+    """Non-fp32 leaves never hit the Pallas kernel (its fp32 out_shape
+    aliases onto the param-dtype mu/nu); the jnp fallback computes in the
+    state dtype like the optax chain, so values track the optax path and
+    the state dtype stays stable — checkpoints stay interchangeable."""
+    cfg = {"weight_decay": 0.01}
+    fused = build_optimizer(opt, dict(cfg, pallas_fused=True))
+    ref = build_optimizer(opt, dict(cfg))
+    to_bf16 = lambda t: jax.tree.map(lambda x: x.astype(jnp.bfloat16), t)
+    p_f, p_r = to_bf16(_tree()), to_bf16(_tree())
+    s_f, s_r = fused.init(p_f), ref.init(p_r)
+    for step in range(3):
+        g = to_bf16(_grads(step))
+        p_f, s_f = fused.update(g, s_f, p_f, 1e-3)
+        p_r, s_r = ref.update(g, s_r, p_r, 1e-3)
+    for leaf in jax.tree.leaves(s_f[0].mu) + jax.tree.leaves(p_f):
+        assert leaf.dtype == jnp.bfloat16, leaf.dtype
+    # trajectory parity with the optax chain in bf16 (loose tolerance:
+    # associativity of the fused expression differs slightly)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a, np.float32), np.asarray(b, np.float32),
+        rtol=0.05, atol=1e-3), p_f, p_r)
+
+
 def test_engine_trains_with_pallas_fused_zero1():
     """Under ZeRO-1 (sharded optimizer state on the 8-device mesh) the
     fused path's per-leaf routing must fall back to the jnp math (a

@@ -57,6 +57,16 @@ WORKER = textwrap.dedent("""
     objs = [f"from-{rank}", rank * 10]
     comm.broadcast_object_list(objs, src=1)
     assert objs == ["from-1", 10], objs
+    # src is a GLOBAL rank (reference semantics): with the reversed group
+    # (1, 0), src=1 must still pick process 1's payload, not index 1.
+    objs = [f"from-{rank}"]
+    comm.broadcast_object_list(objs, src=1, group=(1, 0))
+    assert objs == ["from-1"], objs
+    try:
+        comm.broadcast_object_list([0], src=5, group=(1, 0))
+        raise AssertionError("src outside group must raise")
+    except ValueError:
+        pass
     comm.monitored_barrier(timeout=60.0)
 
     model = get_model_config("gpt2-tiny")
