@@ -394,7 +394,8 @@ def new_group(ranks):
     axis name as ``group`` to the collectives.  The returned rank tuple is
     accepted by the host-object collectives as a RESULT FILTER only:
     their transport stays whole-job (every process must still call), and
-    ``src`` indexes within the group."""
+    ``src`` arguments are GLOBAL ranks that must be group members
+    (reference semantics — see :func:`broadcast_object_list`)."""
     return tuple(sorted(int(r) for r in ranks))
 
 

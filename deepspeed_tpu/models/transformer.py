@@ -611,36 +611,70 @@ def make_pipeline_stage_fn(cfg: TransformerConfig, topo):
             f"divisible by moe_layer_freq ({f}) so expert placement is "
             "static per stage")
 
-    def stage_fn(stage_params, h, pos_mb):
+    def stage_fn(stage_params, h, extras_mb):
+        # extras carry (positions, per-microbatch dropout key rows) when
+        # training threads randomness — the key rides the same per-
+        # microbatch slicing as positions, so the 1F1B backward tick
+        # replays the identical mask (remat-bit-exact, like the dense
+        # path's keyed dropout).  Bare positions = no randomness.
+        pos_mb, keys_mb = (extras_mb if isinstance(extras_mb, tuple)
+                           else (extras_mb, None))
+        mb_key = keys_mb[0] if keys_mb is not None else None
+        from deepspeed_tpu.parallel.topology import PIPE_AXIS
+        stage0 = lax.axis_index(PIPE_AXIS) * lp_count
+
+        def layer_key(li):
+            # fold the GLOBAL layer index so stages draw distinct masks,
+            # mirroring the dense path's fold_in(key, layer_idx)
+            return jax.random.fold_in(mb_key, stage0 + li) \
+                if mb_key is not None else None
+
         zero = jnp.zeros((), jnp.float32)
         if f > 1:
             steps = lp_count // f
 
-            def body(carry, glp):
+            def body(carry, xs):
                 h, aux_acc = carry
+                glp, g = xs
                 for j in range(f):
                     lp = jax.tree.map(lambda p, j=j: p[j], glp)
                     h, aux = transformer_layer(h, lp, pos_mb, cfg,
-                                               layer_is_moe=(j == f - 1))
+                                               layer_is_moe=(j == f - 1),
+                                               dropout_key=layer_key(g * f + j))
                     aux_acc = aux_acc + aux
                 return (h, aux_acc), None
 
             body = _maybe_remat(body, cfg)
             grouped = jax.tree.map(
                 lambda p: p.reshape((steps, f) + p.shape[1:]), stage_params)
-            (h, aux), _ = lax.scan(body, (h, zero), grouped)
+            (h, aux), _ = lax.scan(body, (h, zero),
+                                   (grouped, jnp.arange(steps)))
         else:
-            def body(carry, lp):
+            def body(carry, xs):
                 h, aux_acc = carry
+                lp, li = xs
                 h, aux = transformer_layer(h, lp, pos_mb, cfg,
-                                           layer_is_moe=cfg.is_moe)
+                                           layer_is_moe=cfg.is_moe,
+                                           dropout_key=layer_key(li))
                 return (h, aux_acc + aux), None
 
             body = _maybe_remat(body, cfg)
-            (h, aux), _ = lax.scan(body, (h, zero), stage_params)
+            (h, aux), _ = lax.scan(body, (h, zero),
+                                   (stage_params, jnp.arange(lp_count)))
         return h, aux
 
     return stage_fn
+
+
+def _pipeline_key_rows(dropout_key, b: int, n_micro: int):
+    """Expand a per-step PRNG key into per-example rows [B, 2] where every
+    row of microbatch ``m`` holds ``fold_in(step_key, m)`` — the shape the
+    pipeline's per-microbatch extras slicing expects (row 0 of a microbatch
+    slice is its key)."""
+    mb = b // n_micro
+    mb_keys = jax.vmap(lambda m: jax.random.fold_in(dropout_key, m))(
+        jnp.arange(n_micro))
+    return jnp.repeat(mb_keys, mb, axis=0)
 
 
 def forward(params: Params, input_ids, cfg: TransformerConfig,
@@ -685,16 +719,17 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
             raise NotImplementedError(
                 "param streaming + pipeline parallelism not supported "
                 "(the pipe axis already partitions layers pp-ways)")
-        if dropout_key is not None:
-            raise NotImplementedError(
-                "dropout / noisy MoE gating + pipeline parallelism not "
-                "supported (stage fns do not thread per-layer keys)")
         from deepspeed_tpu.parallel.pipeline import spmd_pipeline
 
         stage_fn = make_pipeline_stage_fn(cfg, topo)
         n_micro = cfg.pipeline_microbatches or topo.pp_size
+        extras = positions
+        if dropout_key is not None:
+            # per-microbatch keys ride the extras so every stage/layer/
+            # microbatch draws a distinct, replay-stable mask
+            extras = (positions, _pipeline_key_rows(dropout_key, b, n_micro))
         x, moe_aux = spmd_pipeline(stage_fn, params["layers"], x, topo=topo,
-                                   n_micro=n_micro, extras=positions)
+                                   n_micro=n_micro, extras=extras)
     else:
         def scan_segment(x, pos, layers_slice, idx0, n_layers):
             """Scan a contiguous slice of the stacked layers.
@@ -923,22 +958,33 @@ def _pipeline_1f1b_loss(params, batch, cfg: TransformerConfig, topo,
         lt = jnp.float32 if op_fp32(cfg, "loss") else logits.dtype
         return _nll_sum(logits.astype(lt), labels_mb)
 
-    def embed_fn(ep, ids_mb, pos_mb):
+    def embed_fn(ep, ids_mb, extras_mb):
         # runs inside the pipelined region: stage 0 embeds per microbatch
         # and its backward folds the input cotangent straight into these
         # tables (no O(batch) dx stash — see make_pipeline_train_loss)
-        return _embed(ep, ids_mb, pos_mb, cfg)
+        pos_mb, keys_mb = (extras_mb if isinstance(extras_mb, tuple)
+                           else (extras_mb, None))
+        x = _embed(ep, ids_mb, pos_mb, cfg)
+        if keys_mb is not None and cfg.dropout > 0:
+            # embedding dropout, keyed per microbatch (dense path uses
+            # fold_in(step_key, 10_000) — same sentinel here)
+            x = _dropout(x, cfg.dropout,
+                         jax.random.fold_in(keys_mb[0], 10_000))
+        return x
 
     tail_params = {"final_norm": params["final_norm"],
                    "w": params["embed"]["tokens"] if cfg.tie_embeddings
                    else params["lm_head"]}
     stage_fn = make_pipeline_stage_fn(cfg, topo)
     n_micro = cfg.pipeline_microbatches or topo.pp_size
+    dropout_key = batch.get("dropout_key")
+    extras = positions if dropout_key is None else (
+        positions, _pipeline_key_rows(dropout_key, b, n_micro))
     f = make_pipeline_train_loss(
         stage_fn, tail_fn, topo, n_micro,
         aux_coef=MOE_AUX_COEF if cfg.is_moe else 0.0, embed_fn=embed_fn)
     return f(params["layers"], tail_params, {"embed": params["embed"]},
-             input_ids, labels_eff, positions, denom)
+             input_ids, labels_eff, extras, denom)
 
 
 def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfig,

@@ -485,7 +485,17 @@ def make_pipeline_train_loss(stage_fn: Callable, tail_fn: Callable,
             return stage_fn(sp, h, ex)
 
         if embed_fn is not None:
-            x = embed_fn(embed_params, x, extras)
+            # embed per microbatch (vmapped), exactly as _run's stage-0
+            # ticks do — so extras that carry per-microbatch state (e.g.
+            # dropout key rows, whose row 0 per slice is that microbatch's
+            # key) draw the same masks on this loss-only path as on the
+            # differentiated 1F1B path
+            b = x.shape[0]
+            mb = b // n_micro
+            resh = lambda a: a.reshape((n_micro, mb) + a.shape[1:])
+            x_mb = jax.vmap(embed_fn, in_axes=(None, 0, 0))(
+                embed_params, resh(x), jax.tree.map(resh, extras))
+            x = x_mb.reshape((b,) + x_mb.shape[2:])
         h, aux = spmd_pipeline(wrap, stage_params, x, topo=topo,
                                n_micro=n_micro, extras=extras)
         return tail_fn(tail_params, h, labels) / denom + aux_coef * aux

@@ -1201,11 +1201,6 @@ class DeepSpeedEngine:
             or getattr(mc, "moe_noisy_gate_policy", None))
         if not needs_key:
             return batch_stack
-        if self.topology.pp_size > 1:
-            raise DeepSpeedConfigError(
-                "dropout / noisy MoE gating + pipeline parallelism is not "
-                "supported (pipeline stage fns do not thread per-layer "
-                "keys)")
         if not hasattr(self, "_dropout_base_key"):
             self._dropout_base_key = jax.random.PRNGKey(self.seed + 7919)
         step_key = jax.random.fold_in(self._dropout_base_key,
@@ -1425,10 +1420,6 @@ class DeepSpeedEngine:
                                or getattr(mc, "moe_noisy_gate_policy", None)):
             # trio path gets its own per-micro key (train_batch's stacked
             # path attaches [gas, 2] keys via _maybe_add_dropout_key)
-            if self.topology.pp_size > 1:
-                raise DeepSpeedConfigError(
-                    "dropout / noisy MoE gating + pipeline parallelism "
-                    "is not supported")
             if not hasattr(self, "_dropout_base_key"):
                 self._dropout_base_key = jax.random.PRNGKey(self.seed + 7919)
             k = jax.random.fold_in(

@@ -46,3 +46,37 @@ def test_zero3_dropout_noisy_moe_autocast_composition(tmp_path):
     # the continued step bit-for-bit
     assert resumed == cont, (resumed, cont)
     topology._GLOBAL_TOPOLOGY = None
+
+
+def test_pipeline_dropout_clip_schedule_composition(tmp_path):
+    """pipe=2 × data=4 with dropout + gradient clipping + LR schedule +
+    checkpoint resume: the 1F1B keyed-dropout path composing with the
+    rest of the training stack (ref: every GPT-2 pipeline run trains with
+    dropout, runtime/pipe/engine.py:337)."""
+    model = get_model_config("gpt2-tiny", dropout=0.1)
+    config = {
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 1e-3, "weight_decay": 0.01}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 4}},
+        "mesh": {"pipe": 2, "data": 4},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config, seed=11)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, model.vocab_size, size=(32, 33), dtype=np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
+    losses = [float(np.asarray(engine.train_batch(batch)))
+              for _ in range(6)]
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0] - 0.5, losses
+
+    engine.save_checkpoint(str(tmp_path), tag="pp")
+    cont = float(np.asarray(engine.train_batch(batch)))
+    engine.load_checkpoint(str(tmp_path), tag="pp")
+    resumed = float(np.asarray(engine.train_batch(batch)))
+    assert resumed == cont, (resumed, cont)
+    topology._GLOBAL_TOPOLOGY = None
