@@ -76,6 +76,10 @@ class TransformerConfig:
     # True: renormalize top-k weights to sum to 1 (HF mixtral
     # norm_topk_prob); False: deepspeed top2gating drop-aware scaling
     moe_norm_topk: bool = False
+    # Residual MoE (PR-MoE, ref moe/layer.py:29 use_residual /
+    # arXiv:2201.05596): a dense expert-shaped MLP runs every token and a
+    # learned 2-way coefficient softmax mixes it with the routed output
+    moe_use_residual: bool = False
     # "auto" | "einsum" | "sorted": [T,E,C] one-hot einsum dispatch vs
     # argsort-by-expert gather dispatch (auto switches on one-hot size)
     moe_dispatch: str = "auto"
@@ -228,7 +232,7 @@ def init_layer_params(cfg: TransformerConfig, key) -> Params:
         # Expert weights stacked on a leading expert axis (sharded over the
         # "expert" mesh axis); router is replicated. Ref: moe/experts.py +
         # sharded_moe.py TopKGate.
-        ek = jax.random.split(keys[7], 8)
+        ek = jax.random.split(keys[7], 12)
         e = cfg.num_experts
         mffn = cfg.moe_intermediate_size or ffn
         block["moe"] = {
@@ -237,6 +241,18 @@ def init_layer_params(cfg: TransformerConfig, key) -> Params:
             "wg": _dense_init(ek[2], (e, h, mffn), scale, pd) if cfg.activation == "swiglu" else None,
             "wo": _dense_init(ek[3], (e, mffn, h), out_scale, pd),
         }
+        if cfg.moe_use_residual:
+            # PR-MoE (ref moe/layer.py:83-86): the residual branch is an
+            # expert-shaped dense MLP plus a Linear(h, 2) mixing head
+            block["moe"]["residual"] = {
+                k: v for k, v in {
+                    "wi": _dense_init(ek[8], (h, mffn), scale, pd),
+                    "wg": _dense_init(ek[9], (h, mffn), scale, pd)
+                    if cfg.activation == "swiglu" else None,
+                    "wo": _dense_init(ek[10], (mffn, h), out_scale, pd),
+                }.items() if v is not None}
+            block["moe"]["coef_w"] = _dense_init(ek[11], (h, 2), scale, pd)
+            block["moe"]["coef_b"] = jnp.zeros((2,), pd)
         if cfg.moe_shared_expert_size:
             sf = cfg.moe_shared_expert_size
             block["moe"]["shared"] = {

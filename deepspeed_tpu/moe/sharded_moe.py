@@ -283,8 +283,32 @@ def moe_forward(x: jnp.ndarray, p: Dict[str, jnp.ndarray], cfg,
                                                        dt, select)
     expert_out = _expert_ffn(dispatched, p, dt)
     out = combine_fn(expert_out)
+    out = _residual_mix(tokens, out, p, dt)
     out = out + _shared_expert_out(tokens, p, dt)
     return out.reshape(b, s, h), l_aux.astype(jnp.float32)
+
+
+def _residual_mix(tokens: jnp.ndarray, routed: jnp.ndarray,
+                  p: Dict[str, jnp.ndarray], dt):
+    """Residual MoE (PR-MoE, ref moe/layer.py:124-135 use_residual /
+    arXiv:2201.05596): a dense expert-shaped MLP runs every token and
+    ``softmax(x @ coef)`` mixes it with the routed output —
+    ``routed·c₀ + mlp·c₁``.  Identity when params carry no 'residual'."""
+    if "residual" not in p:
+        return routed
+    rp = p["residual"]
+    if "wg" in rp:
+        hdn = jax.nn.silu(tokens @ rp["wg"].astype(dt)) \
+            * (tokens @ rp["wi"].astype(dt))
+    else:
+        hdn = jax.nn.gelu(tokens @ rp["wi"].astype(dt), approximate=True)
+    mlp_out = hdn @ rp["wo"].astype(dt)
+    # the 2-way mixing head is tiny and decision-like — fp32, as with the
+    # router/shared gates
+    coef = jax.nn.softmax(
+        tokens.astype(jnp.float32) @ p["coef_w"].astype(jnp.float32)
+        + p["coef_b"].astype(jnp.float32), axis=-1).astype(dt)
+    return routed * coef[:, 0:1] + mlp_out * coef[:, 1:2]
 
 
 def _shared_expert_out(tokens: jnp.ndarray, p: Dict[str, jnp.ndarray], dt):
@@ -367,7 +391,8 @@ def moe_forward_ep(x: jnp.ndarray, p: Dict[str, jnp.ndarray], cfg,
     # the router is replicated.  The shared expert (dense, every token) is
     # computed outside the manual region under the auto partitioner.
     routed_p = {k: v for k, v in p.items()
-                if k not in ("shared", "shared_gate")}
+                if k not in ("shared", "shared_gate", "residual",
+                             "coef_w", "coef_b")}
     p_specs = {key: P(EXPERT_AXIS) if key != "router" else P()
                for key in routed_p}
     # inside another shard_map (e.g. the pipeline's manual "pipe" axis) the
@@ -380,6 +405,11 @@ def moe_forward_ep(x: jnp.ndarray, p: Dict[str, jnp.ndarray], cfg,
         in_specs=(P(EXPERT_AXIS), p_specs),
         out_specs=(P(EXPERT_AXIS), P()))
     out, l_aux = mapped(x, routed_p)
+    # dense-per-token branches (PR-MoE residual mix, qwen2-moe shared
+    # expert) run outside the manual region under the auto partitioner
+    if "residual" in p:
+        out = _residual_mix(x.reshape(b * s, h), out.reshape(b * s, h), p,
+                            dt).reshape(x.shape)
     if "shared" in p:
         out = out + _shared_expert_out(x.reshape(b * s, h), p,
                                        dt).reshape(x.shape)

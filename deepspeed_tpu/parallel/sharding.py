@@ -65,6 +65,11 @@ DEFAULT_RULES: List[Tuple[str, Tuple[Optional[str], ...]]] = [
     (r"moe/shared/w[ig]$", ("layer", "embed", "mlp")),
     (r"moe/shared/wo$", ("layer", "mlp", "embed")),
     (r"moe/shared_gate$", ("layer", "embed", None)),
+    # PR-MoE residual branch (ref moe/layer.py:83): dense FFN + Linear(h,2)
+    (r"moe/residual/w[ig]$", ("layer", "embed", "mlp")),
+    (r"moe/residual/wo$", ("layer", "mlp", "embed")),
+    (r"moe/coef_w$", ("layer", "embed", None)),
+    (r"moe/coef_b$", ("layer", None)),
     (r"ln\d/(scale|bias)$", ("layer", "norm")),
     (r"final_norm/(scale|bias)$", ("norm",)),
     (r"lm_head$", ("embed", "vocab")),
