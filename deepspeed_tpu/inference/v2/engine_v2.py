@@ -51,6 +51,26 @@ class RaggedInferenceEngineConfig:
         self.num_blocks = int(self.memory_config.get("num_blocks", 512))
         self.block_size = int(self.memory_config.get("block_size", 16))
         self.max_context = int(d.get("max_context", 2048))
+        # Compile-time guard: the paged decode kernel's per-token page loop
+        # is ceil(max_context / block_size) long, and Mosaic compile time
+        # grows sharply with it — observed >880 s at 512 blocks/seq on v5e
+        # (r04, block_size=64 at 32k context) where a user would assume a
+        # hang.  A config error beats a silent 15-minute compile; opt in
+        # with {"allow_slow_compile": true} if the one-off compile is
+        # acceptable (executions are cached afterwards).
+        blocks_per_seq = -(-self.max_context // self.block_size)
+        if blocks_per_seq > 256 and not bool(d.get("allow_slow_compile")):
+            raise ValueError(
+                f"max_context={self.max_context} / block_size="
+                f"{self.block_size} = {blocks_per_seq} blocks per sequence: "
+                "TPU compile time grows sharply past ~256 (observed >880 s "
+                "at 512 on v5e). Raise memory_config.block_size, lower "
+                "max_context, or set allow_slow_compile=true to proceed.")
+        if blocks_per_seq > 128:
+            log_dist(
+                f"inference v2: {blocks_per_seq} KV blocks per sequence — "
+                "first-compile time on TPU may reach minutes; larger "
+                "memory_config.block_size compiles faster", level="warning")
         # longest fused multi-step decode dispatch (one host round-trip
         # runs up to this many steps on device); latency-sensitive hosts
         # raise it to amortize dispatch overhead.  Rounded down to a power

@@ -54,12 +54,18 @@ def _process_index() -> int:
         return 0
 
 
-def log_dist(message: str, ranks=None, level: int = logging.INFO) -> None:
+def log_dist(message: str, ranks=None, level=logging.INFO) -> None:
     """Log ``message`` only on the given process ranks (default: rank 0).
 
     ``ranks=[-1]`` logs on every process. Mirrors the reference ``log_dist``
-    (deepspeed/utils/logging.py) with process-index semantics.
+    (deepspeed/utils/logging.py) with process-index semantics.  ``level``
+    may be an int or a level name ("warning").
     """
+    if isinstance(level, str):
+        resolved = logging.getLevelName(level.upper())
+        if not isinstance(resolved, int):
+            raise ValueError(f"unknown log level name {level!r}")
+        level = resolved
     ranks = ranks or [0]
     my_rank = _process_index()
     if my_rank in ranks or -1 in ranks:

@@ -225,3 +225,24 @@ def test_soak_staggered_eos_and_sampling_allocator_clean():
     from deepspeed_tpu.parallel import topology
 
     topology._GLOBAL_TOPOLOGY = None
+
+
+def test_compile_time_guard_for_small_block_sizes():
+    """ceil(max_context/block_size) > 256 is a multi-minute TPU compile
+    (observed >880 s at 512 blocks/seq on v5e, r04) — the engine refuses
+    it up front unless allow_slow_compile opts in; >128 warns only."""
+    import pytest
+
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        RaggedInferenceEngineConfig)
+
+    with pytest.raises(ValueError, match="blocks per sequence"):
+        RaggedInferenceEngineConfig({
+            "max_context": 32768, "memory_config": {"block_size": 64}})
+    cfg = RaggedInferenceEngineConfig({
+        "max_context": 32768, "memory_config": {"block_size": 64},
+        "allow_slow_compile": True})
+    assert cfg.block_size == 64
+    # the default operating point (2048 / 16 = 128) stays silent
+    cfg = RaggedInferenceEngineConfig({})
+    assert -(-cfg.max_context // cfg.block_size) == 128
