@@ -65,8 +65,17 @@ class InferenceEngine:
         log_dist(f"InferenceEngine: tp={self.cfg.tp_size} dtype={dt.__name__}")
 
     # ------------------------------------------------------------------
-    def forward(self, input_ids) -> jnp.ndarray:
-        out = tf_model.forward(self.params, jnp.asarray(input_ids), self.model_config)
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None) -> jnp.ndarray:
+        """Full-sequence logits.  ``token_type_ids``/``attention_mask``
+        serve the encoder (bert/distilbert fill-mask/classify) families —
+        ref v1 injection bert containers."""
+        out = tf_model.forward(
+            self.params, jnp.asarray(input_ids), self.model_config,
+            token_type_ids=None if token_type_ids is None
+            else jnp.asarray(token_type_ids),
+            attention_mask=None if attention_mask is None
+            else jnp.asarray(attention_mask))
         return out[0] if isinstance(out, tuple) else out
 
     __call__ = forward
@@ -79,6 +88,11 @@ class InferenceEngine:
         decode loop samples the rest (shares inference/v2's model path; ref
         inference/engine.py:40 generate + FastGen KV semantics).  Greedy
         when temperature == 0."""
+        if not self.model_config.causal:
+            raise ValueError(
+                "generate() requires a causal (decoder) model; "
+                f"{self.model_config.arch} is a bidirectional encoder — "
+                "use forward() for fill-mask/classification logits")
         if self._kv_gen is None:
             from deepspeed_tpu.inference.kv_generate import KVCachedGenerator
 

@@ -9,9 +9,11 @@ the stacked functional param tree, after which every subsystem (engine,
 AutoTP, ZeRO, inference v1/v2) consumes the model like any other.
 
 Supported families: gpt2, llama, mistral, qwen, qwen2, mixtral, qwen2_moe,
-opt, falcon, phi, phi3 — the same set as the reference's v2 model implementations
-(MoE included); :func:`register_converter` adds new families without
-touching this module (the analog of the v2 registry).
+opt, falcon, phi, phi3 — the same set as the reference's v2 model
+implementations (MoE included) — plus the encoder family bert/distilbert
+(ref v1 injection containers module_inject/containers/{bert,distil_bert}.py);
+:func:`register_converter` adds new families without touching this module
+(the analog of the v2 registry).
 
 Conventions handled per family:
 * HF ``nn.Linear`` stores [out, in] → transposed to our [in, out];
@@ -157,6 +159,42 @@ def config_from_hf(hf_config) -> TransformerConfig:
             use_bias=bool(getattr(hf_config, "bias", False)),
             tie_embeddings=True,
             layernorm_eps=getattr(hf_config, "layer_norm_epsilon", 1e-5))
+    if mt in ("bert", "distilbert"):
+        # map HF activation names onto the functional vocabulary ("gelu"
+        # in HF BERT is the exact erf form; gelu_new/_tanh are the tanh
+        # approximation the decoder families use)
+        act_name = str(getattr(hf_config, "hidden_act", None)
+                       or getattr(hf_config, "activation", "gelu"))
+        act_table = {"gelu": "gelu_exact", "gelu_new": "gelu",
+                     "gelu_pytorch_tanh": "gelu", "relu": "relu"}
+        if act_name not in act_table:
+            raise ValueError(f"{mt}: unsupported hidden_act {act_name!r} "
+                             f"(supported: {sorted(act_table)})")
+        enc_kw = dict(
+            arch=mt, norm="layernorm", activation=act_table[act_name],
+            causal=False, norm_position="post", embed_norm=True,
+            mlm_head=True, tie_embeddings=True)
+        if mt == "bert":
+            return TransformerConfig(
+                vocab_size=hf_config.vocab_size,
+                hidden_size=hf_config.hidden_size,
+                intermediate_size=hf_config.intermediate_size,
+                num_layers=hf_config.num_hidden_layers,
+                num_heads=hf_config.num_attention_heads,
+                max_seq_len=hf_config.max_position_embeddings,
+                type_vocab_size=getattr(hf_config, "type_vocab_size", 2),
+                dropout=getattr(hf_config, "hidden_dropout_prob", 0.1),
+                layernorm_eps=getattr(hf_config, "layer_norm_eps", 1e-12),
+                **enc_kw)
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.dim,
+            intermediate_size=hf_config.hidden_dim,
+            num_layers=hf_config.n_layers,
+            num_heads=hf_config.n_heads,
+            max_seq_len=hf_config.max_position_embeddings,
+            dropout=getattr(hf_config, "dropout", 0.1),
+            layernorm_eps=1e-12, **enc_kw)
     if mt == "phi":
         return TransformerConfig(
             vocab_size=hf_config.vocab_size,
@@ -483,13 +521,115 @@ def _convert_qwen(sd, cfg):
             "lm_head": sd["lm_head.weight"].T}
 
 
+def _convert_bert(sd, cfg):
+    """HF BertForMaskedLM → functional tree (ref v1 injection
+    module_inject/containers/bert.py; post-LN handled by norm_position)."""
+    h = cfg.hidden_size
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"bert.encoder.layer.{i}."
+        layers.append({
+            "attn": {"wq": sd[p + "attention.self.query.weight"].T,
+                     "bq": sd[p + "attention.self.query.bias"],
+                     "wk": sd[p + "attention.self.key.weight"].T,
+                     "bk": sd[p + "attention.self.key.bias"],
+                     "wv": sd[p + "attention.self.value.weight"].T,
+                     "bv": sd[p + "attention.self.value.bias"],
+                     "wo": sd[p + "attention.output.dense.weight"].T,
+                     "bo": sd[p + "attention.output.dense.bias"]},
+            "mlp": {"wi": sd[p + "intermediate.dense.weight"].T,
+                    "bi": sd[p + "intermediate.dense.bias"],
+                    "wo": sd[p + "output.dense.weight"].T,
+                    "bo": sd[p + "output.dense.bias"]},
+            # post-LN: ln1 = attention.output.LayerNorm, ln2 = output.LayerNorm
+            "ln1": {"scale": sd[p + "attention.output.LayerNorm.weight"],
+                    "bias": sd[p + "attention.output.LayerNorm.bias"]},
+            "ln2": {"scale": sd[p + "output.LayerNorm.weight"],
+                    "bias": sd[p + "output.LayerNorm.bias"]},
+        })
+    out = {
+        "embed": {
+            "tokens": sd["bert.embeddings.word_embeddings.weight"],
+            "positions": sd["bert.embeddings.position_embeddings.weight"],
+            "token_types": sd["bert.embeddings.token_type_embeddings.weight"],
+            "norm": {"scale": sd["bert.embeddings.LayerNorm.weight"],
+                     "bias": sd["bert.embeddings.LayerNorm.bias"]}},
+        "layers": _stack(layers),
+        # post-LN stacks never apply final_norm; identity keeps the tree
+        # shape every subsystem (sharding, checkpoints) expects
+        "final_norm": {"scale": np.ones((h,), np.float32),
+                       "bias": np.zeros((h,), np.float32)},
+    }
+    if "cls.predictions.transform.dense.weight" not in sd:
+        raise KeyError(
+            "bert checkpoint carries no MLM head (cls.predictions.*): "
+            "convert a BertForMaskedLM model, or build the config with "
+            "mlm_head=False for headless encoders")
+    out["mlm_head"] = {
+        "w": sd["cls.predictions.transform.dense.weight"].T,
+        "b": sd["cls.predictions.transform.dense.bias"],
+        "ln": {"scale": sd["cls.predictions.transform.LayerNorm.weight"],
+               "bias": sd["cls.predictions.transform.LayerNorm.bias"]},
+        "bias": sd["cls.predictions.bias"]}
+    return out
+
+
+def _convert_distilbert(sd, cfg):
+    """HF DistilBertForMaskedLM → functional tree (ref
+    module_inject/containers/distil_bert.py).  No token-type table; the
+    vocab_projector weight is tied to the embeddings."""
+    h = cfg.hidden_size
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"distilbert.transformer.layer.{i}."
+        layers.append({
+            "attn": {"wq": sd[p + "attention.q_lin.weight"].T,
+                     "bq": sd[p + "attention.q_lin.bias"],
+                     "wk": sd[p + "attention.k_lin.weight"].T,
+                     "bk": sd[p + "attention.k_lin.bias"],
+                     "wv": sd[p + "attention.v_lin.weight"].T,
+                     "bv": sd[p + "attention.v_lin.bias"],
+                     "wo": sd[p + "attention.out_lin.weight"].T,
+                     "bo": sd[p + "attention.out_lin.bias"]},
+            "mlp": {"wi": sd[p + "ffn.lin1.weight"].T,
+                    "bi": sd[p + "ffn.lin1.bias"],
+                    "wo": sd[p + "ffn.lin2.weight"].T,
+                    "bo": sd[p + "ffn.lin2.bias"]},
+            "ln1": {"scale": sd[p + "sa_layer_norm.weight"],
+                    "bias": sd[p + "sa_layer_norm.bias"]},
+            "ln2": {"scale": sd[p + "output_layer_norm.weight"],
+                    "bias": sd[p + "output_layer_norm.bias"]},
+        })
+    return {
+        "embed": {
+            "tokens": sd["distilbert.embeddings.word_embeddings.weight"],
+            "positions": sd["distilbert.embeddings.position_embeddings.weight"],
+            "norm": {"scale": sd["distilbert.embeddings.LayerNorm.weight"],
+                     "bias": sd["distilbert.embeddings.LayerNorm.bias"]}},
+        "layers": _stack(layers),
+        "final_norm": {"scale": np.ones((h,), np.float32),
+                       "bias": np.zeros((h,), np.float32)},
+        "mlm_head": {
+            "w": sd["vocab_transform.weight"].T,
+            "b": sd["vocab_transform.bias"],
+            "ln": {"scale": sd["vocab_layer_norm.weight"],
+                   "bias": sd["vocab_layer_norm.bias"]},
+            "bias": sd["vocab_projector.bias"]},
+    }
+
+
 def load_hf_model(name_or_model, dtype=None):
     """AutoModel / checkpoint path → (TransformerConfig, params).  The
     one-call porting path for reference users (ref build_hf_engine)."""
     if isinstance(name_or_model, str):
-        from transformers import AutoModelForCausalLM
+        from transformers import AutoConfig
 
-        model = AutoModelForCausalLM.from_pretrained(name_or_model)
+        conf = AutoConfig.from_pretrained(name_or_model)
+        if getattr(conf, "model_type", "") in ("bert", "distilbert"):
+            from transformers import AutoModelForMaskedLM as Auto
+        else:
+            from transformers import AutoModelForCausalLM as Auto
+        model = Auto.from_pretrained(name_or_model)
     else:
         model = name_or_model
     cfg = config_from_hf(model.config)
@@ -500,5 +640,6 @@ for _arch, _fn in (("gpt2", _convert_gpt2), ("llama", _convert_llama),
                    ("mistral", _convert_llama), ("qwen2", _convert_llama),
                    ("opt", _convert_opt), ("falcon", _convert_falcon),
                    ("phi", _convert_phi), ("phi3", _convert_phi3),
-                   ("qwen", _convert_qwen)):
+                   ("qwen", _convert_qwen), ("bert", _convert_bert),
+                   ("distilbert", _convert_distilbert)):
     register_converter(_arch, _fn)

@@ -157,3 +157,35 @@ register("phi-tiny", TransformerConfig(
     num_heads=4, max_seq_len=256, arch="phi", norm="layernorm",
     activation="gelu", use_rope=True, rotary_pct=0.5, tie_embeddings=False,
     parallel_block=True, use_bias=True))
+
+
+# -- Encoder (BERT-class) family ---------------------------------------
+# Ref: the reference trains these through its fused transformer kernel
+# (ops/transformer/transformer.py:296) and serves them via the
+# bert/distil_bert v1 injection containers (module_inject/containers).
+_bert = dict(arch="bert", norm="layernorm", activation="gelu_exact",
+             causal=False, norm_position="post", embed_norm=True,
+             mlm_head=True, tie_embeddings=True, layernorm_eps=1e-12)
+
+register("bert-base-uncased", TransformerConfig(
+    vocab_size=30522, hidden_size=768, intermediate_size=3072,
+    num_layers=12, num_heads=12, max_seq_len=512, type_vocab_size=2,
+    dropout=0.1, **_bert))
+
+register("bert-large-uncased", TransformerConfig(
+    vocab_size=30522, hidden_size=1024, intermediate_size=4096,
+    num_layers=24, num_heads=16, max_seq_len=512, type_vocab_size=2,
+    dropout=0.1, **_bert))
+
+register("bert-tiny", TransformerConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=512, num_layers=2,
+    num_heads=4, max_seq_len=256, type_vocab_size=2, **_bert))
+
+register("distilbert-base-uncased", TransformerConfig(
+    vocab_size=30522, hidden_size=768, intermediate_size=3072,
+    num_layers=6, num_heads=12, max_seq_len=512, dropout=0.1,
+    **{**_bert, "arch": "distilbert"}))
+
+register("distilbert-tiny", TransformerConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=512, num_layers=2,
+    num_heads=4, max_seq_len=256, **{**_bert, "arch": "distilbert"}))

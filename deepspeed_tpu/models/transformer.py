@@ -58,6 +58,21 @@ class TransformerConfig:
     use_bias: Optional[bool] = None  # all proj biases; None → gpt2/opt
     qkv_bias: bool = False  # qkv-only bias (Qwen2)
     sliding_window: Optional[int] = None  # Mistral
+    # False = bidirectional (encoder/BERT-class) attention.  The reference
+    # trains encoders through its fused transformer kernel
+    # (ops/transformer/transformer.py:296 DeepSpeedTransformerLayer) and
+    # serves bert/distilbert via v1 injection containers.
+    causal: bool = True
+    # "pre" (GPT/llama) | "post" (BERT: residual-add then LayerNorm; the
+    # final norm is per-layer, so no final_norm is applied)
+    norm_position: str = "pre"
+    # BERT segment embeddings: 0 = none; batch may carry "token_type_ids"
+    type_vocab_size: int = 0
+    # BERT: LayerNorm (+dropout) applied to the summed embeddings
+    embed_norm: bool = False
+    # BERT MLM head: LN(gelu(h @ W + b)) @ embed.T + bias instead of the
+    # plain lm_head matmul (HF BertLMPredictionHead)
+    mlm_head: bool = False
     parallel_block: bool = False  # Falcon/Phi: x + attn(n) + mlp(n)
     # Falcon new_decoder_architecture (40B/180B, num_ln_in_parallel_attn=2):
     # the parallel block gets separate input norms — attn uses ln1 (HF
@@ -164,13 +179,13 @@ class TransformerConfig:
     def has_learned_positions(self) -> bool:
         if self.learned_positions is not None:
             return self.learned_positions
-        return self.arch in ("gpt2", "opt")
+        return self.arch in ("gpt2", "opt", "bert", "distilbert")
 
     @property
     def has_bias(self) -> bool:
         if self.use_bias is not None:
             return self.use_bias
-        return self.arch in ("gpt2", "opt", "phi")
+        return self.arch in ("gpt2", "opt", "phi", "bert", "distilbert")
 
     def replace(self, **kw) -> "TransformerConfig":
         return dataclasses.replace(self, **kw)
@@ -281,25 +296,45 @@ def init_layer_params(cfg: TransformerConfig, key) -> Params:
 
 def init_params(cfg: TransformerConfig, key) -> Params:
     """Full model params with per-layer params stacked on axis 0."""
-    keys = jax.random.split(key, cfg.num_layers + 3)
+    # nl+5 keys: rows are counter-derived, so rows nl..nl+2 keep the same
+    # values the old nl+3 split produced (init stays bit-stable for
+    # existing archs); the encoder-only params use the two new rows.
+    nl = cfg.num_layers
+    keys = jax.random.split(key, nl + 5)
     scale = 1.0 / math.sqrt(cfg.hidden_size)
     pd = cfg.param_dtype
+    h = cfg.hidden_size
 
-    layer_list = [init_layer_params(cfg, keys[i]) for i in range(cfg.num_layers)]
+    layer_list = [init_layer_params(cfg, keys[i]) for i in range(nl)]
     layers = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *layer_list)
 
     params: Params = {
-        "embed": {"tokens": _dense_init(keys[-3], (cfg.vocab_size, cfg.hidden_size), scale, pd)},
+        "embed": {"tokens": _dense_init(keys[nl], (cfg.vocab_size, h), scale, pd)},
         "layers": layers,
-        "final_norm": {"scale": jnp.ones((cfg.hidden_size,), pd)},
+        "final_norm": {"scale": jnp.ones((h,), pd)},
     }
     if cfg.norm == "layernorm":
-        params["final_norm"]["bias"] = jnp.zeros((cfg.hidden_size,), pd)
+        params["final_norm"]["bias"] = jnp.zeros((h,), pd)
     if cfg.has_learned_positions:
         params["embed"]["positions"] = _dense_init(
-            keys[-2], (cfg.max_seq_len, cfg.hidden_size), scale, pd)
+            keys[nl + 1], (cfg.max_seq_len, h), scale, pd)
+    if cfg.type_vocab_size:
+        params["embed"]["token_types"] = _dense_init(
+            keys[nl + 3], (cfg.type_vocab_size, h), scale, pd)
+    if cfg.embed_norm:
+        params["embed"]["norm"] = {"scale": jnp.ones((h,), pd),
+                                   "bias": jnp.zeros((h,), pd)}
+    if cfg.mlm_head:
+        # BERT MLM head (HF BertLMPredictionHead): transform dense + LN,
+        # decoder tied to the token embeddings, per-vocab output bias
+        params["mlm_head"] = {
+            "w": _dense_init(keys[nl + 4], (h, h), scale, pd),
+            "b": jnp.zeros((h,), pd),
+            "ln": {"scale": jnp.ones((h,), pd), "bias": jnp.zeros((h,), pd)},
+            "bias": jnp.zeros((cfg.vocab_size,), pd),
+        }
     if not cfg.tie_embeddings:
-        params["lm_head"] = _dense_init(keys[-1], (cfg.hidden_size, cfg.vocab_size), scale, pd)
+        params["lm_head"] = _dense_init(keys[nl + 2], (h, cfg.vocab_size), scale, pd)
     return params
 
 
@@ -369,9 +404,12 @@ def _rope(q, k, positions, cfg: TransformerConfig):
     return rot(q).astype(q.dtype), rot(k).astype(k.dtype)
 
 
-def _attention_scores(q, k, v, cfg: TransformerConfig, segment_pos=None):
-    """Causal MHA/GQA over [B, S, H, D] via XLA einsums (MXU-friendly).
-    Pallas flash attention is selected by the engine when attn_impl allows."""
+def _attention_scores(q, k, v, cfg: TransformerConfig, segment_pos=None,
+                      attention_mask=None):
+    """MHA/GQA over [B, S, H, D] via XLA einsums (MXU-friendly) — causal
+    or bidirectional per ``cfg.causal``.  ``attention_mask``: [B, S] 1 =
+    attend / 0 = padding key (HF convention).  Pallas flash attention is
+    selected by the engine when attn_impl allows."""
     b, s, nh, d = q.shape
     nkv = k.shape[2]
     if nkv != nh:  # GQA: repeat kv heads
@@ -379,13 +417,19 @@ def _attention_scores(q, k, v, cfg: TransformerConfig, segment_pos=None):
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
-    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
-    if cfg.sliding_window:
-        # Mistral sliding-window: key within the last `window` positions
-        qpos = lax.broadcasted_iota(jnp.int32, (s, s), 0)
-        kpos = lax.broadcasted_iota(jnp.int32, (s, s), 1)
-        mask = mask & (qpos - kpos < cfg.sliding_window)
-    scores = jnp.where(mask[None, None, :, :], scores, jnp.finfo(jnp.float32).min)
+    if cfg.causal:
+        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+        if cfg.sliding_window:
+            # Mistral sliding-window: key within the last `window` positions
+            qpos = lax.broadcasted_iota(jnp.int32, (s, s), 0)
+            kpos = lax.broadcasted_iota(jnp.int32, (s, s), 1)
+            mask = mask & (qpos - kpos < cfg.sliding_window)
+        mask = mask[None, None, :, :]
+    else:
+        mask = jnp.ones((1, 1, s, s), dtype=bool)
+    if attention_mask is not None:
+        mask = mask & attention_mask[:, None, None, :].astype(bool)
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     ct = jnp.float32 if op_fp32(cfg, "softmax") else scores.dtype
     probs = jax.nn.softmax(scores.astype(ct), axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
@@ -408,10 +452,11 @@ def _sparse_attn(q, k, v, cfg: TransformerConfig):
            "variable": VariableSparsityConfig,
            "dense": DenseSparsityConfig}[mode]
     sparsity = cls(num_heads=q.shape[2], **sc)
-    return sparse_attention(q, k, v, sparsity, causal=True)
+    return sparse_attention(q, k, v, sparsity, causal=cfg.causal)
 
 
-def _attn_block(x, p, positions, cfg: TransformerConfig):
+def _attn_block(x, p, positions, cfg: TransformerConfig,
+                attention_mask=None):
     b, s, h = x.shape
     nh, nkv, d = cfg.num_heads, cfg.kv_heads, cfg.dim_per_head
     dt0 = x.dtype  # residual-stream dtype: restored at the block boundary
@@ -437,7 +482,12 @@ def _attn_block(x, p, positions, cfg: TransformerConfig):
 
     q, k, v = ulysses_qkv_constraint(q, k, v)
 
-    if cfg.attn_impl == "sparse":
+    if attention_mask is not None:
+        # key-padding masks thread only through the XLA scores path (the
+        # flash kernel has no padding-mask lane; padded serving batches
+        # are the encoder fill-mask/classify case, not the long-seq path)
+        out = _attention_scores(q, k, v, cfg, attention_mask=attention_mask)
+    elif cfg.attn_impl == "sparse":
         out = _sparse_attn(q, k, v, cfg)
     elif cfg.attn_impl in ("pallas_flash", "auto"):
         # flash_attention dispatches: Pallas kernel on TPU (tiled online
@@ -445,7 +495,7 @@ def _attn_block(x, p, positions, cfg: TransformerConfig):
         # tiles at the grid level), equivalent XLA math elsewhere.
         from deepspeed_tpu.ops.flash_attention import flash_attention
 
-        out = flash_attention(q, k, v, causal=True,
+        out = flash_attention(q, k, v, causal=cfg.causal,
                               window=cfg.sliding_window or None)
     else:
         out = _attention_scores(q, k, v, cfg)
@@ -467,8 +517,10 @@ def _mlp_block(x, p, cfg: TransformerConfig):
     y = x @ p["wi"].astype(dt)
     if p.get("bi") is not None:
         y = y + p["bi"].astype(dt)
+    # "gelu_exact" = erf gelu (HF BERT's hidden_act="gelu"); "gelu" keeps
+    # the tanh approximation the decoder families use
     y = jax.nn.relu(y) if cfg.activation == "relu" \
-        else jax.nn.gelu(y, approximate=True)
+        else jax.nn.gelu(y, approximate=cfg.activation != "gelu_exact")
     y = y @ p["wo"].astype(dt)
     if p.get("bo") is not None:
         y = y + p["bo"].astype(dt)
@@ -529,14 +581,16 @@ def _dropout(x, rate: float, key):
 
 
 def transformer_layer(x, layer_params, positions, cfg: TransformerConfig,
-                      layer_is_moe=False, dropout_key=None):
-    """One pre-norm transformer block. Returns (x, moe_aux_loss).
+                      layer_is_moe=False, dropout_key=None,
+                      attention_mask=None):
+    """One transformer block (pre- or post-norm). Returns (x, moe_aux_loss).
 
     ``layer_is_moe`` may be a traced bool (layer index inside a scan): the
     MoE-vs-dense choice then lowers to ``lax.cond``, which is how the
     reference's per-layer MoE placement (PR-MoE, moe_layer_freq) maps onto a
     uniform scan-over-layers body.  ``dropout_key``: this layer's PRNG key
-    for residual dropout (None → off).
+    for residual dropout (None → off).  ``attention_mask``: [B, S] key
+    padding mask (encoder serving).
     """
     dk = (lambda i: jax.random.fold_in(dropout_key, i)) \
         if dropout_key is not None else (lambda i: None)
@@ -546,13 +600,26 @@ def transformer_layer(x, layer_params, positions, cfg: TransformerConfig,
         # falcon/phi v2 containers).
         n = _norm(x, layer_params["ln1"], cfg)
         n_mlp = _norm(x, layer_params["ln2"], cfg) if cfg.parallel_norms else n
-        attn_out = _attn_block(n, layer_params["attn"], positions, cfg)
+        attn_out = _attn_block(n, layer_params["attn"], positions, cfg,
+                               attention_mask=attention_mask)
         y, aux = _select_ffn(n_mlp, layer_params, cfg, layer_is_moe,
                              noise_key=dk(2))
         return x + _dropout(attn_out, cfg.dropout, dk(0)) \
             + _dropout(y, cfg.dropout, dk(1)), aux
+    if cfg.norm_position == "post":
+        # BERT-class post-LN (HF BertLayer): residual add THEN LayerNorm —
+        # ln1 is attention.output.LayerNorm, ln2 is output.LayerNorm
+        attn_out = _attn_block(x, layer_params["attn"], positions, cfg,
+                               attention_mask=attention_mask)
+        x = _norm(x + _dropout(attn_out, cfg.dropout, dk(0)),
+                  layer_params["ln1"], cfg)
+        y, aux = _select_ffn(x, layer_params, cfg, layer_is_moe,
+                             noise_key=dk(2))
+        return _norm(x + _dropout(y, cfg.dropout, dk(1)),
+                     layer_params["ln2"], cfg), aux
     attn_out = _attn_block(_norm(x, layer_params["ln1"], cfg),
-                           layer_params["attn"], positions, cfg)
+                           layer_params["attn"], positions, cfg,
+                           attention_mask=attention_mask)
     x = x + _dropout(attn_out, cfg.dropout, dk(0))
     h = _norm(x, layer_params["ln2"], cfg)
     y, aux = _select_ffn(h, layer_params, cfg, layer_is_moe,
@@ -696,12 +763,15 @@ def _pipeline_key_rows(dropout_key, b: int, n_micro: int):
 def forward(params: Params, input_ids, cfg: TransformerConfig,
             positions=None, pld_theta=None,
             return_hidden: bool = False, token_embeds=None,
-            dropout_key=None) -> jnp.ndarray:
+            dropout_key=None, token_type_ids=None,
+            attention_mask=None) -> jnp.ndarray:
     """Token ids [B, S] → logits [B, S, V]. lax.scan over stacked layers.
     ``pld_theta``: progressive-layer-drop keep prob (traced scalar or None).
     ``return_hidden``: final-norm hidden states instead of logits (tiled
     loss path).  ``dropout_key``: per-step PRNG key enabling
-    ``cfg.dropout`` (None → dropout off, the eval/serve contract)."""
+    ``cfg.dropout`` (None → dropout off, the eval/serve contract).
+    ``token_type_ids``/``attention_mask``: encoder (BERT-class) segment
+    ids and [B, S] key-padding mask."""
     b, s = input_ids.shape
     dt = cfg.dtype
     if positions is None:
@@ -711,8 +781,18 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
             "dropout / noisy MoE gating + param streaming not supported "
             "(the streamed scan's custom VJP does not thread per-layer "
             "keys)")
+    if attention_mask is not None and cfg.param_stream:
+        raise NotImplementedError(
+            "attention_mask + param streaming not supported (the streamed "
+            "scan does not thread the mask)")
+    if attention_mask is not None and 0 < cfg.ltd_kept < s:
+        raise NotImplementedError(
+            "attention_mask + random-LTD not supported (the LTD band's "
+            "reduced token subset would need the mask gathered by the "
+            "kept indices)")
 
-    x = _embed(params, input_ids, positions, cfg, token_embeds)
+    x = _embed(params, input_ids, positions, cfg, token_embeds,
+               token_type_ids=token_type_ids)
     if dropout_key is not None and cfg.dropout > 0:
         x = _dropout(x, cfg.dropout, jax.random.fold_in(dropout_key, 10_000))
 
@@ -735,6 +815,10 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
             raise NotImplementedError(
                 "param streaming + pipeline parallelism not supported "
                 "(the pipe axis already partitions layers pp-ways)")
+        if attention_mask is not None:
+            raise NotImplementedError(
+                "attention_mask + pipeline parallelism not supported "
+                "(masks do not ride the pipeline extras yet)")
         from deepspeed_tpu.parallel.pipeline import spmd_pipeline
 
         stage_fn = make_pipeline_stage_fn(cfg, topo)
@@ -771,7 +855,8 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
                     if dropout_key is not None else None
                 h2, aux = transformer_layer(h, lp, pos, cfg,
                                             layer_is_moe=is_moe_layer,
-                                            dropout_key=lk)
+                                            dropout_key=lk,
+                                            attention_mask=attention_mask)
                 if pld_theta is not None:
                     # progressive layer drop (ref progressive_layer_drop.py
                     # + stochastic depth): deeper layers drop more; batch
@@ -912,13 +997,23 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
             x, moe_aux = scan_segment(x, positions, params["layers"], 0,
                                       cfg.num_layers)
 
-    x = _norm(x, params["final_norm"], cfg)
+    if cfg.norm_position != "post":
+        # post-LN stacks (BERT) normalise inside every layer — no final norm
+        x = _norm(x, params["final_norm"], cfg)
     if return_hidden:
         return (x, moe_aux) if cfg.is_moe else x
     # honor the autocast safe-module list for the output head: an unlisted
     # lm_head is promoted to fp32 like any other module class.
     ht = _module_dtype(cfg, "lm_head", dt)
-    if cfg.tie_embeddings:
+    if cfg.mlm_head:
+        # BERT MLM head: LN(gelu(h W + b)) @ embed.T + vocab bias (HF
+        # BertLMPredictionHead; decoder tied to the token embeddings)
+        mh = params["mlm_head"]
+        t = x.astype(ht) @ mh["w"].astype(ht) + mh["b"].astype(ht)
+        t = _norm(jax.nn.gelu(t, approximate=False), mh["ln"], cfg)
+        logits = t.astype(ht) @ params["embed"]["tokens"].astype(ht).T \
+            + mh["bias"].astype(ht)
+    elif cfg.tie_embeddings:
         logits = x.astype(ht) @ params["embed"]["tokens"].astype(ht).T
     else:
         logits = x.astype(ht) @ params["lm_head"].astype(ht)
@@ -941,16 +1036,23 @@ def _nll_sum(logits32, labels_mb):
 
 
 def _embed(params: Params, input_ids, positions, cfg: TransformerConfig,
-           token_embeds=None):
+           token_embeds=None, token_type_ids=None):
     """Embedding prologue shared by forward() and the 1F1B loss path.
     ``token_embeds``: precomputed table rows [B,S,H] — the sparse-gradient
     path (runtime/sparse.py) hoists the lookup out of the differentiated
-    function so the table cotangent stays (ids, values)-sparse."""
+    function so the table cotangent stays (ids, values)-sparse.
+    ``token_type_ids``: BERT segment ids (default segment 0)."""
     et = _module_dtype(cfg, "embed", cfg.dtype)
     x = (params["embed"]["tokens"].astype(et)[input_ids]
          if token_embeds is None else token_embeds.astype(et))
     if cfg.has_learned_positions:
         x = x + params["embed"]["positions"].astype(et)[positions]
+    if cfg.type_vocab_size:
+        tt = (token_type_ids if token_type_ids is not None
+              else jnp.zeros_like(input_ids))
+        x = x + params["embed"]["token_types"].astype(et)[tt]
+    if cfg.embed_norm:
+        x = _norm(x.astype(cfg.dtype), params["embed"]["norm"], cfg)
     return x.astype(cfg.dtype)
 
 
@@ -1021,6 +1123,12 @@ def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfi
 
     s = batch["input_ids"].shape[1]
     tiled = cfg.loss_tiles and s % cfg.loss_tiles == 0
+    if tiled and cfg.mlm_head:
+        raise NotImplementedError(
+            "loss_tiles + mlm_head not supported (the tiled loss computes "
+            "logits directly against the embedding table, bypassing the "
+            "MLM transform head); encoder sequences are short — drop "
+            "loss_tiles")
 
     from deepspeed_tpu.parallel.topology import get_topology
 
@@ -1030,6 +1138,10 @@ def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfi
             and not cfg.param_stream   # forward() raises for pp+streaming
             and batch.get("pld_theta") is None
             and not (0 < cfg.ltd_kept < s)      # forward() raises for pp+LTD
+            # encoder stacks: the 1F1B tail applies final_norm + the plain
+            # tied head — post-LN/MLM-head models keep the AD GPipe path
+            and not cfg.mlm_head and cfg.norm_position != "post"
+            and batch.get("attention_mask") is None
             # fp16 needs the dynamic loss scale inside the backward, but the
             # 1F1B custom VJP computes grads in its forward before the scale
             # cotangent exists — fp16 stays on the AD-differentiated GPipe
@@ -1042,7 +1154,9 @@ def loss_fn(params: Params, batch: Dict[str, jnp.ndarray], cfg: TransformerConfi
     out = forward(params, batch["input_ids"], cfg,
                   pld_theta=batch.get("pld_theta"), return_hidden=bool(tiled),
                   token_embeds=token_embeds,
-                  dropout_key=batch.get("dropout_key"))
+                  dropout_key=batch.get("dropout_key"),
+                  token_type_ids=batch.get("token_type_ids"),
+                  attention_mask=batch.get("attention_mask"))
     moe_aux = jnp.zeros((), jnp.float32)
     if isinstance(out, tuple):
         out, moe_aux = out

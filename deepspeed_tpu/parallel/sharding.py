@@ -50,6 +50,13 @@ from deepspeed_tpu.utils.logging import logger
 DEFAULT_RULES: List[Tuple[str, Tuple[Optional[str], ...]]] = [
     (r"embed/tokens$", ("vocab", "embed")),
     (r"embed/positions$", ("pos", "embed")),
+    (r"embed/token_types$", ("pos", "embed")),
+    (r"embed/norm/(scale|bias)$", ("norm",)),
+    # BERT MLM head (transform dense + LN + vocab bias)
+    (r"mlm_head/w$", ("embed", None)),
+    (r"mlm_head/b$", ("embed",)),
+    (r"mlm_head/ln/(scale|bias)$", ("norm",)),
+    (r"mlm_head/bias$", ("vocab",)),
     (r"attn/w[qkv]$", ("layer", "embed", "heads")),
     (r"attn/b[qkv]$", ("layer", "heads")),
     (r"attn/wo$", ("layer", "heads", "embed")),

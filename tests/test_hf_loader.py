@@ -261,3 +261,75 @@ def test_qwen2_moe_parity():
         decoder_sparse_step=1, norm_topk_prob=False,
         tie_word_embeddings=False))
     _compare(m, atol=4e-3)
+
+
+def test_bert_parity():
+    """Encoder family: bidirectional post-LN stack + MLM head logits must
+    match HF BertForMaskedLM (ref module_inject/containers/bert.py)."""
+    from transformers import BertConfig, BertForMaskedLM
+
+    torch.manual_seed(0)
+    m = BertForMaskedLM(BertConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, type_vocab_size=2))
+    m.eval()
+    cfg = config_from_hf(m.config).replace(dtype=jnp.float32)
+    assert not cfg.causal and cfg.norm_position == "post" and cfg.mlm_head
+    params = params_from_hf(m, cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 12), dtype=np.int64)
+    tt = rng.integers(0, 2, size=(2, 12), dtype=np.int64)
+    with torch.no_grad():
+        ref = m(torch.tensor(ids),
+                token_type_ids=torch.tensor(tt)).logits.float().numpy()
+    out = np.asarray(tf.forward(params, jnp.asarray(ids, jnp.int32), cfg,
+                                token_type_ids=jnp.asarray(tt, jnp.int32)),
+                     np.float32)
+    np.testing.assert_allclose(out, ref, atol=2e-3, rtol=1e-3)
+
+
+def test_bert_attention_mask_parity():
+    """Key-padding mask: padded positions must not influence kept tokens'
+    logits (matches HF attention_mask semantics)."""
+    from transformers import BertConfig, BertForMaskedLM
+
+    torch.manual_seed(1)
+    m = BertForMaskedLM(BertConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, type_vocab_size=2))
+    m.eval()
+    cfg = config_from_hf(m.config).replace(dtype=jnp.float32)
+    params = params_from_hf(m, cfg)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 12), dtype=np.int64)
+    mask = np.ones((2, 12), np.int64)
+    mask[:, 9:] = 0  # right padding
+    with torch.no_grad():
+        ref = m(torch.tensor(ids),
+                attention_mask=torch.tensor(mask)).logits.float().numpy()
+    out = np.asarray(
+        tf.forward(params, jnp.asarray(ids, jnp.int32), cfg,
+                   attention_mask=jnp.asarray(mask, jnp.int32)), np.float32)
+    np.testing.assert_allclose(out[:, :9], ref[:, :9], atol=2e-3, rtol=1e-3)
+
+
+def test_distilbert_parity():
+    from transformers import DistilBertConfig, DistilBertForMaskedLM
+
+    torch.manual_seed(0)
+    m = DistilBertForMaskedLM(DistilBertConfig(
+        vocab_size=128, dim=64, hidden_dim=128, n_layers=2, n_heads=4,
+        max_position_embeddings=64))
+    m.eval()
+    cfg = config_from_hf(m.config).replace(dtype=jnp.float32)
+    assert cfg.arch == "distilbert" and cfg.type_vocab_size == 0
+    params = params_from_hf(m, cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 12), dtype=np.int64)
+    with torch.no_grad():
+        ref = m(torch.tensor(ids)).logits.float().numpy()
+    out = np.asarray(tf.forward(params, jnp.asarray(ids, jnp.int32), cfg),
+                     np.float32)
+    np.testing.assert_allclose(out, ref, atol=2e-3, rtol=1e-3)
