@@ -14,12 +14,12 @@ from deepspeed_tpu.runtime.data_pipeline.variable_batch import (
     batch_by_token_budget, scale_lr_by_batch_size)
 from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
     IndexedDataset, IndexedDatasetBuilder)
-from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (DataAnalyzer,
-                                                               load_metric)
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+    DataAnalyzer, DistributedDataAnalyzer, load_metric)
 
 __all__ = [
     "CurriculumScheduler", "DeepSpeedDataSampler", "RandomLTDScheduler",
     "random_ltd_drop", "random_ltd_restore", "batch_by_token_budget",
     "scale_lr_by_batch_size", "IndexedDataset", "IndexedDatasetBuilder",
-    "DataAnalyzer", "load_metric",
+    "DataAnalyzer", "DistributedDataAnalyzer", "load_metric",
 ]

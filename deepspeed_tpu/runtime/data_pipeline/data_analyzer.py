@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -107,3 +107,151 @@ class DataAnalyzer:
 def load_metric(output_dir: str, name: str = "seqlen") -> np.ndarray:
     """Load a reduced metric as the sampler's ``difficulties`` array."""
     return np.load(os.path.join(output_dir, f"{name}_values.npy"))
+
+
+class DistributedDataAnalyzer:
+    """Multi-process map-reduce dataset analysis (ref
+    ``DistributedDataAnalyzer``, data_sampling/data_analyzer.py:455).
+
+    Each ``jax.distributed`` process maps a CONTIGUOUS split of the
+    dataset (the reference's ``split_dataset`` semantics), shards merge
+    over the DCN host-object collectives
+    (:func:`deepspeed_tpu.comm.comm.all_gather_object` — the analog of
+    the reference's gather_v/file_write_ordered, which also funnel to
+    rank 0 for writing), and rank 0 writes the merged index files the
+    reference emits per metric, under ``save_path/<metric>/``:
+
+    * ``<metric>_sample_to_metric.npy`` — value per sample id (dense)
+    * ``<metric>_index_to_metric.npy`` — sorted unique metric values
+    * ``<metric>_index_to_sample.npz`` — per-value sample-id lists as
+      ``ids`` (concatenated) + ``offsets`` (row starts) — the ragged
+      layout the reference's mmap builder stores row-per-value
+    * ``<metric>_index_to_sample_percentile_merged.npz`` — ~100 merged
+      buckets of ids in metric order (ref
+      output_index_to_sample_percentile, data_analyzer.py:415)
+    * ``<metric>_metric_value.npy`` — for ``accumulate_value_over_samples``
+      metrics: the elementwise sum over all workers (e.g. vocab counts)
+
+    plus the flat ``<metric>_values.npy`` / ``<metric>_index_sorted.npy``
+    files :class:`DataAnalyzer` writes, so curriculum samplers consume
+    either analyzer's output interchangeably.
+
+    The reference sorts via a distributed sample-sort because per-rank
+    tensors live on GPU; here metric shards are small host arrays, so
+    the merge sorts on rank 0 after the DCN gather — same outputs.
+
+    ``metric_types``: {name: "single_value_per_sample" (default) |
+    "accumulate_value_over_samples"}.  ``sample_indices`` optionally maps
+    iteration order to user-defined sample ids.
+    """
+
+    def __init__(self, dataset, save_path: str,
+                 metrics: Optional[Dict[str, Callable]] = None,
+                 metric_types: Optional[Dict[str, str]] = None,
+                 sample_indices: Optional[Sequence[int]] = None):
+        import jax
+
+        self.dataset = dataset
+        self.save_path = save_path
+        self.metrics = metrics or {"seqlen": metric_seqlen}
+        self.metric_types = dict(metric_types or {})
+        for name, t in self.metric_types.items():
+            if t not in ("single_value_per_sample",
+                         "accumulate_value_over_samples"):
+                raise ValueError(f"metric_type {t!r} for {name!r} not "
+                                 "implemented")
+        self.sample_indices = sample_indices
+        self.num_workers = jax.process_count()
+        self.worker_id = jax.process_index()
+        os.makedirs(save_path, exist_ok=True)
+
+    def _worker_split(self) -> range:
+        """Contiguous split (ref split_dataset): worker w gets
+        [w*n//W, (w+1)*n//W)."""
+        n = len(self.dataset)
+        w, nw = self.worker_id, self.num_workers
+        return range(n * w // nw, n * (w + 1) // nw)
+
+    def run_map_reduce(self) -> Dict[str, str]:
+        from deepspeed_tpu.comm import comm
+
+        split = self._worker_split()
+        local: Dict[str, Any] = {}
+        for name, fn in self.metrics.items():
+            mtype = self.metric_types.get(name, "single_value_per_sample")
+            if mtype == "single_value_per_sample":
+                pairs = []
+                for i in split:
+                    sid = (int(self.sample_indices[i])
+                           if self.sample_indices is not None else i)
+                    pairs.append((sid, float(fn(self.dataset[i]))))
+                local[name] = pairs
+            else:
+                acc = None
+                for i in split:
+                    v = np.asarray(fn(self.dataset[i]), np.float64)
+                    acc = v if acc is None else acc + v
+                local[name] = (None if acc is None else acc.tolist())
+
+        gathered = comm.all_gather_object(local)
+        results: Dict[str, str] = {}
+        if self.worker_id == 0:
+            n = len(self.dataset)
+            for name in self.metrics:
+                mdir = os.path.join(self.save_path, name)
+                os.makedirs(mdir, exist_ok=True)
+                mtype = self.metric_types.get(name,
+                                              "single_value_per_sample")
+                if mtype == "accumulate_value_over_samples":
+                    parts = [np.asarray(g[name], np.float64)
+                             for g in gathered if g[name] is not None]
+                    total = np.sum(parts, axis=0)
+                    path = os.path.join(mdir, f"{name}_metric_value.npy")
+                    np.save(path, total)
+                    results[name] = path
+                    continue
+                pairs = np.asarray(
+                    [p for g in gathered for p in g[name]], np.float64)
+                ids = pairs[:, 0].astype(np.int64)
+                vals = pairs[:, 1]
+                # sample_indices may map into a larger corpus id space;
+                # size the dense table by the largest id seen (duplicate
+                # ids keep the last-mapped value)
+                size = max(n, int(ids.max()) + 1 if len(ids) else 0)
+                dense = np.zeros(size, np.float64)
+                dense[ids] = vals
+                np.save(os.path.join(mdir, f"{name}_sample_to_metric.npy"),
+                        dense)
+                # merged metric→samples index: sorted unique values with
+                # their (metric-sorted) sample-id rows
+                order = np.lexsort((ids, vals))
+                sv, si = vals[order], ids[order]
+                uniq, starts = np.unique(sv, return_index=True)
+                offsets = np.append(starts, len(si)).astype(np.int64)
+                np.save(os.path.join(mdir, f"{name}_index_to_metric.npy"),
+                        uniq)
+                np.savez(os.path.join(mdir, f"{name}_index_to_sample.npz"),
+                         ids=si, offsets=offsets)
+                # ~100 percentile-merged buckets in metric order
+                step = max(1, len(uniq) // 100)
+                b_off = [0]
+                b_ids = []
+                for v_idx in range(0, len(uniq), step):
+                    lo = offsets[v_idx]
+                    hi = offsets[min(v_idx + step, len(uniq))]
+                    b_ids.append(si[lo:hi])
+                    b_off.append(b_off[-1] + (hi - lo))
+                np.savez(os.path.join(
+                    mdir, f"{name}_index_to_sample_percentile_merged.npz"),
+                    ids=np.concatenate(b_ids) if b_ids else
+                    np.zeros(0, np.int64),
+                    offsets=np.asarray(b_off, np.int64))
+                # flat sampler-compatible files (DataAnalyzer layout)
+                np.save(os.path.join(self.save_path, f"{name}_values.npy"),
+                        dense)
+                np.save(os.path.join(self.save_path,
+                                     f"{name}_index_sorted.npy"),
+                        np.argsort(dense, kind="stable"))
+                results[name] = mdir
+        comm.barrier()
+        return results

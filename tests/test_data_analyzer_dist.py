@@ -1,0 +1,149 @@
+"""DistributedDataAnalyzer: multi-process map-reduce with merged
+index-file outputs (ref data_sampling/data_analyzer.py:455
+DistributedDataAnalyzer + output_index_to_sample_percentile :415)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _check_outputs(out, n):
+    """Shared assertions: merged index files are complete and coherent."""
+    mdir = os.path.join(out, "seqlen")
+    s2m = np.load(os.path.join(mdir, "seqlen_sample_to_metric.npy"))
+    assert s2m.shape == (n,)
+    # ground truth: sample i has length 4 + i % 7
+    np.testing.assert_array_equal(s2m, 4 + np.arange(n) % 7)
+    uniq = np.load(os.path.join(mdir, "seqlen_index_to_metric.npy"))
+    assert np.all(np.diff(uniq) > 0)
+    z = np.load(os.path.join(mdir, "seqlen_index_to_sample.npz"))
+    ids, offsets = z["ids"], z["offsets"]
+    assert offsets[0] == 0 and offsets[-1] == n == len(ids)
+    for v_idx, v in enumerate(uniq):
+        row = ids[offsets[v_idx]:offsets[v_idx + 1]]
+        np.testing.assert_array_equal(np.sort(row),
+                                      np.where(s2m == v)[0])
+    pm = np.load(os.path.join(
+        mdir, "seqlen_index_to_sample_percentile_merged.npz"))
+    assert pm["offsets"][-1] == n
+    # sampler-compatible flat files (DataAnalyzer layout)
+    vals = np.load(os.path.join(out, "seqlen_values.npy"))
+    np.testing.assert_array_equal(vals, s2m)
+    order = np.load(os.path.join(out, "seqlen_index_sorted.npy"))
+    assert np.all(np.diff(vals[order]) >= 0)
+    # accumulate metric: elementwise sum over all workers
+    tok = np.load(os.path.join(out, "tokens", "tokens_metric_value.npy"))
+    assert tok.shape == (16,) and tok.sum() == n
+
+
+class _Ds:
+    def __init__(self, n=103):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return {"input_ids": list(range(4 + i % 7)), "first": i % 16}
+
+
+def _metrics():
+    def tokens_hist(sample):
+        h = np.zeros(16)
+        h[sample["first"]] = 1
+        return h
+
+    return ({"seqlen": lambda s: len(s["input_ids"]),
+             "tokens": tokens_hist},
+            {"tokens": "accumulate_value_over_samples"})
+
+
+def test_single_process_outputs(tmp_path):
+    from deepspeed_tpu.runtime.data_pipeline import DistributedDataAnalyzer
+
+    metrics, types = _metrics()
+    a = DistributedDataAnalyzer(_Ds(), str(tmp_path), metrics=metrics,
+                                metric_types=types)
+    a.run_map_reduce()
+    _check_outputs(str(tmp_path), 103)
+
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+
+    rank = int(sys.argv[1]); world = int(sys.argv[2])
+    port = sys.argv[3]; out = sys.argv[4]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    os.environ["DSTPU_COORDINATOR"] = f"localhost:{port}"
+    os.environ["DSTPU_NUM_PROCS"] = str(world)
+    os.environ["DSTPU_PROC_ID"] = str(rank)
+    sys.path.insert(0, os.environ["DSTPU_TEST_REPO"])
+    sys.path.insert(0, os.path.join(os.environ["DSTPU_TEST_REPO"], "tests"))
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from deepspeed_tpu.comm import comm
+    comm.init_distributed(mesh_sizes={"data": 4})
+    assert jax.process_count() == world
+
+    from test_data_analyzer_dist import _Ds, _metrics
+    from deepspeed_tpu.runtime.data_pipeline import DistributedDataAnalyzer
+
+    metrics, types = _metrics()
+    a = DistributedDataAnalyzer(_Ds(), out, metrics=metrics,
+                                metric_types=types)
+    assert a.num_workers == 2 and a.worker_id == rank
+    # contiguous split (ref split_dataset): disjoint cover of the dataset
+    split = a._worker_split()
+    assert len(split) in (51, 52)
+    a.run_map_reduce()
+    print(f"analyzer worker {rank} OK", flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_map_reduce(tmp_path):
+    """2 real jax.distributed processes: each maps its contiguous split,
+    rank 0 writes the merged index files; outputs equal the single-process
+    ground truth."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    out = str(tmp_path)
+    procs, logs = [], []
+    import tempfile
+
+    files = []
+    for r in range(2):
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(("DSTPU_", "XLA_", "JAX_"))}
+        env["DSTPU_TEST_REPO"] = REPO
+        f = tempfile.NamedTemporaryFile("w+", suffix=f"_a{r}.log",
+                                        delete=False)
+        files.append(f)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER, str(r), "2", str(port), out],
+            stdout=f, stderr=subprocess.STDOUT, env=env))
+    for p, f in zip(procs, files):
+        try:
+            p.wait(timeout=300)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+        f.flush()
+        f.seek(0)
+        logs.append(f.read())
+        f.close()
+        os.unlink(f.name)
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"worker failed:\n{log[-3000:]}"
+    _check_outputs(out, 103)
