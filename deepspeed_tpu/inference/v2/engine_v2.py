@@ -50,6 +50,13 @@ class RaggedInferenceEngineConfig:
         self.memory_config = d.get("memory_config", {})
         self.num_blocks = int(self.memory_config.get("num_blocks", 512))
         self.block_size = int(self.memory_config.get("block_size", 16))
+        # "int8": blockwise-quantized KV pages (one fp32 scale per
+        # (head, row)) — halves decode's KV bandwidth, the bound resource
+        # (ref KV-block layout inference/v2/ragged/kv_cache.py:40)
+        self.kv_dtype = str(self.memory_config.get("kv_dtype", "auto"))
+        if self.kv_dtype not in ("auto", "int8", "bf16", "bfloat16"):
+            raise ValueError(f"memory_config.kv_dtype={self.kv_dtype!r}: "
+                             "expected 'auto', 'int8', or 'bf16'")
         self.max_context = int(d.get("max_context", 2048))
         # Compile-time guard: the paged decode kernel's per-token page loop
         # is ceil(max_context / block_size) long, and Mosaic compile time
@@ -142,8 +149,19 @@ class InferenceEngineV2:
         # [L, nkv, P, d]: kv-head-major so the paged-attention kernel's page
         # blocks have (rows, head_dim) as their minor dims (lane-aligned).
         kv_shape = (mc.num_layers, mc.kv_heads, pages, mc.dim_per_head)
-        self.cache_k = jnp.zeros(kv_shape, dtype=dt)
-        self.cache_v = jnp.zeros(kv_shape, dtype=dt)
+        if self.cfg.kv_dtype == "int8":
+            # quantized cache: int8 payload + one fp32 scale per (head,
+            # row) — decode reads half the KV bytes (bandwidth-bound)
+            sc_shape = kv_shape[:-1]
+            self.cache_k = {"q": jnp.zeros(kv_shape, jnp.int8),
+                            "s": jnp.zeros(sc_shape, jnp.float32)}
+            self.cache_v = {"q": jnp.zeros(kv_shape, jnp.int8),
+                            "s": jnp.zeros(sc_shape, jnp.float32)}
+        else:
+            kv_dt = (jnp.bfloat16 if self.cfg.kv_dtype in ("bf16", "bfloat16")
+                     else dt)
+            self.cache_k = jnp.zeros(kv_shape, dtype=kv_dt)
+            self.cache_v = jnp.zeros(kv_shape, dtype=kv_dt)
 
         self._step = jax.jit(
             partial(ragged_forward, cfg=mc, block_size=self.cfg.block_size),

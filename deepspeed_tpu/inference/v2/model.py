@@ -58,19 +58,46 @@ def _on_tpu() -> bool:
         return False
 
 
+def _is_quant_cache(pages) -> bool:
+    """Int8 KV cache layout: {"q": int8 payload, "s": fp32 per-row scales}
+    (ref KV-block layout inference/v2/ragged/kv_cache.py:40; quantization
+    per (head, row) over head_dim)."""
+    return isinstance(pages, dict)
+
+
+def _kv_append(pages, x, token_dest):
+    """Scatter this step's KV rows [T, nkv, d] into the page pool —
+    quantizing on append when the cache is int8."""
+    xh = x.swapaxes(0, 1)                                # [nkv, T, d]
+    if _is_quant_cache(pages):
+        xf = xh.astype(jnp.float32)
+        scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+        q8 = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+        return {"q": pages["q"].at[:, token_dest].set(q8.astype(jnp.int8)),
+                "s": pages["s"].at[:, token_dest].set(scale)}
+    return pages.at[:, token_dest].set(xh.astype(pages.dtype))
+
+
 def _paged_attention_xla(q, k_pages, v_pages, gather_idx, token_pos,
                          token_ctx_len, cfg: TransformerConfig):
     """Gather-based fallback (non-TPU backends / oversize shapes).
 
-    q: [T, nh, d]; k_pages/v_pages: [nkv, P, d]; gather_idx: [T, C] flat
-    page-row indices of each token's context. GQA-native: queries are
-    grouped by KV head instead of repeating KV.
+    q: [T, nh, d]; k_pages/v_pages: [nkv, P, d] (or int8 dict caches);
+    gather_idx: [T, C] flat page-row indices of each token's context.
+    GQA-native: queries are grouped by KV head instead of repeating KV.
     """
     t, nh, d = q.shape
-    nkv = k_pages.shape[0]
+    if _is_quant_cache(k_pages):
+        nkv = k_pages["q"].shape[0]
+        k_ctx = (k_pages["q"][:, gather_idx].astype(q.dtype)
+                 * k_pages["s"][:, gather_idx, None].astype(q.dtype))
+        v_ctx = (v_pages["q"][:, gather_idx].astype(q.dtype)
+                 * v_pages["s"][:, gather_idx, None].astype(q.dtype))
+    else:
+        nkv = k_pages.shape[0]
+        k_ctx = k_pages[:, gather_idx]  # [nkv, T, C, d]
+        v_ctx = v_pages[:, gather_idx]
     g = nh // nkv
-    k_ctx = k_pages[:, gather_idx]  # [nkv, T, C, d]
-    v_ctx = v_pages[:, gather_idx]
     qg = q.reshape(t, nkv, g, d)
     scale = 1.0 / math.sqrt(cfg.dim_per_head)
     scores = jnp.einsum("tkgd,ktcd->tkgc", qg, k_ctx) * scale
@@ -110,6 +137,11 @@ def _attn_impl_pallas(q, k_pages, v_pages, gather_idx, token_pos,
             "mixed path carries none) — use 'auto' or 'paged_xla'")
     pages = block_tables[token_slot]  # [T, NB]
     scale = 1.0 / math.sqrt(cfg.dim_per_head)
+    if _is_quant_cache(k_pages):
+        return paged_decode_attention(
+            q, k_pages["q"], v_pages["q"], pages, token_pos, token_ctx_len,
+            block_size, scale, window=cfg.sliding_window or None,
+            k_scales=k_pages["s"], v_scales=v_pages["s"])
     return paged_decode_attention(
         q, k_pages, v_pages, pages, token_pos, token_ctx_len,
         block_size, scale, window=cfg.sliding_window or None)
@@ -163,11 +195,10 @@ def _ragged_layer(x, lp, k_pages, v_pages, meta, cfg: TransformerConfig,
 
     # Write this step's KV to its pages (padding tokens target page 0 =
     # garbage, so no mask needed; ref: linear_blocked_kv_copy). Cache layout
-    # is [nkv, P, d] (kv-head-major for the Pallas kernel's page blocks).
-    k_pages = k_pages.at[:, token_dest].set(
-        k.swapaxes(0, 1).astype(k_pages.dtype))
-    v_pages = v_pages.at[:, token_dest].set(
-        v.swapaxes(0, 1).astype(v_pages.dtype))
+    # is [nkv, P, d] (kv-head-major for the Pallas kernel's page blocks),
+    # quantized on append when the cache is int8 (_kv_append).
+    k_pages = _kv_append(k_pages, k, token_dest)
+    v_pages = _kv_append(v_pages, v, token_dest)
 
     attn = _paged_attention(q, k_pages, v_pages, gather_idx, token_pos,
                             token_ctx_len, cfg, block_tables=block_tables,

@@ -126,31 +126,123 @@ def _kernel(pages_ref, pos_ref, clen_ref, q_ref, k_hbm, v_hbm, o_ref,
     o_ref[0, 0] = (acc / safe_l).astype(o_ref.dtype)
 
 
+def _kernel_quant(pages_ref, pos_ref, clen_ref, q_ref, ksc_ref, vsc_ref,
+                  k_hbm, v_hbm, o_ref, k_buf, v_buf, sem_k, sem_v, *,
+                  bs, group, sm_scale, window=None):
+    """Int8-KV variant of :func:`_kernel`: the page payloads are int8 with
+    one fp32 scale per (head, row).  Only the d-wide payload rides the
+    manual double-buffered DMA (half the bytes of the bf16 cache — the
+    decode bandwidth win); the [P]-long per-head scale rows are small and
+    arrive whole through an ordinary VMEM BlockSpec, sliced per page.
+    Scales fold into existing vectors: the k scale multiplies score
+    COLUMNS after the q·k matmul, the v scale multiplies the softmax
+    probabilities before p·v — no [bs, d] dequantized buffer ever
+    materialises."""
+    t = pl.program_id(0)
+    h = pl.program_id(1)
+    pos = pos_ref[t]
+    clen = clen_ref[t]
+    j_lo = jnp.int32(0)
+    if window is not None:
+        j_lo = jnp.maximum((pos - (window - 1)) // bs, 0)
+    j_hi = pos // bs + 1
+
+    def page_copy(j, slot):
+        page = pages_ref[t, j]
+        pltpu.make_async_copy(
+            k_hbm.at[h, pl.dslice(page * bs, bs)], k_buf.at[slot],
+            sem_k.at[slot]).start()
+        pltpu.make_async_copy(
+            v_hbm.at[h, pl.dslice(page * bs, bs)], v_buf.at[slot],
+            sem_v.at[slot]).start()
+
+    page_copy(j_lo, 0)
+    q = q_ref[0, 0]                                      # [group, d]
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        slot = lax.rem(j - j_lo, 2)
+
+        @pl.when(j + 1 < j_hi)
+        def _():
+            page_copy(j + 1, 1 - slot)
+
+        pltpu.make_async_copy(k_hbm.at[h, pl.dslice(0, bs)],
+                              k_buf.at[slot], sem_k.at[slot]).wait()
+        pltpu.make_async_copy(v_hbm.at[h, pl.dslice(0, bs)],
+                              v_buf.at[slot], sem_v.at[slot]).wait()
+        page = pages_ref[t, j]
+        ks = ksc_ref[0, pl.dslice(page * bs, bs)]        # [bs] f32
+        vs = vsc_ref[0, pl.dslice(page * bs, bs)]
+        k = k_buf[slot].astype(jnp.float32)              # int8 rows exact
+        v = v_buf[slot].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q.astype(jnp.float32), k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [group, bs]
+        s = s * (sm_scale * ks)[None, :]
+        c = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + j * bs
+        valid = (c <= pos) & (c < clen)
+        if window is not None:
+            valid &= pos - c < window
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        e = jnp.exp(s - m_new)                           # [group, bs]
+        l_new = l_prev * alpha + jnp.sum(e, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            e * vs[None, :], v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [group, d]
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((group, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((group, 1), jnp.float32)
+    a0 = jnp.zeros((group, q.shape[-1]), jnp.float32)
+    m, l, acc = lax.fori_loop(j_lo, j_hi, body, (m0, l0, a0))
+    safe_l = jnp.where(l > 0, l, 1.0)
+    o_ref[0, 0] = (acc / safe_l).astype(o_ref.dtype)
+
+
 @functools.partial(jax.jit, static_argnames=("block_size", "sm_scale",
                                              "window"))
 def paged_decode_attention(q, k_pages, v_pages, pages, token_pos,
                            token_ctx_len, block_size: int, sm_scale: float,
-                           window: int | None = None):
+                           window: int | None = None,
+                           k_scales=None, v_scales=None):
     """q: [T, nh, d]; k_pages/v_pages: [nkv, P, d]; pages: [T, NB] page ids
     per token; token_pos/token_ctx_len: [T]; ``window``: Mistral sliding
-    window (key visible iff qpos - kpos < window).  Returns [T, nh, d]."""
+    window (key visible iff qpos - kpos < window).  With
+    ``k_scales``/``v_scales`` [nkv, P] the page payloads are int8 rows
+    scaled per (head, row) — ref KV-block layout
+    inference/v2/ragged/kv_cache.py:40.  Returns [T, nh, d]."""
     t, nh, d = q.shape
-    nkv = k_pages.shape[0]
+    nkv, p_rows = k_pages.shape[0], k_pages.shape[1]
     group = nh // nkv
     bs = block_size
+    quant = k_scales is not None
 
+    in_specs = [
+        # q reshaped to [T, nkv, group, d] outside: one KV head's query
+        # group per block, full trailing dims (Mosaic block constraint)
+        pl.BlockSpec((1, 1, group, d), lambda t_, h, *refs: (t_, h, 0, 0)),
+    ]
+    extra = ()
+    if quant:
+        # whole per-head scale rows live in VMEM via the normal pipeline
+        in_specs += [pl.BlockSpec((1, p_rows), lambda t_, h, *refs: (h, 0)),
+                     pl.BlockSpec((1, p_rows), lambda t_, h, *refs: (h, 0))]
+        extra = (k_scales.astype(jnp.float32), v_scales.astype(jnp.float32))
+    in_specs += [
+        # the page pools stay in HBM; the kernel DMAs live pages into
+        # its double buffer itself
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(t, nkv),
-        in_specs=[
-            # q reshaped to [T, nkv, group, d] outside: one KV head's query
-            # group per block, full trailing dims (Mosaic block constraint)
-            pl.BlockSpec((1, 1, group, d), lambda t_, h, *refs: (t_, h, 0, 0)),
-            # the page pools stay in HBM; the kernel DMAs live pages into
-            # its double buffer itself
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, group, d),
                                lambda t_, h, *refs: (t_, h, 0, 0)),
         scratch_shapes=[
@@ -160,13 +252,14 @@ def paged_decode_attention(q, k_pages, v_pages, pages, token_pos,
             pltpu.SemaphoreType.DMA((2,)),
         ],
     )
+    kern = _kernel_quant if quant else _kernel
     out = pl.pallas_call(
-        functools.partial(_kernel, bs=bs, group=group, sm_scale=sm_scale,
+        functools.partial(kern, bs=bs, group=group, sm_scale=sm_scale,
                           window=window),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((t, nkv, group, d), q.dtype),
         interpret=INTERPRET,
     )(pages.astype(jnp.int32), token_pos.astype(jnp.int32),
       token_ctx_len.astype(jnp.int32), q.reshape(t, nkv, group, d),
-      k_pages, v_pages)
+      *extra, k_pages, v_pages)
     return out.reshape(t, nh, d)

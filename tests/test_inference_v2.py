@@ -246,3 +246,40 @@ def test_compile_time_guard_for_small_block_sizes():
     # the default operating point (2048 / 16 = 128) stays silent
     cfg = RaggedInferenceEngineConfig({})
     assert -(-cfg.max_context // cfg.block_size) == 128
+
+
+def test_int8_kv_cache_generation():
+    """memory_config.kv_dtype=int8: the cache stores int8 payload + fp32
+    per-row scales (half the KV bytes), generation runs the quantize-on-
+    append path, and greedy outputs match the bf16 cache closely."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import get_model_config
+    from deepspeed_tpu.parallel import topology
+
+    model = get_model_config("llama-tiny")
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, model.vocab_size, size=(12,)).tolist()
+               for _ in range(3)]
+    outs = {}
+    for kind in ("bf16", "int8"):
+        eng = InferenceEngineV2(
+            model, {"memory_config": {"kv_dtype": kind}}, seed=11)
+        if kind == "int8":
+            assert eng.cache_k["q"].dtype == jnp.int8
+            assert eng.cache_k["s"].dtype == jnp.float32
+            # payload bytes halve vs the bf16 cache; scales add 4/(2d)
+            assert eng.cache_k["q"].nbytes * 2 == bf16_nbytes
+            d = eng.cache_k["q"].shape[-1]
+            assert eng.cache_k["s"].nbytes * d == eng.cache_k["q"].nbytes * 4
+        else:
+            assert eng.cache_k.dtype == jnp.bfloat16
+            bf16_nbytes = eng.cache_k.nbytes
+        outs[kind] = eng.generate(prompts, max_new_tokens=8)
+        topology._GLOBAL_TOPOLOGY = None
+    # greedy decode over a random tiny model: quantization noise may flip
+    # an occasional argmax, but the sequences must agree on most tokens
+    agree = np.mean([np.mean(np.asarray(a[:4]) == np.asarray(b[:4]))
+                     for a, b in zip(outs["bf16"], outs["int8"])])
+    assert agree >= 0.5, (agree, outs)

@@ -186,3 +186,33 @@ def test_paged_sliding_window_parity():
     ref = jnp.einsum("tkgc,ktcd->tkgd", p, v_ctx).reshape(t, nh, d)
     err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
     assert err < 0.05, err
+
+
+@pytest.mark.parametrize("t,nh,nkv,d,n_pages,nb,bs", CASES)
+def test_paged_quantized_parity(t, nh, nkv, d, n_pages, nb, bs):
+    """Int8-KV kernel variant: quantize the page pools per (head, row),
+    run the quantized kernel, and compare against the float reference on
+    the DEQUANTIZED pools (exact math parity) and against the original
+    float pools (small quantization error)."""
+    q, kp, vp, tbl, pos, clen = _make_case(
+        jax.random.PRNGKey(1), t, nh, nkv, d, n_pages, nb, bs)
+    scale = 1.0 / np.sqrt(d)
+
+    def quantize(p):
+        pf = p.astype(jnp.float32)
+        s = jnp.maximum(jnp.max(jnp.abs(pf), axis=-1), 1e-8) / 127.0
+        q8 = jnp.clip(jnp.round(pf / s[..., None]), -127, 127)
+        return q8.astype(jnp.int8), s
+
+    kq, ks = quantize(kp)
+    vq, vs = quantize(vp)
+    out = _decode_fn(q, kq, vq, tbl, pos, clen, block_size=bs,
+                     sm_scale=scale, k_scales=ks, v_scales=vs)
+    deq = lambda q8, s: (q8.astype(jnp.float32) * s[..., None]).astype(jnp.bfloat16)
+    ref_exact = _ref_paged(q, deq(kq, ks), deq(vq, vs), tbl, pos, clen, bs,
+                           scale)
+    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref_exact)))
+    assert err < 0.05, err
+    ref_float = _ref_paged(q, kp, vp, tbl, pos, clen, bs, scale)
+    qerr = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref_float)))
+    assert qerr < 0.15, qerr  # int8 per-row quantization noise bound
