@@ -560,6 +560,8 @@ def _convert_bert(sd, cfg):
         "final_norm": {"scale": np.ones((h,), np.float32),
                        "bias": np.zeros((h,), np.float32)},
     }
+    if not cfg.mlm_head:
+        return out  # headless encoder (hidden states / classification)
     if "cls.predictions.transform.dense.weight" not in sd:
         raise KeyError(
             "bert checkpoint carries no MLM head (cls.predictions.*): "

@@ -483,6 +483,11 @@ def _attn_block(x, p, positions, cfg: TransformerConfig,
     q, k, v = ulysses_qkv_constraint(q, k, v)
 
     if attention_mask is not None:
+        if cfg.attn_impl == "sparse":
+            raise NotImplementedError(
+                "attention_mask + attn_impl='sparse' not supported (the "
+                "padding mask would silently replace the block-sparse "
+                "layout's semantics)")
         # key-padding masks thread only through the XLA scores path (the
         # flash kernel has no padding-mask lane; padded serving batches
         # are the encoder fill-mask/classify case, not the long-seq path)
@@ -1010,7 +1015,11 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
         # BertLMPredictionHead; decoder tied to the token embeddings)
         mh = params["mlm_head"]
         t = x.astype(ht) @ mh["w"].astype(ht) + mh["b"].astype(ht)
-        t = _norm(jax.nn.gelu(t, approximate=False), mh["ln"], cfg)
+        # transform activation follows cfg.activation like the MLP blocks
+        # (HF BertPredictionHeadTransform uses config.hidden_act)
+        t = jax.nn.relu(t) if cfg.activation == "relu" else \
+            jax.nn.gelu(t, approximate=cfg.activation != "gelu_exact")
+        t = _norm(t, mh["ln"], cfg)
         logits = t.astype(ht) @ params["embed"]["tokens"].astype(ht).T \
             + mh["bias"].astype(ht)
     elif cfg.tie_embeddings:
