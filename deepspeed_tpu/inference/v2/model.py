@@ -45,10 +45,16 @@ def _rope_tok(x, positions, cfg: TransformerConfig):
     sin = jnp.sin(angles)[:, None, :]
     xf = x.astype(jnp.float32)
     xr, x_pass = xf[..., :rot_d], xf[..., rot_d:]
-    x1, x2 = jnp.split(xr, 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin, x_pass],
-                          axis=-1)
-    return out.astype(x.dtype)
+    if cfg.rope_interleaved:
+        # GPT-J "rotate every two" pairing
+        x1, x2 = xr[..., 0::2], xr[..., 1::2]
+        xr = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                       axis=-1).reshape(xr.shape)
+    else:
+        x1, x2 = jnp.split(xr, 2, axis=-1)
+        xr = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                             axis=-1)
+    return jnp.concatenate([xr, x_pass], axis=-1).astype(x.dtype)
 
 
 def _on_tpu() -> bool:
@@ -102,6 +108,14 @@ def _paged_attention_xla(q, k_pages, v_pages, gather_idx, token_pos,
     scale = 1.0 / math.sqrt(cfg.dim_per_head)
     scores = jnp.einsum("tkgd,ktcd->tkgc", qg, k_ctx) * scale
     c_pos = jnp.arange(scores.shape[-1], dtype=jnp.int32)
+    if cfg.use_alibi:
+        # Bloom ALiBi (key-position form; softmax-shift equivalent)
+        from deepspeed_tpu.models.transformer import alibi_slopes
+
+        sl = alibi_slopes(nh).reshape(nkv, g)
+        scores = scores + (sl[None, :, :, None]
+                           * c_pos.astype(jnp.float32)[None, None, None, :]
+                           ).astype(scores.dtype)
     valid = (c_pos[None, :] <= token_pos[:, None]) & \
             (c_pos[None, :] < token_ctx_len[:, None])       # [T, C]
     if cfg.sliding_window:
@@ -115,8 +129,10 @@ def _paged_attention_xla(q, k_pages, v_pages, gather_idx, token_pos,
 
 
 def _pallas_attn_default(block_size=0, head_dim=0, on_tpu=False,
-                         has_tables=False, **_):
-    if not (has_tables and on_tpu):
+                         has_tables=False, use_alibi=False, **_):
+    if not (has_tables and on_tpu) or use_alibi:
+        # alibi rides the XLA gather path (the Pallas kernel has no
+        # score-bias lane)
         return False
     from deepspeed_tpu.ops.pallas.paged_attention import supports
 
@@ -166,7 +182,8 @@ def _paged_attention(q, k_pages, v_pages, gather_idx, token_pos, token_ctx_len,
     name = dict(cfg.v2_modules or ()).get("attention", "auto")
     impl = resolve("attention", name, block_size=block_size,
                    head_dim=cfg.dim_per_head, on_tpu=_on_tpu(),
-                   has_tables=block_tables is not None)
+                   has_tables=block_tables is not None,
+                   use_alibi=cfg.use_alibi)
     return impl(q, k_pages, v_pages, gather_idx, token_pos, token_ctx_len,
                 cfg, block_tables, token_slot, block_size)
 
@@ -208,8 +225,11 @@ def _ragged_layer(x, lp, k_pages, v_pages, meta, cfg: TransformerConfig,
         attn = attn + lp["attn"]["bo"].astype(dt)
 
     if cfg.parallel_block:
-        # Falcon/Phi: attention and MLP both read the shared input norm
-        return x + attn + _mlp_block(h, lp["mlp"], cfg), k_pages, v_pages
+        # Falcon/Phi: attention and MLP read the shared input norm;
+        # Falcon-40B/GPT-NeoX (parallel_norms): the MLP gets its own
+        # ln2 on the same residual input (HF use_parallel_residual)
+        h_mlp = _norm(x, lp["ln2"], cfg) if cfg.parallel_norms else h
+        return x + attn + _mlp_block(h_mlp, lp["mlp"], cfg), k_pages, v_pages
 
     x = x + attn
 
@@ -268,6 +288,8 @@ def ragged_forward(params, cache_k, cache_v, token_ids, token_slot, token_pos,
     x = params["embed"]["tokens"].astype(dt)[token_ids]  # [T, H]
     if cfg.arch == "gpt2":
         x = x + params["embed"]["positions"].astype(dt)[token_pos]
+    if cfg.embed_norm:
+        x = _norm(x, params["embed"]["norm"], cfg)  # Bloom embedding LN
 
     # Context gather indices, shared by all layers (ref: atom_builder).
     nb = block_tables.shape[1]

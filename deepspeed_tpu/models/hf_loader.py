@@ -10,8 +10,9 @@ AutoTP, ZeRO, inference v1/v2) consumes the model like any other.
 
 Supported families: gpt2, llama, mistral, qwen, qwen2, mixtral, qwen2_moe,
 opt, falcon, phi, phi3 — the same set as the reference's v2 model
-implementations (MoE included) — plus the encoder family bert/distilbert
-(ref v1 injection containers module_inject/containers/{bert,distil_bert}.py);
+implementations (MoE included) — plus the v1-injection families
+bloom (ALiBi), gptj (interleaved rotary), gpt_neox, and the encoder
+family bert/distilbert (ref module_inject/containers/);
 :func:`register_converter` adds new families without touching this module
 (the analog of the v2 registry).
 
@@ -38,6 +39,20 @@ def _np(t) -> np.ndarray:
     if hasattr(t, "detach"):
         t = t.detach().cpu().float().numpy()
     return np.asarray(t, np.float32)
+
+
+def _map_hf_activation(mt: str, act_name) -> str:
+    """HF activation names → the functional vocabulary ("gelu" in HF
+    BERT/NeoX is the exact erf form; gelu_new/_fast/_tanh are the tanh
+    approximation the decoder families use)."""
+    table = {"gelu": "gelu_exact", "gelu_new": "gelu",
+             "gelu_fast": "gelu", "gelu_pytorch_tanh": "gelu",
+             "relu": "relu"}
+    name = str(act_name)
+    if name not in table:
+        raise ValueError(f"{mt}: unsupported hidden_act {name!r} "
+                         f"(supported: {sorted(table)})")
+    return table[name]
 
 
 def config_from_hf(hf_config) -> TransformerConfig:
@@ -159,19 +174,61 @@ def config_from_hf(hf_config) -> TransformerConfig:
             use_bias=bool(getattr(hf_config, "bias", False)),
             tie_embeddings=True,
             layernorm_eps=getattr(hf_config, "layer_norm_epsilon", 1e-5))
+    if mt == "bloom":
+        # ALiBi attention, embedding LayerNorm, BloomGelu = tanh approx
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=4 * hf_config.hidden_size,
+            num_layers=hf_config.n_layer, num_heads=hf_config.n_head,
+            max_seq_len=getattr(hf_config, "seq_length", 2048),
+            arch="bloom", norm="layernorm", activation="gelu",
+            use_alibi=True, embed_norm=True, use_bias=True,
+            tie_embeddings=True,
+            layernorm_eps=getattr(hf_config, "layer_norm_epsilon", 1e-5))
+    if mt == "gptj":
+        # interleaved partial rotary, parallel block with ONE shared norm,
+        # biasless attention + biased MLP, gelu_new = tanh approx
+        d = hf_config.n_embd // hf_config.n_head
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size, hidden_size=hf_config.n_embd,
+            intermediate_size=(hf_config.n_inner
+                               or 4 * hf_config.n_embd),
+            num_layers=hf_config.n_layer, num_heads=hf_config.n_head,
+            max_seq_len=hf_config.n_positions, arch="gptj",
+            norm="layernorm", activation="gelu", use_rope=True,
+            rope_interleaved=True,
+            # rotary_dim=None = full-head rotary (HF GPTJAttention)
+            rotary_pct=(hf_config.rotary_dim or d) / d,
+            parallel_block=True, use_bias=False, mlp_bias=True,
+            tie_embeddings=False,
+            layernorm_eps=getattr(hf_config, "layer_norm_epsilon", 1e-5))
+    if mt == "gpt_neox":
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=hf_config.intermediate_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            max_seq_len=hf_config.max_position_embeddings, arch="gptneox",
+            norm="layernorm",
+            activation=_map_hf_activation(mt, hf_config.hidden_act),
+            use_rope=True, rotary_pct=hf_config.rotary_pct,
+            parallel_block=bool(getattr(hf_config, "use_parallel_residual",
+                                        True)),
+            parallel_norms=bool(getattr(hf_config, "use_parallel_residual",
+                                        True)),
+            use_bias=True, tie_embeddings=False,
+            layernorm_eps=getattr(hf_config, "layer_norm_eps", 1e-5))
     if mt in ("bert", "distilbert"):
         # map HF activation names onto the functional vocabulary ("gelu"
         # in HF BERT is the exact erf form; gelu_new/_tanh are the tanh
         # approximation the decoder families use)
-        act_name = str(getattr(hf_config, "hidden_act", None)
-                       or getattr(hf_config, "activation", "gelu"))
-        act_table = {"gelu": "gelu_exact", "gelu_new": "gelu",
-                     "gelu_pytorch_tanh": "gelu", "relu": "relu"}
-        if act_name not in act_table:
-            raise ValueError(f"{mt}: unsupported hidden_act {act_name!r} "
-                             f"(supported: {sorted(act_table)})")
+        act_name = (getattr(hf_config, "hidden_act", None)
+                    or getattr(hf_config, "activation", "gelu"))
         enc_kw = dict(
-            arch=mt, norm="layernorm", activation=act_table[act_name],
+            arch=mt, norm="layernorm",
+            activation=_map_hf_activation(mt, act_name),
             causal=False, norm_position="post", embed_norm=True,
             mlm_head=True, tie_embeddings=True)
         if mt == "bert":
@@ -521,6 +578,121 @@ def _convert_qwen(sd, cfg):
             "lm_head": sd["lm_head.weight"].T}
 
 
+def _split_headwise_qkv(w, b, nh, d):
+    """Bloom/GPT-NeoX fused query_key_value: rows are grouped PER HEAD as
+    [nh, (q|k|v), d] (ref GQAMegatronQKVParameter, module_inject/layers.py).
+    Returns ((wq, wk, wv), (bq, bk, bv)) in the functional [in, out]
+    layout."""
+    h_in = w.shape[1]
+    wg = w.reshape(nh, 3, d, h_in)
+    ws = tuple(wg[:, j].reshape(nh * d, h_in).T for j in range(3))
+    if b is None:
+        return ws, (None, None, None)
+    bg = b.reshape(nh, 3, d)
+    return ws, tuple(bg[:, j].reshape(nh * d) for j in range(3))
+
+
+def _convert_bloom(sd, cfg):
+    """HF BloomForCausalLM → functional tree (ref
+    module_inject/containers/bloom.py)."""
+    nh, d = cfg.num_heads, cfg.dim_per_head
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        (wq, wk, wv), (bq, bk, bv) = _split_headwise_qkv(
+            sd[p + "self_attention.query_key_value.weight"],
+            sd[p + "self_attention.query_key_value.bias"], nh, d)
+        layers.append({
+            "attn": {"wq": wq, "wk": wk, "wv": wv,
+                     "bq": bq, "bk": bk, "bv": bv,
+                     "wo": sd[p + "self_attention.dense.weight"].T,
+                     "bo": sd[p + "self_attention.dense.bias"]},
+            "mlp": {"wi": sd[p + "mlp.dense_h_to_4h.weight"].T,
+                    "bi": sd[p + "mlp.dense_h_to_4h.bias"],
+                    "wo": sd[p + "mlp.dense_4h_to_h.weight"].T,
+                    "bo": sd[p + "mlp.dense_4h_to_h.bias"]},
+            "ln1": {"scale": sd[p + "input_layernorm.weight"],
+                    "bias": sd[p + "input_layernorm.bias"]},
+            "ln2": {"scale": sd[p + "post_attention_layernorm.weight"],
+                    "bias": sd[p + "post_attention_layernorm.bias"]},
+        })
+    return {
+        "embed": {
+            "tokens": sd["transformer.word_embeddings.weight"],
+            "norm": {
+                "scale": sd["transformer.word_embeddings_layernorm.weight"],
+                "bias": sd["transformer.word_embeddings_layernorm.bias"]}},
+        "layers": _stack(layers),
+        "final_norm": {"scale": sd["transformer.ln_f.weight"],
+                       "bias": sd["transformer.ln_f.bias"]},
+    }
+
+
+def _convert_gptj(sd, cfg):
+    """HF GPTJForCausalLM → functional tree (ref
+    module_inject/containers/gptj.py).  A nonzero lm_head bias cannot be
+    represented (functional head has no output bias) and is warned about."""
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        ln1 = {"scale": sd[p + "ln_1.weight"], "bias": sd[p + "ln_1.bias"]}
+        layers.append({
+            "attn": {"wq": sd[p + "attn.q_proj.weight"].T,
+                     "wk": sd[p + "attn.k_proj.weight"].T,
+                     "wv": sd[p + "attn.v_proj.weight"].T,
+                     "wo": sd[p + "attn.out_proj.weight"].T},
+            "mlp": {"wi": sd[p + "mlp.fc_in.weight"].T,
+                    "bi": sd[p + "mlp.fc_in.bias"],
+                    "wo": sd[p + "mlp.fc_out.weight"].T,
+                    "bo": sd[p + "mlp.fc_out.bias"]},
+            # one shared input norm (parallel_norms=False): ln2 mirrors
+            # ln1 to keep the stacked tree shape
+            "ln1": ln1, "ln2": dict(ln1),
+        })
+    out = {"embed": {"tokens": sd["transformer.wte.weight"]},
+           "layers": _stack(layers),
+           "final_norm": {"scale": sd["transformer.ln_f.weight"],
+                          "bias": sd["transformer.ln_f.bias"]},
+           "lm_head": sd["lm_head.weight"].T}
+    if "lm_head.bias" in sd and np.abs(sd["lm_head.bias"]).max() > 0:
+        logger.warning("gptj lm_head bias dropped (functional head has no "
+                       "output bias)")
+    return out
+
+
+def _convert_gptneox(sd, cfg):
+    """HF GPTNeoXForCausalLM → functional tree (ref
+    module_inject/containers/gptneox.py)."""
+    nh, d = cfg.num_heads, cfg.dim_per_head
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"gpt_neox.layers.{i}."
+        (wq, wk, wv), (bq, bk, bv) = _split_headwise_qkv(
+            sd[p + "attention.query_key_value.weight"],
+            sd.get(p + "attention.query_key_value.bias"), nh, d)
+        attn = {"wq": wq, "wk": wk, "wv": wv,
+                "wo": sd[p + "attention.dense.weight"].T}
+        if bq is not None:
+            attn.update(bq=bq, bk=bk, bv=bv,
+                        bo=sd[p + "attention.dense.bias"])
+        layers.append({
+            "attn": attn,
+            "mlp": {"wi": sd[p + "mlp.dense_h_to_4h.weight"].T,
+                    "bi": sd[p + "mlp.dense_h_to_4h.bias"],
+                    "wo": sd[p + "mlp.dense_4h_to_h.weight"].T,
+                    "bo": sd[p + "mlp.dense_4h_to_h.bias"]},
+            "ln1": {"scale": sd[p + "input_layernorm.weight"],
+                    "bias": sd[p + "input_layernorm.bias"]},
+            "ln2": {"scale": sd[p + "post_attention_layernorm.weight"],
+                    "bias": sd[p + "post_attention_layernorm.bias"]},
+        })
+    return {"embed": {"tokens": sd["gpt_neox.embed_in.weight"]},
+            "layers": _stack(layers),
+            "final_norm": {"scale": sd["gpt_neox.final_layer_norm.weight"],
+                           "bias": sd["gpt_neox.final_layer_norm.bias"]},
+            "lm_head": sd["embed_out.weight"].T}
+
+
 def _convert_bert(sd, cfg):
     """HF BertForMaskedLM → functional tree (ref v1 injection
     module_inject/containers/bert.py; post-LN handled by norm_position)."""
@@ -643,5 +815,7 @@ for _arch, _fn in (("gpt2", _convert_gpt2), ("llama", _convert_llama),
                    ("opt", _convert_opt), ("falcon", _convert_falcon),
                    ("phi", _convert_phi), ("phi3", _convert_phi3),
                    ("qwen", _convert_qwen), ("bert", _convert_bert),
-                   ("distilbert", _convert_distilbert)):
+                   ("distilbert", _convert_distilbert),
+                   ("bloom", _convert_bloom), ("gptj", _convert_gptj),
+                   ("gptneox", _convert_gptneox)):
     register_converter(_arch, _fn)

@@ -189,3 +189,46 @@ register("distilbert-base-uncased", TransformerConfig(
 register("distilbert-tiny", TransformerConfig(
     vocab_size=512, hidden_size=128, intermediate_size=512, num_layers=2,
     num_heads=4, max_seq_len=256, **{**_bert, "arch": "distilbert"}))
+
+
+# -- Bloom / GPT-J / GPT-NeoX (v1 injection breadth) -------------------
+# Ref containers: module_inject/containers/{bloom,gptj,gptneox}.py
+register("bloom-560m", TransformerConfig(
+    vocab_size=250880, hidden_size=1024, intermediate_size=4096,
+    num_layers=24, num_heads=16, max_seq_len=2048, arch="bloom",
+    norm="layernorm", activation="gelu", use_alibi=True, embed_norm=True,
+    use_bias=True, tie_embeddings=True))
+
+register("bloom-tiny", TransformerConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=512, num_layers=2,
+    num_heads=4, max_seq_len=256, arch="bloom", norm="layernorm",
+    activation="gelu", use_alibi=True, embed_norm=True, use_bias=True,
+    tie_embeddings=True))
+
+register("gptj-6b", TransformerConfig(
+    vocab_size=50400, hidden_size=4096, intermediate_size=16384,
+    num_layers=28, num_heads=16, max_seq_len=2048, arch="gptj",
+    norm="layernorm", activation="gelu", use_rope=True,
+    rope_interleaved=True, rotary_pct=64 / 256, parallel_block=True,
+    use_bias=False, mlp_bias=True, tie_embeddings=False))
+
+register("gptj-tiny", TransformerConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=512, num_layers=2,
+    num_heads=4, max_seq_len=256, arch="gptj", norm="layernorm",
+    activation="gelu", use_rope=True, rope_interleaved=True,
+    rotary_pct=0.5, parallel_block=True, use_bias=False, mlp_bias=True,
+    tie_embeddings=False))
+
+register("gptneox-20b", TransformerConfig(
+    vocab_size=50432, hidden_size=6144, intermediate_size=24576,
+    num_layers=44, num_heads=64, max_seq_len=2048, arch="gptneox",
+    norm="layernorm", activation="gelu_exact", use_rope=True,
+    rotary_pct=0.25, parallel_block=True, parallel_norms=True,
+    use_bias=True, tie_embeddings=False))
+
+register("gptneox-tiny", TransformerConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=512, num_layers=2,
+    num_heads=4, max_seq_len=256, arch="gptneox", norm="layernorm",
+    activation="gelu_exact", use_rope=True, rotary_pct=0.25,
+    parallel_block=True, parallel_norms=True, use_bias=True,
+    tie_embeddings=False))

@@ -58,6 +58,15 @@ class TransformerConfig:
     use_bias: Optional[bool] = None  # all proj biases; None → gpt2/opt
     qkv_bias: bool = False  # qkv-only bias (Qwen2)
     sliding_window: Optional[int] = None  # Mistral
+    # ALiBi positional bias (Bloom): score += slope[h] · key_position —
+    # used instead of rope/learned positions
+    use_alibi: bool = False
+    # GPT-J rotary layout: dims pair as (2i, 2i+1) ("rotate every two")
+    # instead of the llama/neox half-split
+    rope_interleaved: bool = False
+    # MLP bias independent of attention bias (GPT-J: biasless attention,
+    # biased MLP); None → follows has_bias
+    mlp_bias: Optional[bool] = None
     # False = bidirectional (encoder/BERT-class) attention.  The reference
     # trains encoders through its fused transformer kernel
     # (ops/transformer/transformer.py:296 DeepSpeedTransformerLayer) and
@@ -187,6 +196,10 @@ class TransformerConfig:
             return self.use_bias
         return self.arch in ("gpt2", "opt", "phi", "bert", "distilbert")
 
+    @property
+    def has_mlp_bias(self) -> bool:
+        return self.has_bias if self.mlp_bias is None else self.mlp_bias
+
     def replace(self, **kw) -> "TransformerConfig":
         return dataclasses.replace(self, **kw)
 
@@ -231,7 +244,7 @@ def init_layer_params(cfg: TransformerConfig, key) -> Params:
             "wi": _dense_init(k1, (h, ffn), scale, pd),
             "wo": _dense_init(k3, (ffn, h), out_scale, pd),
         }
-        if cfg.has_bias:
+        if cfg.has_mlp_bias:
             mlp["bi"] = jnp.zeros((ffn,), pd)
             mlp["bo"] = jnp.zeros((h,), pd)
         return mlp
@@ -397,11 +410,31 @@ def _rope(q, k, positions, cfg: TransformerConfig):
     def rot(x):
         xf = x.astype(ct)
         xr, x_pass = xf[..., :rot_d], xf[..., rot_d:]
-        x1, x2 = jnp.split(xr, 2, axis=-1)
-        xr = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        if cfg.rope_interleaved:
+            # GPT-J "rotate every two": dims pair as (2i, 2i+1)
+            x1, x2 = xr[..., 0::2], xr[..., 1::2]
+            xr = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                           axis=-1).reshape(xr.shape)
+        else:
+            x1, x2 = jnp.split(xr, 2, axis=-1)
+            xr = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                                 axis=-1)
         return jnp.concatenate([xr, x_pass], axis=-1)
 
     return rot(q).astype(q.dtype), rot(k).astype(k.dtype)
+
+
+def alibi_slopes(nh: int) -> jnp.ndarray:
+    """ALiBi head slopes (Press et al.; HF build_alibi_tensor semantics,
+    including the non-power-of-two head interleave)."""
+    cp2 = 2 ** math.floor(math.log2(nh))
+    base = 2.0 ** (-(2.0 ** -(math.log2(cp2) - 3)))
+    slopes = [base ** (i + 1) for i in range(cp2)]
+    if cp2 != nh:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * cp2) - 3)))
+        slopes += [extra_base ** (i + 1)
+                   for i in range(0, 2 * (nh - cp2), 2)]
+    return jnp.asarray(slopes, jnp.float32)
 
 
 def _attention_scores(q, k, v, cfg: TransformerConfig, segment_pos=None,
@@ -417,6 +450,13 @@ def _attention_scores(q, k, v, cfg: TransformerConfig, segment_pos=None,
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+    if cfg.use_alibi:
+        # Bloom ALiBi: slope[h] · key_position added to the scores (HF's
+        # key-position form — per-query-row softmax shift makes it
+        # equivalent to the distance form)
+        kpos = jnp.arange(s, dtype=jnp.float32)
+        scores = scores + (alibi_slopes(nh)[:, None, None]
+                           * kpos[None, None, :]).astype(scores.dtype)
     if cfg.causal:
         mask = jnp.tril(jnp.ones((s, s), dtype=bool))
         if cfg.sliding_window:
@@ -482,15 +522,15 @@ def _attn_block(x, p, positions, cfg: TransformerConfig,
 
     q, k, v = ulysses_qkv_constraint(q, k, v)
 
-    if attention_mask is not None:
+    if attention_mask is not None or cfg.use_alibi:
         if cfg.attn_impl == "sparse":
             raise NotImplementedError(
-                "attention_mask + attn_impl='sparse' not supported (the "
-                "padding mask would silently replace the block-sparse "
+                "attention_mask/alibi + attn_impl='sparse' not supported "
+                "(the padding mask would silently replace the block-sparse "
                 "layout's semantics)")
-        # key-padding masks thread only through the XLA scores path (the
-        # flash kernel has no padding-mask lane; padded serving batches
-        # are the encoder fill-mask/classify case, not the long-seq path)
+        # key-padding masks and the ALiBi score bias thread only through
+        # the XLA scores path (the flash kernel has neither lane; padded
+        # serving is the encoder case, alibi the bloom family)
         out = _attention_scores(q, k, v, cfg, attention_mask=attention_mask)
     elif cfg.attn_impl == "sparse":
         out = _sparse_attn(q, k, v, cfg)

@@ -333,3 +333,89 @@ def test_distilbert_parity():
     out = np.asarray(tf.forward(params, jnp.asarray(ids, jnp.int32), cfg),
                      np.float32)
     np.testing.assert_allclose(out, ref, atol=2e-3, rtol=1e-3)
+
+
+def test_bloom_parity():
+    """ALiBi attention + embedding LayerNorm + headwise-fused qkv (ref
+    module_inject/containers/bloom.py)."""
+    from transformers import BloomConfig, BloomForCausalLM
+
+    torch.manual_seed(0)
+    m = BloomForCausalLM(BloomConfig(
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4))
+    _compare(m)
+
+
+def test_gptj_parity():
+    """Interleaved partial rotary + parallel block with one shared norm +
+    biasless attention / biased MLP (ref containers/gptj.py)."""
+    from transformers import GPTJConfig, GPTJForCausalLM
+
+    torch.manual_seed(0)
+    m = GPTJForCausalLM(GPTJConfig(
+        vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
+        rotary_dim=8))
+    _compare(m, zero_lm_head_bias=True)
+
+
+@pytest.mark.parametrize("parallel", [True, False])
+def test_gptneox_parity(parallel):
+    """Partial rotate-half rotary + parallel residual with separate norms
+    (and the sequential use_parallel_residual=False variant); headwise
+    fused qkv (ref containers/gptneox.py)."""
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    torch.manual_seed(0)
+    m = GPTNeoXForCausalLM(GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, rotary_pct=0.25,
+        use_parallel_residual=parallel))
+    _compare(m)
+
+
+def test_bloom_gptj_neox_generate_matches_hf():
+    """The new v1-injection families serve through the KV-cached generate
+    path: greedy continuations must match HF transformers' generate."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel import topology
+    from transformers import (BloomConfig, BloomForCausalLM, GPTJConfig,
+                              GPTJForCausalLM, GPTNeoXConfig,
+                              GPTNeoXForCausalLM)
+
+    cases = [
+        BloomForCausalLM(BloomConfig(vocab_size=128, hidden_size=64,
+                                     n_layer=2, n_head=4)),
+        GPTJForCausalLM(GPTJConfig(vocab_size=128, n_embd=64, n_layer=2,
+                                   n_head=4, n_positions=64, rotary_dim=8)),
+        GPTNeoXForCausalLM(GPTNeoXConfig(
+            vocab_size=128, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            max_position_embeddings=64, rotary_pct=0.25)),
+    ]
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 128, size=(1, 10), dtype=np.int64)
+    for m in cases:
+        torch.manual_seed(0)
+        m.eval()
+        with torch.no_grad():
+            # fresh LayerNorms are weight=1/bias=0, which makes ln1 == ln2
+            # numerically and would mask norm-routing bugs (e.g. the v2
+            # parallel_norms path) — randomize them
+            for name, p in m.named_parameters():
+                if "layernorm" in name.lower() or "ln_" in name.lower():
+                    p.add_(torch.randn_like(p) * 0.1)
+        if getattr(getattr(m, "lm_head", None), "bias", None) is not None:
+            with torch.no_grad():
+                m.lm_head.bias.zero_()
+        cfg = config_from_hf(m.config).replace(dtype=jnp.float32)
+        params = params_from_hf(m, cfg)
+        with torch.no_grad():
+            ref = m.generate(torch.tensor(ids), max_new_tokens=6,
+                             do_sample=False).numpy()[0, 10:]
+        eng = ds.init_inference(model=cfg, model_params=params,
+                                dtype="float32")
+        out = np.asarray(eng.generate(ids.astype(np.int32),
+                                      max_new_tokens=6))[0, 10:]
+        np.testing.assert_array_equal(out, ref, err_msg=cfg.arch)
+        topology._GLOBAL_TOPOLOGY = None
