@@ -151,6 +151,10 @@ def _attn_impl_pallas(q, k_pages, v_pages, gather_idx, token_pos,
         raise ValueError(
             "attention='paged_pallas' needs block tables (the prefill "
             "mixed path carries none) — use 'auto' or 'paged_xla'")
+    if cfg.use_alibi:
+        raise ValueError(
+            "attention='paged_pallas' has no ALiBi score-bias lane — use "
+            "'auto' or 'paged_xla' for bloom-class models")
     pages = block_tables[token_slot]  # [T, NB]
     scale = 1.0 / math.sqrt(cfg.dim_per_head)
     if _is_quant_cache(k_pages):

@@ -1,10 +1,13 @@
-"""QuantizedParameter — int-quantized storage with on-the-fly dequant.
+"""QuantizedParameter — quantized storage with on-the-fly dequant.
 
 Analog of ``deepspeed/linear/quantization.py`` (``QuantizedParameter``
-:18): a frozen weight stored as int8 (or packed int4) + per-group scales,
-dequantized inside the jitted forward so the matmul reads bf16 while HBM
-holds the compressed bytes.  Built on the blockwise quantizer kernels in
-``deepspeed_tpu.ops.quantizer`` (the TPU analog of csrc/quantization).
+:18): a frozen weight stored as int8, packed int4, or packed FP6 +
+per-group scales, dequantized inside the jitted forward so the matmul
+reads bf16 while HBM holds the compressed bytes.  Built on the blockwise
+quantizer kernels in ``deepspeed_tpu.ops.quantizer`` (the TPU analog of
+csrc/quantization); ``q_bits=6`` uses the FP6 e3m2 plane packing whose
+Pallas GEMM (``ops/pallas/fp6_linear``) reads only the packed bytes —
+the reference's cuda_linear weight-only path.
 """
 
 from __future__ import annotations
@@ -18,16 +21,28 @@ from deepspeed_tpu.ops.quantizer import (dequantize_blockwise, pack_int4,
 class QuantizedParameter:
     """Quantize once at construction; ``dequantized()`` inside jit.
 
-    q_bits 8 → int8 storage; 4 → two nibbles per byte. Grouping is along
-    the last dim (``group_size`` clipped to it).
+    q_bits 8 → int8 storage; 4 → two nibbles per byte; 6 → FP6 e3m2
+    plane packing (2-D weights, per-output-column scales; ``matmul``
+    runs the packed-read Pallas GEMM).  Int grouping is along the last
+    dim (``group_size`` clipped to it).
     """
 
     def __init__(self, weight, q_bits: int = 8, group_size: int = 512):
-        if q_bits not in (4, 8):
-            raise ValueError(f"q_bits must be 4 or 8, got {q_bits}")
+        if q_bits not in (4, 6, 8):
+            raise ValueError(f"q_bits must be 4, 6, or 8, got {q_bits}")
         self.shape = tuple(weight.shape)
         self.dtype = weight.dtype
         self.q_bits = q_bits
+        if q_bits == 6:
+            from deepspeed_tpu.ops.pallas.fp6_linear import fp6_quantize
+
+            if len(self.shape) != 2:
+                raise ValueError("q_bits=6 (FP6 packed) needs a 2-D "
+                                 f"weight, got shape {self.shape}")
+            self.data, self.scale = fp6_quantize(weight)
+            self.zero = None
+            self.group_size = self.shape[0]  # per-column (channel) scale
+            return
         n = self.shape[-1]
         group_size = min(group_size, n)
         while n % group_size != 0:  # shrink to a divisor of the last dim
@@ -40,10 +55,23 @@ class QuantizedParameter:
         self.data = pack_int4(q) if q_bits == 4 else q
 
     def dequantized(self) -> jnp.ndarray:
+        if self.q_bits == 6:
+            from deepspeed_tpu.ops.pallas.fp6_linear import fp6_dequantize
+
+            return fp6_dequantize(self.data, self.scale, self.dtype)
         q = unpack_int4(self.data) if self.q_bits == 4 else self.data
         w = dequantize_blockwise(q, self.scale, self.zero,
                                  num_bits=self.q_bits)
         return w.astype(self.dtype)
+
+    def matmul(self, x) -> jnp.ndarray:
+        """``x @ W`` without materialising the dequantized weight when a
+        packed-read kernel exists (FP6); otherwise dequant-then-dot."""
+        if self.q_bits == 6:
+            from deepspeed_tpu.ops.pallas.fp6_linear import fp6_matmul
+
+            return fp6_matmul(x, self.data, self.scale)
+        return x @ self.dequantized()
 
     @property
     def nbytes(self) -> int:

@@ -214,6 +214,9 @@ def config_from_hf(hf_config) -> TransformerConfig:
             norm="layernorm",
             activation=_map_hf_activation(mt, hf_config.hidden_act),
             use_rope=True, rotary_pct=hf_config.rotary_pct,
+            rope_theta=float(getattr(hf_config, "rope_theta", None)
+                             or getattr(hf_config, "rotary_emb_base",
+                                        10000.0)),
             parallel_block=bool(getattr(hf_config, "use_parallel_residual",
                                         True)),
             parallel_norms=bool(getattr(hf_config, "use_parallel_residual",
