@@ -41,10 +41,19 @@ def init_lora_params(key, in_dim: int, out_dim: int,
 def lora_linear(x, base, lora_A=None, lora_B=None,
                 lora_alpha: float = 16.0, lora_r: Optional[int] = None,
                 bias=None):
-    """y = x @ W_base (frozen) + (alpha/r) * (x @ A) @ B."""
-    w = base.dequantized() if isinstance(base, QuantizedParameter) else base
-    w = jax.lax.stop_gradient(w)
-    y = x @ w.astype(x.dtype)
+    """y = x @ W_base (frozen) + (alpha/r) * (x @ A) @ B.
+
+    Packed bases (FP6 q_bits=6) route through
+    :meth:`QuantizedParameter.matmul` so the base product reads only the
+    packed bytes; its custom VJP keeps dx flowing to upstream layers
+    while the packed ints stay frozen."""
+    if isinstance(base, QuantizedParameter) and base.q_bits == 6:
+        y = base.matmul(x)
+    else:
+        w = (base.dequantized() if isinstance(base, QuantizedParameter)
+             else base)
+        w = jax.lax.stop_gradient(w)
+        y = x @ w.astype(x.dtype)
     if lora_A is not None and lora_B is not None:
         r = lora_r or lora_A.shape[-1]
         scale = lora_alpha / r

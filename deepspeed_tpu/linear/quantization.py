@@ -66,12 +66,34 @@ class QuantizedParameter:
 
     def matmul(self, x) -> jnp.ndarray:
         """``x @ W`` without materialising the dequantized weight when a
-        packed-read kernel exists (FP6); otherwise dequant-then-dot."""
-        if self.q_bits == 6:
-            from deepspeed_tpu.ops.pallas.fp6_linear import fp6_matmul
+        packed-read kernel exists (FP6); otherwise dequant-then-dot.
 
-            return fp6_matmul(x, self.data, self.scale)
-        return x @ self.dequantized()
+        The FP6 path carries a custom VJP: the weight is frozen (packed
+        ints take no gradient), but dx = g @ Wᵀ must flow to upstream
+        layers — the backward dequantizes (LoRA training is not the
+        bandwidth-bound serve case the packed read exists for)."""
+        if self.q_bits != 6:
+            return x @ self.dequantized()
+        import jax
+
+        from deepspeed_tpu.ops.pallas.fp6_linear import (fp6_dequantize,
+                                                         fp6_matmul)
+
+        packed, scale = self.data, self.scale
+
+        @jax.custom_vjp
+        def mm(xx):
+            return fp6_matmul(xx, packed, scale)
+
+        def mm_fwd(xx):
+            return mm(xx), None
+
+        def mm_bwd(_, g):
+            w = fp6_dequantize(packed, scale, g.dtype)
+            return (g @ w.T,)
+
+        mm.defvjp(mm_fwd, mm_bwd)
+        return mm(x)
 
     @property
     def nbytes(self) -> int:

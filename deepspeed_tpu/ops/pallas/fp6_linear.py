@@ -159,10 +159,13 @@ def fp6_matmul(x, packed, scale, block_m: int = 256, block_n: int = 256,
         on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     except Exception:
         on_tpu = False
-    bm = min(block_m, m)
+    # bm: the largest divisor of M within the block budget, so ragged
+    # serving batch sizes (e.g. M=300) keep the packed-read path instead
+    # of silently falling back to full dequantization
+    bm = next((c for c in range(min(block_m, m), 0, -1) if m % c == 0), m)
     bn = min(block_n, n)
     bk4 = min(block_k4, k4)
-    servable = (m % bm == 0 and n % bn == 0 and k4 % bk4 == 0
+    servable = (n % bn == 0 and k4 % bk4 == 0
                 and bn % 128 == 0 and bk4 % 8 == 0)
     if not servable or not (on_tpu or INTERPRET):
         return (x @ fp6_dequantize(packed, scale, x.dtype))

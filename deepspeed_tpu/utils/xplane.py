@@ -1,0 +1,135 @@
+"""XPlane trace analysis: device-op timelines and collective/compute
+overlap.
+
+The on-chip counterpart of the Domino overlap claim (ref
+blogs/deepspeed-domino/README.md:126 — "50-100% of the communication is
+hidden"): given an XPlane capture (``jax.profiler.start_trace``), extract
+each TPU device plane's op events, classify them as collectives
+(all-reduce / all-gather / reduce-scatter / collective-permute /
+all-to-all) or compute (fusion / dot / convolution / custom-call), and
+measure what fraction of collective wall-time overlaps compute on the
+same device — the direct evidence that XLA scheduled chunk B's matmuls
+under chunk A's all-reduce.
+
+Parsing uses the xplane proto bundled with tensorflow
+(``tensorflow.tsl.profiler.protobuf.xplane_pb2``); everything here is
+pure-host analysis, importable without a TPU.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List, Sequence, Tuple
+
+_COLLECTIVE_MARKERS = ("all-reduce", "all-gather", "reduce-scatter",
+                       "collective-permute", "all-to-all")
+# NOTE: no "while" here — the scan-loop parent event spans the whole
+# layer loop (collectives included) and would count every in-loop
+# collective as hidden, inflating the metric toward 1.0
+_COMPUTE_MARKERS = ("fusion", "dot", "convolution", "custom-call")
+
+
+def find_xplane_files(logdir: str) -> List[str]:
+    return sorted(glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                            recursive=True))
+
+
+def load_xspace(path: str):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    return xs
+
+
+def device_op_intervals(xspace, device_substr: str = "TPU"
+                        ) -> Dict[str, Dict[str, List[Tuple[int, int]]]]:
+    """Per device plane: {"collective": [(start_ps, end_ps)...],
+    "compute": [...]} from the XLA-op lines."""
+    out: Dict[str, Dict[str, List[Tuple[int, int]]]] = {}
+    for plane in xspace.planes:
+        if device_substr not in plane.name:
+            continue
+        buckets = {"collective": [], "compute": []}
+        meta = plane.event_metadata
+        # TPU device planes carry several hierarchy lines ("XLA Modules",
+        # "Steps", "XLA Ops"); only the op-level line has leaf events —
+        # parent module/step spans would swallow the collectives.
+        op_lines = [ln for ln in plane.lines if "op" in ln.name.lower()]
+        for line in (op_lines or plane.lines):
+            base = line.timestamp_ns * 1000  # → ps
+            for ev in line.events:
+                name = meta[ev.metadata_id].name.lower()
+                start = base + ev.offset_ps
+                end = start + ev.duration_ps
+                if any(m in name for m in _COLLECTIVE_MARKERS):
+                    buckets["collective"].append((start, end))
+                elif any(m in name for m in _COMPUTE_MARKERS):
+                    buckets["compute"].append((start, end))
+        if buckets["collective"] or buckets["compute"]:
+            out[plane.name] = buckets
+    return out
+
+
+def _merge(intervals: Sequence[Tuple[int, int]]) -> List[Tuple[int, int]]:
+    merged: List[Tuple[int, int]] = []
+    for s, e in sorted(intervals):
+        if merged and s <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], e))
+        else:
+            merged.append((s, e))
+    return merged
+
+
+def overlap_fraction(collective: Sequence[Tuple[int, int]],
+                     compute: Sequence[Tuple[int, int]]) -> float:
+    """Fraction of total collective time that coincides with compute on
+    the same timeline.  1.0 = fully hidden communication."""
+    coll = _merge(collective)
+    comp = _merge(compute)
+    total = sum(e - s for s, e in coll)
+    if total == 0:
+        return 0.0
+    covered = 0
+    j = 0
+    for s, e in coll:
+        while j < len(comp) and comp[j][1] <= s:
+            j += 1
+        k = j
+        while k < len(comp) and comp[k][0] < e:
+            covered += min(e, comp[k][1]) - max(s, comp[k][0])
+            k += 1
+    return covered / total
+
+
+def analyze_logdir(logdir: str, device_substr: str = "TPU") -> Dict:
+    """Aggregate overlap stats over every device plane in a capture."""
+    files = find_xplane_files(logdir)
+    if not files:
+        return {"error": f"no xplane files under {logdir}"}
+    per_device = {}
+    for path in files:
+        for dev, b in device_op_intervals(load_xspace(path),
+                                          device_substr).items():
+            # multi-host captures: every host names its plane
+            # /device:TPU:0 — key by file too so hosts don't overwrite
+            if len(files) > 1:
+                dev = f"{os.path.basename(path)}:{dev}"
+            frac = overlap_fraction(b["collective"], b["compute"])
+            per_device[dev] = {
+                "overlap_fraction": round(frac, 4),
+                "collective_ms": round(sum(e - s for s, e
+                                           in _merge(b["collective"]))
+                                       / 1e9, 3),
+                "compute_ms": round(sum(e - s for s, e
+                                        in _merge(b["compute"])) / 1e9, 3),
+            }
+    if not per_device:
+        return {"error": "no device planes matched "
+                         f"{device_substr!r} (CPU captures carry host "
+                         "events only)"}
+    fracs = [d["overlap_fraction"] for d in per_device.values()]
+    return {"devices": per_device,
+            "mean_overlap_fraction": round(sum(fracs) / len(fracs), 4)}
