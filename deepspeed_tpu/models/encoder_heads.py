@@ -1,0 +1,30 @@
+"""Encoder task heads: BERT pooling + sequence classification.
+
+Analog of the reference's bert-injection serving surface
+(module_inject/containers/bert.py — HF BertPooler + the classification
+head): ``bert_pooled_classify`` consumes the encoder's hidden states
+(``forward(..., return_hidden=True)``) and produces [B, num_labels]
+logits through tanh-pooled [CLS] + the classifier linear.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def bert_pool(params, hidden) -> jnp.ndarray:
+    """HF BertPooler: tanh(dense([CLS])) — ``hidden`` [B, S, H] → [B, H].
+    ``params["pooler"]`` = {"w": [H, H], "b": [H]}."""
+    p = params["pooler"]
+    cls = hidden[:, 0]
+    return jnp.tanh(cls @ p["w"].astype(cls.dtype)
+                    + p["b"].astype(cls.dtype))
+
+
+def bert_pooled_classify(params, hidden) -> jnp.ndarray:
+    """Pooled classification logits [B, num_labels] (HF
+    BertForSequenceClassification head; eval path — dropout between the
+    pooler and classifier is a train-time-only op)."""
+    pooled = bert_pool(params, hidden)
+    c = params["classifier"]
+    return pooled @ c["w"].astype(pooled.dtype) + c["b"].astype(pooled.dtype)

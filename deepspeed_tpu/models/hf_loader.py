@@ -735,6 +735,15 @@ def _convert_bert(sd, cfg):
         "final_norm": {"scale": np.ones((h,), np.float32),
                        "bias": np.zeros((h,), np.float32)},
     }
+    # classification checkpoints (BertForSequenceClassification) carry a
+    # pooler + classifier instead of the MLM head; convert them so
+    # models.encoder_heads.bert_pooled_classify can serve the logits
+    if "bert.pooler.dense.weight" in sd:
+        out["pooler"] = {"w": sd["bert.pooler.dense.weight"].T,
+                         "b": sd["bert.pooler.dense.bias"]}
+    if "classifier.weight" in sd:
+        out["classifier"] = {"w": sd["classifier.weight"].T,
+                             "b": sd["classifier.bias"]}
     if not cfg.mlm_head:
         return out  # headless encoder (hidden states / classification)
     if "cls.predictions.transform.dense.weight" not in sd:

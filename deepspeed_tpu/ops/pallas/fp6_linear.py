@@ -151,6 +151,10 @@ def fp6_matmul(x, packed, scale, block_m: int = 256, block_n: int = 256,
     plane dots per tile (split-K over the plane structure), accumulating
     across the K grid in f32 scratch.  Falls back to the XLA
     dequantize-then-dot form off-TPU unless INTERPRET."""
+    lead = x.shape[:-1]
+    if x.ndim != 2:
+        # [..., K] activations (e.g. [B, S, H]) flatten to rows
+        x = x.reshape(-1, x.shape[-1])
     m, k = x.shape
     _, k4, n = packed.shape
     if k4 * 4 != k:
@@ -161,14 +165,17 @@ def fp6_matmul(x, packed, scale, block_m: int = 256, block_n: int = 256,
         on_tpu = False
     # bm: the largest divisor of M within the block budget, so ragged
     # serving batch sizes (e.g. M=300) keep the packed-read path instead
-    # of silently falling back to full dequantization
+    # of silently falling back to full dequantization.  A floor of 8
+    # (sublane) stops prime/awkward M degenerating into 1-row MXU tiles
+    # slower than the dequant fallback.
     bm = next((c for c in range(min(block_m, m), 0, -1) if m % c == 0), m)
     bn = min(block_n, n)
     bk4 = min(block_k4, k4)
-    servable = (n % bn == 0 and k4 % bk4 == 0
+    servable = (bm >= 8 and n % bn == 0 and k4 % bk4 == 0
                 and bn % 128 == 0 and bk4 % 8 == 0)
     if not servable or not (on_tpu or INTERPRET):
-        return (x @ fp6_dequantize(packed, scale, x.dtype))
+        out = x @ fp6_dequantize(packed, scale, x.dtype)
+        return out.reshape(lead + (n,))
 
     x4 = x.reshape(m, k4, 4).swapaxes(0, 2).swapaxes(1, 2)  # [4, M, K/4]
     nk = k4 // bk4
@@ -187,4 +194,4 @@ def fp6_matmul(x, packed, scale, block_m: int = 256, block_n: int = 256,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=INTERPRET,
     )(x4, packed, scale.reshape(1, n))
-    return out
+    return out.reshape(lead + (n,))

@@ -131,3 +131,17 @@ def test_lora_over_fp6_base_grads_flow():
     gx_ref = jax.grad(loss_ref)(x, lin.lora_A, b_rand)
     np.testing.assert_allclose(np.asarray(gx), np.asarray(gx_ref),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_fp6_matmul_batched_activations():
+    """[B, S, H] activations flatten through the packed path and restore
+    their leading shape (transformer-shaped callers)."""
+    rng = np.random.default_rng(4)
+    w = rng.standard_normal((64, 256)).astype(np.float32) * 0.1
+    packed, scale = f6.fp6_quantize(w)
+    x = jnp.asarray(rng.standard_normal((2, 5, 64)), jnp.float32)
+    out = f6.fp6_matmul.__wrapped__(x, packed, scale)
+    assert out.shape == (2, 5, 256)
+    ref = x.reshape(-1, 64) @ f6.fp6_dequantize(packed, scale, jnp.float32)
+    np.testing.assert_allclose(np.asarray(out).reshape(-1, 256),
+                               np.asarray(ref), rtol=1e-5, atol=1e-5)

@@ -419,3 +419,31 @@ def test_bloom_gptj_neox_generate_matches_hf():
                                       max_new_tokens=6))[0, 10:]
         np.testing.assert_array_equal(out, ref, err_msg=cfg.arch)
         topology._GLOBAL_TOPOLOGY = None
+
+
+def test_bert_sequence_classification_parity():
+    """Classification checkpoints: pooler + classifier convert, and
+    pooled logits match HF BertForSequenceClassification (eval mode)."""
+    from transformers import BertConfig, BertForSequenceClassification
+
+    from deepspeed_tpu.models.encoder_heads import bert_pooled_classify
+
+    torch.manual_seed(2)
+    m = BertForSequenceClassification(BertConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, type_vocab_size=2, num_labels=3))
+    m.eval()
+    cfg = config_from_hf(m.config).replace(dtype=jnp.float32,
+                                           mlm_head=False)
+    params = params_from_hf(m, cfg)
+    assert "pooler" in params and "classifier" in params
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, cfg.vocab_size, size=(2, 12), dtype=np.int64)
+    with torch.no_grad():
+        ref = m(torch.tensor(ids)).logits.float().numpy()
+    hidden = tf.forward(params, jnp.asarray(ids, jnp.int32), cfg,
+                        return_hidden=True)
+    out = np.asarray(bert_pooled_classify(params, hidden), np.float32)
+    assert out.shape == (2, 3)
+    np.testing.assert_allclose(out, ref, atol=2e-3, rtol=1e-3)

@@ -4,8 +4,11 @@ blogs/deepspeed-domino/README.md:126)."""
 
 import jax
 import numpy as np
+import pytest
 
-from deepspeed_tpu.utils import xplane
+pytest.importorskip("tensorflow")  # xplane proto ships with tensorflow
+
+from deepspeed_tpu.utils import xplane  # noqa: E402
 
 
 def test_overlap_fraction_math():
