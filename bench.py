@@ -499,7 +499,10 @@ def _run_row_subprocess(name: str, timeout_s: float = 900.0) -> dict:
             "error": ("no result line; " + " | ".join(tail[-3:]))[:300]}
 
 
-_ROW_TIMEOUTS = {"peak_params": 3000.0}
+# peak_params walks the ladder serially; the NVMe rungs alone can spend
+# 1500+1200+900 s before the cpu rungs run, so the row budget must cover
+# a failing-descent worst case
+_ROW_TIMEOUTS = {"peak_params": 5400.0}
 
 
 def main() -> None:

@@ -163,19 +163,24 @@ def fp6_matmul(x, packed, scale, block_m: int = 256, block_n: int = 256,
         on_tpu = jax.devices()[0].platform in ("tpu", "axon")
     except Exception:
         on_tpu = False
-    # bm: the largest divisor of M within the block budget, so ragged
-    # serving batch sizes (e.g. M=300) keep the packed-read path instead
-    # of silently falling back to full dequantization.  A floor of 8
-    # (sublane) stops prime/awkward M degenerating into 1-row MXU tiles
-    # slower than the dequant fallback.
-    bm = next((c for c in range(min(block_m, m), 0, -1) if m % c == 0), m)
+    # Awkward M (prime, 2·prime, …) would degenerate the largest-divisor
+    # tile into 1-2 rows; pad M up to a multiple of 8 (sublane) instead —
+    # a few zero rows beat either tiny tiles or falling back to reading
+    # the full dequantized weight on this weight-bandwidth-bound path.
+    m_pad = -(-m // 8) * 8
+    if m_pad != m:
+        x = jnp.concatenate(
+            [x, jnp.zeros((m_pad - m, k), x.dtype)], axis=0)
+    bm = next((c for c in range(min(block_m, m_pad), 7, -1)
+               if m_pad % c == 0), 8)
     bn = min(block_n, n)
     bk4 = min(block_k4, k4)
-    servable = (bm >= 8 and n % bn == 0 and k4 % bk4 == 0
+    servable = (n % bn == 0 and k4 % bk4 == 0
                 and bn % 128 == 0 and bk4 % 8 == 0)
     if not servable or not (on_tpu or INTERPRET):
-        out = x @ fp6_dequantize(packed, scale, x.dtype)
+        out = x[:m] @ fp6_dequantize(packed, scale, x.dtype)
         return out.reshape(lead + (n,))
+    m = m_pad
 
     x4 = x.reshape(m, k4, 4).swapaxes(0, 2).swapaxes(1, 2)  # [4, M, K/4]
     nk = k4 // bk4
@@ -194,4 +199,5 @@ def fp6_matmul(x, packed, scale, block_m: int = 256, block_n: int = 256,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=INTERPRET,
     )(x4, packed, scale.reshape(1, n))
-    return out.reshape(lead + (n,))
+    rows = int(np.prod(lead, dtype=np.int64)) if lead else 1
+    return out[:rows].reshape(lead + (n,))

@@ -145,3 +145,29 @@ def test_fp6_matmul_batched_activations():
     ref = x.reshape(-1, 64) @ f6.fp6_dequantize(packed, scale, jnp.float32)
     np.testing.assert_allclose(np.asarray(out).reshape(-1, 256),
                                np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_fp6_matmul_awkward_m_pads_not_falls_back(monkeypatch):
+    """Prime / 2*prime M pads to the sublane and KEEPS the packed-read
+    kernel (serving is weight-bandwidth-bound; dequant fallback would
+    re-read the full bf16 weight)."""
+    calls = {}
+    orig = f6.pl.pallas_call
+
+    def spy(*a, **kw):
+        calls["kernel"] = True
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(f6.pl, "pallas_call", spy)
+    rng = np.random.default_rng(5)
+    w = rng.standard_normal((64, 256)).astype(np.float32) * 0.1
+    packed, scale = f6.fp6_quantize(w)
+    for m in (7, 514):  # prime; 2*257
+        calls.clear()
+        x = jnp.asarray(rng.standard_normal((m, 64)), jnp.float32)
+        out = f6.fp6_matmul.__wrapped__(x, packed, scale)
+        assert calls.get("kernel"), f"M={m} fell back to dequant"
+        assert out.shape == (m, 256)
+        ref = x @ f6.fp6_dequantize(packed, scale, jnp.float32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
