@@ -135,6 +135,11 @@ class TransformerConfig:
     # sequence-tiled logits+loss (ALST, sequence/alst.py): never
     # materialises [B, S, V]; 0 = full logits
     loss_tiles: int = 0
+    # sequence-parallel attention form over the "seq" mesh axis:
+    # "ulysses" (all-to-all head exchange; needs heads % (tp·sp) == 0) |
+    # "ring" (K/V blocks rotate the ring with online softmax; no head
+    # divisibility requirement — sequence/ring.py)
+    seq_impl: str = "ulysses"
     # layer-scan unroll factor (XLA overlaps across unrolled iterations)
     scan_unroll: int = 1
     # residual/embedding dropout rate (GPT-2/BERT-class training; llama
@@ -514,6 +519,35 @@ def _attn_block(x, p, positions, cfg: TransformerConfig,
     v = proj(p["wv"], p.get("bv"), nkv * d).reshape(b, s, nkv, d)
     if cfg.use_rope:
         q, k = _rope(q, k, positions, cfg)
+
+    from deepspeed_tpu.parallel.topology import get_topology
+
+    topo = get_topology()
+    if (topo is not None and topo.sp_size > 1 and cfg.seq_impl == "ring"):
+        # Ring attention: K/V blocks rotate the seq ring (nearest-
+        # neighbour ppermute + online softmax) — no heads % sp
+        # requirement, unlike the Ulysses all-to-all below.
+        if attention_mask is not None:
+            raise NotImplementedError(
+                "attention_mask + ring sequence parallelism not supported")
+        if cfg.use_alibi:
+            raise NotImplementedError(
+                "alibi + ring sequence parallelism not supported (the "
+                "ring hop has no score-bias lane yet)")
+        if cfg.attn_impl == "sparse":
+            raise NotImplementedError(
+                "attn_impl='sparse' + ring sequence parallelism not "
+                "supported (dense ring hops would silently replace the "
+                "block-sparse layout's semantics)")
+        from deepspeed_tpu.sequence.ring import ring_attention
+
+        out = ring_attention(q, k, v, topo, causal=cfg.causal,
+                             window=cfg.sliding_window or None)
+        out = out.reshape(b, s, nh * d)
+        out = out @ p["wo"].astype(dt)
+        if p.get("bo") is not None:
+            out = out + p["bo"].astype(dt)
+        return out.astype(dt0)
 
     # Ulysses SP: re-shard seq-sharded q/k/v to head-sharded (XLA lowers the
     # layout switch to all-to-all over ICI; ref sequence/layer.py:331).

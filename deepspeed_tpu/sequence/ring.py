@@ -1,0 +1,135 @@
+"""Ring attention: context parallelism by rotating K/V blocks over ICI.
+
+The second half of the long-context story (the task the reference covers
+with Ulysses all-to-all + FPDT chunking; ring attention is the
+blockwise-rotation alternative of Liu et al. 2023): queries stay local to
+their sequence shard while K/V blocks travel the "seq" mesh ring one
+neighbour per hop (``lax.ppermute``), and a flash-style online softmax
+accumulates each visiting block.  Communication per hop is O(S_local·d)
+nearest-neighbour traffic that XLA overlaps with the block's attention
+compute — and, unlike Ulysses, there is NO heads % sp divisibility
+requirement, so it scales past the KV-head count (GQA models with 8 KV
+heads on a 16-way context mesh).
+
+Per-block math mirrors the Pallas flash kernel's online softmax
+(ops/pallas/flash_mha.py) with the block loop living on the mesh instead
+of the grid.  The block products are plain XLA einsums — on-chip they
+fuse; swapping the inner block for the flash kernel is a later
+optimization that doesn't change this interface.
+
+Causal masking uses global positions (shard i's queries own rows
+[i·S_l, (i+1)·S_l)); hops whose source block lies entirely in the masked
+future contribute nothing (their probabilities are zeroed — compute is
+spent but numerics are exact; skipping them is the classic ring-attention
+load-imbalance optimization, also a later step).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import SEQ_AXIS, get_topology
+
+_NEG = -1e30
+
+
+def ring_attention(q, k, v, topo=None, causal: bool = True,
+                   sm_scale: Optional[float] = None,
+                   window: Optional[int] = None):
+    """q/k/v: [B, S, H, D] GLOBAL arrays with S sharded over "seq".
+    Returns [B, S, H, D].  GQA KV heads are repeated locally.  Must be
+    called under jit (partial-manual shard_map over the seq axis; batch
+    and head dims stay in GSPMD auto mode)."""
+    topo = topo or get_topology()
+    sp = topo.sp_size if topo is not None else 1
+    nh = q.shape[2]
+    rep = nh // k.shape[2]  # GQA group: K/V travel the ring UNREPEATED
+    scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
+    if sp == 1:
+        if rep != 1:
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
+        return _block_attend_single(q, k, v, scale, causal, window)
+
+    def body(ql, kl, vl):
+        idx = lax.axis_index(SEQ_AXIS)
+        b, s_l, nh_, d = ql.shape
+        qf = ql.astype(jnp.float32)
+        q_pos = idx * s_l + jnp.arange(s_l)
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+        def attend(m, l, acc, kc, vc, t):
+            """One block's online-softmax update.  K/V are expanded to the
+            query-head count HERE, after the hop — per-hop ICI traffic is
+            O(S_l·nkv·d), not O(S_l·nh·d) (the GQA/MQA point of ring)."""
+            src = lax.rem(idx - t + sp, sp)
+            k_pos = src * s_l + jnp.arange(s_l)
+            kr = kc if rep == 1 else jnp.repeat(kc, rep, axis=2)
+            vr = vc if rep == 1 else jnp.repeat(vc, rep, axis=2)
+            s = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                           kr.astype(jnp.float32)) * scale
+            valid = jnp.ones((s_l, s_l), bool)
+            if causal:
+                valid = q_pos[:, None] >= k_pos[None, :]
+            if window is not None:
+                valid &= (q_pos[:, None] - k_pos[None, :]) < window
+            s = jnp.where(valid[None, None], s, _NEG)
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, m_cur)
+            # exp(NEG - NEG) would be 1 on fully-masked rows — zero the
+            # masked probabilities explicitly
+            p = jnp.where(valid[None, None], jnp.exp(s - m_new), 0.0)
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+            acc = acc * alpha + jnp.einsum(
+                "bhqk,bkhd->bhqd", p, vr.astype(jnp.float32))
+            return m_new, l, acc
+
+        def hop(carry, t):
+            m, l, acc, kc, vc = carry
+            m, l, acc = attend(m, l, acc, kc, vc, t)
+            kc = lax.ppermute(kc, SEQ_AXIS, perm)
+            vc = lax.ppermute(vc, SEQ_AXIS, perm)
+            return (m, l, acc, kc, vc), None
+
+        m0 = jnp.full((b, nh_, s_l, 1), _NEG, jnp.float32)
+        l0 = jnp.zeros((b, nh_, s_l, 1), jnp.float32)
+        a0 = jnp.zeros((b, nh_, s_l, d), jnp.float32)
+        # sp-1 hops permute after attending; the LAST block attends
+        # without the dead ring rotation (a collective inside scan that
+        # XLA cannot eliminate)
+        (m, l, acc, kc, vc), _ = lax.scan(
+            hop, (m0, l0, a0, kl, vl), jnp.arange(sp - 1))
+        m, l, acc = attend(m, l, acc, kc, vc, jnp.int32(sp - 1))
+        out = acc / jnp.maximum(l, 1e-20)
+        return out.swapaxes(1, 2).astype(ql.dtype)
+
+    ctx = jax.sharding.get_abstract_mesh()
+    mesh = topo.mesh if ctx.empty else ctx
+    spec = P(None, SEQ_AXIS, None, None)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={SEQ_AXIS},
+                         check_vma=False)(q, k, v)
+
+
+def _block_attend_single(q, k, v, scale, causal, window):
+    """sp=1 degenerate form (same math, no ring)."""
+    s_len = q.shape[1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    valid = jnp.ones((s_len, s_len), bool)
+    if causal:
+        pos = jnp.arange(s_len)
+        valid = pos[:, None] >= pos[None, :]
+    if window is not None:
+        pos = jnp.arange(s_len)
+        valid &= (pos[:, None] - pos[None, :]) < window
+    s = jnp.where(valid[None, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)

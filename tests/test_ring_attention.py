@@ -1,0 +1,120 @@
+"""Ring attention (sequence/ring.py): K/V blocks rotating the "seq" mesh
+ring with online softmax — the context-parallel alternative to Ulysses
+(no heads % sp requirement).  Parity against full attention, gradients,
+and engine training on a seq mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+from deepspeed_tpu.sequence.ring import ring_attention
+
+
+def _ref_attention(q, k, v, causal=True, window=None):
+    s_len = q.shape[1]
+    nh, nkv = q.shape[2], k.shape[2]
+    if nkv != nh:
+        k = jnp.repeat(k, nh // nkv, axis=2)
+        v = jnp.repeat(v, nh // nkv, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(q.shape[-1])
+    pos = jnp.arange(s_len)
+    valid = jnp.ones((s_len, s_len), bool)
+    if causal:
+        valid = pos[:, None] >= pos[None, :]
+    if window is not None:
+        valid &= (pos[:, None] - pos[None, :]) < window
+    s = jnp.where(valid[None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+
+@pytest.fixture
+def seq_topo():
+    topo = MeshTopology({"seq": 4, "data": 2})
+    set_topology(topo)
+    yield topo
+    set_topology(None)
+
+
+@pytest.mark.parametrize("causal,window,nkv", [
+    (True, None, 4),     # causal MHA
+    (False, None, 4),    # bidirectional
+    (True, 8, 4),        # sliding window
+    (True, None, 1),     # MQA: 1 KV head on a 4-way seq ring — the case
+                         # Ulysses cannot shard (heads % sp fails)
+])
+def test_ring_matches_full_attention(seq_topo, causal, window, nkv):
+    rng = np.random.default_rng(0)
+    b, s, nh, d = 2, 32, 4, 16
+    q = jnp.asarray(rng.standard_normal((b, s, nh, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, nkv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, nkv, d)), jnp.float32)
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, seq_topo, causal=causal, window=window))(q, k, v)
+    ref = _ref_attention(q, k, v, causal=causal, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_grads_match_reference(seq_topo):
+    rng = np.random.default_rng(1)
+    b, s, nh, d = 2, 16, 2, 8
+    q = jnp.asarray(rng.standard_normal((b, s, nh, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, nh, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, nh, d)), jnp.float32)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, seq_topo) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref_attention(q, k, v) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, r in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_ring_engine_training_matches_ulysses():
+    """llama-tiny on a seq=4 mesh: ring and Ulysses are the same math in
+    a different order — losses must track closely, and ring must train."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import get_model_config
+    from deepspeed_tpu.parallel import topology
+
+    losses = {}
+    for impl in ("ring", "ulysses"):
+        model = get_model_config("llama-tiny", seq_impl=impl,
+                                 attn_impl="xla")
+        config = {
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "mesh": {"seq": 4, "data": 2},
+            "steps_per_print": 10_000,
+        }
+        engine, _, _, _ = ds.initialize(model=model, config=config, seed=7)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, model.vocab_size, size=(8, 33), dtype=np.int32)
+        batch = {"input_ids": ids[:, :-1],
+                 "labels": ids[:, 1:].astype(np.int32)}
+        losses[impl] = [float(np.asarray(engine.train_batch(batch)))
+                        for _ in range(4)]
+        assert losses[impl][-1] < losses[impl][0], (impl, losses[impl])
+        topology._GLOBAL_TOPOLOGY = None
+    np.testing.assert_allclose(losses["ring"], losses["ulysses"],
+                               rtol=5e-3, atol=5e-3)
+
+
+def test_ring_collectives_are_ppermute(seq_topo):
+    """The compiled ring must move K/V with collective-permute edges (the
+    nearest-neighbour ICI pattern), not all-to-all or all-gather."""
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((2, 32, 4, 16)), jnp.float32)
+    hlo = jax.jit(lambda q: ring_attention(q, q, q, seq_topo)).lower(
+        q).compile().as_text()
+    assert "collective-permute" in hlo
+    assert "all-to-all" not in hlo
