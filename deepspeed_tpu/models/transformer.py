@@ -523,6 +523,9 @@ def _attn_block(x, p, positions, cfg: TransformerConfig,
     from deepspeed_tpu.parallel.topology import get_topology
 
     topo = get_topology()
+    if cfg.seq_impl not in ("ulysses", "ring"):
+        raise ValueError(f"seq_impl={cfg.seq_impl!r}: expected 'ulysses' "
+                         "or 'ring'")
     if (topo is not None and topo.sp_size > 1 and cfg.seq_impl == "ring"):
         # Ring attention: K/V blocks rotate the seq ring (nearest-
         # neighbour ppermute + online softmax) — no heads % sp

@@ -59,35 +59,35 @@ def ring_attention(q, k, v, topo=None, causal: bool = True,
     def body(ql, kl, vl):
         idx = lax.axis_index(SEQ_AXIS)
         b, s_l, nh_, d = ql.shape
-        qf = ql.astype(jnp.float32)
+        nkv = kl.shape[2]
+        # grouped-head layout: K/V stay at nkv heads END TO END — they
+        # travel the ring unrepeated AND feed the einsums unexpanded
+        # (per-hop ICI traffic and per-hop HBM are both O(S_l·nkv·d))
+        q5 = ql.astype(jnp.float32).reshape(b, s_l, nkv, rep, d)
         q_pos = idx * s_l + jnp.arange(s_l)
         perm = [(i, (i + 1) % sp) for i in range(sp)]
 
         def attend(m, l, acc, kc, vc, t):
-            """One block's online-softmax update.  K/V are expanded to the
-            query-head count HERE, after the hop — per-hop ICI traffic is
-            O(S_l·nkv·d), not O(S_l·nh·d) (the GQA/MQA point of ring)."""
             src = lax.rem(idx - t + sp, sp)
             k_pos = src * s_l + jnp.arange(s_l)
-            kr = kc if rep == 1 else jnp.repeat(kc, rep, axis=2)
-            vr = vc if rep == 1 else jnp.repeat(vc, rep, axis=2)
-            s = jnp.einsum("bqhd,bkhd->bhqk", qf,
-                           kr.astype(jnp.float32)) * scale
+            s = jnp.einsum("bqcgd,bscd->bcgqs", q5,
+                           kc.astype(jnp.float32)) * scale
             valid = jnp.ones((s_l, s_l), bool)
             if causal:
                 valid = q_pos[:, None] >= k_pos[None, :]
             if window is not None:
                 valid &= (q_pos[:, None] - k_pos[None, :]) < window
-            s = jnp.where(valid[None, None], s, _NEG)
+            vm = valid[None, None, None]
+            s = jnp.where(vm, s, _NEG)
             m_cur = jnp.max(s, axis=-1, keepdims=True)
             m_new = jnp.maximum(m, m_cur)
             # exp(NEG - NEG) would be 1 on fully-masked rows — zero the
             # masked probabilities explicitly
-            p = jnp.where(valid[None, None], jnp.exp(s - m_new), 0.0)
+            p = jnp.where(vm, jnp.exp(s - m_new), 0.0)
             alpha = jnp.exp(m - m_new)
             l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
             acc = acc * alpha + jnp.einsum(
-                "bhqk,bkhd->bhqd", p, vr.astype(jnp.float32))
+                "bcgqs,bscd->bcgqd", p, vc.astype(jnp.float32))
             return m_new, l, acc
 
         def hop(carry, t):
@@ -97,17 +97,18 @@ def ring_attention(q, k, v, topo=None, causal: bool = True,
             vc = lax.ppermute(vc, SEQ_AXIS, perm)
             return (m, l, acc, kc, vc), None
 
-        m0 = jnp.full((b, nh_, s_l, 1), _NEG, jnp.float32)
-        l0 = jnp.zeros((b, nh_, s_l, 1), jnp.float32)
-        a0 = jnp.zeros((b, nh_, s_l, d), jnp.float32)
+        m0 = jnp.full((b, nkv, rep, s_l, 1), _NEG, jnp.float32)
+        l0 = jnp.zeros((b, nkv, rep, s_l, 1), jnp.float32)
+        a0 = jnp.zeros((b, nkv, rep, s_l, d), jnp.float32)
         # sp-1 hops permute after attending; the LAST block attends
         # without the dead ring rotation (a collective inside scan that
         # XLA cannot eliminate)
         (m, l, acc, kc, vc), _ = lax.scan(
             hop, (m0, l0, a0, kl, vl), jnp.arange(sp - 1))
         m, l, acc = attend(m, l, acc, kc, vc, jnp.int32(sp - 1))
-        out = acc / jnp.maximum(l, 1e-20)
-        return out.swapaxes(1, 2).astype(ql.dtype)
+        out = acc / jnp.maximum(l, 1e-20)        # [b, nkv, rep, q, d]
+        out = out.transpose(0, 3, 1, 2, 4).reshape(b, s_l, nh_, d)
+        return out.astype(ql.dtype)
 
     ctx = jax.sharding.get_abstract_mesh()
     mesh = topo.mesh if ctx.empty else ctx

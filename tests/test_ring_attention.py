@@ -43,8 +43,8 @@ def seq_topo():
     (True, None, 4),     # causal MHA
     (False, None, 4),    # bidirectional
     (True, 8, 4),        # sliding window
-    (True, None, 1),     # MQA: 1 KV head on a 4-way seq ring — the case
-                         # Ulysses cannot shard (heads % sp fails)
+    (True, None, 1),     # MQA: 1 KV head on a 4-way seq ring (K/V
+                         # travel and attend ungrouped at nkv=1)
 ])
 def test_ring_matches_full_attention(seq_topo, causal, window, nkv):
     rng = np.random.default_rng(0)
@@ -57,6 +57,26 @@ def test_ring_matches_full_attention(seq_topo, causal, window, nkv):
     ref = _ref_attention(q, k, v, causal=causal, window=window)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_ring_sp_exceeds_query_heads():
+    """seq ring LARGER than the query-head count — the regime Ulysses
+    cannot shard at all (heads % sp fails): ring must still match full
+    attention exactly."""
+    topo = MeshTopology({"seq": 8})
+    set_topology(topo)
+    try:
+        rng = np.random.default_rng(3)
+        b, s, nh, nkv, d = 2, 32, 4, 2, 16
+        q = jnp.asarray(rng.standard_normal((b, s, nh, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, s, nkv, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, s, nkv, d)), jnp.float32)
+        out = jax.jit(lambda q, k, v: ring_attention(q, k, v, topo))(q, k, v)
+        ref = _ref_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        set_topology(None)
 
 
 def test_ring_grads_match_reference(seq_topo):
@@ -87,24 +107,29 @@ def test_ring_engine_training_matches_ulysses():
     from deepspeed_tpu.parallel import topology
 
     losses = {}
-    for impl in ("ring", "ulysses"):
-        model = get_model_config("llama-tiny", seq_impl=impl,
-                                 attn_impl="xla")
-        config = {
-            "train_micro_batch_size_per_gpu": 4,
-            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
-            "mesh": {"seq": 4, "data": 2},
-            "steps_per_print": 10_000,
-        }
-        engine, _, _, _ = ds.initialize(model=model, config=config, seed=7)
-        rng = np.random.default_rng(0)
-        ids = rng.integers(0, model.vocab_size, size=(8, 33), dtype=np.int32)
-        batch = {"input_ids": ids[:, :-1],
-                 "labels": ids[:, 1:].astype(np.int32)}
-        losses[impl] = [float(np.asarray(engine.train_batch(batch)))
-                        for _ in range(4)]
-        assert losses[impl][-1] < losses[impl][0], (impl, losses[impl])
-        topology._GLOBAL_TOPOLOGY = None
+    try:
+        for impl in ("ring", "ulysses"):
+            model = get_model_config("llama-tiny", seq_impl=impl,
+                                     attn_impl="xla")
+            config = {
+                "train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "mesh": {"seq": 4, "data": 2},
+                "steps_per_print": 10_000,
+            }
+            engine, _, _, _ = ds.initialize(model=model, config=config,
+                                            seed=7)
+            rng = np.random.default_rng(0)
+            ids = rng.integers(0, model.vocab_size, size=(8, 33),
+                               dtype=np.int32)
+            batch = {"input_ids": ids[:, :-1],
+                     "labels": ids[:, 1:].astype(np.int32)}
+            losses[impl] = [float(np.asarray(engine.train_batch(batch)))
+                            for _ in range(4)]
+            assert losses[impl][-1] < losses[impl][0], (impl, losses[impl])
+            topology.set_topology(None)
+    finally:
+        topology.set_topology(None)
     np.testing.assert_allclose(losses["ring"], losses["ulysses"],
                                rtol=5e-3, atol=5e-3)
 
