@@ -22,6 +22,13 @@ Causal masking uses global positions (shard i's queries own rows
 future contribute nothing (their probabilities are zeroed — compute is
 spent but numerics are exact; skipping them is the classic ring-attention
 load-imbalance optimization, also a later step).
+
+Known partitioner wart: composed with ZeRO-2 on a data×seq mesh, XLA's
+SPMD partitioner reports one "involuntary full rematerialization" for a
+backward residual crossing the partial-manual boundary (it replicates a
+[B, S_l, H] tensor before resharding — its own warning points to the
+Shardy tracker b/433785288).  Numerics are unaffected; revisit the
+in/out specs once Shardy lands.
 """
 
 from __future__ import annotations
