@@ -105,7 +105,8 @@ def _paged_attention_xla(q, k_pages, v_pages, gather_idx, token_pos,
         v_ctx = v_pages[:, gather_idx]
     g = nh // nkv
     qg = q.reshape(t, nkv, g, d)
-    scale = 1.0 / math.sqrt(cfg.dim_per_head)
+    scale = (cfg.attn_scale if cfg.attn_scale is not None
+             else 1.0 / math.sqrt(cfg.dim_per_head))
     scores = jnp.einsum("tkgd,ktcd->tkgc", qg, k_ctx) * scale
     c_pos = jnp.arange(scores.shape[-1], dtype=jnp.int32)
     if cfg.use_alibi:
@@ -156,7 +157,8 @@ def _attn_impl_pallas(q, k_pages, v_pages, gather_idx, token_pos,
             "attention='paged_pallas' has no ALiBi score-bias lane — use "
             "'auto' or 'paged_xla' for bloom-class models")
     pages = block_tables[token_slot]  # [T, NB]
-    scale = 1.0 / math.sqrt(cfg.dim_per_head)
+    scale = (cfg.attn_scale if cfg.attn_scale is not None
+             else 1.0 / math.sqrt(cfg.dim_per_head))
     if _is_quant_cache(k_pages):
         return paged_decode_attention(
             q, k_pages["q"], v_pages["q"], pages, token_pos, token_ctx_len,
@@ -290,7 +292,9 @@ def ragged_forward(params, cache_k, cache_v, token_ids, token_slot, token_pos,
     """
     dt = cfg.dtype
     x = params["embed"]["tokens"].astype(dt)[token_ids]  # [T, H]
-    if cfg.arch == "gpt2":
+    if cfg.has_learned_positions and "positions" in params["embed"]:
+        # gpt2/opt/gpt-neo learned positions (OPT's +2 offset is already
+        # stripped at conversion, so token_pos indexes directly)
         x = x + params["embed"]["positions"].astype(dt)[token_pos]
     if cfg.embed_norm:
         x = _norm(x, params["embed"]["norm"], cfg)  # Bloom embedding LN
@@ -306,23 +310,58 @@ def ragged_forward(params, cache_k, cache_v, token_ids, token_slot, token_pos,
 
     moe_every = max(1, cfg.moe_layer_freq)
 
-    def body(h, scanned):
-        lp, ck_l, cv_l, idx = scanned
-        if not cfg.is_moe:
-            is_moe_layer = False
-        elif moe_every == 1:
-            # static: every layer is MoE — keeps the selection out of
-            # lax.cond so the expert-parallel shard_map path can apply
-            is_moe_layer = True
-        else:
-            is_moe_layer = (idx % moe_every) == (moe_every - 1)
-        h, ck_l, cv_l = _ragged_layer(h, lp, ck_l, cv_l, meta, cfg,
-                                      layer_is_moe=is_moe_layer)
-        return h, (ck_l, cv_l)
+    if cfg.alt_window:
+        # GPT-Neo alternating global/local: scan layer PAIRS so each
+        # member's window is static (see models/transformer scan_segment)
+        if cfg.is_moe:
+            raise NotImplementedError("alt_window + MoE not supported")
+        if cfg.num_layers % 2:
+            raise NotImplementedError(
+                "alt_window needs an even layer count (the ragged path "
+                f"scans layer pairs; got {cfg.num_layers})")
+        pairs = cfg.num_layers // 2
 
-    layer_idx = jnp.arange(cfg.num_layers)
-    x, (cache_k, cache_v) = lax.scan(
-        body, x, (params["layers"], cache_k, cache_v, layer_idx))
+        def body2(h, scanned):
+            lp, ck_l, cv_l, idx = scanned
+            ck_out, cv_out = [], []
+            for j in range(2):
+                sub = jax.tree.map(lambda p, j=j: p[j], lp)
+                lcfg = cfg if j % 2 else cfg.replace(sliding_window=None)
+                h, ck_j, cv_j = _ragged_layer(
+                    h, sub, jax.tree.map(lambda c, j=j: c[j], ck_l),
+                    jax.tree.map(lambda c, j=j: c[j], cv_l), meta, lcfg)
+                ck_out.append(ck_j)
+                cv_out.append(cv_j)
+            stack = lambda xs: jax.tree.map(
+                lambda *ys: jnp.stack(ys, axis=0), *xs)
+            return h, (stack(ck_out), stack(cv_out))
+
+        pair = lambda tree: jax.tree.map(
+            lambda a: a.reshape((pairs, 2) + a.shape[1:]), tree)
+        unpair = lambda tree: jax.tree.map(
+            lambda a: a.reshape((cfg.num_layers,) + a.shape[2:]), tree)
+        x, (cache_k, cache_v) = lax.scan(
+            body2, x, (pair(params["layers"]), pair(cache_k),
+                       pair(cache_v), jnp.arange(pairs)))
+        cache_k, cache_v = unpair(cache_k), unpair(cache_v)
+    else:
+        def body(h, scanned):
+            lp, ck_l, cv_l, idx = scanned
+            if not cfg.is_moe:
+                is_moe_layer = False
+            elif moe_every == 1:
+                # static: every layer is MoE — keeps the selection out of
+                # lax.cond so the expert-parallel shard_map path can apply
+                is_moe_layer = True
+            else:
+                is_moe_layer = (idx % moe_every) == (moe_every - 1)
+            h, ck_l, cv_l = _ragged_layer(h, lp, ck_l, cv_l, meta, cfg,
+                                          layer_is_moe=is_moe_layer)
+            return h, (ck_l, cv_l)
+
+        layer_idx = jnp.arange(cfg.num_layers)
+        x, (cache_k, cache_v) = lax.scan(
+            body, x, (params["layers"], cache_k, cache_v, layer_idx))
 
     x = _norm(x, params["final_norm"], cfg)
     last = x[logits_idx]  # [S+1, H] — ref: logits_gather

@@ -203,6 +203,39 @@ def config_from_hf(hf_config) -> TransformerConfig:
             parallel_block=True, use_bias=False, mlp_bias=True,
             tie_embeddings=False,
             layernorm_eps=getattr(hf_config, "layer_norm_epsilon", 1e-5))
+    if mt == "gpt_neo":
+        # alternating global/local attention, learned positions, NO
+        # sqrt(d) score scaling, biasless q/k/v with biased out/mlp
+        layers = list(getattr(hf_config, "attention_layers", []))
+        alt = (len(layers) == hf_config.num_layers and all(
+            p == ("global" if i % 2 == 0 else "local")
+            for i, p in enumerate(layers)))
+        all_global = all(p == "global" for p in layers)
+        if not (alt or all_global):
+            raise ValueError(
+                f"gpt_neo: unsupported attention_layers pattern {layers} "
+                "(supported: all-global, or alternating global/local)")
+        if alt and hf_config.num_layers % 2:
+            raise ValueError(
+                "gpt_neo: alternating attention needs an even layer count "
+                f"(got {hf_config.num_layers}) — the alt-window paths scan "
+                "layer pairs")
+        return TransformerConfig(
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            intermediate_size=(hf_config.intermediate_size
+                               or 4 * hf_config.hidden_size),
+            num_layers=hf_config.num_layers,
+            num_heads=hf_config.num_heads,
+            max_seq_len=hf_config.max_position_embeddings, arch="gptneo",
+            norm="layernorm",
+            activation=_map_hf_activation(
+                mt, getattr(hf_config, "activation_function", "gelu_new")),
+            learned_positions=True, use_bias=False, mlp_bias=True,
+            attn_out_bias=True, alt_window=alt,
+            sliding_window=(hf_config.window_size if alt else None),
+            attn_scale=1.0, tie_embeddings=True,
+            layernorm_eps=getattr(hf_config, "layer_norm_epsilon", 1e-5))
     if mt == "gpt_neox":
         return TransformerConfig(
             vocab_size=hf_config.vocab_size,
@@ -663,6 +696,37 @@ def _convert_gptj(sd, cfg):
     return out
 
 
+def _convert_gptneo(sd, cfg):
+    """HF GPTNeoForCausalLM → functional tree (ref
+    module_inject/containers/gptneo.py).  q/k/v carry no bias; out_proj
+    and the MLP do."""
+    layers = []
+    for i in range(cfg.num_layers):
+        p = f"transformer.h.{i}."
+        layers.append({
+            "attn": {"wq": sd[p + "attn.attention.q_proj.weight"].T,
+                     "wk": sd[p + "attn.attention.k_proj.weight"].T,
+                     "wv": sd[p + "attn.attention.v_proj.weight"].T,
+                     "wo": sd[p + "attn.attention.out_proj.weight"].T,
+                     "bo": sd[p + "attn.attention.out_proj.bias"]},
+            "mlp": {"wi": sd[p + "mlp.c_fc.weight"].T,
+                    "bi": sd[p + "mlp.c_fc.bias"],
+                    "wo": sd[p + "mlp.c_proj.weight"].T,
+                    "bo": sd[p + "mlp.c_proj.bias"]},
+            "ln1": {"scale": sd[p + "ln_1.weight"],
+                    "bias": sd[p + "ln_1.bias"]},
+            "ln2": {"scale": sd[p + "ln_2.weight"],
+                    "bias": sd[p + "ln_2.bias"]},
+        })
+    return {
+        "embed": {"tokens": sd["transformer.wte.weight"],
+                  "positions": sd["transformer.wpe.weight"]},
+        "layers": _stack(layers),
+        "final_norm": {"scale": sd["transformer.ln_f.weight"],
+                       "bias": sd["transformer.ln_f.bias"]},
+    }
+
+
 def _convert_gptneox(sd, cfg):
     """HF GPTNeoXForCausalLM → functional tree (ref
     module_inject/containers/gptneox.py)."""
@@ -829,5 +893,6 @@ for _arch, _fn in (("gpt2", _convert_gpt2), ("llama", _convert_llama),
                    ("qwen", _convert_qwen), ("bert", _convert_bert),
                    ("distilbert", _convert_distilbert),
                    ("bloom", _convert_bloom), ("gptj", _convert_gptj),
-                   ("gptneox", _convert_gptneox)):
+                   ("gptneox", _convert_gptneox),
+                   ("gptneo", _convert_gptneo)):
     register_converter(_arch, _fn)

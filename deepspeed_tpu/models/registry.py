@@ -232,3 +232,20 @@ register("gptneox-tiny", TransformerConfig(
     activation="gelu_exact", use_rope=True, rotary_pct=0.25,
     parallel_block=True, parallel_norms=True, use_bias=True,
     tie_embeddings=False))
+
+
+register("gptneo-1.3b", TransformerConfig(
+    vocab_size=50257, hidden_size=2048, intermediate_size=8192,
+    num_layers=24, num_heads=16, max_seq_len=2048, arch="gptneo",
+    norm="layernorm", activation="gelu", learned_positions=True,
+    use_bias=False, mlp_bias=True, attn_out_bias=True, alt_window=True,
+    sliding_window=256,
+    attn_scale=1.0, tie_embeddings=True))
+
+register("gptneo-tiny", TransformerConfig(
+    vocab_size=512, hidden_size=128, intermediate_size=512, num_layers=2,
+    num_heads=4, max_seq_len=256, arch="gptneo", norm="layernorm",
+    activation="gelu", learned_positions=True, use_bias=False,
+    mlp_bias=True, attn_out_bias=True, alt_window=True,
+    sliding_window=16, attn_scale=1.0,
+    tie_embeddings=True))

@@ -58,6 +58,14 @@ class TransformerConfig:
     use_bias: Optional[bool] = None  # all proj biases; None → gpt2/opt
     qkv_bias: bool = False  # qkv-only bias (Qwen2)
     sliding_window: Optional[int] = None  # Mistral
+    # GPT-Neo attention_types: ODD global layer indices use
+    # sliding_window ("local"), even ones attend globally.  Realized by
+    # scanning layer PAIRS with a static per-member config — no dynamic
+    # masks (ref module_inject/containers/gptneo.py)
+    alt_window: bool = False
+    # attention score scale; None → 1/sqrt(head_dim).  GPT-Neo famously
+    # omits the sqrt(d) scaling (scale = 1.0)
+    attn_scale: Optional[float] = None
     # ALiBi positional bias (Bloom): score += slope[h] · key_position —
     # used instead of rope/learned positions
     use_alibi: bool = False
@@ -67,6 +75,9 @@ class TransformerConfig:
     # MLP bias independent of attention bias (GPT-J: biasless attention,
     # biased MLP); None → follows has_bias
     mlp_bias: Optional[bool] = None
+    # attention OUT-projection bias independent of q/k/v bias (GPT-Neo:
+    # biasless q/k/v, biased out_proj); None → follows has_bias
+    attn_out_bias: Optional[bool] = None
     # False = bidirectional (encoder/BERT-class) attention.  The reference
     # trains encoders through its fused transformer kernel
     # (ops/transformer/transformer.py:296 DeepSpeedTransformerLayer) and
@@ -205,6 +216,11 @@ class TransformerConfig:
     def has_mlp_bias(self) -> bool:
         return self.has_bias if self.mlp_bias is None else self.mlp_bias
 
+    @property
+    def has_attn_out_bias(self) -> bool:
+        return (self.has_bias if self.attn_out_bias is None
+                else self.attn_out_bias)
+
     def replace(self, **kw) -> "TransformerConfig":
         return dataclasses.replace(self, **kw)
 
@@ -235,7 +251,7 @@ def init_layer_params(cfg: TransformerConfig, key) -> Params:
         attn["bq"] = jnp.zeros((nh * hd,), pd)
         attn["bk"] = jnp.zeros((nkv * hd,), pd)
         attn["bv"] = jnp.zeros((nkv * hd,), pd)
-    if cfg.has_bias:
+    if cfg.has_attn_out_bias:
         attn["bo"] = jnp.zeros((h,), pd)
 
     def mlp_params(k1, k2, k3):
@@ -454,7 +470,8 @@ def _attention_scores(q, k, v, cfg: TransformerConfig, segment_pos=None,
         rep = nh // nkv
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(d)
+    scale = cfg.attn_scale if cfg.attn_scale is not None else 1 / math.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if cfg.use_alibi:
         # Bloom ALiBi: slope[h] · key_position added to the scores (HF's
         # key-position form — per-query-row softmax shift makes it
@@ -545,6 +562,7 @@ def _attn_block(x, p, positions, cfg: TransformerConfig,
         from deepspeed_tpu.sequence.ring import ring_attention
 
         out = ring_attention(q, k, v, topo, causal=cfg.causal,
+                             sm_scale=cfg.attn_scale,
                              window=cfg.sliding_window or None)
         out = out.reshape(b, s, nh * d)
         out = out @ p["wo"].astype(dt)
@@ -578,6 +596,7 @@ def _attn_block(x, p, positions, cfg: TransformerConfig,
         from deepspeed_tpu.ops.flash_attention import flash_attention
 
         out = flash_attention(q, k, v, causal=cfg.causal,
+                              sm_scale=cfg.attn_scale,
                               window=cfg.sliding_window or None)
     else:
         out = _attention_scores(q, k, v, cfg)
@@ -768,6 +787,10 @@ def make_pipeline_stage_fn(cfg: TransformerConfig, topo):
     if cfg.num_layers % pp:
         raise ValueError(f"num_layers={cfg.num_layers} not divisible by "
                          f"pipeline stages ({pp})")
+    if cfg.alt_window:
+        raise NotImplementedError(
+            "alt_window (GPT-Neo alternating local attention) + pipeline "
+            "parallelism not supported (stage fns scan a uniform body)")
     lp_count = cfg.num_layers // pp
     f = max(1, cfg.moe_layer_freq) if cfg.is_moe else 1
     if cfg.is_moe and lp_count % f != 0:
@@ -925,17 +948,34 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
             random-LTD band cuts through a group) run unrolled with their
             static global indices.
             """
-            f = moe_every if cfg.is_moe else 1
+            if cfg.alt_window:
+                # GPT-Neo alternating global/local attention: scan layer
+                # PAIRS so each member's window is STATIC (even global
+                # index → global, odd → cfg.sliding_window)
+                if cfg.is_moe:
+                    raise NotImplementedError(
+                        "alt_window + MoE not supported")
+                f = 2
+            else:
+                f = moe_every if cfg.is_moe else 1
             if n_layers == 0:
                 return x, jnp.zeros((), jnp.float32)
 
-            def apply_layer(h, aux_acc, lp, layer_idx, is_moe_layer):
+            def member_cfg(parity: int):
+                """Per-layer static config: alt_window strips the local
+                window from even global indices."""
+                if not cfg.alt_window or parity % 2:
+                    return cfg
+                return cfg.replace(sliding_window=None)
+
+            def apply_layer(h, aux_acc, lp, layer_idx, is_moe_layer,
+                            lcfg=cfg):
                 # keys serve dropout AND noisy MoE gating — thread whenever
                 # one is present (each consumer no-ops when its rate/policy
                 # is off)
                 lk = jax.random.fold_in(dropout_key, layer_idx) \
                     if dropout_key is not None else None
-                h2, aux = transformer_layer(h, lp, pos, cfg,
+                h2, aux = transformer_layer(h, lp, pos, lcfg,
                                             layer_is_moe=is_moe_layer,
                                             dropout_key=lk,
                                             attention_mask=attention_mask)
@@ -988,7 +1028,8 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
                         for j in range(f):
                             sub = jax.tree.map(lambda p, j=j: p[j], lp)
                             h, aux = transformer_layer(
-                                h, sub, pos_, cfg, layer_is_moe=(j == f - 1))
+                                h, sub, pos_, member_cfg(j % 2),
+                                layer_is_moe=(cfg.is_moe and j == f - 1))
                             aux_acc = aux_acc + aux
                     else:
                         h, aux = transformer_layer(
@@ -1002,9 +1043,10 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
                 for j in range(lo, hi):
                     lp = jax.tree.map(lambda p, j=j: p[j], layers_slice)
                     is_moe = cfg.is_moe and ((idx0 + j) % f == f - 1)
+                    lcfg = member_cfg((idx0 + j) % 2)
                     step = _maybe_remat(
-                        lambda h, a, lp, j=j, m=is_moe:
-                        apply_layer(h, a, lp, idx0 + j, m), cfg)
+                        lambda h, a, lp, j=j, m=is_moe, c=lcfg:
+                        apply_layer(h, a, lp, idx0 + j, m, lcfg=c), cfg)
                     x, aux = step(x, aux, lp)
                 return x, aux
 
@@ -1019,8 +1061,12 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
                         for j in range(f):
                             lp = jax.tree.map(lambda p, j=j: p[j],
                                               layer_params)
-                            h, aux_acc = apply_layer(h, aux_acc, lp,
-                                                     i * f + j, j == f - 1)
+                            # group starts are ≡ 0 mod f, so the member's
+                            # global parity is j's — static
+                            h, aux_acc = apply_layer(
+                                h, aux_acc, lp, i * f + j,
+                                cfg.is_moe and j == f - 1,
+                                lcfg=member_cfg(j % 2))
                     else:
                         h, aux_acc = apply_layer(h, aux_acc, layer_params, i,
                                                  cfg.is_moe and f == 1)

@@ -447,3 +447,76 @@ def test_bert_sequence_classification_parity():
     out = np.asarray(bert_pooled_classify(params, hidden), np.float32)
     assert out.shape == (2, 3)
     np.testing.assert_allclose(out, ref, atol=2e-3, rtol=1e-3)
+
+
+def test_gptneo_parity():
+    """GPT-Neo: alternating global/local attention (layer pairs with a
+    static per-member window), learned positions, unscaled scores, and
+    biasless q/k/v with biased out/mlp (ref containers/gptneo.py).
+    window_size=8 < seq=12 so the local layer's mask is live."""
+    from transformers import GPTNeoConfig, GPTNeoForCausalLM
+
+    torch.manual_seed(0)
+    m = GPTNeoForCausalLM(GPTNeoConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        attention_types=[[["global", "local"], 1]], window_size=8,
+        max_position_embeddings=64, intermediate_size=128))
+    cfg = config_from_hf(m.config).replace(dtype=jnp.float32)
+    assert cfg.alt_window and cfg.sliding_window == 8
+    assert cfg.attn_scale == 1.0
+    _compare(m)
+
+
+def test_gptneo_generate_matches_hf():
+    """GPT-Neo serves through the paged ragged path (paired alt-window
+    scan + learned positions): greedy continuation equals HF generate."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel import topology
+    from transformers import GPTNeoConfig, GPTNeoForCausalLM
+
+    torch.manual_seed(1)
+    m = GPTNeoForCausalLM(GPTNeoConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        attention_types=[[["global", "local"], 1]], window_size=8,
+        max_position_embeddings=64, intermediate_size=128)).eval()
+    cfg = config_from_hf(m.config).replace(dtype=jnp.float32)
+    params = params_from_hf(m, cfg)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 128, size=(1, 12), dtype=np.int64)
+    with torch.no_grad():
+        ref = m.generate(torch.tensor(ids), max_new_tokens=6,
+                         do_sample=False).numpy()[0, 12:]
+    eng = ds.init_inference(model=cfg, model_params=params,
+                            dtype="float32")
+    out = np.asarray(eng.generate(ids.astype(np.int32),
+                                  max_new_tokens=6))[0, 12:]
+    np.testing.assert_array_equal(out, ref)
+    topology._GLOBAL_TOPOLOGY = None
+
+
+def test_opt_generate_matches_hf():
+    """Regression: the ragged embed path used to gate learned positions
+    on arch == 'gpt2', silently dropping OPT's position embeddings in
+    paged serving."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.parallel import topology
+    from transformers import OPTConfig, OPTForCausalLM
+
+    torch.manual_seed(2)
+    m = OPTForCausalLM(OPTConfig(
+        vocab_size=128, hidden_size=64, ffn_dim=128, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        do_layer_norm_before=True, word_embed_proj_dim=64)).eval()
+    cfg = config_from_hf(m.config).replace(dtype=jnp.float32)
+    params = params_from_hf(m, cfg)
+    rng = np.random.default_rng(2)
+    ids = rng.integers(4, 128, size=(1, 10), dtype=np.int64)
+    with torch.no_grad():
+        ref = m.generate(torch.tensor(ids), max_new_tokens=6,
+                         do_sample=False).numpy()[0, 10:]
+    eng = ds.init_inference(model=cfg, model_params=params,
+                            dtype="float32")
+    out = np.asarray(eng.generate(ids.astype(np.int32),
+                                  max_new_tokens=6))[0, 10:]
+    np.testing.assert_array_equal(out, ref)
+    topology._GLOBAL_TOPOLOGY = None

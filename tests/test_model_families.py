@@ -119,3 +119,25 @@ def test_families_ragged_inference(name):
     assert len(out[0]) == 4  # generate returns the new tokens
     assert all(0 <= t < model.vocab_size for t in out[0])
     _reset_topo()
+
+
+def test_gptneo_alt_window_trains():
+    """GPT-Neo's alternating global/local attention trains through the
+    paired grouped scan (static per-member window)."""
+    import deepspeed_tpu as ds
+
+    model = get_model_config("gptneo-tiny")
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config, seed=3)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, model.vocab_size, size=(16, 33), dtype=np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
+    losses = [float(np.asarray(engine.train_batch(batch)))
+              for _ in range(4)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+    _reset_topo()
