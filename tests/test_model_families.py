@@ -1,5 +1,7 @@
 """Model families: OPT, Mistral (sliding window), Qwen2 (qkv bias),
-Falcon (MQA + parallel block), Phi (partial rotary).
+Falcon (MQA + parallel block), Phi (partial rotary), Bloom (ALiBi),
+GPT-J (interleaved rotary), GPT-NeoX, GPT-Neo (alternating local
+attention), BERT/DistilBERT (encoders).
 
 Mirrors the reference's per-arch inference/v2 model implementations
 (inference/v2/model_implementations/) exercised through training and the
@@ -15,7 +17,8 @@ from deepspeed_tpu.models import get_model_config, init_params, list_models
 from deepspeed_tpu.models import transformer as tf
 
 FAMILIES = ["opt-tiny", "mistral-tiny", "qwen2-tiny", "falcon-tiny",
-            "phi-tiny"]
+            "phi-tiny", "bloom-tiny", "gptj-tiny", "gptneox-tiny",
+            "gptneo-tiny", "bert-tiny", "distilbert-tiny"]
 
 
 def _reset_topo():
