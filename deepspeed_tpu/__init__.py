@@ -130,4 +130,9 @@ from deepspeed_tpu import zero  # noqa: E402
 from deepspeed_tpu import checkpointing  # noqa: E402
 from deepspeed_tpu.utils.init_on_device import OnDevice  # noqa: E402
 from deepspeed_tpu.utils.mpu_adapter import MpuAdapter  # noqa: E402
+from deepspeed_tpu.utils.tensor_fragment import (  # noqa: E402
+    safe_get_full_fp32_param, safe_get_full_grad,
+    safe_get_full_optimizer_state, safe_get_local_fp32_param,
+    safe_get_local_grad, safe_get_local_optimizer_state,
+    safe_set_full_fp32_param, safe_set_full_optimizer_state)
 from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine  # noqa: E402

@@ -1,0 +1,228 @@
+"""Safe accessors for ZeRO-sharded params / optimizer state / gradients.
+
+Analog of ``deepspeed/utils/tensor_fragment.py`` (safe_get_full_fp32_param
+:134, safe_get_full_optimizer_state :169, safe_get_full_grad :207, the
+set_* mirrors, and the stage-3 local-shard variants) — the documented
+debugging surface for reaching inside a partitioned engine.
+
+The reference addresses fragments through attributes patched onto
+``torch.nn.Parameter``; here params are a functional pytree, so the
+address is the engine plus a PATH ("layers/attn/wq", the same strings
+``parallel/sharding.py`` rules match).  "Full" accessors return/accept
+the complete logical array regardless of ZeRO stage (fetching a sharded
+jax.Array materializes every shard on host — exactly the reference's
+assemble semantics); "local" accessors work on THIS process's
+addressable shard.  Optimizer-state keys use the torch names
+(``exp_avg``/``exp_avg_sq``/``momentum``/``sum``) mapped onto the optax
+chain's fields (mu/nu/trace/sum).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.parallel.sharding import path_str
+
+# torch optimizer-state key → optax state field
+_STATE_KEYS = {
+    "exp_avg": "mu",
+    "exp_avg_sq": "nu",
+    "momentum": "trace",
+    "momentum_buffer": "trace",
+    "sum": "sum",  # adagrad accumulator (scale_by_rss)
+}
+
+
+def _find_leaf(tree, path: str):
+    """Leaf whose sharding-rule path equals ``path`` (path_str form)."""
+    hits = [(path_str(p), leaf) for p, leaf
+            in jax.tree_util.tree_flatten_with_path(tree)[0]]
+    for p, leaf in hits:
+        if p == path:
+            return leaf
+    known = ", ".join(sorted(p for p, _ in hits)[:12])
+    raise KeyError(f"no param at path {path!r}; first paths: {known} …")
+
+
+def _set_leaf(tree, path: str, value):
+    matched = []
+
+    def rebuild(p, leaf):
+        if path_str(p) == path:
+            matched.append(True)
+            return value
+        return leaf
+
+    out = jax.tree_util.tree_map_with_path(rebuild, tree)
+    if not matched:
+        raise KeyError(f"no param at path {path!r}")
+    return out
+
+
+def _guard_param_resident(engine, path: str) -> None:
+    if (getattr(engine, "_param_store", None) is not None
+            and path.startswith("layers/")):
+        raise RuntimeError(
+            "layer params are NVMe-store-resident between steps "
+            "(ZeRO-Infinity offload_param device=nvme) — not addressable "
+            "through the safe accessors")
+
+
+def _locate_state(engine, field: str, path: str):
+    """(moment subtree, path within it, writeback) for the optax chain's
+    ``field`` — handling the param-streaming engine's split
+    {"stream": ..., "resident": ...} state, whose stream subtree mirrors
+    params["layers"] with layer-relative paths."""
+    state = engine.opt_state
+    if state is None:
+        raise RuntimeError(
+            "optimizer state is not engine-resident (NVMe/SuperOffload "
+            "store is authoritative between steps)")
+
+    def moment_of(sub, write):
+        for leaf_state in jax.tree_util.tree_leaves(
+                sub, is_leaf=lambda x: hasattr(x, "_fields")):
+            if hasattr(leaf_state, field):
+                def writeback(new_tree, target=leaf_state):
+                    def swap(ls):
+                        if ls is target:
+                            return ls._replace(**{field: new_tree})
+                        return ls
+
+                    write(jax.tree_util.tree_map(
+                        swap, sub, is_leaf=lambda x: hasattr(x, "_fields")))
+
+                return getattr(leaf_state, field), writeback
+        raise KeyError(f"optimizer {engine.optimizer.name!r} carries no "
+                       f"{field!r} state")
+
+    if isinstance(state, dict) and set(state) == {"stream", "resident"}:
+        if path.startswith("layers/"):
+            sub_path = path[len("layers/"):]
+            def write(new): engine.opt_state = {**engine.opt_state,
+                                                "stream": new}
+            tree, wb = moment_of(state["stream"], write)
+        else:
+            sub_path = path
+            def write(new): engine.opt_state = {**engine.opt_state,
+                                                "resident": new}
+            tree, wb = moment_of(state["resident"], write)
+        return tree, sub_path, wb
+
+    def write(new):
+        engine.opt_state = new
+
+    tree, wb = moment_of(state, write)
+    return tree, path, wb
+
+
+def safe_get_full_fp32_param(engine, path: str) -> np.ndarray:
+    """Full fp32 view of a (possibly ZeRO-sharded) parameter.
+    Ref: safe_get_full_fp32_param (tensor_fragment.py:134)."""
+    _guard_param_resident(engine, path)
+    return np.asarray(_find_leaf(engine.params, path), np.float32)
+
+
+def safe_set_full_fp32_param(engine, path: str, value) -> None:
+    """Replace a parameter with a full-value update, re-placed onto its
+    original sharding.  Ref: safe_set_full_fp32_param."""
+    _guard_param_resident(engine, path)
+    old = _find_leaf(engine.params, path)
+    new = jnp.asarray(value, old.dtype).reshape(old.shape)
+    new = jax.device_put(new, old.sharding)
+    engine.params = _set_leaf(engine.params, path, new)
+
+
+def safe_get_full_optimizer_state(engine, path: str,
+                                  optim_state_key: str) -> np.ndarray:
+    """Full fp32 optimizer state of a parameter, by torch key name.
+    Ref: safe_get_full_optimizer_state (tensor_fragment.py:169)."""
+    field = _STATE_KEYS.get(optim_state_key)
+    if field is None:
+        raise KeyError(f"unknown optimizer state key {optim_state_key!r} "
+                       f"(known: {sorted(_STATE_KEYS)})")
+    tree, sub_path, _ = _locate_state(engine, field, path)
+    return np.asarray(_find_leaf(tree, sub_path), np.float32)
+
+
+def safe_set_full_optimizer_state(engine, path: str, value,
+                                  optim_state_key: str) -> None:
+    """Ref: safe_set_full_optimizer_state."""
+    field = _STATE_KEYS.get(optim_state_key)
+    if field is None:
+        raise KeyError(f"unknown optimizer state key {optim_state_key!r}")
+    tree, sub_path, writeback = _locate_state(engine, field, path)
+    old = _find_leaf(tree, sub_path)
+    new = jax.device_put(jnp.asarray(value, old.dtype).reshape(old.shape),
+                         old.sharding)
+    writeback(_set_leaf(tree, sub_path, new))
+
+
+def _grad_unscale(engine) -> float:
+    """fp16 dynamic loss scaling stores SCALED grads in the buffer
+    (unscaling happens inside apply_update); divide it out so the
+    accessor matches the reference's true-gradient semantics."""
+    ls = getattr(engine, "loss_scale_state", None)
+    if not ls:
+        return 1.0
+    return float(np.asarray(ls.get("scale", 1.0)))
+
+
+def safe_get_full_grad(engine, path: str) -> Optional[np.ndarray]:
+    """Accumulated gradient of a parameter between ``backward()`` and
+    ``step()`` on the forward/backward/step trio path (the fused
+    train_batch consumes grads inside one compiled step — as in the
+    reference, None means no gradient is live).  fp16 loss scaling is
+    divided out.  Ref: safe_get_full_grad (tensor_fragment.py:207)."""
+    buf = getattr(engine, "_grad_buffer", None)
+    if buf is None:
+        return None
+    g = np.asarray(_find_leaf(buf, path), np.float32)
+    return g / _grad_unscale(engine)
+
+
+# --------------------------------------------------------------------
+# Local (this-process shard) API — ref tensor_fragment.py Local API
+# --------------------------------------------------------------------
+def _local_shard(arr) -> np.ndarray:
+    """This process's DISTINCT shards (one per unique index — a
+    replicated leaf yields its single full copy, not one per device),
+    stacked when several devices hold different partitions locally."""
+    seen = {}
+    for s in arr.addressable_shards:
+        key = tuple((sl.start, sl.stop, sl.step) for sl in s.index)
+        if key not in seen:
+            seen[key] = np.asarray(s.data)
+    shards = list(seen.values())
+    if len(shards) == 1:
+        return shards[0]
+    return np.stack(shards)
+
+
+def safe_get_local_fp32_param(engine, path: str) -> np.ndarray:
+    """THIS process's distinct shard(s) of a parameter (stacked when
+    several devices hold different partitions locally; a replicated leaf
+    returns one full copy).  Ref: safe_get_local_fp32_param."""
+    _guard_param_resident(engine, path)
+    return _local_shard(_find_leaf(engine.params, path)).astype(np.float32)
+
+
+def safe_get_local_optimizer_state(engine, path: str,
+                                   optim_state_key: str) -> np.ndarray:
+    field = _STATE_KEYS.get(optim_state_key)
+    if field is None:
+        raise KeyError(f"unknown optimizer state key {optim_state_key!r}")
+    tree, sub_path, _ = _locate_state(engine, field, path)
+    return _local_shard(_find_leaf(tree, sub_path)).astype(np.float32)
+
+
+def safe_get_local_grad(engine, path: str) -> Optional[np.ndarray]:
+    buf = getattr(engine, "_grad_buffer", None)
+    if buf is None:
+        return None
+    g = _local_shard(_find_leaf(buf, path)).astype(np.float32)
+    return g / _grad_unscale(engine)

@@ -1,0 +1,189 @@
+"""safe_get/set accessors for ZeRO-sharded state
+(deepspeed_tpu/utils/tensor_fragment.py; ref utils/tensor_fragment.py:134+
+and its Local API)."""
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import get_model_config
+from deepspeed_tpu.parallel import topology
+
+
+@pytest.fixture
+def zero3_engine():
+    model = get_model_config("gpt2-tiny")
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        # threshold 0: even tiny params shard, so the accessors are
+        # exercised against genuinely partitioned leaves
+        "zero_optimization": {"stage": 3,
+                              "param_persistence_threshold": 0},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config, seed=5)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, model.vocab_size, size=(16, 33), dtype=np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
+    engine.train_batch(batch)  # populate optimizer state
+    yield engine, batch
+    topology._GLOBAL_TOPOLOGY = None
+
+
+def test_get_full_param_assembles_sharded(zero3_engine):
+    engine, _ = zero3_engine
+    w = ds.safe_get_full_fp32_param(engine, "layers/attn/wq")
+    mc = engine.model_config
+    assert w.shape == (mc.num_layers, mc.hidden_size,
+                       mc.num_heads * mc.dim_per_head)
+    assert np.isfinite(w).all() and np.abs(w).sum() > 0
+    with pytest.raises(KeyError, match="no param"):
+        ds.safe_get_full_fp32_param(engine, "layers/attn/nope")
+
+
+def test_set_full_param_roundtrips_and_trains(zero3_engine):
+    engine, batch = zero3_engine
+    w = ds.safe_get_full_fp32_param(engine, "embed/tokens")
+    ds.safe_set_full_fp32_param(engine, "embed/tokens", w * 0.5)
+    w2 = ds.safe_get_full_fp32_param(engine, "embed/tokens")
+    np.testing.assert_allclose(w2, w * 0.5, rtol=1e-6)
+    # sharding preserved → the engine still trains
+    loss = float(np.asarray(engine.train_batch(batch)))
+    assert np.isfinite(loss)
+
+
+def test_optimizer_state_by_torch_key(zero3_engine):
+    engine, batch = zero3_engine
+    m = ds.safe_get_full_optimizer_state(engine, "embed/tokens", "exp_avg")
+    v = ds.safe_get_full_optimizer_state(engine, "embed/tokens",
+                                         "exp_avg_sq")
+    assert m.shape == v.shape and (v >= 0).all()
+    assert np.abs(m).sum() > 0  # one step taken in the fixture
+    # set: zero the second moment and confirm the write landed sharded
+    ds.safe_set_full_optimizer_state(engine, "embed/tokens",
+                                     np.zeros_like(v), "exp_avg_sq")
+    v2 = ds.safe_get_full_optimizer_state(engine, "embed/tokens",
+                                          "exp_avg_sq")
+    assert np.abs(v2).sum() == 0
+    loss = float(np.asarray(engine.train_batch(batch)))
+    assert np.isfinite(loss)
+    with pytest.raises(KeyError, match="unknown optimizer state key"):
+        ds.safe_get_full_optimizer_state(engine, "embed/tokens", "bogus")
+
+
+def test_grad_accessor_on_trio_path():
+    model = get_model_config("gpt2-tiny")
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config, seed=6)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, model.vocab_size, size=(8, 33), dtype=np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
+    assert ds.safe_get_full_grad(engine, "embed/tokens") is None
+    loss = engine.forward(batch)
+    engine.backward(loss)
+    g = ds.safe_get_full_grad(engine, "embed/tokens")
+    assert g is not None and np.abs(g).sum() > 0
+    topology._GLOBAL_TOPOLOGY = None
+
+
+def test_local_shard_accessors(zero3_engine):
+    engine, _ = zero3_engine
+    from deepspeed_tpu.utils.tensor_fragment import _find_leaf
+
+    leaf = _find_leaf(engine.params, "layers/mlp/wi")
+    assert any(ax is not None for ax in leaf.sharding.spec), \
+        "fixture should shard this leaf (threshold 0)"
+    full = ds.safe_get_full_fp32_param(engine, "layers/mlp/wi")
+    local = ds.safe_get_local_fp32_param(engine, "layers/mlp/wi")
+    # single process holding all 8 distinct shards: stacked = full size
+    assert local.size == full.size
+    assert local.shape[0] == 8  # one stacked entry per device shard
+    m_local = ds.safe_get_local_optimizer_state(engine, "layers/mlp/wi",
+                                                "exp_avg")
+    assert m_local.size == full.size
+
+
+def test_replicated_leaf_local_is_single_copy(zero3_engine):
+    engine, _ = zero3_engine
+    # final_norm/scale is 1-D tiny; under threshold 0 it may shard — use
+    # a replicated leaf by construction: fetch full and compare shapes
+    from deepspeed_tpu.utils.tensor_fragment import _find_leaf, _local_shard
+
+    leaf = _find_leaf(engine.params, "final_norm/scale")
+    local = _local_shard(leaf)
+    if not any(ax is not None for ax in leaf.sharding.spec):
+        # replicated: ONE copy, not one per device
+        assert local.shape == leaf.shape
+
+
+def test_fp16_grad_accessor_unscales():
+    """Under fp16 dynamic loss scaling the buffer holds SCALED grads;
+    the accessor must divide the scale out."""
+    model = get_model_config("gpt2-tiny")
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "fp16": {"enabled": True, "initial_scale_power": 8},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config, seed=8)
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, model.vocab_size, size=(8, 33), dtype=np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
+    loss = engine.forward(batch)
+    engine.backward(loss)
+    scale = float(np.asarray(engine.loss_scale_state["scale"]))
+    assert scale == 2.0 ** 8
+    g = ds.safe_get_full_grad(engine, "embed/tokens")
+    raw = np.asarray(engine._grad_buffer["embed"]["tokens"], np.float32)
+    np.testing.assert_allclose(g, raw / scale, rtol=1e-6)
+    # unscaled grads of a ~6.2-loss CE on a tiny model are O(1e-3..1),
+    # not O(scale)
+    assert np.abs(g).max() < 50.0
+    topology._GLOBAL_TOPOLOGY = None
+
+
+def test_param_stream_state_routing():
+    """The split {'stream','resident'} optimizer state of the param-
+    streaming engine routes layer paths to the stream subtree (and
+    set only rewrites that subtree)."""
+    model = get_model_config("gpt2-tiny")
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 3,
+                              "offload_param": {"device": "cpu"},
+                              "offload_optimizer": {"device": "cpu"}},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config, seed=9)
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, model.vocab_size, size=(8, 33), dtype=np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
+    engine.train_batch(batch)
+    m_layer = ds.safe_get_full_optimizer_state(engine, "layers/attn/wq",
+                                               "exp_avg")
+    assert np.abs(m_layer).sum() > 0
+    m_res = ds.safe_get_full_optimizer_state(engine, "embed/tokens",
+                                             "exp_avg")
+    ds.safe_set_full_optimizer_state(engine, "layers/attn/wq",
+                                     np.zeros_like(m_layer), "exp_avg")
+    assert np.abs(ds.safe_get_full_optimizer_state(
+        engine, "layers/attn/wq", "exp_avg")).sum() == 0
+    # resident subtree untouched by the stream write
+    np.testing.assert_array_equal(
+        ds.safe_get_full_optimizer_state(engine, "embed/tokens", "exp_avg"),
+        m_res)
+    # the engine still steps after the surgical write
+    loss = float(np.asarray(engine.train_batch(batch)))
+    assert np.isfinite(loss)
+    topology._GLOBAL_TOPOLOGY = None
