@@ -40,6 +40,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--coordinator_addr", type=str, default="127.0.0.1")
     p.add_argument("--coordinator_port", type=int, default=29500)
     p.add_argument("--pid_dir", type=str, default="/tmp")
+    p.add_argument("--bind_cores_to_rank", action="store_true",
+                   help="numactl-bind each local rank to its core slice "
+                        "(+ membind when the slice fits one NUMA node) — "
+                        "ref launcher --bind_cores_to_rank")
+    p.add_argument("--bind_core_list", type=str, default=None,
+                   help='cores to divide among ranks, e.g. "0-7,16-23" '
+                        "(default: one logical CPU per physical core)")
     p.add_argument("user_script", type=str)
     p.add_argument("user_args", nargs=argparse.REMAINDER)
     return p
@@ -90,8 +97,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         if len(slots) > 1:
             # Chip-per-process layout on a multi-chip host (or CPU test mesh).
             env.setdefault("TPU_VISIBLE_DEVICES", str(slot))
-        cmd = [sys.executable, "-u", args.user_script,
-               f"--local_rank={local_rank}"] + args.user_args
+        prefix: List[str] = []
+        if args.bind_cores_to_rank:
+            from deepspeed_tpu.utils.numa import get_numactl_cmd
+
+            prefix, cores = get_numactl_cmd(args.bind_core_list,
+                                            len(slots), local_rank)
+            # cap intra-op host threads to the slice (ref launch.py
+            # sets OMP_NUM_THREADS alongside the binding)
+            env.setdefault("OMP_NUM_THREADS", str(max(1, len(cores))))
+        cmd = prefix + [sys.executable, "-u", args.user_script,
+                        f"--local_rank={local_rank}"] + args.user_args
         procs.append(subprocess.Popen(cmd, env=env))
 
     pid_path = os.path.join(args.pid_dir, f"{PID_FILE_BASENAME}.{node_rank}")

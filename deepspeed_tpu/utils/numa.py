@@ -1,0 +1,110 @@
+"""NUMA-aware core binding for launched host processes.
+
+Analog of ``deepspeed/utils/numa.py`` (``get_numactl_cmd`` :104,
+``get_numa_cores`` :24): on multi-socket TPU hosts the input pipeline,
+AIO threads, and host optimizer (csrc/cpu_optimizer) are CPU-bound, so
+binding each local rank to its slice of cores — and its memory to the
+matching NUMA node — avoids cross-socket traffic.
+
+Differences from the reference: missing ``numactl`` degrades to an empty
+prefix (the reference prints an install nag); no psutil dependency
+(``os.cpu_count``); HBM-flat/fake-NUMA special cases are collapsed into
+the general membind rule (bind memory iff the rank's cores sit in one
+node).
+"""
+
+from __future__ import annotations
+
+import functools
+import glob
+import os
+import shutil
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+
+def parse_range_list(spec: str) -> List[int]:
+    """"0-7,16-23" → [0..7, 16..23] (ref parse_range_list)."""
+    cores: List[int] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            lo, hi = part.split("-", 1)
+            lo_i, hi_i = int(lo), int(hi)
+            if hi_i < lo_i:
+                raise ValueError(f"bad core range {part!r}")
+            cores.extend(range(lo_i, hi_i + 1))
+        else:
+            cores.append(int(part))
+    if len(set(cores)) != len(cores):
+        raise ValueError(f"duplicate cores in {spec!r}")
+    return sorted(cores)
+
+
+def physical_cores() -> List[int]:
+    """One logical CPU per physical core (the first thread sibling),
+    mirroring the reference's ``psutil.cpu_count(logical=False)`` basis;
+    falls back to all logical CPUs when sysfs is unavailable."""
+    paths = glob.glob(
+        "/sys/devices/system/cpu/cpu*/topology/thread_siblings_list")
+    firsts = set()
+    for p in paths:
+        try:
+            with open(p) as f:
+                firsts.add(parse_range_list(f.read().strip())[0])
+        except (OSError, ValueError):
+            return list(range(os.cpu_count() or 1))
+    return sorted(firsts) if firsts else list(range(os.cpu_count() or 1))
+
+
+@functools.lru_cache(maxsize=1)
+def get_numa_cores() -> List[List[int]]:
+    """Per-NUMA-node core lists via ``numactl --hardware`` (cached —
+    topology is static); [] when numactl is unavailable (ref
+    get_numa_cores, numa.py:24)."""
+    if shutil.which("numactl") is None:
+        return []
+    try:
+        out = subprocess.check_output(["numactl", "--hardware"],
+                                      text=True, timeout=10)
+    except Exception:
+        return []
+    nodes: List[List[int]] = []
+    for line in out.splitlines():
+        if line.startswith("node ") and " cpus:" in line:
+            cores = line.split("cpus:", 1)[1].split()
+            nodes.append([int(c) for c in cores])
+    return nodes
+
+
+def get_numactl_cmd(bind_core_list: Optional[str], num_local_procs: int,
+                    local_rank: int) -> Tuple[List[str], Sequence[int]]:
+    """numactl prefix + this rank's core slice (ref get_numactl_cmd,
+    numa.py:104).  Empty prefix when numactl is missing."""
+    if "KMP_AFFINITY" in os.environ:
+        raise ValueError(
+            "KMP_AFFINITY conflicts with numactl core binding — unset it "
+            "before launching with --bind_cores_to_rank")
+    if bind_core_list:
+        core_list: Sequence[int] = parse_range_list(bind_core_list)
+    else:
+        core_list = physical_cores()
+    per_rank = len(core_list) // num_local_procs
+    if per_rank < 1:
+        raise ValueError(
+            f"{len(core_list)} cores cannot give every one of "
+            f"{num_local_procs} local ranks a core")
+    mine = list(core_list)[per_rank * local_rank:per_rank * (local_rank + 1)]
+    if shutil.which("numactl") is None:
+        return [], mine
+    cmd = ["numactl", "-C", f"{mine[0]}-{mine[-1]}"
+           if mine == list(range(mine[0], mine[-1] + 1))
+           else ",".join(map(str, mine))]
+    # bind memory too when the slice lives inside one NUMA node
+    for node, cores in enumerate(get_numa_cores()):
+        if cores and set(mine) <= set(cores):
+            cmd += ["-m", str(node)]
+            break
+    return cmd, mine

@@ -63,13 +63,29 @@ def _set_leaf(tree, path: str, value):
     return out
 
 
-def _guard_param_resident(engine, path: str) -> None:
+def _guard_param_resident(engine, path: str, writing: bool = False) -> None:
     if (getattr(engine, "_param_store", None) is not None
             and path.startswith("layers/")):
         raise RuntimeError(
             "layer params are NVMe-store-resident between steps "
             "(ZeRO-Infinity offload_param device=nvme) — not addressable "
             "through the safe accessors")
+    if writing and getattr(engine, "_super_opt", None) is not None:
+        raise RuntimeError(
+            "SuperOffload keeps authoritative fp32 masters host-side — a "
+            "device-param write would be silently overwritten by the next "
+            "step; edit through the SuperOffload optimizer state instead")
+
+
+def _fetch_full(arr) -> np.ndarray:
+    """Full host value of a (possibly cross-host-sharded) jax.Array —
+    the reference's assemble semantics.  Multi-process arrays ride
+    process_allgather (np.asarray raises on non-addressable shards)."""
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
 
 
 def _locate_state(engine, field: str, path: str):
@@ -124,13 +140,13 @@ def safe_get_full_fp32_param(engine, path: str) -> np.ndarray:
     """Full fp32 view of a (possibly ZeRO-sharded) parameter.
     Ref: safe_get_full_fp32_param (tensor_fragment.py:134)."""
     _guard_param_resident(engine, path)
-    return np.asarray(_find_leaf(engine.params, path), np.float32)
+    return _fetch_full(_find_leaf(engine.params, path)).astype(np.float32)
 
 
 def safe_set_full_fp32_param(engine, path: str, value) -> None:
     """Replace a parameter with a full-value update, re-placed onto its
     original sharding.  Ref: safe_set_full_fp32_param."""
-    _guard_param_resident(engine, path)
+    _guard_param_resident(engine, path, writing=True)
     old = _find_leaf(engine.params, path)
     new = jnp.asarray(value, old.dtype).reshape(old.shape)
     new = jax.device_put(new, old.sharding)
@@ -146,7 +162,7 @@ def safe_get_full_optimizer_state(engine, path: str,
         raise KeyError(f"unknown optimizer state key {optim_state_key!r} "
                        f"(known: {sorted(_STATE_KEYS)})")
     tree, sub_path, _ = _locate_state(engine, field, path)
-    return np.asarray(_find_leaf(tree, sub_path), np.float32)
+    return _fetch_full(_find_leaf(tree, sub_path)).astype(np.float32)
 
 
 def safe_set_full_optimizer_state(engine, path: str, value,
@@ -181,7 +197,7 @@ def safe_get_full_grad(engine, path: str) -> Optional[np.ndarray]:
     buf = getattr(engine, "_grad_buffer", None)
     if buf is None:
         return None
-    g = np.asarray(_find_leaf(buf, path), np.float32)
+    g = _fetch_full(_find_leaf(buf, path)).astype(np.float32)
     return g / _grad_unscale(engine)
 
 

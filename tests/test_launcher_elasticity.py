@@ -157,3 +157,46 @@ def test_elastic_v2_respects_gpu_envelope():
                           "model_parallel_size": 4, "num_gpus_per_node": 8}}
     _, gpus = compute_elastic_config(cfg)
     assert all(6 <= g <= 256 and g % 4 == 0 for g in gpus)
+
+
+def test_numa_core_binding_helpers(monkeypatch):
+    """get_numactl_cmd slices cores per rank and degrades to an empty
+    prefix without numactl (ref utils/numa.py:104)."""
+    from deepspeed_tpu.utils.numa import (get_numactl_cmd, parse_range_list,
+                                          physical_cores)
+
+    monkeypatch.delenv("KMP_AFFINITY", raising=False)
+    assert parse_range_list("0-3,8") == [0, 1, 2, 3, 8]
+    with pytest.raises(ValueError):
+        parse_range_list("3-1")
+    cmd, cores = get_numactl_cmd("0-7", num_local_procs=4, local_rank=2)
+    assert list(cores) == [4, 5]
+    if cmd:  # numactl present: prefix binds exactly this slice
+        assert cmd[:3] == ["numactl", "-C", "4-5"]
+    with pytest.raises(ValueError, match="cores cannot give"):
+        get_numactl_cmd("0-1", num_local_procs=4, local_rank=0)
+    # one logical CPU per physical core, and all distinct
+    pc = physical_cores()
+    assert pc and len(set(pc)) == len(pc)
+    monkeypatch.setenv("KMP_AFFINITY", "x")
+    with pytest.raises(ValueError, match="KMP_AFFINITY"):
+        get_numactl_cmd(None, 1, 0)
+
+
+def test_launch_bind_cores_spawns(tmp_path):
+    """--bind_cores_to_rank launches children with the numactl prefix (or
+    bare when numactl is absent) and an OMP_NUM_THREADS cap."""
+    import subprocess
+    import sys as _sys
+
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import os, sys\n"
+        "print('OMP', os.environ.get('OMP_NUM_THREADS'))\n")
+    r = subprocess.run(
+        [_sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+         "--nproc", "2", "--bind_cores_to_rank", "--bind_core_list", "0-1",
+         "--pid_dir", str(tmp_path), str(script)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert r.stdout.count("OMP 1") == 2, r.stdout
