@@ -103,9 +103,10 @@ def main(argv: Optional[List[str]] = None) -> int:
 
             prefix, cores = get_numactl_cmd(args.bind_core_list,
                                             len(slots), local_rank)
-            # cap intra-op host threads to the slice (ref launch.py
-            # sets OMP_NUM_THREADS alongside the binding)
-            env.setdefault("OMP_NUM_THREADS", str(max(1, len(cores))))
+            # cap intra-op host threads to the slice — unconditionally,
+            # or an inherited OMP_NUM_THREADS oversubscribes the slice
+            # the binding exists to protect (ref launch.py does the same)
+            env["OMP_NUM_THREADS"] = str(max(1, len(cores)))
         cmd = prefix + [sys.executable, "-u", args.user_script,
                         f"--local_rank={local_rank}"] + args.user_args
         procs.append(subprocess.Popen(cmd, env=env))

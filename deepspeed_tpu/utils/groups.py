@@ -1,0 +1,144 @@
+"""Process-group getters, mapped onto mesh axes.
+
+Compat shim for ``deepspeed/utils/groups.py`` (get_data_parallel_group
+:126, get_tensor_model_parallel_group :110, the world-size/rank getters,
+and the _get_expert_* family): reference user code imports these to pass
+groups into collectives and to branch on parallel coordinates.  Under
+SPMD a "group" for in-jit collectives IS a mesh axis name (or a tuple of
+them), directly accepted by every ``ds.comm`` collective's ``group=``
+argument — so the *_group() getters return axis names, and the
+world-size/rank getters answer from the live topology.
+
+Rank and world-size getters delegate to ``ds.comm.get_rank/
+get_world_size(group=...)`` — one implementation of the
+coordinate-along-axes rule, shared with the host-object collectives."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+from deepspeed_tpu.parallel.topology import (DATA_AXIS, EXPERT_AXIS,
+                                             PIPE_AXIS, SEQ_AXIS,
+                                             SUBDATA_AXIS, TENSOR_AXIS,
+                                             get_topology)
+
+GroupName = Union[str, Tuple[str, ...]]
+
+
+def _topo():
+    topo = get_topology()
+    if topo is None:
+        raise RuntimeError(
+            "no topology initialized — build the engine (ds.initialize) "
+            "or call comm.init_distributed first")
+    return topo
+
+
+def _axis_coord(axis_names: Sequence[str]) -> int:
+    from deepspeed_tpu.comm import comm
+
+    _topo()  # uniform RuntimeError when no topology is live
+    return comm.get_rank(group=tuple(axis_names))
+
+
+# -- data parallel ----------------------------------------------------
+def get_data_parallel_group() -> GroupName:
+    """The reference's DP group = data×subdata×expert here (the axes ZeRO
+    reduces gradients over).  Usable directly as ``group=`` in ds.comm."""
+    return (DATA_AXIS, SUBDATA_AXIS, EXPERT_AXIS)
+
+
+def get_data_parallel_world_size() -> int:
+    from deepspeed_tpu.comm import comm
+
+    _topo()
+    return comm.get_world_size(group=get_data_parallel_group())
+
+
+def get_data_parallel_rank() -> int:
+    return _axis_coord([DATA_AXIS, SUBDATA_AXIS, EXPERT_AXIS])
+
+
+# -- tensor / model parallel ------------------------------------------
+def get_tensor_model_parallel_group() -> GroupName:
+    return TENSOR_AXIS
+
+
+get_model_parallel_group = get_tensor_model_parallel_group
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return _topo().tp_size
+
+
+get_model_parallel_world_size = get_tensor_model_parallel_world_size
+
+
+def get_tensor_model_parallel_rank() -> int:
+    return _axis_coord([TENSOR_AXIS])
+
+
+get_model_parallel_rank = get_tensor_model_parallel_rank
+
+
+# -- pipeline ---------------------------------------------------------
+def get_pipeline_model_parallel_group() -> GroupName:
+    return PIPE_AXIS
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return _topo().pp_size
+
+
+def get_pipeline_model_parallel_rank() -> int:
+    return _axis_coord([PIPE_AXIS])
+
+
+# -- sequence parallel ------------------------------------------------
+def get_sequence_parallel_group() -> GroupName:
+    return SEQ_AXIS
+
+
+def get_sequence_parallel_world_size() -> int:
+    return _topo().sp_size
+
+
+def get_sequence_parallel_rank() -> int:
+    return _axis_coord([SEQ_AXIS])
+
+
+# -- expert parallel (ref _get_expert_parallel_group family) ----------
+def _get_expert_parallel_group(group_name: str = "") -> GroupName:
+    """Reference MoE code keys expert groups by "ep_size_N" names; every
+    MoE layer here shares the one expert mesh axis."""
+    return EXPERT_AXIS
+
+
+def _get_expert_parallel_world_size(group_name: str = "") -> int:
+    return _topo().ep_size
+
+
+def _get_expert_parallel_rank(group_name: str = "") -> int:
+    return _axis_coord([EXPERT_AXIS])
+
+
+def _get_expert_data_parallel_group(group_name: str = "") -> GroupName:
+    """DP-within-experts: the data axes excluding the expert axis."""
+    return (DATA_AXIS, SUBDATA_AXIS)
+
+
+def _get_expert_data_parallel_world_size(group_name: str = "") -> int:
+    topo = _topo()
+    return topo.sizes[DATA_AXIS] * topo.sizes[SUBDATA_AXIS]
+
+
+def _get_expert_data_parallel_rank(group_name: str = "") -> int:
+    return _axis_coord([DATA_AXIS, SUBDATA_AXIS])
+
+
+def get_world_group() -> GroupName:
+    return tuple(_topo().sizes)
+
+
+def get_world_size() -> int:
+    return _topo().world_size

@@ -74,7 +74,8 @@ def _guard_param_resident(engine, path: str, writing: bool = False) -> None:
         raise RuntimeError(
             "SuperOffload keeps authoritative fp32 masters host-side — a "
             "device-param write would be silently overwritten by the next "
-            "step; edit through the SuperOffload optimizer state instead")
+            "step. Edit the host store directly (engine._super_opt holds "
+            "the masters/moments; see runtime/superoffload.py)")
 
 
 def _fetch_full(arr) -> np.ndarray:
@@ -140,7 +141,7 @@ def safe_get_full_fp32_param(engine, path: str) -> np.ndarray:
     """Full fp32 view of a (possibly ZeRO-sharded) parameter.
     Ref: safe_get_full_fp32_param (tensor_fragment.py:134)."""
     _guard_param_resident(engine, path)
-    return _fetch_full(_find_leaf(engine.params, path)).astype(np.float32)
+    return _fetch_full(_find_leaf(engine.params, path)).astype(np.float32, copy=False)
 
 
 def safe_set_full_fp32_param(engine, path: str, value) -> None:
@@ -162,7 +163,7 @@ def safe_get_full_optimizer_state(engine, path: str,
         raise KeyError(f"unknown optimizer state key {optim_state_key!r} "
                        f"(known: {sorted(_STATE_KEYS)})")
     tree, sub_path, _ = _locate_state(engine, field, path)
-    return _fetch_full(_find_leaf(tree, sub_path)).astype(np.float32)
+    return _fetch_full(_find_leaf(tree, sub_path)).astype(np.float32, copy=False)
 
 
 def safe_set_full_optimizer_state(engine, path: str, value,
@@ -197,7 +198,7 @@ def safe_get_full_grad(engine, path: str) -> Optional[np.ndarray]:
     buf = getattr(engine, "_grad_buffer", None)
     if buf is None:
         return None
-    g = _fetch_full(_find_leaf(buf, path)).astype(np.float32)
+    g = _fetch_full(_find_leaf(buf, path)).astype(np.float32, copy=False)
     return g / _grad_unscale(engine)
 
 
@@ -224,7 +225,7 @@ def safe_get_local_fp32_param(engine, path: str) -> np.ndarray:
     several devices hold different partitions locally; a replicated leaf
     returns one full copy).  Ref: safe_get_local_fp32_param."""
     _guard_param_resident(engine, path)
-    return _local_shard(_find_leaf(engine.params, path)).astype(np.float32)
+    return _local_shard(_find_leaf(engine.params, path)).astype(np.float32, copy=False)
 
 
 def safe_get_local_optimizer_state(engine, path: str,
@@ -233,12 +234,12 @@ def safe_get_local_optimizer_state(engine, path: str,
     if field is None:
         raise KeyError(f"unknown optimizer state key {optim_state_key!r}")
     tree, sub_path, _ = _locate_state(engine, field, path)
-    return _local_shard(_find_leaf(tree, sub_path)).astype(np.float32)
+    return _local_shard(_find_leaf(tree, sub_path)).astype(np.float32, copy=False)
 
 
 def safe_get_local_grad(engine, path: str) -> Optional[np.ndarray]:
     buf = getattr(engine, "_grad_buffer", None)
     if buf is None:
         return None
-    g = _local_shard(_find_leaf(buf, path)).astype(np.float32)
+    g = _local_shard(_find_leaf(buf, path)).astype(np.float32, copy=False)
     return g / _grad_unscale(engine)

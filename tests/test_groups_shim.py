@@ -1,0 +1,54 @@
+"""deepspeed.utils.groups compat shim (utils/groups.py; ref
+deepspeed/utils/groups.py getters): axis names as groups + live
+topology sizes, and the names feed ds.comm collectives directly."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+from deepspeed_tpu.utils import groups
+
+
+@pytest.fixture
+def mesh():
+    topo = MeshTopology({"data": 2, "expert": 2, "tensor": 2})
+    set_topology(topo)
+    yield topo
+    set_topology(None)
+
+
+def test_getters_answer_from_topology(mesh):
+    assert groups.get_data_parallel_world_size() == 4  # data x expert
+    assert groups.get_tensor_model_parallel_world_size() == 2
+    assert groups.get_model_parallel_world_size() == 2
+    assert groups.get_pipeline_model_parallel_world_size() == 1
+    assert groups.get_sequence_parallel_world_size() == 1
+    assert groups._get_expert_parallel_world_size("ep_size_2") == 2
+    assert groups._get_expert_data_parallel_world_size() == 2
+    assert groups.get_world_size() == 8
+    # single-controller process: first-device coordinate is 0 everywhere
+    assert groups.get_data_parallel_rank() == 0
+    assert groups.get_tensor_model_parallel_rank() == 0
+
+
+def test_group_names_feed_comm_collectives(mesh):
+    """The returned group IS the axis name ds.comm collectives take."""
+    from jax.sharding import PartitionSpec as P
+
+    g = groups.get_tensor_model_parallel_group()
+
+    def body(x):
+        return jax.lax.psum(x, g)
+
+    out = jax.jit(jax.shard_map(
+        body, mesh=mesh.mesh, in_specs=P("tensor"), out_specs=P()))(
+        jnp.arange(2, dtype=jnp.float32))
+    assert float(np.asarray(out)) == 1.0  # 0 + 1 summed over tensor axis
+
+
+def test_requires_topology():
+    set_topology(None)
+    with pytest.raises(RuntimeError, match="no topology"):
+        groups.get_data_parallel_world_size()
