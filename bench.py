@@ -249,6 +249,100 @@ def row_longseq_llama():
     return _longseq_row(model, 4, "llama_d128")
 
 
+def _longseq_ring_body():
+    """Ring context parallelism measured for real: llama-class geometry
+    with the sequence sharded over a "seq" mesh ring — striped block
+    placement (causal load balance), the Pallas flash inner block on TPU,
+    ZeRO-2 composed on top (the exact composition the remat fix in
+    sequence/ring.py + runtime/engine.py targets).  Reports
+    tokens/s/chip; vs_baseline = MFU / 0.55 like the other longseq rows."""
+    import jax
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import get_model_config
+
+    n = jax.device_count()
+    if SMOKE:
+        sp = min(4, n)
+        model = get_model_config("llama-tiny", max_seq_len=256,
+                                 seq_impl="ring", ring_placement="striped",
+                                 attn_impl="xla")
+        batch_size, gas, steps, warmup = 2, 1, 2, 1
+        mesh = {"seq": sp}
+    else:
+        # d=128 GQA llama geometry (the longseq_llama row's model) with the
+        # 32k sequence sharded over every chip in one ring
+        sp = n
+        model = get_model_config(
+            "llama3-8b", hidden_size=2048, num_heads=16, num_kv_heads=8,
+            intermediate_size=8192, num_layers=6, vocab_size=32256,
+            max_seq_len=32768, loss_tiles=32, seq_impl="ring",
+            ring_placement="striped", attn_impl="pallas_flash")
+        batch_size, gas, steps, warmup = 1, 2, 3, 2
+        mesh = {"seq": sp}
+    config = {
+        "train_micro_batch_size_per_gpu": batch_size,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "gradient_clipping": 1.0,
+        "mesh": mesh,
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+    seq = model.max_seq_len
+    dp = engine.topology.dp_size
+    rows = batch_size * dp * gas
+    rng = np.random.default_rng(6)
+    ids = rng.integers(0, model.vocab_size, size=(rows, seq + 1),
+                       dtype=np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
+    dt = _time_train(engine, batch, steps, warmup=warmup)
+    tps_chip = steps * rows * seq / dt / max(1, n)
+    _reset_topology()
+    mfu = _mfu(tps_chip, model, seq)
+    return {
+        "metric": f"longseq_{seq}_ring_sp{sp}_train_tokens_per_sec_per_chip",
+        "value": round(tps_chip, 1), "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.55, 3),
+        "mfu": round(mfu, 3),
+        "placement": "striped",
+    }
+
+
+def row_longseq_ring():
+    """Ring-attention long-context row.  The ring needs sp > 1; smoke mode
+    pins the in-process backend to ONE cpu device, so the smoke variant
+    re-execs itself on a virtual 8-device CPU mesh (same pattern as the
+    driver's row isolation)."""
+    if SMOKE and "--ring-inner" not in sys.argv:
+        import os
+        import subprocess
+
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        env["JAX_PLATFORMS"] = "cpu"
+        cmd = [sys.executable, __file__, "--row", "longseq_ring",
+               "--smoke", "--ring-inner"]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=900, env=env)
+        except subprocess.TimeoutExpired:
+            return {"metric": "longseq_ring", "error": "smoke timed out"}
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return {"metric": "longseq_ring",
+                "error": ("no result line; " + " | ".join(tail[-3:]))[:300]}
+    return _longseq_ring_body()
+
+
 # Peak-params ladder: (name, base preset, model overrides, zero_config).
 # Big entries lean on the framework's own scale machinery — ZeRO-Infinity
 # layer streaming (offload_param cpu: layer weights live host-side,
@@ -465,6 +559,7 @@ _ROWS = {
     "llama8b_class_zero3": row_llama8b_class_zero3,
     "longseq_flash": row_longseq_flash,
     "longseq_llama": row_longseq_llama,
+    "longseq_ring": row_longseq_ring,
     "peak_params": row_peak_params,
     "v2_decode": row_v2_decode,
     "gpt2_350m": row_gpt2_350m,
@@ -533,7 +628,7 @@ def main() -> None:
         return
     rows = []
     for name in ("llama8b_class_zero3", "longseq_flash", "longseq_llama",
-                 "peak_params", "v2_decode"):
+                 "longseq_ring", "peak_params", "v2_decode"):
         if SMOKE:
             try:
                 r = _ROWS[name]()

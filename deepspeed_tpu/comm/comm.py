@@ -37,6 +37,7 @@ from deepspeed_tpu.parallel.topology import (DATA_AXIS, EXPERT_AXIS, MESH_AXES, 
                                              get_topology, set_topology)
 from deepspeed_tpu.utils.comms_logging import get_comms_logger
 from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.jax_compat import axis_size, shard_map
 
 AxisName = Union[str, Sequence[str]]
 
@@ -267,7 +268,7 @@ def scatter(x, src: int = 0, group: AxisName = ZERO_AXES, axis: int = 0):
     slice i of rank-``src``'s tensor along ``axis``."""
     _log_op("scatter", x, group)
     full = broadcast(x, src=src, group=group)
-    n = lax.axis_size(group)
+    n = axis_size(group)
     if full.shape[axis] % n != 0:
         raise ValueError(
             f"scatter: axis {axis} (size {full.shape[axis]}) must divide "
@@ -283,7 +284,7 @@ def scatter(x, src: int = 0, group: AxisName = ZERO_AXES, axis: int = 0):
 # ----------------------------------------------------------------------
 def _eager(fn, x, spec_in, spec_out):
     topo = _require_topology()
-    mapped = jax.shard_map(fn, mesh=topo.mesh, in_specs=spec_in, out_specs=spec_out,
+    mapped = shard_map(fn, mesh=topo.mesh, in_specs=spec_in, out_specs=spec_out,
                            check_vma=False)
     return mapped(x)
 

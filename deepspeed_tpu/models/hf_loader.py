@@ -201,7 +201,7 @@ def config_from_hf(hf_config) -> TransformerConfig:
             # rotary_dim=None = full-head rotary (HF GPTJAttention)
             rotary_pct=(hf_config.rotary_dim or d) / d,
             parallel_block=True, use_bias=False, mlp_bias=True,
-            tie_embeddings=False,
+            tie_embeddings=False, lm_head_bias=True,
             layernorm_eps=getattr(hf_config, "layer_norm_epsilon", 1e-5))
     if mt == "gpt_neo":
         # alternating global/local attention, learned positions, NO
@@ -666,8 +666,10 @@ def _convert_bloom(sd, cfg):
 
 def _convert_gptj(sd, cfg):
     """HF GPTJForCausalLM → functional tree (ref
-    module_inject/containers/gptj.py).  A nonzero lm_head bias cannot be
-    represented (functional head has no output bias) and is warned about."""
+    module_inject/containers/gptj.py).  The checkpoint's lm_head.bias
+    (nonzero in the released EleutherAI weights) maps to the functional
+    head's optional vocab-size output bias — served logits match HF
+    per-token."""
     layers = []
     for i in range(cfg.num_layers):
         p = f"transformer.h.{i}."
@@ -690,9 +692,8 @@ def _convert_gptj(sd, cfg):
            "final_norm": {"scale": sd["transformer.ln_f.weight"],
                           "bias": sd["transformer.ln_f.bias"]},
            "lm_head": sd["lm_head.weight"].T}
-    if "lm_head.bias" in sd and np.abs(sd["lm_head.bias"]).max() > 0:
-        logger.warning("gptj lm_head bias dropped (functional head has no "
-                       "output bias)")
+    if "lm_head.bias" in sd:
+        out["lm_head_bias"] = sd["lm_head.bias"]
     return out
 
 

@@ -93,6 +93,9 @@ class TransformerConfig:
     # BERT MLM head: LN(gelu(h @ W + b)) @ embed.T + bias instead of the
     # plain lm_head matmul (HF BertLMPredictionHead)
     mlm_head: bool = False
+    # vocab-size output bias added to the logits (GPT-J ships a nonzero
+    # lm_head.bias; HF applies it, so serving parity requires it too)
+    lm_head_bias: bool = False
     parallel_block: bool = False  # Falcon/Phi: x + attn(n) + mlp(n)
     # Falcon new_decoder_architecture (40B/180B, num_ln_in_parallel_attn=2):
     # the parallel block gets separate input norms — attn uses ln1 (HF
@@ -151,6 +154,15 @@ class TransformerConfig:
     # "ring" (K/V blocks rotate the ring with online softmax; no head
     # divisibility requirement — sequence/ring.py)
     seq_impl: str = "ulysses"
+    # ring attention block placement over the seq mesh: "contiguous"
+    # (shard r owns rows [r·S_l, (r+1)·S_l)) | "striped" (shard r owns
+    # rows r, r+sp, … — Striped Attention causal load balancing: every
+    # hop is ~half-masked on every rank, so the flash kernel's tile skip
+    # halves causal compute uniformly instead of idling early ranks).
+    # Striped feeds require stripe-permuted ids/labels; the engine
+    # applies the permutation host-side and forward() derives matching
+    # positions, so training is turnkey (sequence/ring.py helpers).
+    ring_placement: str = "contiguous"
     # layer-scan unroll factor (XLA overlaps across unrolled iterations)
     scan_unroll: int = 1
     # residual/embedding dropout rate (GPT-2/BERT-class training; llama
@@ -369,6 +381,8 @@ def init_params(cfg: TransformerConfig, key) -> Params:
         }
     if not cfg.tie_embeddings:
         params["lm_head"] = _dense_init(keys[nl + 2], (h, cfg.vocab_size), scale, pd)
+    if cfg.lm_head_bias and not cfg.mlm_head:
+        params["lm_head_bias"] = jnp.zeros((cfg.vocab_size,), pd)
     return params
 
 
@@ -476,9 +490,20 @@ def _attention_scores(q, k, v, cfg: TransformerConfig, segment_pos=None,
         # Bloom ALiBi: slope[h] · key_position added to the scores (HF's
         # key-position form — per-query-row softmax shift makes it
         # equivalent to the distance form)
-        kpos = jnp.arange(s, dtype=jnp.float32)
-        scores = scores + (alibi_slopes(nh)[:, None, None]
-                           * kpos[None, None, :]).astype(scores.dtype)
+        if attention_mask is not None:
+            # HF build_alibi_tensor derives key positions from the padding
+            # mask (cumsum - 1 over the kept keys), so LEFT-padded batches
+            # bias by the token's position within the real sequence, not
+            # its slot index.  Padding slots get position 0; their scores
+            # are masked below anyway.
+            am = attention_mask.astype(jnp.float32)
+            kpos = (jnp.cumsum(am, axis=-1) - 1.0) * am      # [B, S]
+            scores = scores + (alibi_slopes(nh)[None, :, None, None]
+                               * kpos[:, None, None, :]).astype(scores.dtype)
+        else:
+            kpos = jnp.arange(s, dtype=jnp.float32)
+            scores = scores + (alibi_slopes(nh)[:, None, None]
+                               * kpos[None, None, :]).astype(scores.dtype)
     if cfg.causal:
         mask = jnp.tril(jnp.ones((s, s), dtype=bool))
         if cfg.sliding_window:
@@ -563,7 +588,8 @@ def _attn_block(x, p, positions, cfg: TransformerConfig,
 
         out = ring_attention(q, k, v, topo, causal=cfg.causal,
                              sm_scale=cfg.attn_scale,
-                             window=cfg.sliding_window or None)
+                             window=cfg.sliding_window or None,
+                             placement=cfg.ring_placement)
         out = out.reshape(b, s, nh * d)
         out = out @ p["wo"].astype(dt)
         if p.get("bo") is not None:
@@ -880,7 +906,18 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
     b, s = input_ids.shape
     dt = cfg.dtype
     if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+        pos_row = jnp.arange(s, dtype=jnp.int32)
+        if cfg.seq_impl == "ring" and cfg.ring_placement == "striped":
+            from deepspeed_tpu.parallel.topology import get_topology as _gt
+            from deepspeed_tpu.sequence.ring import ring_position_map
+
+            topo_ = _gt()
+            if topo_ is not None and topo_.sp_size > 1:
+                # striped ring: the engine feeds stripe-permuted ids, so
+                # slot j of shard r holds token r + sp*j — positions must
+                # follow (RoPE/learned embeddings stay exact)
+                pos_row = ring_position_map(s, topo_.sp_size, "striped")
+        positions = jnp.broadcast_to(pos_row[None, :], (b, s))
     if dropout_key is not None and cfg.param_stream:
         raise NotImplementedError(
             "dropout / noisy MoE gating + param streaming not supported "
@@ -1149,6 +1186,9 @@ def forward(params: Params, input_ids, cfg: TransformerConfig,
         logits = x.astype(ht) @ params["embed"]["tokens"].astype(ht).T
     else:
         logits = x.astype(ht) @ params["lm_head"].astype(ht)
+    if not cfg.mlm_head and params.get("lm_head_bias") is not None:
+        # GPT-J-style per-vocab output bias (HF applies lm_head.bias)
+        logits = logits + params["lm_head_bias"].astype(ht)
     if cfg.is_moe:
         # stash aux loss on the fwd for the engine loss fn via closure return
         return logits, moe_aux

@@ -443,6 +443,171 @@ def _dkv_kernel_blocked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 # ----------------------------------------------------------------------
+# Ring-hop carry kernel: one fused flash pass over a visiting K/V block
+# with an ONLINE-SOFTMAX CARRY (m, l, acc) threaded in and out, so ring
+# attention (sequence/ring.py) runs each ppermute hop as a single kernel
+# launch instead of materialized fp32 [S_l, S_l] score blocks.
+#
+# Positions are decoupled from array indices: the hop's query/key blocks
+# live at *global* positions ``off + stride * i`` (contiguous placement:
+# stride 1, off = shard * S_l; striped placement: stride sp, off =
+# shard index).  The offsets are TRACED scalars (they derive from
+# lax.axis_index inside shard_map) and ride in SMEM; strides are static.
+# Causally-dead tiles are skipped at the grid level via ``pl.when`` on
+# the offset arithmetic — under striped placement every hop is ~half
+# dead, which is exactly the ring causal-load-balancing win.
+# ----------------------------------------------------------------------
+def _carry_kernel(info_ref, q_ref, k_ref, v_ref, mi_ref, li_ref, acci_ref,
+                  mo_ref, lo_ref, acco_ref, m_scr, l_scr, acc_scr, *,
+                  sm_scale, causal, window, bq, bk, q_stride, k_stride,
+                  s_real):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+    q_off = info_ref[0]
+    k_off = info_ref[1]
+
+    @pl.when(ik == 0)
+    def _():
+        m_scr[...] = mi_ref[0, 0]
+        l_scr[...] = li_ref[0, 0]
+        acc_scr[...] = acci_ref[0, 0]
+
+    # tile liveness/interiority from the hop's global position ranges
+    # (strides are positive, so block corners bound the tile's positions)
+    q_lo = q_off + q_stride * (iq * bq)
+    q_hi = q_off + q_stride * (iq * bq + bq - 1)
+    k_lo = k_off + k_stride * (ik * bk)
+    k_hi = k_off + k_stride * (ik * bk + bk - 1)
+    live = jnp.bool_(True)
+    interior = (ik * bk + bk <= s_real) & (iq * bq + bq <= s_real)
+    if causal:
+        live &= k_lo <= q_hi
+        interior &= k_hi <= q_lo
+    if window is not None:
+        live &= q_lo - k_hi < window
+        interior &= q_hi - k_lo < window
+
+    def compute(masked):
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = _scores(q, k, sm_scale)
+        if masked:
+            rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            rpos = q_off + q_stride * (iq * bq + rows)
+            cpos = k_off + k_stride * (ik * bk + cols)
+            valid = ((iq * bq + rows < s_real) & (ik * bk + cols < s_real))
+            if causal:
+                valid &= cpos <= rpos
+            if window is not None:
+                valid &= rpos - cpos < window
+            s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_scr[:, 0:1]
+        l_prev = l_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        if masked:
+            # fully-masked rows keep m_new = NEG_INF; exp(s - m_new) would
+            # be 1 on the masked entries — kill them explicitly
+            p = jnp.where(valid, p, 0.0)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v,
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * alpha + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    pl.when(jnp.logical_and(live, interior))(lambda: compute(False))
+    pl.when(jnp.logical_and(live, jnp.logical_not(interior)))(
+        lambda: compute(True))
+
+    @pl.when(ik == nk - 1)
+    def _():
+        mo_ref[0, 0] = m_scr[...]
+        lo_ref[0, 0] = l_scr[...]
+        acco_ref[0, 0] = acc_scr[...]
+
+
+# q/k block edge for the carry kernel (per-hop S_l blocks). 512 keeps the
+# per-program footprint (q + k/v + carry in/out + one [bq, bk] score tile,
+# double-buffered) well inside scoped VMEM at d=128; override for sweeps.
+_RING_BLK = 512
+
+
+def ring_carry_pad(s_l: int) -> int:
+    """Padded per-shard length the carry kernel runs at: lane-aligned and
+    a whole number of `_RING_BLK` blocks once past one block."""
+    s_pad = -(-s_l // 128) * 128
+    if s_pad > _RING_BLK:
+        s_pad = -(-s_pad // _RING_BLK) * _RING_BLK
+    return s_pad
+
+
+def flash_carry_block(q, k, v, m, l, acc, q_off, k_off, *, q_stride=1,
+                      k_stride=1, s_real=None, sm_scale=None, causal=True,
+                      window=None):
+    """One ring hop: online-softmax update of ``(m, l, acc)`` against the
+    visiting K/V block, fused in a single Pallas pass (no materialized
+    score matrix in HBM).
+
+    ``q [B, Hq, S_pad, D]``; ``k/v [B, Hkv, S_pad, D]`` (GQA folded in the
+    index map, KV never repeated); ``m/l [B, Hq, S_pad, 128]`` fp32
+    lane-replicated running max / normalizer; ``acc [B, Hq, S_pad, D]``
+    fp32 running numerator.  ``q_off/k_off``: traced int32 global position
+    offsets of the two blocks; ``q_stride/k_stride``: static position
+    strides (1 = contiguous shards, sp = striped placement).  S_pad must
+    be ``ring_carry_pad(s_real)``.  Returns updated ``(m, l, acc)``.
+    """
+    b, hq, s_pad, d = q.shape
+    hkv = k.shape[1]
+    group = hq // hkv
+    s_real = s_pad if s_real is None else s_real
+    sm_scale = 1.0 / math.sqrt(d) if sm_scale is None else sm_scale
+    bq = bk = min(_RING_BLK, s_pad)
+    if s_pad % bq:
+        raise ValueError(f"S_pad={s_pad} not a multiple of the ring block "
+                         f"({bq}); pad with ring_carry_pad")
+    info = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+    grid = (b, hq, s_pad // bq, s_pad // bk)
+    q_spec = pl.BlockSpec((1, 1, bq, d),
+                          lambda ib, ih, iq, ik: (ib, ih, iq, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, d),
+                           lambda ib, ih, iq, ik: (ib, ih // group, ik, 0))
+    lane_spec = pl.BlockSpec((1, 1, bq, 128),
+                             lambda ib, ih, iq, ik: (ib, ih, iq, 0))
+    return pl.pallas_call(
+        functools.partial(_carry_kernel, sm_scale=sm_scale, causal=causal,
+                          window=window, bq=bq, bk=bk, q_stride=q_stride,
+                          k_stride=k_stride, s_real=s_real),
+        grid=grid,
+        interpret=INTERPRET,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            q_spec, kv_spec, kv_spec, lane_spec, lane_spec, q_spec,
+        ],
+        out_specs=[lane_spec, lane_spec, q_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, s_pad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, s_pad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, s_pad, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),   # m
+            pltpu.VMEM((bq, 128), jnp.float32),   # l
+            pltpu.VMEM((bq, d), jnp.float32),     # acc
+        ],
+        # the carry is read once (ik == 0) and rewritten in place — alias
+        # it through so the per-hop scan never copies the running state
+        input_output_aliases={4: 0, 5: 1, 6: 2},
+    )(info, q, k, v, m, l, acc)
+
+
+# ----------------------------------------------------------------------
 # pallas_call plumbing
 # ----------------------------------------------------------------------
 def _pad_seq(x, s_pad):

@@ -38,7 +38,15 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from deepspeed_tpu.utils.jax_compat import tpu_compiler_params
+from deepspeed_tpu.utils.logging import logger
+
 INTERPRET = False
+
+# One-time flag: the dequantize-then-dot fallback silently reads 16-bit
+# weights (the whole point of fp6 is the 6-bit wire/HBM read), so losing
+# the bandwidth win must be visible in logs exactly once per process.
+_warned_fallback = False
 
 _BIAS = 3
 _MAX_VAL = 28.0  # (2 - 2^-2) * 2^(7-3): full exponent range, no inf/nan
@@ -178,6 +186,17 @@ def fp6_matmul(x, packed, scale, block_m: int = 256, block_n: int = 256,
     servable = (n % bn == 0 and k4 % bk4 == 0
                 and bn % 128 == 0 and bk4 % 8 == 0)
     if not servable or not (on_tpu or INTERPRET):
+        global _warned_fallback
+        if not _warned_fallback:
+            reason = (f"unservable tile shape (K={k}, N={n} vs blocks "
+                      f"bn={bn}, bk4={bk4})" if (on_tpu or INTERPRET)
+                      else "not running on TPU")
+            logger.warning(
+                "fp6_matmul: %s — falling back to dequantize-then-dot; the "
+                "packed 6-bit HBM/bandwidth win is lost for these calls "
+                "(weights are expanded to %s before the MXU dot)",
+                reason, jnp.dtype(x.dtype).name)
+            _warned_fallback = True
         out = x[:m] @ fp6_dequantize(packed, scale, x.dtype)
         return out.reshape(lead + (n,))
     m = m_pad
@@ -195,7 +214,7 @@ def fp6_matmul(x, packed, scale, block_m: int = 256, block_n: int = 256,
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, k_: (i, j)),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=INTERPRET,
     )(x4, packed, scale.reshape(1, n))

@@ -43,6 +43,7 @@ import numpy as np
 from jax import lax
 
 from deepspeed_tpu.parallel.topology import PIPE_AXIS, MeshTopology
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 
 def _drain_schedule(n_micro: int, pp: int):
@@ -197,7 +198,7 @@ def spmd_pipeline(layer_fn: Callable,
 
     param_specs = jax.tree.map(lambda _: P(PIPE_AXIS), stage_params)
     extras_specs = jax.tree.map(lambda _: P(), extras)
-    out, aux = jax.shard_map(
+    out, aux = shard_map(
         per_stage,
         mesh=topo.mesh,
         in_specs=(param_specs, P(), extras_specs),
@@ -466,7 +467,7 @@ def make_pipeline_train_loss(stage_fn: Callable, tail_fn: Callable,
         ex_specs = jax.tree.map(lambda _: P(), extras)
         acc_specs = (P() if embed_fn is None
                      else jax.tree.map(lambda _: P(), embed_params))
-        return jax.shard_map(
+        return shard_map(
             per_stage,
             mesh=topo.mesh,
             in_specs=(sp_specs, tp_specs, ep_specs, P(), P(), ex_specs),

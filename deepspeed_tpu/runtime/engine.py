@@ -244,6 +244,20 @@ class DeepSpeedEngine:
                             if cfg.activation_checkpointing.partition_activations
                             or cfg.activation_checkpointing.remat_policy != "nothing_saveable"
                             else mc.remat_policy)
+            if (mc.seq_impl == "ring" and topology.sp_size > 1
+                    and mc.remat_policy == "nothing_saveable"):
+                # Ring attention's forward is a ring of ppermute hops; under
+                # nothing_saveable the backward would re-run that whole
+                # collective chain per layer just to rebuild (o, lse).  The
+                # ring tags exactly those residuals "flash_out"/"flash_lse"
+                # (sequence/ring.py), so saving them — and only them — keeps
+                # the backward collective-free on the forward side at
+                # O(B·S_l·H) extra HBM per layer.
+                mc = mc.replace(remat_policy="flash_saveable")
+                log_dist("ring sequence parallelism: remat policy upgraded "
+                         "nothing_saveable -> flash_saveable (saves the "
+                         "ring's (o, lse) so the backward never re-runs "
+                         "the forward ppermute chain)", level="info")
             if cfg.pipeline.num_microbatches:
                 mc = mc.replace(pipeline_microbatches=cfg.pipeline.num_microbatches)
             if self._param_stream:
@@ -1109,15 +1123,43 @@ class DeepSpeedEngine:
             n = np.shape(first)[0]
             per_step = self.micro_batch_size * self.topology.dp_size
             if n == gas and np.ndim(first) >= 2 and np.shape(first)[1] == per_step:
-                return data  # already [gas, B, ...]
+                return self._maybe_stripe_ring(data, seq_axis=2)
             if n != gas * per_step:
                 raise ValueError(
                     f"batch dim {n} != gas({gas}) * micro*dp({per_step})")
-            return {k: np.asarray(v).reshape((gas, per_step) + np.shape(v)[1:])
-                    for k, v in data.items()}
+            return self._maybe_stripe_ring(
+                {k: np.asarray(v).reshape((gas, per_step) + np.shape(v)[1:])
+                 for k, v in data.items()}, seq_axis=2)
         # iterator of micro-batches
         micros = [next(data) for _ in range(gas)]
-        return {k: np.stack([np.asarray(m[k]) for m in micros], axis=0) for k in micros[0]}
+        return self._maybe_stripe_ring(
+            {k: np.stack([np.asarray(m[k]) for m in micros], axis=0)
+             for k in micros[0]}, seq_axis=2)
+
+    def _maybe_stripe_ring(self, batch, seq_axis: int):
+        """Striped ring placement (model cfg ring_placement="striped"):
+        permute sequence-axis batch arrays into the stripe order the
+        model's positions assume — shard r of the seq mesh then owns
+        tokens r, r+sp, … and every causal ring hop is load-balanced
+        (sequence/ring.py).  Host-side numpy: the permutation costs no
+        device collectives, and labels ride the same order so the loss
+        pairing is untouched."""
+        mc = self.model_config
+        if (mc is None or getattr(mc, "seq_impl", None) != "ring"
+                or getattr(mc, "ring_placement", None) != "striped"
+                or self.topology.sp_size <= 1):
+            return batch
+        from deepspeed_tpu.sequence.ring import stripe_sequence
+
+        sp = self.topology.sp_size
+        out = dict(batch)
+        for k in ("input_ids", "labels", "attention_mask",
+                  "token_type_ids", "position_ids"):
+            v = out.get(k)
+            if v is not None and np.ndim(v) > seq_axis \
+                    and np.shape(v)[seq_axis] % sp == 0:
+                out[k] = stripe_sequence(np.asarray(v), sp, axis=seq_axis)
+        return out
 
     def _apply_curriculum(self, data):
         """Truncate seq-dim batch keys to the curriculum's current
@@ -1467,6 +1509,7 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch: Batch) -> jnp.ndarray:
         self._swap_in_params()
+        batch = self._maybe_stripe_ring(batch, seq_axis=1)
         batch = self._put_batch(batch, stacked=False)
         return self._eval_step_jit(self.params, batch)
 

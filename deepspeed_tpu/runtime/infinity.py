@@ -36,8 +36,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-HOST = jax.memory.Space.Host
-DEVICE = jax.memory.Space.Device
+try:  # jax >= 0.5 spells memory spaces as an enum
+    HOST = jax.memory.Space.Host
+    DEVICE = jax.memory.Space.Device
+except AttributeError:  # jax 0.4.x: device_put targets inside jit take
+    # TransferToMemoryKind (same placement semantics, string-keyed)
+    from jax._src.sharding_impls import TransferToMemoryKind
+
+    HOST = TransferToMemoryKind("pinned_host")
+    DEVICE = TransferToMemoryKind("device")
 
 _MEMORY_KINDS: dict = {}
 

@@ -31,7 +31,16 @@ from deepspeed_tpu.utils.logging import log_dist, logger
 
 
 def with_memory_kind(shardings, kind: str):
-    return jax.tree.map(lambda s: s.with_memory_kind(kind), shardings)
+    def _wk(s):
+        try:
+            return s.with_memory_kind(kind)
+        except ValueError:
+            # backend has no such memory space (CPU mesh: only
+            # unpinned_host) — placement degrades to a no-op, matching
+            # memory_kinds_supported()'s platform gate
+            return s
+
+    return jax.tree.map(_wk, shardings)
 
 
 _HOST_OFFLOAD_PROBE: Dict[str, bool] = {}
@@ -112,7 +121,7 @@ def partial_offload_shardings(param_shape_tree, device_shardings, ratio: float):
             break
         host_set.add(i)
         host_bytes += sizes[i]
-    out = [s.with_memory_kind("pinned_host") if i in host_set else s
+    out = [with_memory_kind(s, "pinned_host") if i in host_set else s
            for i, s in enumerate(shard_leaves)]
     return jax.tree_util.tree_unflatten(treedef, out)
 

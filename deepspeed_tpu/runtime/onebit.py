@@ -39,6 +39,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deepspeed_tpu.comm.compressed import compressed_allreduce
 from deepspeed_tpu.parallel.topology import DATA_AXIS, MeshTopology
 from deepspeed_tpu.utils.logging import log_dist
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 ONEBIT_OPTIMIZERS = ("onebitadam", "onebitlamb", "zerooneadam")
 
@@ -236,7 +237,7 @@ class OnebitTrainStep:
         param_specs = jax.tree.map(lambda s: s.spec, param_shardings)
         batch_specs = batch_shardings_fn
 
-        mapped = jax.shard_map(
+        mapped = shard_map(
             local_step, mesh=mesh,
             in_specs=(param_specs, rep, rep, rep, err_spec, err_spec,
                       batch_specs, rep),

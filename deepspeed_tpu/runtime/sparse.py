@@ -27,6 +27,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.parallel.topology import BATCH_AXES
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 
 @jax.tree_util.register_pytree_node_class
@@ -104,7 +105,7 @@ def dp_allgather_sparse(st: SparseTensor, topo) -> SparseTensor:
             vals = lax.all_gather(vals, ax, tiled=True)
         return idx, vals
 
-    idx, vals = jax.shard_map(
+    idx, vals = shard_map(
         gather, mesh=topo.mesh,
         in_specs=(P(BATCH_AXES), P(BATCH_AXES)),
         out_specs=(P(), P()),

@@ -138,9 +138,11 @@ def _memory_space_supported() -> bool:
     plat = jax.devices()[0].platform
     if plat not in _MEM_SPACE_PROBE:
         try:
+            from deepspeed_tpu.runtime.infinity import DEVICE, HOST
+
             def f(a):
-                h = jax.device_put(a, jax.memory.Space.Host)
-                return jax.device_put(h, jax.memory.Space.Device)
+                h = jax.device_put(a, HOST)
+                return jax.device_put(h, DEVICE)
 
             jax.jit(f)(jnp.ones((4,))).block_until_ready()
             _MEM_SPACE_PROBE[plat] = True
@@ -153,14 +155,18 @@ def _park_on_host(x):
     """Move chunked KV to pinned host memory when the backend supports it
     (ref chunk offloading, fpdt_layer.py:510)."""
     try:
-        return jax.device_put(x, jax.memory.Space.Host)
+        from deepspeed_tpu.runtime.infinity import HOST
+
+        return jax.device_put(x, HOST)
     except Exception:  # CPU test backend: memory kinds unsupported → no-op
         return x
 
 
 def _fetch_from_host(x):
     try:
-        return jax.device_put(x, jax.memory.Space.Device)
+        from deepspeed_tpu.runtime.infinity import DEVICE
+
+        return jax.device_put(x, DEVICE)
     except Exception:
         return x
 

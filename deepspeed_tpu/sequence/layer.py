@@ -30,6 +30,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deepspeed_tpu.parallel.topology import (BATCH_AXES, SEQ_AXIS,
                                              TENSOR_AXIS, MeshTopology,
                                              get_topology)
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 
 def _constraint(x, spec):
@@ -125,7 +126,7 @@ class DistributedAttention:
             # inverse: scatter seq, gather heads
             return single_all_to_all(out, self.gather_idx, self.scatter_idx)
 
-        return jax.shard_map(body, mesh=mesh, in_specs=(in_spec, in_spec, in_spec),
+        return shard_map(body, mesh=mesh, in_specs=(in_spec, in_spec, in_spec),
                              out_specs=out_spec, check_vma=False)(q, k, v)
 
 

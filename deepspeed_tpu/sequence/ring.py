@@ -11,51 +11,443 @@ compute — and, unlike Ulysses, there is NO heads % sp divisibility
 requirement, so it scales past the KV-head count (GQA models with 8 KV
 heads on a 16-way context mesh).
 
-Per-block math mirrors the Pallas flash kernel's online softmax
-(ops/pallas/flash_mha.py) with the block loop living on the mesh instead
-of the grid.  The block products are plain XLA einsums — on-chip they
-fuse; swapping the inner block for the flash kernel is a later
-optimization that doesn't change this interface.
+Perf-grade inner block: on TPU (or under the Pallas interpreter) each
+FORWARD hop is ONE fused flash pass — :func:`flash_carry_block` threads
+the online softmax carry (m, l, acc) through the kernel, so no fp32
+``[S_l, S_l]`` score block reaches HBM on the forward and causally-dead
+tiles are skipped at the grid level.  Off-TPU the same math runs as XLA
+einsums (the CPU test mesh), so parity tests cover both paths.  The
+BACKWARD hops are currently XLA einsums and do materialize per-hop
+score-shaped fp32 intermediates — fusing them through offset-aware
+variants of the existing dq/dkv flash kernels is the queued next step
+(BENCH_MEASURED_r06.json); until then long-sequence training memory is
+bounded by the backward, not the forward.
 
-Causal masking uses global positions (shard i's queries own rows
-[i·S_l, (i+1)·S_l)); hops whose source block lies entirely in the masked
-future contribute nothing (their probabilities are zeroed — compute is
-spent but numerics are exact; skipping them is the classic ring-attention
-load-imbalance optimization, also a later step).
+Causal scheduling: with the default ``contiguous`` placement, hops whose
+source block lies entirely in the masked future are skipped outright
+(``lax.cond`` around the attend — no score FLOPs), but the ring is
+bulk-synchronous per hop so the skip saves energy, not wall-clock (rank 0
+idles while rank sp-1 works).  ``placement="striped"`` fixes the load
+balance (Striped Attention, arXiv 2311.09431): shard r owns tokens
+``r, r+sp, r+2sp, …``, so every hop is a ~half-masked block on every rank
+— the flash kernel's tile skipping then halves causal compute uniformly.
+Callers feed striped data (:func:`stripe_sequence` /
+:func:`unstripe_sequence` are pure global reshapes; the engine applies
+them host-side to ids/labels) and positions follow automatically.
 
-Known partitioner wart: composed with ZeRO-2 on a data×seq mesh, XLA's
-SPMD partitioner reports one "involuntary full rematerialization" for a
-backward residual crossing the partial-manual boundary (it replicates a
-[B, S_l, H] tensor before resharding — its own warning points to the
-Shardy tracker b/433785288).  Numerics are unaffected; revisit the
-in/out specs once Shardy lands.
+Gradients are a hand-written second ring pass (``jax.custom_vjp``): the
+forward saves (o, lse) per shard, the backward rotates K/V again and
+accumulates dk/dv on buffers that TRAVEL WITH their block, delivered home
+by one final ppermute.  Because the forward scan is never differentiated,
+no per-hop carry residual ever crosses the shard_map partial-manual
+boundary — which is what used to make the XLA SPMD partitioner report an
+"involuntary full rematerialization" (a replicated [B, S_l, H] backward
+residual) when ring composed with ZeRO-2 on a data×seq mesh.  The saved
+(o, lse) are tagged ``checkpoint_name`` "flash_out"/"flash_lse", so the
+engine's flash-aware remat policies keep them and the backward never
+re-runs the forward ring (see runtime/engine.py's ring policy upgrade).
 """
 
 from __future__ import annotations
 
-from typing import Optional
+import functools
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu.parallel.topology import SEQ_AXIS, get_topology
+from deepspeed_tpu.parallel.topology import (BATCH_AXES, SEQ_AXIS,
+                                             get_topology)
+from deepspeed_tpu.utils.jax_compat import get_abstract_mesh, shard_map
 
 _NEG = -1e30
 
+PLACEMENTS = ("contiguous", "striped")
 
+
+class _RingSpec(NamedTuple):
+    """Static per-call config (hashable: rides custom_vjp nondiff)."""
+    sp: int
+    rep: int
+    scale: float
+    causal: bool
+    window: Optional[int]
+    placement: str
+    use_flash: bool
+
+
+# ----------------------------------------------------------------------
+# Placement helpers
+# ----------------------------------------------------------------------
+def ring_position_map(s: int, sp: int, placement: str = "contiguous"):
+    """Global token position held by each slot of the seq-sharded array
+    ([S] int32).  Under ``striped`` placement shard r's slot j holds token
+    ``r + sp*j`` — feed the model positions from this map (RoPE/ALiBi stay
+    exact) when its inputs went through :func:`stripe_sequence`."""
+    if placement == "striped" and sp > 1:
+        s_l = s // sp
+        i = jnp.arange(s, dtype=jnp.int32)
+        return (i // s_l) + sp * (i % s_l)
+    return jnp.arange(s, dtype=jnp.int32)
+
+
+def stripe_sequence(x, sp: int, axis: int = 1):
+    """Reorder a GLOBAL sequence-axis array from natural token order to
+    striped placement (shard r gets tokens r, r+sp, …).  Pure reshape +
+    transpose — apply before sharding (host-side ids/labels, or globally
+    before jit).  Works on numpy and jax arrays."""
+    if sp <= 1:
+        return x
+    s = x.shape[axis]
+    if s % sp:
+        raise ValueError(f"sequence length {s} not divisible by sp={sp}")
+    s_l = s // sp
+    shape = x.shape
+    y = x.reshape(shape[:axis] + (s_l, sp) + shape[axis + 1:])
+    return y.swapaxes(axis, axis + 1).reshape(shape)
+
+
+def unstripe_sequence(x, sp: int, axis: int = 1):
+    """Inverse of :func:`stripe_sequence`."""
+    if sp <= 1:
+        return x
+    s = x.shape[axis]
+    if s % sp:
+        raise ValueError(f"sequence length {s} not divisible by sp={sp}")
+    s_l = s // sp
+    shape = x.shape
+    y = x.reshape(shape[:axis] + (sp, s_l) + shape[axis + 1:])
+    return y.swapaxes(axis, axis + 1).reshape(shape)
+
+
+def _block_positions(block_idx, s_l: int, sp: int, placement: str):
+    """Traced [s_l] global positions of the block owned by ``block_idx``."""
+    i = jnp.arange(s_l, dtype=jnp.int32)
+    if placement == "striped":
+        return block_idx + sp * i
+    return block_idx * s_l + i
+
+
+def _block_bounds(block_idx, s_l: int, sp: int, placement: str):
+    """Traced (lo, hi) global position range of a block (strides > 0)."""
+    if placement == "striped":
+        return block_idx, block_idx + sp * (s_l - 1)
+    return block_idx * s_l, block_idx * s_l + s_l - 1
+
+
+def _hop_dead(idx, src, s_l: int, spec: _RingSpec):
+    """Whether the (query block idx, key block src) hop contributes
+    nothing: the source block is entirely in the causal future, or
+    entirely older than the sliding window."""
+    q_lo, q_hi = _block_bounds(idx, s_l, spec.sp, spec.placement)
+    k_lo, k_hi = _block_bounds(src, s_l, spec.sp, spec.placement)
+    dead = jnp.bool_(False)
+    if spec.causal:
+        dead |= k_lo > q_hi
+    if spec.window is not None:
+        dead |= q_lo - k_hi >= spec.window
+    return dead
+
+
+def _kernel_enabled() -> bool:
+    """Run the Pallas carry kernel: on TPU, or whenever the flash module's
+    INTERPRET flag is up (CPU parity tests)."""
+    import importlib
+
+    # the ops.pallas package re-exports the flash_mha *function* under the
+    # same name as its submodule — resolve the module itself
+    fm = importlib.import_module("deepspeed_tpu.ops.pallas.flash_mha")
+    if fm.INTERPRET:
+        return True
+    try:
+        return jax.devices()[0].platform in ("tpu", "axon")
+    except Exception:  # pragma: no cover - no backend at trace time
+        return False
+
+
+# ----------------------------------------------------------------------
+# Local (per-shard) forward: XLA einsum path and Pallas flash path.
+# Both return (o [b, s_l, nh, d], lse [b, nkv, rep, s_l] fp32).
+# ----------------------------------------------------------------------
+def _ring_fwd_xla(ql, kl, vl, spec: _RingSpec):
+    b, s_l, nh, d = ql.shape
+    nkv = kl.shape[2]
+    rep = spec.rep
+    # Only masked variants need the shard's ring position; dense
+    # bidirectional hops never touch axis_index (whose partition-id
+    # lowering old SPMD partitioners reject when it ends up dead code).
+    masked = spec.causal or spec.window is not None
+    idx = lax.axis_index(SEQ_AXIS) if masked else jnp.int32(0)
+    # grouped-head layout: K/V stay at nkv heads END TO END — they travel
+    # the ring UNREPEATED and feed the einsums unexpanded (per-hop ICI
+    # traffic and per-hop HBM are both O(S_l·nkv·d))
+    q5 = ql.astype(jnp.float32).reshape(b, s_l, nkv, rep, d)
+    q_pos = _block_positions(idx, s_l, spec.sp, spec.placement)
+    perm = [(i, (i + 1) % spec.sp) for i in range(spec.sp)]
+
+    def attend(m, l, acc, kc, vc, src):
+        k_pos = _block_positions(src, s_l, spec.sp, spec.placement)
+        s = jnp.einsum("bqcgd,bscd->bcgqs", q5,
+                       kc.astype(jnp.float32)) * spec.scale
+        valid = jnp.ones((s_l, s_l), bool)
+        if spec.causal:
+            valid = q_pos[:, None] >= k_pos[None, :]
+        if spec.window is not None:
+            valid &= (q_pos[:, None] - k_pos[None, :]) < spec.window
+        vm = valid[None, None, None]
+        s = jnp.where(vm, s, _NEG)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        # exp(NEG - NEG) would be 1 on fully-masked rows — zero the masked
+        # probabilities explicitly
+        p = jnp.where(vm, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bcgqs,bscd->bcgqd", p, vc.astype(jnp.float32))
+        return m_new, l, acc
+
+    def maybe_attend(m, l, acc, kc, vc, src):
+        if not masked:
+            return attend(m, l, acc, kc, vc, src)
+        return lax.cond(_hop_dead(idx, src, s_l, spec),
+                        lambda: (m, l, acc),
+                        lambda: attend(m, l, acc, kc, vc, src))
+
+    def hop(carry, t):
+        m, l, acc, kc, vc = carry
+        src = lax.rem(idx - t + spec.sp, spec.sp)
+        m, l, acc = maybe_attend(m, l, acc, kc, vc, src)
+        kc = lax.ppermute(kc, SEQ_AXIS, perm)
+        vc = lax.ppermute(vc, SEQ_AXIS, perm)
+        return (m, l, acc, kc, vc), None
+
+    m0 = jnp.full((b, nkv, rep, s_l, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, nkv, rep, s_l, 1), jnp.float32)
+    a0 = jnp.zeros((b, nkv, rep, s_l, d), jnp.float32)
+    # sp-1 hops permute after attending; the LAST block attends without
+    # the dead ring rotation (a collective inside scan that XLA cannot
+    # eliminate)
+    (m, l, acc, kc, vc), _ = lax.scan(
+        hop, (m0, l0, a0, kl, vl), jnp.arange(spec.sp - 1))
+    src_last = lax.rem(idx + 1, spec.sp)
+    m, l, acc = maybe_attend(m, l, acc, kc, vc, src_last)
+    out = acc / jnp.maximum(l, 1e-20)            # [b, nkv, rep, q, d]
+    out = out.transpose(0, 3, 1, 2, 4).reshape(b, s_l, nh, d)
+    lse = (m + jnp.log(jnp.maximum(l, 1e-20)))[..., 0]  # [b, nkv, rep, q]
+    return out.astype(ql.dtype), lse
+
+
+def _ring_fwd_flash(ql, kl, vl, spec: _RingSpec):
+    """Same contract as :func:`_ring_fwd_xla` with the per-hop attend
+    fused into one Pallas pass (flash_carry_block): the carry (m, l, acc)
+    lives in HBM between hops, aliased in place, and dead tiles cost
+    neither VPU masking nor MXU FLOPs."""
+    from deepspeed_tpu.ops.pallas.flash_mha import (flash_carry_block,
+                                                    ring_carry_pad)
+
+    b, s_l, nh, d = ql.shape
+    nkv = kl.shape[2]
+    masked = spec.causal or spec.window is not None
+    idx = lax.axis_index(SEQ_AXIS) if masked else jnp.int32(0)
+    stride = spec.sp if spec.placement == "striped" else 1
+    s_pad = ring_carry_pad(s_l)
+
+    def to_kernel(x):  # [b, s, h, d] -> [b, h, s_pad, d]
+        x = x.swapaxes(1, 2)
+        if s_pad != s_l:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, s_pad - s_l), (0, 0)))
+        return x
+
+    qk, kk, vk = to_kernel(ql), to_kernel(kl), to_kernel(vl)
+    q_off = (idx if spec.placement == "striped"
+             else idx * s_l).astype(jnp.int32)
+    perm = [(i, (i + 1) % spec.sp) for i in range(spec.sp)]
+
+    def attend(m, l, acc, kc, vc, src):
+        k_off = (src if spec.placement == "striped"
+                 else src * s_l).astype(jnp.int32)
+        return flash_carry_block(
+            qk, kc, vc, m, l, acc, q_off, k_off, q_stride=stride,
+            k_stride=stride, s_real=s_l, sm_scale=spec.scale,
+            causal=spec.causal, window=spec.window)
+
+    def maybe_attend(m, l, acc, kc, vc, src):
+        if not masked:
+            return attend(m, l, acc, kc, vc, src)
+        return lax.cond(_hop_dead(idx, src, s_l, spec),
+                        lambda: (m, l, acc),
+                        lambda: attend(m, l, acc, kc, vc, src))
+
+    def hop(carry, t):
+        m, l, acc, kc, vc = carry
+        src = lax.rem(idx - t + spec.sp, spec.sp)
+        m, l, acc = maybe_attend(m, l, acc, kc, vc, src)
+        kc = lax.ppermute(kc, SEQ_AXIS, perm)
+        vc = lax.ppermute(vc, SEQ_AXIS, perm)
+        return (m, l, acc, kc, vc), None
+
+    m0 = jnp.full((b, nh, s_pad, 128), _NEG, jnp.float32)
+    l0 = jnp.zeros((b, nh, s_pad, 128), jnp.float32)
+    a0 = jnp.zeros((b, nh, s_pad, d), jnp.float32)
+    (m, l, acc, kc, vc), _ = lax.scan(
+        hop, (m0, l0, a0, kk, vk), jnp.arange(spec.sp - 1))
+    src_last = lax.rem(idx + 1, spec.sp)
+    m, l, acc = maybe_attend(m, l, acc, kc, vc, src_last)
+
+    m1 = m[:, :, :s_l, 0]                                # [b, nh, s_l]
+    l1 = l[:, :, :s_l, 0]
+    out = acc[:, :, :s_l] / jnp.maximum(l1, 1e-20)[..., None]
+    out = out.swapaxes(1, 2).astype(ql.dtype)            # [b, s_l, nh, d]
+    lse = m1 + jnp.log(jnp.maximum(l1, 1e-20))           # [b, nh, s_l]
+    lse = lse.reshape(b, nkv, spec.rep, s_l)
+    return out, lse
+
+
+# ----------------------------------------------------------------------
+# custom_vjp: forward ring + hand-written backward ring
+# ----------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _ring_local(ql, kl, vl, spec: _RingSpec):
+    o, _ = (_ring_fwd_flash if spec.use_flash else _ring_fwd_xla)(
+        ql, kl, vl, spec)
+    return checkpoint_name(o, "flash_out")
+
+
+def _ring_fwd_rule(ql, kl, vl, spec: _RingSpec):
+    o, lse = (_ring_fwd_flash if spec.use_flash else _ring_fwd_xla)(
+        ql, kl, vl, spec)
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return o, (ql, kl, vl, o, lse)
+
+
+def _ring_bwd_rule(spec: _RingSpec, res, do):
+    """Flash-style ring backward: with the forward's (o, lse) saved, each
+    hop recomputes only its own p = exp(s - lse) block and accumulates
+    dq locally while dk/dv TRAVEL WITH their K/V block; one final
+    ppermute delivers them to their owner shard.  Dead hops (fully-masked
+    source blocks) are skipped like the forward.
+
+    The per-hop grads are XLA einsums (s/p/dp/ds are score-shaped fp32
+    transients, ~4·s_l²·nkv·rep·4 B per hop) — the fused-kernel backward
+    (offset-aware dq/dkv flash kernels) is the queued follow-up; see the
+    module docstring."""
+    ql, kl, vl, o, lse = res
+    masked = spec.causal or spec.window is not None
+    idx = lax.axis_index(SEQ_AXIS) if masked else jnp.int32(0)
+    b, s_l, nh, d = ql.shape
+    nkv = kl.shape[2]
+    rep = spec.rep
+    q5 = ql.astype(jnp.float32).reshape(b, s_l, nkv, rep, d)
+    do5 = do.astype(jnp.float32).reshape(b, s_l, nkv, rep, d)
+    o5 = o.astype(jnp.float32).reshape(b, s_l, nkv, rep, d)
+    # delta = sum(do * o) per query row — [b, nkv, rep, s_l, 1]
+    delta = jnp.sum(do5 * o5, axis=-1).transpose(0, 2, 3, 1)[..., None]
+    lse_ = lse[..., None]                            # [b, nkv, rep, s_l, 1]
+    q_pos = _block_positions(idx, s_l, spec.sp, spec.placement)
+    perm = [(i, (i + 1) % spec.sp) for i in range(spec.sp)]
+
+    def hop_grads(kc, vc, src):
+        k_pos = _block_positions(src, s_l, spec.sp, spec.placement)
+        kf = kc.astype(jnp.float32)
+        vf = vc.astype(jnp.float32)
+        s = jnp.einsum("bqcgd,bscd->bcgqs", q5, kf) * spec.scale
+        valid = jnp.ones((s_l, s_l), bool)
+        if spec.causal:
+            valid = q_pos[:, None] >= k_pos[None, :]
+        if spec.window is not None:
+            valid &= (q_pos[:, None] - k_pos[None, :]) < spec.window
+        vm = valid[None, None, None]
+        p = jnp.where(vm, jnp.exp(s - lse_), 0.0)    # [b, c, g, q, s]
+        dv_c = jnp.einsum("bcgqs,bqcgd->bscd", p, do5)
+        dp = jnp.einsum("bqcgd,bscd->bcgqs", do5, vf)
+        ds = p * (dp - delta) * spec.scale
+        dq_c = jnp.einsum("bcgqs,bscd->bqcgd", ds, kf)
+        dk_c = jnp.einsum("bcgqs,bqcgd->bscd", ds, q5)
+        return dq_c, dk_c, dv_c
+
+    def maybe_grads(kc, vc, src, zq, zk, zv):
+        if not masked:
+            return hop_grads(kc, vc, src)
+        return lax.cond(_hop_dead(idx, src, s_l, spec),
+                        lambda: (zq, zk, zv),
+                        lambda: hop_grads(kc, vc, src))
+
+    zq = jnp.zeros((b, s_l, nkv, rep, d), jnp.float32)
+    zk = jnp.zeros((b, s_l, nkv, d), jnp.float32)
+
+    def hop(carry, t):
+        dq, dk_t, dv_t, kc, vc = carry
+        src = lax.rem(idx - t + spec.sp, spec.sp)
+        dq_c, dk_c, dv_c = maybe_grads(kc, vc, src, zq, zk, zk)
+        dq = dq + dq_c
+        dk_t = dk_t + dk_c
+        dv_t = dv_t + dv_c
+        # K/V and their accumulated grads rotate together
+        kc = lax.ppermute(kc, SEQ_AXIS, perm)
+        vc = lax.ppermute(vc, SEQ_AXIS, perm)
+        dk_t = lax.ppermute(dk_t, SEQ_AXIS, perm)
+        dv_t = lax.ppermute(dv_t, SEQ_AXIS, perm)
+        return (dq, dk_t, dv_t, kc, vc), None
+
+    (dq, dk_t, dv_t, kc, vc), _ = lax.scan(
+        hop, (zq, zk, zk, kl, vl), jnp.arange(spec.sp - 1))
+    src_last = lax.rem(idx + 1, spec.sp)
+    dq_c, dk_c, dv_c = maybe_grads(kc, vc, src_last, zq, zk, zk)
+    dq = dq + dq_c
+    # the traveling grads sit one rank behind their owner — deliver home
+    dk_t = lax.ppermute(dk_t + dk_c, SEQ_AXIS, perm)
+    dv_t = lax.ppermute(dv_t + dv_c, SEQ_AXIS, perm)
+    return (dq.reshape(b, s_l, nh, d).astype(ql.dtype),
+            dk_t.astype(kl.dtype), dv_t.astype(vl.dtype))
+
+
+_ring_local.defvjp(_ring_fwd_rule, _ring_bwd_rule)
+
+
+# ----------------------------------------------------------------------
+# Public entry
+# ----------------------------------------------------------------------
 def ring_attention(q, k, v, topo=None, causal: bool = True,
                    sm_scale: Optional[float] = None,
-                   window: Optional[int] = None):
+                   window: Optional[int] = None,
+                   placement: str = "contiguous"):
     """q/k/v: [B, S, H, D] GLOBAL arrays with S sharded over "seq".
-    Returns [B, S, H, D].  GQA KV heads are repeated locally.  Must be
-    called under jit (partial-manual shard_map over the seq axis; batch
-    and head dims stay in GSPMD auto mode)."""
+    Returns [B, S, H, D].  GQA KV heads travel the ring unrepeated.  Must
+    be called under jit (shard_map manual over the seq + batch axes; on
+    current jax the head/tensor dims stay in GSPMD auto mode, while the
+    0.4.x compat fallback runs fully manual and replicates tensor-sharded
+    heads into each seq shard — see utils/jax_compat.shard_map).
+
+    ``placement``: how sequence blocks map to shards — "contiguous"
+    (shard r owns rows [r·S_l, (r+1)·S_l)) or "striped" (shard r owns
+    rows r, r+sp, …; the causal-load-balanced layout — see module
+    docstring; the caller must feed striped data, cf.
+    :func:`stripe_sequence`)."""
     topo = topo or get_topology()
     sp = topo.sp_size if topo is not None else 1
-    nh = q.shape[2]
-    rep = nh // k.shape[2]  # GQA group: K/V travel the ring UNREPEATED
+    nh, nkv = q.shape[2], k.shape[2]
+    if nh % nkv:
+        raise ValueError(
+            f"ring_attention: num_heads={nh} not divisible by "
+            f"kv_heads={nkv} — GQA requires an integer group size")
+    if window is not None:
+        if window <= 0:
+            raise ValueError(f"window must be positive, got {window}")
+        if not causal:
+            raise ValueError(
+                "window without causal would be a ONE-SIDED band "
+                "(key ∈ (qpos-window, qpos+∞)), which is almost never "
+                "intended; pass causal=True for Mistral-style sliding "
+                "windows")
+    if placement not in PLACEMENTS:
+        raise ValueError(f"placement={placement!r}: expected one of "
+                         f"{PLACEMENTS}")
+    rep = nh // nkv
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     if sp == 1:
         if rep != 1:
@@ -63,66 +455,25 @@ def ring_attention(q, k, v, topo=None, causal: bool = True,
             v = jnp.repeat(v, rep, axis=2)
         return _block_attend_single(q, k, v, scale, causal, window)
 
+    spec = _RingSpec(sp=sp, rep=rep, scale=float(scale), causal=causal,
+                     window=window, placement=placement,
+                     use_flash=_kernel_enabled())
+
     def body(ql, kl, vl):
-        idx = lax.axis_index(SEQ_AXIS)
-        b, s_l, nh_, d = ql.shape
-        nkv = kl.shape[2]
-        # grouped-head layout: K/V stay at nkv heads END TO END — they
-        # travel the ring unrepeated AND feed the einsums unexpanded
-        # (per-hop ICI traffic and per-hop HBM are both O(S_l·nkv·d))
-        q5 = ql.astype(jnp.float32).reshape(b, s_l, nkv, rep, d)
-        q_pos = idx * s_l + jnp.arange(s_l)
-        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        return _ring_local(ql, kl, vl, spec)
 
-        def attend(m, l, acc, kc, vc, t):
-            src = lax.rem(idx - t + sp, sp)
-            k_pos = src * s_l + jnp.arange(s_l)
-            s = jnp.einsum("bqcgd,bscd->bcgqs", q5,
-                           kc.astype(jnp.float32)) * scale
-            valid = jnp.ones((s_l, s_l), bool)
-            if causal:
-                valid = q_pos[:, None] >= k_pos[None, :]
-            if window is not None:
-                valid &= (q_pos[:, None] - k_pos[None, :]) < window
-            vm = valid[None, None, None]
-            s = jnp.where(vm, s, _NEG)
-            m_cur = jnp.max(s, axis=-1, keepdims=True)
-            m_new = jnp.maximum(m, m_cur)
-            # exp(NEG - NEG) would be 1 on fully-masked rows — zero the
-            # masked probabilities explicitly
-            p = jnp.where(vm, jnp.exp(s - m_new), 0.0)
-            alpha = jnp.exp(m - m_new)
-            l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-            acc = acc * alpha + jnp.einsum(
-                "bcgqs,bscd->bcgqd", p, vc.astype(jnp.float32))
-            return m_new, l, acc
-
-        def hop(carry, t):
-            m, l, acc, kc, vc = carry
-            m, l, acc = attend(m, l, acc, kc, vc, t)
-            kc = lax.ppermute(kc, SEQ_AXIS, perm)
-            vc = lax.ppermute(vc, SEQ_AXIS, perm)
-            return (m, l, acc, kc, vc), None
-
-        m0 = jnp.full((b, nkv, rep, s_l, 1), _NEG, jnp.float32)
-        l0 = jnp.zeros((b, nkv, rep, s_l, 1), jnp.float32)
-        a0 = jnp.zeros((b, nkv, rep, s_l, d), jnp.float32)
-        # sp-1 hops permute after attending; the LAST block attends
-        # without the dead ring rotation (a collective inside scan that
-        # XLA cannot eliminate)
-        (m, l, acc, kc, vc), _ = lax.scan(
-            hop, (m0, l0, a0, kl, vl), jnp.arange(sp - 1))
-        m, l, acc = attend(m, l, acc, kc, vc, jnp.int32(sp - 1))
-        out = acc / jnp.maximum(l, 1e-20)        # [b, nkv, rep, q, d]
-        out = out.transpose(0, 3, 1, 2, 4).reshape(b, s_l, nh_, d)
-        return out.astype(ql.dtype)
-
-    ctx = jax.sharding.get_abstract_mesh()
+    ctx = get_abstract_mesh()
     mesh = topo.mesh if ctx.empty else ctx
-    spec = P(None, SEQ_AXIS, None, None)
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, axis_names={SEQ_AXIS},
-                         check_vma=False)(q, k, v)
+    # manual over seq + the batch axes (the ring only communicates over
+    # "seq"; keeping batch sharded costs nothing).  On current jax the
+    # head/tensor dims stay in GSPMD auto mode, so tensor-sharded heads
+    # are NOT gathered; on 0.4.x the compat layer degrades to full manual
+    # (partial-auto miscompiles axis_index/ppermute there) and unmentioned
+    # axes replicate into each shard instead.
+    pspec = P(BATCH_AXES, SEQ_AXIS, None, None)
+    return shard_map(body, mesh=mesh, in_specs=(pspec, pspec, pspec),
+                     out_specs=pspec, axis_names={SEQ_AXIS, *BATCH_AXES},
+                     check_vma=False)(q, k, v)
 
 
 def _block_attend_single(q, k, v, scale, causal, window):

@@ -1,0 +1,104 @@
+"""Version-portable spellings of the jax APIs this repo leans on.
+
+The codebase targets the current jax API surface (``jax.shard_map`` with
+``axis_names``/``check_vma``, ``jax.sharding.get_abstract_mesh``,
+``pltpu.CompilerParams``, ``jax.memory.Space``), but CI images and TPU
+pods pin older 0.4.x releases where the same features exist under their
+pre-stabilization names (``jax.experimental.shard_map`` with
+``auto``/``check_rep``, ``pltpu.TPUCompilerParams``,
+``TransferToMemoryKind``).  Every call site imports the helpers here so
+the version split lives in exactly one file.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["shard_map", "get_abstract_mesh", "tpu_compiler_params",
+           "axis_size", "axis_bound_manually"]
+
+
+def axis_bound_manually(axis_name: str) -> bool:
+    """Whether ``axis_name`` is already bound as a manual axis at trace
+    time on a 0.4.x jax (always False on current jax, where nested
+    shard_map resolves through the abstract-mesh context instead).  Used
+    by callers that would nest a shard_map over an axis the 0.4.x
+    full-manual fallback has already manualized — there the body can run
+    directly on the local shard."""
+    if hasattr(jax, "shard_map"):
+        return False
+    from jax._src import core as _core
+
+    try:
+        _core.axis_frame(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a bound mesh axis (or product over a sequence of
+    axes) inside shard_map — ``lax.axis_size`` on current jax,
+    ``core.axis_frame`` (which returns the size) on 0.4.x."""
+    names = ((axis_name,) if isinstance(axis_name, str) else tuple(axis_name))
+    if hasattr(jax.lax, "axis_size"):
+        n = 1
+        for name in names:
+            n *= int(jax.lax.axis_size(name))
+        return n
+    from jax._src import core as _core
+
+    n = 1
+    for name in names:
+        n *= int(_core.axis_frame(name))
+    return n
+
+
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None,
+              check_vma: bool = False):
+    """``jax.shard_map`` with the new-API signature on every jax.
+
+    ``axis_names``: the axes the body is *manual* over (None = all mesh
+    axes).  On 0.4.x this maps to the complementary ``auto`` frozenset and
+    ``check_vma`` to ``check_rep``.
+    """
+    if hasattr(jax, "shard_map"):
+        kw = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+        if axis_names is not None:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(f, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # 0.4.x partial-manual (the `auto` frozenset) miscompiles the patterns
+    # this repo needs (axis_index lowers to an unpartitionable PartitionId;
+    # scan+ppermute trips a manual-subgroup check in the SPMD partitioner),
+    # so fall back to FULL manual: axes the caller left automatic are
+    # simply unmentioned in the specs (= replicated into each shard), which
+    # is semantically identical and only costs a reshard at the boundary.
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)
+
+
+class _EmptyMesh:
+    """Stand-in for an empty abstract mesh on jax versions without
+    mesh contexts: ``.empty`` is the only attribute call sites read."""
+
+    empty = True
+
+
+def get_abstract_mesh():
+    """Current abstract mesh context (``.empty`` when not under one)."""
+    try:
+        return jax.sharding.get_abstract_mesh()
+    except AttributeError:
+        return _EmptyMesh()
+
+
+def tpu_compiler_params(**kwargs):
+    """``pltpu.CompilerParams`` (named ``TPUCompilerParams`` on 0.4.x)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or getattr(pltpu, "TPUCompilerParams")
+    return cls(**kwargs)

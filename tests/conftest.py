@@ -12,10 +12,14 @@ import os
 
 # Must be set before the CPU backend initializes (backends are lazy, so
 # setting it at conftest import is early enough even though sitecustomize
-# may have imported jax already).
-_flag = "--xla_force_host_platform_device_count=8"
-if _flag not in os.environ.get("XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " " + _flag
+# may have imported jax already).  Optimization level 0: the CPU mesh
+# exists to check numerics and collective structure, not codegen quality —
+# skipping XLA:CPU's heavy optimization passes cuts suite compile time
+# ~30% with identical results (measured on test_engine: 115s → 80s).
+for _flag in ("--xla_force_host_platform_device_count=8",
+              "--xla_backend_optimization_level=0"):
+    if _flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " " + _flag
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402

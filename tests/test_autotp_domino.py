@@ -18,6 +18,7 @@ from deepspeed_tpu.module_inject import (AutoTP, column_parallel_linear,
                                          vocab_parallel_logits)
 from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
 from deepspeed_tpu.runtime.domino import domino_forward, domino_transformer_layer
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 
 # ----------------------------------------------------------------------
@@ -91,7 +92,7 @@ def test_column_then_row_matches_dense(rng):
         h = column_parallel_linear(x, w1s)          # [4, 64/8] local
         return row_parallel_linear(h, w2s, b2)      # psum over tensor
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         block, mesh=topo.mesh,
         in_specs=(P(), P(None, "tensor"), P("tensor", None), P()),
         out_specs=P()))(x, w1, w2, b2)
@@ -105,7 +106,7 @@ def test_vocab_parallel_logits_matches_dense(rng):
     x = jnp.asarray(rng.normal(size=(4, 32)).astype(np.float32))
     emb = jnp.asarray(rng.normal(size=(64, 32)).astype(np.float32))
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda x, e: vocab_parallel_logits(x, e),
         mesh=topo.mesh, in_specs=(P(), P("tensor", None)), out_specs=P(),
         check_vma=False))(x, emb)

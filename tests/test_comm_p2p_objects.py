@@ -7,7 +7,7 @@ gather/scatter/monitored_barrier), :229/:247 (object collectives),
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from deepspeed_tpu.utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.comm import comm

@@ -9,6 +9,7 @@ import pytest
 
 from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
 from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 
 @pytest.fixture
@@ -42,7 +43,7 @@ def test_group_names_feed_comm_collectives(mesh):
     def body(x):
         return jax.lax.psum(x, g)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         body, mesh=mesh.mesh, in_specs=P("tensor"), out_specs=P()))(
         jnp.arange(2, dtype=jnp.float32))
     assert float(np.asarray(out)) == 1.0  # 0 + 1 summed over tensor axis

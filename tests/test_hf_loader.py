@@ -346,16 +346,50 @@ def test_bloom_parity():
     _compare(m)
 
 
+def test_bloom_left_padded_alibi_matches_hf():
+    """LEFT-padded batches: HF build_alibi_tensor derives key positions
+    from attention_mask.cumsum — the bias must shift by the padding
+    offset per row, not use absolute slot indices."""
+    from transformers import BloomConfig, BloomForCausalLM
+
+    torch.manual_seed(0)
+    m = BloomForCausalLM(BloomConfig(
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4))
+    m.eval()
+    cfg = config_from_hf(m.config).replace(dtype=jnp.float32)
+    params = params_from_hf(m, cfg)
+    rng = np.random.default_rng(11)
+    ids = rng.integers(3, cfg.vocab_size, size=(2, 12), dtype=np.int64)
+    mask = np.ones((2, 12), np.int64)
+    mask[0, :4] = 0   # row 0 left-padded by 4
+    mask[1, :1] = 0
+    with torch.no_grad():
+        ref = m(torch.tensor(ids),
+                attention_mask=torch.tensor(mask)).logits.float().numpy()
+    out = tf.forward(params, jnp.asarray(ids, jnp.int32), cfg,
+                     attention_mask=jnp.asarray(mask, jnp.int32))
+    out = np.asarray(out, np.float32)
+    keep = mask.astype(bool)
+    np.testing.assert_allclose(out[keep], ref[keep], atol=2e-3, rtol=1e-3)
+
+
 def test_gptj_parity():
     """Interleaved partial rotary + parallel block with one shared norm +
-    biasless attention / biased MLP (ref containers/gptj.py)."""
+    biasless attention / biased MLP (ref containers/gptj.py).  The HF
+    lm_head.bias is NOT zeroed: the converter carries it into the
+    functional head's vocab-size output bias, so logits must match with a
+    nonzero bias applied (the released EleutherAI weights ship one)."""
     from transformers import GPTJConfig, GPTJForCausalLM
 
     torch.manual_seed(0)
     m = GPTJForCausalLM(GPTJConfig(
         vocab_size=128, n_embd=64, n_layer=2, n_head=4, n_positions=64,
         rotary_dim=8))
-    _compare(m, zero_lm_head_bias=True)
+    with torch.no_grad():
+        # the random init leaves it zero — make the parity check prove the
+        # bias actually reaches the logits
+        m.lm_head.bias.uniform_(-0.5, 0.5)
+    _compare(m)
 
 
 @pytest.mark.parametrize("parallel", [True, False])

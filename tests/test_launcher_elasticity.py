@@ -185,9 +185,25 @@ def test_numa_core_binding_helpers(monkeypatch):
 
 def test_launch_bind_cores_spawns(tmp_path):
     """--bind_cores_to_rank launches children with the numactl prefix (or
-    bare when numactl is absent) and an OMP_NUM_THREADS cap."""
+    bare when numactl is absent) and an OMP_NUM_THREADS cap.
+
+    De-flaked: the bind list is derived from the CPUs this process may
+    actually use (a hardcoded "0-1" fails on 1-CPU CI boxes and boxes with
+    a restricted affinity mask), nproc degrades to the available
+    parallelism, and the spawn timeout scales up on small/loaded hosts
+    (two interpreter boots through a loaded 1-core machine can far exceed
+    the old 120 s budget)."""
+    import os
     import subprocess
     import sys as _sys
+
+    try:
+        avail = sorted(os.sched_getaffinity(0))
+    except AttributeError:  # non-Linux
+        avail = list(range(os.cpu_count() or 1))
+    nproc = 2 if len(avail) >= 2 else 1
+    core_list = ",".join(str(c) for c in avail[:nproc])
+    timeout_s = 120 if len(avail) >= 4 else 360
 
     script = tmp_path / "probe.py"
     script.write_text(
@@ -195,8 +211,9 @@ def test_launch_bind_cores_spawns(tmp_path):
         "print('OMP', os.environ.get('OMP_NUM_THREADS'))\n")
     r = subprocess.run(
         [_sys.executable, "-m", "deepspeed_tpu.launcher.launch",
-         "--nproc", "2", "--bind_cores_to_rank", "--bind_core_list", "0-1",
+         "--nproc", str(nproc), "--bind_cores_to_rank",
+         "--bind_core_list", core_list,
          "--pid_dir", str(tmp_path), str(script)],
-        capture_output=True, text=True, timeout=120)
+        capture_output=True, text=True, timeout=timeout_s)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert r.stdout.count("OMP 1") == 2, r.stdout
+    assert r.stdout.count("OMP 1") == nproc, r.stdout

@@ -386,3 +386,56 @@ def test_blocked_asymmetric_window_parity(bq, bk, _force_blocked,
         rel = float(jnp.linalg.norm((a - b_).ravel())
                     / (jnp.linalg.norm(b_.ravel()) + 1e-9))
         assert rel < 1e-4, rel
+
+
+@pytest.mark.parametrize("causal,stride", [(True, 1), (False, 1), (True, 4)])
+def test_carry_kernel_chains_to_full_attention(causal, stride):
+    """flash_carry_block (the ring-hop kernel): chaining the online-softmax
+    carry over key blocks fed in ARBITRARY hop order must reproduce dense
+    attention.  stride=4 exercises the striped-placement position
+    arithmetic (block positions off + stride*i)."""
+    ks = jax.random.split(jax.random.PRNGKey(11), 3)
+    b, h, s_l, d, hops = 1, 2, 128, 32, 4
+    s = s_l * hops
+    scale = 1.0 / np.sqrt(d)
+    q = jax.random.normal(ks[0], (b, h, s, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, h, s, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, h, s, d), jnp.float32)
+
+    # reference over GLOBAL positions (identity layout: position == index)
+    pos = np.arange(s)
+    sc = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    valid = np.ones((s, s), bool)
+    if causal:
+        valid = pos[:, None] >= pos[None, :]
+    sc = jnp.where(jnp.asarray(valid)[None, None], sc, -1e30)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(sc, -1), v)
+
+    # hop decomposition: block j holds positions off_j + stride*i.  For
+    # stride=1 that is contiguous chunks; for stride=s_l... use the striped
+    # interleave (off_j = j, stride = hops) and gather the matching rows.
+    if stride == 1:
+        blocks = [(j * s_l, k[:, :, j * s_l:(j + 1) * s_l],
+                   v[:, :, j * s_l:(j + 1) * s_l]) for j in range(hops)]
+        q_off, q_stride = 0, 1
+        qk = q[:, :, :s_l]
+        ref_rows = slice(0, s_l)
+    else:
+        blocks = [(j, k[:, :, j::hops], v[:, :, j::hops])
+                  for j in range(hops)]
+        q_off, q_stride = 0, hops
+        qk = q[:, :, 0::hops]
+        ref_rows = slice(0, s, hops)
+
+    m = jnp.full((b, h, s_l, 128), -1e30, jnp.float32)
+    l = jnp.zeros((b, h, s_l, 128), jnp.float32)
+    acc = jnp.zeros((b, h, s_l, d), jnp.float32)
+    for k_off, kc, vc in reversed(blocks):   # arbitrary order on purpose
+        m, l, acc = fm.flash_carry_block(
+            qk, kc, vc, m, l, acc, jnp.int32(q_off), jnp.int32(k_off),
+            q_stride=q_stride, k_stride=stride if stride > 1 else 1,
+            s_real=s_l, sm_scale=scale, causal=causal)
+    out = acc / jnp.maximum(l[..., 0:1], 1e-20)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(ref[:, :, ref_rows]),
+                               rtol=2e-5, atol=2e-5)

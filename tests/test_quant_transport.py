@@ -17,6 +17,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.comm.coalesced_collectives import all_to_all_quant_reduce
 from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 
 def _reset():
@@ -80,7 +81,7 @@ def test_qgz_all_to_all_moves_int8():
                                            inner_size=4, outer_size=2)
         return shard.sum()
 
-    fn = jax.shard_map(lambda g: (f(g),), mesh=mesh,
+    fn = shard_map(lambda g: (f(g),), mesh=mesh,
                        in_specs=(jax.tree.map(lambda _: P(), grads),),
                        out_specs=(P(),), check_vma=False)
     hlo = jax.jit(lambda g: fn(g)[0]).lower(grads).compile().as_text()

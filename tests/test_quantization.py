@@ -25,6 +25,7 @@ from deepspeed_tpu.ops.fp_quantizer import fp_dequantize, fp_fake_quantize, fp_q
 from deepspeed_tpu.ops.quantizer import (dequantize_blockwise, fake_quantize,
                                          pack_int4, quantize_blockwise, unpack_int4)
 from deepspeed_tpu.parallel.topology import MeshTopology
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 
 # ----------------------------------------------------------------------
@@ -123,7 +124,7 @@ def test_reduce_scatter_coalesced_matches_psum(rng):
         shard, meta = reduce_scatter_coalesced(g, "data", world)
         return shard
 
-    out = jax.jit(jax.shard_map(body, mesh=topo.mesh,
+    out = jax.jit(shard_map(body, mesh=topo.mesh,
                                 in_specs=P("data"), out_specs=P("data")))(stacked)
     expect = jax.tree.map(lambda *xs: sum(xs), *grads)
     flat = np.concatenate([np.asarray(expect["b"]).ravel(),
@@ -144,7 +145,7 @@ def test_reduce_scatter_then_gather_roundtrip(rng):
         full = all_gather_coalesced(shard, meta, shapes, dtypes, "data")
         return jax.tree.map(lambda x: x[None], full)
 
-    out = jax.jit(jax.shard_map(body, mesh=topo.mesh,
+    out = jax.jit(shard_map(body, mesh=topo.mesh,
                                 in_specs=P("data"),
                                 out_specs=jax.tree.map(lambda _: P("data"), grads[0])))(stacked)
     expect = jax.tree.map(lambda *xs: sum(xs), *grads)
@@ -167,7 +168,7 @@ def test_qgz_two_level_quant_reduce_close_to_exact(rng):
                                               num_bits=8, group_size=64)
         return shard[None, None]
 
-    out = jax.jit(jax.shard_map(body, mesh=topo.mesh,
+    out = jax.jit(shard_map(body, mesh=topo.mesh,
                                 in_specs=P("data", "seq"),
                                 out_specs=P("data", "seq")))(stacked)
     expect = jax.tree.map(lambda *xs: sum(xs) / world, *grads)
@@ -198,7 +199,7 @@ def test_loco_error_feedback_reduces_bias(rng):
                                                  num_bits=4, group_size=64)
         return shard[None, None], jax.tree.map(lambda x: x[None, None], new_err)
 
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map(
         body, mesh=topo.mesh,
         in_specs=(P("data", "seq"), P("data", "seq")),
         out_specs=(P("data", "seq"), jax.tree.map(lambda _: P("data", "seq"), errs))))
@@ -237,7 +238,7 @@ def test_compressed_allreduce_error_feedback_convergence(rng):
         out, we2, se2 = compressed_allreduce(x[0], we[0], se[0], "data", world)
         return out[None], we2[None], se2[None]
 
-    step = jax.jit(jax.shard_map(body, mesh=topo.mesh,
+    step = jax.jit(shard_map(body, mesh=topo.mesh,
                                  in_specs=(P("data"), P("data"), P("data")),
                                  out_specs=(P("data"), P("data"), P("data"))))
     we = jnp.zeros((world, n))
@@ -264,7 +265,7 @@ def test_compressed_allreduce_identical_inputs_exact():
         out, we2, se2 = compressed_allreduce(x[0], we[0], se[0], "data", world)
         return out[None], we2[None], se2[None]
 
-    step = jax.jit(jax.shard_map(body, mesh=topo.mesh,
+    step = jax.jit(shard_map(body, mesh=topo.mesh,
                                  in_specs=(P("data"), P("data"), P("data")),
                                  out_specs=(P("data"), P("data"), P("data"))))
     x = jnp.asarray(np.tile(v, (world, 1)))

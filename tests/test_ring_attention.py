@@ -143,3 +143,264 @@ def test_ring_collectives_are_ppermute(seq_topo):
         q).compile().as_text()
     assert "collective-permute" in hlo
     assert "all-to-all" not in hlo
+
+
+# ----------------------------------------------------------------------
+# Perf-grade ring: Pallas flash inner block, striped placement, entry
+# asserts, and remat/ZeRO-2 composition.
+# ----------------------------------------------------------------------
+import importlib  # noqa: E402
+
+from deepspeed_tpu.sequence.ring import (ring_position_map,  # noqa: E402
+                                         stripe_sequence, unstripe_sequence)
+
+fm = importlib.import_module("deepspeed_tpu.ops.pallas.flash_mha")
+
+
+@pytest.fixture
+def flash_interpret():
+    """Route the ring's inner block through the Pallas carry kernel under
+    the interpreter so the KERNEL's numerics are what the CPU mesh
+    checks."""
+    old = fm.INTERPRET
+    fm.INTERPRET = True
+    yield
+    fm.INTERPRET = old
+
+
+@pytest.mark.parametrize("causal,window,nkv", [
+    (True, None, 4),     # causal MHA
+    (False, None, 4),    # bidirectional
+    (True, 8, 4),        # sliding window
+    (True, None, 1),     # MQA
+])
+def test_ring_flash_kernel_parity(seq_topo, flash_interpret, causal,
+                                  window, nkv):
+    """Interpret-mode parity: each hop runs ONE fused flash pass
+    (flash_carry_block) and the assembled ring output must match dense
+    reference attention exactly."""
+    from deepspeed_tpu.sequence import ring as ring_mod
+
+    assert ring_mod._kernel_enabled()  # the fixture routes to the kernel
+    rng = np.random.default_rng(5)
+    b, s, nh, d = 2, 32, 4, 16
+    q = jnp.asarray(rng.standard_normal((b, s, nh, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, s, nkv, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, s, nkv, d)), jnp.float32)
+    out = jax.jit(lambda q, k, v: ring_attention(
+        q, k, v, seq_topo, causal=causal, window=window))(q, k, v)
+    ref = _ref_attention(q, k, v, causal=causal, window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_flash_kernel_grads(seq_topo, flash_interpret):
+    """Gradients through the flash-kernel forward + hand-written ring
+    backward must match the dense reference."""
+    rng = np.random.default_rng(6)
+    q = jnp.asarray(rng.standard_normal((2, 16, 2, 8)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 16, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 16, 2, 8)), jnp.float32)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, seq_topo) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref_attention(q, k, v) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, r in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=5e-5, atol=5e-5)
+
+
+def test_stripe_roundtrip_and_position_map():
+    x = np.arange(2 * 16 * 3).reshape(2, 16, 3)
+    y = stripe_sequence(x, 4)
+    assert not np.array_equal(x, y)
+    np.testing.assert_array_equal(unstripe_sequence(y, 4), x)
+    # slot j of shard r holds token pos_map[r*s_l + j]
+    pos = np.asarray(ring_position_map(16, 4, "striped"))
+    s_l = 4
+    for r in range(4):
+        for j in range(s_l):
+            np.testing.assert_array_equal(y[:, r * s_l + j],
+                                          x[:, pos[r * s_l + j]])
+    np.testing.assert_array_equal(
+        np.asarray(ring_position_map(16, 4, "contiguous")), np.arange(16))
+
+
+@pytest.mark.parametrize("use_flash", [False, True])
+@pytest.mark.parametrize("nkv", [4, 2])
+def test_ring_striped_matches_full_attention(seq_topo, use_flash, nkv):
+    """Striped placement (causal load balancing): stripe the inputs,
+    run the ring, unstripe the output — must equal dense reference
+    attention in natural order, on both inner-block paths."""
+    old = fm.INTERPRET
+    fm.INTERPRET = use_flash
+    try:
+        rng = np.random.default_rng(7)
+        b, s, nh, d = 2, 32, 4, 16
+        sp = 4
+        q = jnp.asarray(rng.standard_normal((b, s, nh, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, s, nkv, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, s, nkv, d)), jnp.float32)
+        qs, ks, vs = (stripe_sequence(x, sp) for x in (q, k, v))
+        out = jax.jit(lambda a, b_, c: ring_attention(
+            a, b_, c, seq_topo, causal=True, placement="striped"))(qs, ks, vs)
+        out = unstripe_sequence(np.asarray(out), sp)
+        ref = _ref_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+    finally:
+        fm.INTERPRET = old
+
+
+def test_ring_striped_grads(seq_topo):
+    """Striped-placement gradients: unstripe(grad(striped)) must equal
+    the dense reference gradient."""
+    rng = np.random.default_rng(8)
+    sp = 4
+    q = jnp.asarray(rng.standard_normal((2, 16, 2, 8)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((2, 16, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((2, 16, 2, 8)), jnp.float32)
+
+    def loss_striped(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, seq_topo,
+                                      placement="striped") ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref_attention(q, k, v) ** 2)
+
+    g_s = jax.jit(jax.grad(loss_striped, argnums=(0, 1, 2)))(
+        stripe_sequence(q, sp), stripe_sequence(k, sp),
+        stripe_sequence(v, sp))
+    g_r = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, r in zip(g_s, g_r):
+        np.testing.assert_allclose(unstripe_sequence(np.asarray(a), sp),
+                                   np.asarray(r), rtol=5e-5, atol=5e-5)
+
+
+def test_ring_entry_asserts(seq_topo):
+    """Loud failures instead of silent truncation/one-sided bands."""
+    q = jnp.zeros((2, 32, 4, 16), jnp.float32)
+    k3 = jnp.zeros((2, 32, 3, 16), jnp.float32)
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_attention(q, k3, k3, seq_topo)
+    with pytest.raises(ValueError, match="causal"):
+        ring_attention(q, q, q, seq_topo, causal=False, window=8)
+    with pytest.raises(ValueError, match="window must be positive"):
+        ring_attention(q, q, q, seq_topo, window=0)
+    with pytest.raises(ValueError, match="placement"):
+        ring_attention(q, q, q, seq_topo, placement="zigzagish")
+
+
+def test_ring_backward_skips_forward_rerun_when_residuals_saved(seq_topo):
+    """The ring tags its saved (o, lse) as flash_out/flash_lse.  Under a
+    remat policy that KEEPS those names the backward must not re-run the
+    forward's ppermute chain — strictly fewer collective-permutes than
+    under nothing_saveable (which legitimately replays the ring)."""
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.standard_normal((2, 32, 4, 16)), jnp.float32)
+
+    def counts(policy):
+        def f(q, k, v):
+            return jnp.sum(jax.checkpoint(
+                lambda a, b, c: ring_attention(a, b, c, seq_topo),
+                policy=policy)(q, k, v) ** 2)
+
+        hlo = jax.jit(jax.grad(f, argnums=(0, 1, 2))).lower(
+            q, q, q).compile().as_text()
+        return hlo.count("collective-permute(")
+
+    saved = counts(jax.checkpoint_policies.save_only_these_names(
+        "flash_out", "flash_lse"))
+    replayed = counts(jax.checkpoint_policies.nothing_saveable)
+    assert saved < replayed, (saved, replayed)
+
+
+def test_ring_zero2_train_step_hlo_and_policy():
+    """ZeRO-2 × ring on a data×seq mesh: the engine upgrades the remat
+    policy to flash_saveable (saving the ring's (o, lse)), the compiled
+    train step moves K/V only with collective-permute (no all-to-all),
+    and training takes real steps."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import get_model_config
+    from deepspeed_tpu.parallel import topology
+
+    try:
+        model = get_model_config("llama-tiny", seq_impl="ring",
+                                 attn_impl="xla")
+        config = {
+            "train_micro_batch_size_per_gpu": 4,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "mesh": {"seq": 4, "data": 2},
+            "zero_optimization": {"stage": 2},
+            "steps_per_print": 10_000,
+        }
+        engine, _, _, _ = ds.initialize(model=model, config=config, seed=7)
+        assert engine.model_config.remat_policy == "flash_saveable"
+
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, model.vocab_size, size=(8, 33), dtype=np.int32)
+        batch = {"input_ids": ids[:, :-1],
+                 "labels": ids[:, 1:].astype(np.int32)}
+        batch_stack = engine._put_batch(
+            engine._stack_micro_batches(batch), stacked=True)
+        hlo = engine._train_step_jit.lower(
+            engine.params, engine.opt_state, engine.loss_scale_state,
+            batch_stack, jnp.float32(1e-3)).compile().as_text()
+        assert "collective-permute" in hlo
+        # no all-to-all may originate from the attention path: K/V must
+        # move as nearest-neighbour ring traffic.  (ZeRO-2's tiny
+        # param-shaped grad reshards may legitimately lower to all-to-all
+        # — filter by source metadata.)
+        for line in hlo.splitlines():
+            if "all-to-all" in line:
+                assert "ring.py" not in line and "_attn_block" not in line \
+                    and "sequence/layer.py" not in line, line
+
+        losses = [float(np.asarray(engine.train_batch(batch)))
+                  for _ in range(3)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0], losses
+    finally:
+        topology.set_topology(None)
+
+
+def test_ring_engine_striped_matches_contiguous():
+    """Engine-level striped placement: host-side stripe of ids/labels +
+    stripe-aware positions is a pure reordering of the same math — the
+    training loss trajectory must track the contiguous ring closely."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import get_model_config
+    from deepspeed_tpu.parallel import topology
+
+    losses = {}
+    try:
+        for placement in ("contiguous", "striped"):
+            model = get_model_config("llama-tiny", seq_impl="ring",
+                                     ring_placement=placement,
+                                     attn_impl="xla")
+            config = {
+                "train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "mesh": {"seq": 4, "data": 2},
+                "steps_per_print": 10_000,
+            }
+            engine, _, _, _ = ds.initialize(model=model, config=config,
+                                            seed=7)
+            rng = np.random.default_rng(0)
+            ids = rng.integers(0, model.vocab_size, size=(8, 33),
+                               dtype=np.int32)
+            batch = {"input_ids": ids[:, :-1],
+                     "labels": ids[:, 1:].astype(np.int32)}
+            losses[placement] = [float(np.asarray(engine.train_batch(batch)))
+                                 for _ in range(4)]
+            assert losses[placement][-1] < losses[placement][0], losses
+            topology.set_topology(None)
+    finally:
+        topology.set_topology(None)
+    np.testing.assert_allclose(losses["striped"], losses["contiguous"],
+                               rtol=5e-3, atol=5e-3)
