@@ -14,6 +14,9 @@ Rows (BASELINE.json milestone configs scaled to one chip):
      host optimizer state; metric = parameter count
   4. v2_decode — inference v2 fused decode loop tokens/s (paged KV), vs
      the reference FastGen's A100 llama-13B ~52 tok/s/seq class figure
+  5. serve_load — the async serving layer (deepspeed_tpu/serving) under
+     an open-loop arrival process: tokens/s, p50/p95 TTFT, preemption
+     rate; vs_baseline = served tokens/s / one-shot batch generate()
 
 Pass --smoke for a tiny-shape CPU plumbing check (no numbers of record).
 """
@@ -547,6 +550,72 @@ def row_v2_decode():
     }
 
 
+def row_serve_load():
+    """Serving layer (deepspeed_tpu/serving) under a synthetic open-loop
+    arrival process: requests arrive on an exponential clock regardless of
+    service progress (the closed-loop alternative hides queueing delay),
+    stream through the async serve loop, and the row reports delivered
+    tokens/s, p50/p95 TTFT, and the preemption rate.  vs_baseline is the
+    serving path's throughput against the same engine's one-shot batch
+    generate() on the identical workload — the async layer's overhead
+    (queue, admission, per-step host fan-out) expressed as a fraction."""
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import get_model_config
+    from deepspeed_tpu.serving import InferenceServer, SamplingParams
+
+    if SMOKE:
+        model = get_model_config("llama-tiny")
+        n_req, new, prompt_len, rate = 8, 8, 16, 100.0
+        # 31 usable blocks vs 8 requests × 6 final blocks: admission
+        # overcommits and the smoke run exercises real preemption
+        eng_cfg = {"dtype": "float32",
+                   "memory_config": {"num_blocks": 32, "block_size": 4},
+                   "max_context": 64}
+    else:
+        model = get_model_config("llama3-8b", num_layers=4, max_seq_len=2048)
+        n_req, new, prompt_len, rate = 64, 64, 32, 32.0
+        eng_cfg = {"memory_config": {"num_blocks": 1024}}
+    eng = InferenceEngineV2(model, eng_cfg)
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(0, model.vocab_size, size=(prompt_len,)).tolist()
+               for _ in range(n_req)]
+    # baseline + warmup in one: batch one-shot generate compiles every
+    # bucket the served run will hit, and times the non-serving path
+    eng.generate(prompts, max_new_tokens=new)
+    t0 = time.perf_counter()
+    eng.generate(prompts, max_new_tokens=new)
+    batch_dt = time.perf_counter() - t0
+    batch_tps = n_req * new / batch_dt
+
+    srv = InferenceServer(eng).start()
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
+    t0 = time.perf_counter()
+    streams = []
+    for i in range(n_req):
+        lag = arrivals[i] - (time.perf_counter() - t0)
+        if lag > 0:
+            time.sleep(lag)
+        streams.append(srv.submit(prompts[i],
+                                  SamplingParams(max_new_tokens=new)))
+    for s in streams:
+        s.result()
+    dt = time.perf_counter() - t0
+    srv.stop()
+    snap = srv.metrics.snapshot()
+    _reset_topology()
+    tps = n_req * new / dt
+    return {
+        "metric": "serve_load_tokens_per_sec",
+        "value": round(tps, 1), "unit": "tokens/s",
+        "vs_baseline": round(tps / batch_tps, 3),
+        "ttft_p50_ms": round(snap["ttft"]["p50"] * 1e3, 1),
+        "ttft_p95_ms": round(snap["ttft"]["p95"] * 1e3, 1),
+        "tpot_p50_ms": round(snap["tpot"]["p50"] * 1e3, 2),
+        "preemption_rate": round(snap["preemptions"] / n_req, 3),
+        "completed": snap["completed"],
+    }
+
+
 def _device_probe_error(timeout_s: float = 120.0):
     """A hung bench run records nothing at all (worse than an error row) —
     probe the backend with a deadline before touching it."""
@@ -562,6 +631,7 @@ _ROWS = {
     "longseq_ring": row_longseq_ring,
     "peak_params": row_peak_params,
     "v2_decode": row_v2_decode,
+    "serve_load": row_serve_load,
     "gpt2_350m": row_gpt2_350m,
 }
 
@@ -628,7 +698,7 @@ def main() -> None:
         return
     rows = []
     for name in ("llama8b_class_zero3", "longseq_flash", "longseq_llama",
-                 "longseq_ring", "peak_params", "v2_decode"):
+                 "longseq_ring", "peak_params", "v2_decode", "serve_load"):
         if SMOKE:
             try:
                 r = _ROWS[name]()

@@ -2,6 +2,7 @@ from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
                                                   RaggedInferenceEngineConfig,
                                                   build_engine)
 from deepspeed_tpu.inference.v2.ragged import (BlockedAllocator, DSStateManager,
-                                               RaggedBatch, SequenceDescriptor,
+                                               KVCacheExhausted, RaggedBatch,
+                                               SequenceDescriptor,
                                                build_ragged_batch)
 from deepspeed_tpu.inference.v2.scheduler import SplitFuseScheduler

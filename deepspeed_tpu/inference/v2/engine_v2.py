@@ -27,7 +27,9 @@ from deepspeed_tpu.inference.v2.model import (check_sampling_params,
                                               ragged_decode_loop,
                                               ragged_forward,
                                               ragged_forward_sampled)
-from deepspeed_tpu.inference.v2.ragged import DSStateManager, build_ragged_batch
+from deepspeed_tpu.inference.v2.ragged import (DSStateManager,
+                                               KVCacheExhausted,
+                                               build_ragged_batch)
 from deepspeed_tpu.inference.v2.scheduler import SplitFuseScheduler
 from deepspeed_tpu.models import transformer as tf_model
 from deepspeed_tpu.models.transformer import TransformerConfig
@@ -144,6 +146,7 @@ class InferenceEngineV2:
             max_blocks_per_seq=max_blocks_per_seq)
         self.scheduler = SplitFuseScheduler(self.state_manager,
                                             token_budget=self.cfg.max_ragged_batch_size)
+        self._step_key = jax.random.PRNGKey(seed ^ 0x57E9)  # step() default
 
         pages = self.cfg.num_blocks * self.cfg.block_size
         # [L, nkv, P, d]: kv-head-major so the paged-attention kernel's page
@@ -203,13 +206,27 @@ class InferenceEngineV2:
                 raise ValueError(f"uid {uid}: empty prompt")
             seen.add(uid)
         for uid, toks in zip(batch_uids, batch_tokens):
-            self.state_manager.open(uid, [int(x) for x in toks])
-            self.scheduler.add(uid)
+            self.admit(uid, toks)
         schedule = self.scheduler.next_schedule()
         if not schedule:
             return None, None
-        rb = build_ragged_batch(schedule, self.state_manager,
-                                self.scheduler.token_budget)
+        try:
+            rb = build_ragged_batch(schedule, self.state_manager,
+                                    self.scheduler.token_budget)
+        except KVCacheExhausted:
+            # Nothing ran: no num_cached advanced, no KV written.  But
+            # next_schedule already promoted prompts whose FINAL chunk was
+            # scheduled into the decode set — roll mid-prefill ones back to
+            # the head of the prefill queue so they keep chunked prefill
+            # (a wrongly-"decoding" prompt would creep 1 token/step).
+            # Pages allocated for earlier schedule entries stay attached
+            # to their sequences (used next step or freed at flush).
+            # Reversed: each demote lands at the queue head, so walking
+            # the schedule backwards keeps the original relative order.
+            for seq, _n in reversed(schedule):
+                if seq.uncached > 1:
+                    self.scheduler.demote(seq.uid)
+            raise
         # Bucket the step's shapes (power-of-two token count and context
         # width) so decode-heavy steps don't pay the full prefill budget:
         # a 16-seq decode step runs [16, ctx] work, not [budget, max_ctx].
@@ -259,6 +276,59 @@ class InferenceEngineV2:
         logits_np = np.asarray(logits)
         return {uid: logits_np[slot] for slot, uid in rb.uids_by_slot.items()}
 
+    def admit(self, uid: int, tokens: Sequence[int], priority: int = 0,
+              front: bool = False) -> None:
+        """Open a sequence and schedule it WITHOUT running a step.
+
+        The serving layer's admission controller decides *when* to call
+        this; ``step()`` decides when work runs.  ``priority`` orders the
+        SplitFuse queues (higher first); ``front=True`` requeues ahead of
+        every waiting prompt (preempted-request requeue).
+        """
+        if uid in self.state_manager:
+            raise ValueError(f"uid {uid} already active")
+        if not len(tokens):
+            raise ValueError(f"uid {uid}: empty prompt")
+        self.state_manager.open(uid, [int(x) for x in tokens])
+        self.scheduler.add(uid, priority=priority, front=front)
+
+    def step(self, temperature: float = 0.0, key: Optional[Any] = None,
+             top_k: int = 0, top_p: float = 1.0,
+             return_logits: bool = False) -> Dict[int, Any]:
+        """Run ONE ragged step over currently-scheduled work.
+
+        The reusable core of ``generate()`` (factored out for the serving
+        loop): returns ``{uid: sampled_token}`` for every sequence whose
+        pending work completed this step (``{uid: logits_row}`` with
+        ``return_logits=True`` — the serving layer's heterogeneous-
+        sampling path), or ``{}`` when nothing is scheduled.  The caller
+        owns the extend-or-flush decision per sampled uid.  Raises
+        ``KVCacheExhausted`` (with scheduler state rolled back, nothing
+        run) when the step needs more KV pages than remain — preempt a
+        victim and retry.
+        """
+        if return_logits:
+            rb, logits = self._ragged_step([], [])
+            if rb is None:
+                return {}
+            logits_np = np.asarray(logits)
+            return {uid: logits_np[slot]
+                    for slot, uid in rb.uids_by_slot.items()}
+        top_k, top_p = check_sampling_params(top_k, top_p,
+                                             self.model_config.vocab_size)
+        if key is None:
+            # fresh subkey per call — a fixed key would correlate every
+            # non-greedy step's draws (deterministic per engine seed)
+            self._step_key, key = jax.random.split(self._step_key)
+        rb, toks = self._ragged_step(
+            [], [], sample={"key": key, "temperature": temperature,
+                            "top_k": top_k, "top_p": top_p})
+        if rb is None:
+            return {}
+        toks_np = np.asarray(toks)
+        return {uid: int(toks_np[slot])
+                for slot, uid in rb.uids_by_slot.items()}
+
     def extend(self, uid: int, token: int) -> None:
         """Append a sampled token so the next step decodes it."""
         self.state_manager.extend(uid, int(token))
@@ -268,9 +338,33 @@ class InferenceEngineV2:
         self.scheduler.retire(uid)
         self.state_manager.flush(uid)
 
+    def preempt(self, uid: int) -> List[int]:
+        """Evict a live sequence, returning every token it knows
+        (prompt + generated-so-far, including any still-uncached sampled
+        token).  Recompute-style preemption: the caller requeues the
+        returned list as a fresh prompt; re-prefill rebuilds the KV and
+        greedy decoding continues bit-identically.  Slot and pages are
+        freed immediately."""
+        seq = self.state_manager.get(uid)
+        tokens = list(seq.tokens)
+        self.flush(uid)
+        return tokens
+
     @property
     def free_blocks(self) -> int:
         return self.state_manager.allocator.free_blocks
+
+    def seq_blocks(self, n_tokens: int) -> int:
+        """KV pages a sequence of ``n_tokens`` tokens occupies — THE page
+        accounting rule; admission layers must use it rather than re-derive
+        it so engine and admission can never disagree."""
+        return -(-int(n_tokens) // self.cfg.block_size)
+
+    @property
+    def max_seq_blocks(self) -> int:
+        """Hard per-sequence page cap (pool size and block-table width)."""
+        return min(self.cfg.num_blocks - 1,
+                   self.state_manager.max_blocks_per_seq)
 
     # ------------------------------------------------------------------
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
@@ -289,9 +383,6 @@ class InferenceEngineV2:
         pending = list(zip(uids, prompts))
         step_key = jax.random.PRNGKey(seed)
 
-        total_blocks = self.cfg.num_blocks - 1  # block 0 reserved
-        bs = self.cfg.block_size
-        max_per_seq = self.state_manager.max_blocks_per_seq
         decode_key = jax.random.PRNGKey(seed ^ 0x5EED)
         while pending or any(u in self.state_manager for u in uids):
             # Pure-decode phase: every live sequence is waiting on exactly
@@ -314,17 +405,17 @@ class InferenceEngineV2:
             for u in uids:
                 if u in self.state_manager:
                     seq = self.state_manager.get(u)
-                    final = -(-(len(seq.tokens) + remaining[u]) // bs)
+                    final = self.seq_blocks(len(seq.tokens) + remaining[u])
                     reserved += max(0, final - len(seq.blocks))
             # Admit while slots and KV pages allow (continuous batching).
             while pending and (self.state_manager.n_active + len(admit_uids)
                                < self.state_manager.max_seqs):
                 u, toks = pending[0]
-                need = -(-(len(toks) + max_new_tokens) // bs)
-                if need > total_blocks or need > max_per_seq:
+                need = self.seq_blocks(len(toks) + max_new_tokens)
+                if need > self.max_seq_blocks:
                     raise RuntimeError(
                         f"prompt uid {u} needs {need} KV blocks but the cache "
-                        f"allows {min(total_blocks, max_per_seq)} per sequence; "
+                        f"allows {self.max_seq_blocks} per sequence; "
                         "raise num_blocks/max_context or shorten the prompt")
                 if need + reserved > self.state_manager.allocator.free_blocks:
                     break
@@ -381,6 +472,19 @@ class InferenceEngineV2:
         cap_tokens = mgr.max_blocks_per_seq * mgr.block_size
         headroom = min(cap_tokens - mgr.get(u).num_cached for u in uids)
         chunk = max(1, min(chunk, headroom))
+        # ...and within the shared POOL: the round-up would allocate pages
+        # past the admission reservation (overshot tokens are masked, but
+        # their pages are real) — on a tight cache that's an exhaustion
+        # crash mid-decode.  Halve back until the whole chunk's new pages
+        # fit; chunk=1 always fits the reservation.
+        bs2 = mgr.block_size
+
+        def _pages_needed(c: int) -> int:
+            return sum(max(0, -(-(mgr.get(u).num_cached + c) // bs2)
+                           - len(mgr.get(u).blocks)) for u in uids)
+
+        while chunk > 1 and _pages_needed(chunk) > mgr.allocator.free_blocks:
+            chunk //= 2
         s_rows = mgr.max_seqs
         tokens0 = np.zeros((s_rows,), np.int32)
         ctx0 = np.zeros((s_rows,), np.int32)

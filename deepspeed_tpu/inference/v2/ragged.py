@@ -28,17 +28,28 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 
+class KVCacheExhausted(RuntimeError):
+    """Allocation failed for want of free KV pages.
+
+    A typed subclass so the serving layer can tell "preempt someone and
+    retry" (this) apart from genuine config errors (plain RuntimeError,
+    e.g. a sequence exceeding max_blocks_per_seq)."""
+
+
 class BlockedAllocator:
     """Free-list page allocator (ref blocked_allocator.py:11).
 
     Block 0 is reserved (garbage page for padding); valid handles are
-    1..num_blocks-1.
+    1..num_blocks-1.  ``free()`` rejects double-frees and out-of-range
+    handles — a double-freed page would be handed to two live sequences
+    and silently cross-write their KV.
     """
 
     def __init__(self, num_blocks: int):
         if num_blocks < 2:
             raise ValueError("need at least 2 blocks (block 0 is reserved)")
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._allocated: set = set()
         self.num_blocks = num_blocks
 
     @property
@@ -47,15 +58,28 @@ class BlockedAllocator:
 
     def allocate(self, n: int) -> List[int]:
         if n > len(self._free):
-            raise RuntimeError(f"KV cache exhausted: want {n} blocks, "
-                               f"have {len(self._free)}")
+            raise KVCacheExhausted(f"KV cache exhausted: want {n} blocks, "
+                                   f"have {len(self._free)}")
         out = [self._free.pop() for _ in range(n)]
+        self._allocated.update(out)
         return out
 
     def free(self, blocks: Sequence[int]) -> None:
+        # Validate the whole batch before mutating: a partially-applied
+        # free() would leave the caller unable to retry safely.
+        if len(set(blocks)) != len(blocks):
+            raise ValueError(f"duplicate handles in free(): {list(blocks)}")
         for b in blocks:
             if b == 0:
                 raise ValueError("block 0 is reserved")
+            if not (0 < b < self.num_blocks):
+                raise ValueError(f"block {b} out of range "
+                                 f"(1..{self.num_blocks - 1})")
+            if b not in self._allocated:
+                raise ValueError(f"block {b} is not allocated "
+                                 "(double free?)")
+        for b in blocks:
+            self._allocated.discard(b)
             self._free.append(b)
 
 

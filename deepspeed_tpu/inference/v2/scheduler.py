@@ -7,12 +7,18 @@ running (decode) sequences contribute one token each, and waiting prompts
 fill the remaining budget — long prompts are *split* across steps, short
 prompts *fuse* into one step. This keeps every forward the same shape
 (compiled once) and latency flat.
+
+Serving extensions: sequences carry a priority (higher runs earlier when
+the budget is short), ``add(front=True)`` requeues a preempted sequence
+ahead of every waiting prompt (preempted work already paid its queue
+wait once), and ``demote()`` rolls a sequence back from the decode set to
+the head of the prefill queue when a scheduled step could not run (KV
+exhaustion caught before any state advanced).
 """
 
 from __future__ import annotations
 
-from collections import deque
-from typing import Deque, List, Tuple
+from typing import Dict, List, Tuple
 
 from deepspeed_tpu.inference.v2.ragged import DSStateManager, SequenceDescriptor
 
@@ -22,16 +28,43 @@ class SplitFuseScheduler:
         self.mgr = mgr
         self.token_budget = token_budget
         self._decode: List[int] = []          # uids generating tokens
-        self._prefill: Deque[int] = deque()   # uids with uncached prompt tokens
+        self._prefill: List[int] = []         # uids with uncached prompt tokens
+        # (-priority, arrival) sort key per uid: higher priority first,
+        # FIFO within a priority class; front-requeues get arrival numbers
+        # below every live entry so they re-enter at the head.
+        self._key: Dict[int, Tuple[int, int]] = {}
+        self._arrival = 0
+        self._front_arrival = 0
 
-    def add(self, uid: int) -> None:
+    def add(self, uid: int, priority: int = 0, front: bool = False) -> None:
+        if front:
+            self._front_arrival -= 1
+            arrival = self._front_arrival
+        else:
+            self._arrival += 1
+            arrival = self._arrival
+        self._key[uid] = (-int(priority), arrival)
         self._prefill.append(uid)
+        self._prefill.sort(key=self._key.__getitem__)
 
     def retire(self, uid: int) -> None:
         if uid in self._decode:
             self._decode.remove(uid)
         if uid in self._prefill:
             self._prefill.remove(uid)
+        self._key.pop(uid, None)
+
+    def demote(self, uid: int) -> None:
+        """Move a decode-set sequence back to the head of the prefill queue
+        (its scheduled chunk never ran — see engine step() rollback)."""
+        if uid in self._decode:
+            self._decode.remove(uid)
+        if uid not in self._prefill:
+            self._front_arrival -= 1
+            prio = self._key.get(uid, (0, 0))[0]
+            self._key[uid] = (prio, self._front_arrival)
+            self._prefill.append(uid)
+            self._prefill.sort(key=self._key.__getitem__)
 
     @property
     def has_work(self) -> bool:
@@ -41,12 +74,13 @@ class SplitFuseScheduler:
         """(sequence, n_tokens) items for one step, ≤ token_budget total.
 
         Decode sequences first (1 token each — they bound latency), then
-        prompt chunks. A prompt whose remaining tokens exceed the leftover
-        budget is split; its unsampled chunk stays queued.
+        prompt chunks; both sets walk in priority order. A prompt whose
+        remaining tokens exceed the leftover budget is split; its
+        unsampled chunk stays queued.
         """
         budget = self.token_budget
         schedule: List[Tuple[SequenceDescriptor, int]] = []
-        for uid in list(self._decode):
+        for uid in sorted(self._decode, key=self._key.__getitem__):
             if budget == 0:
                 break
             seq = self.mgr.get(uid)

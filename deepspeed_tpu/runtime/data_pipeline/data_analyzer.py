@@ -194,6 +194,22 @@ class DistributedDataAnalyzer:
                 local[name] = (None if acc is None else acc.tolist())
 
         gathered = comm.all_gather_object(local)
+        # Validate on EVERY rank: duplicate ids would silently keep
+        # whichever worker's value scattered last — and a rank-0-only
+        # raise would leave the other ranks hung at the closing barrier.
+        for name in self.metrics:
+            if self.metric_types.get(name, "single_value_per_sample") \
+                    != "single_value_per_sample":
+                continue
+            all_ids = np.asarray([p[0] for g in gathered for p in g[name]],
+                                 np.int64)
+            uniq_ids, id_counts = np.unique(all_ids, return_counts=True)
+            if np.any(id_counts > 1):
+                dups = uniq_ids[id_counts > 1][:8]
+                raise ValueError(
+                    f"metric {name!r}: duplicate sample_indices "
+                    f"{dups.tolist()} across workers (each sample id "
+                    "must map to exactly one value)")
         results: Dict[str, str] = {}
         if self.worker_id == 0:
             n = len(self.dataset)
@@ -205,20 +221,31 @@ class DistributedDataAnalyzer:
                 if mtype == "accumulate_value_over_samples":
                     parts = [np.asarray(g[name], np.float64)
                              for g in gathered if g[name] is not None]
-                    total = np.sum(parts, axis=0)
+                    # every worker's split was empty (empty dataset):
+                    # np.sum([], axis=0) would collapse to scalar 0.0 and
+                    # save a shapeless value where callers expect the
+                    # metric's accumulator shape
+                    total = (np.sum(parts, axis=0) if parts
+                             else np.zeros(0, np.float64))
                     path = os.path.join(mdir, f"{name}_metric_value.npy")
                     np.save(path, total)
                     results[name] = path
                     continue
                 pairs = np.asarray(
                     [p for g in gathered for p in g[name]], np.float64)
-                ids = pairs[:, 0].astype(np.int64)
-                vals = pairs[:, 1]
+                if pairs.size:
+                    ids = pairs[:, 0].astype(np.int64)
+                    vals = pairs[:, 1]
+                else:
+                    ids = np.zeros(0, np.int64)
+                    vals = np.zeros(0, np.float64)
+                # (duplicate ids already rejected on every rank above)
                 # sample_indices may map into a larger corpus id space;
-                # size the dense table by the largest id seen (duplicate
-                # ids keep the last-mapped value)
+                # size the dense table by the largest id seen.  Ids absent
+                # from the gather stay NaN so a missing metric is
+                # distinguishable from a measured 0.0.
                 size = max(n, int(ids.max()) + 1 if len(ids) else 0)
-                dense = np.zeros(size, np.float64)
+                dense = np.full(size, np.nan, np.float64)
                 dense[ids] = vals
                 np.save(os.path.join(mdir, f"{name}_sample_to_metric.npy"),
                         dense)
@@ -246,12 +273,18 @@ class DistributedDataAnalyzer:
                     ids=np.concatenate(b_ids) if b_ids else
                     np.zeros(0, np.int64),
                     offsets=np.asarray(b_off, np.int64))
-                # flat sampler-compatible files (DataAnalyzer layout)
+                # flat sampler-compatible files (DataAnalyzer layout).
+                # The NaN missing-id sentinel stays in the merge table
+                # above; the sampler's difficulties array must be finite
+                # (NaN fails every `difficulty <= threshold` test and
+                # would silently drop those samples from the curriculum),
+                # so absent ids fall back to 0.0 here.
+                finite = np.nan_to_num(dense, nan=0.0)
                 np.save(os.path.join(self.save_path, f"{name}_values.npy"),
-                        dense)
+                        finite)
                 np.save(os.path.join(self.save_path,
                                      f"{name}_index_sorted.npy"),
-                        np.argsort(dense, kind="stable"))
+                        np.argsort(finite, kind="stable"))
                 results[name] = mdir
         comm.barrier()
         return results

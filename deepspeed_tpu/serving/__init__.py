@@ -1,0 +1,21 @@
+"""deepspeed_tpu.serving — MII-style async serving over InferenceEngineV2.
+
+See docs/SERVING.md for the architecture (queue → admission → SplitFuse
+→ streams), the preemption/watermark policy, and a runnable CPU example.
+"""
+
+from deepspeed_tpu.serving.admission import (AdmissionConfig,
+                                             AdmissionController)
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.request import (DeadlineExceeded,
+                                           GenerationRequest, QueueFull,
+                                           RequestCancelled, ResponseStream,
+                                           SamplingParams, ServingError)
+from deepspeed_tpu.serving.server import InferenceServer, ServerConfig
+
+__all__ = [
+    "AdmissionConfig", "AdmissionController", "DeadlineExceeded",
+    "GenerationRequest", "InferenceServer", "QueueFull", "RequestCancelled",
+    "ResponseStream", "SamplingParams", "ServerConfig", "ServingError",
+    "ServingMetrics",
+]

@@ -1,0 +1,176 @@
+"""Admission control: backpressure, KV watermarks, preemption policy.
+
+The robustness layer the bare engine lacks (ref DeepSpeed-MII
+``RaggedBatchBase`` request queue + FastGen's watermark'd KV usage):
+
+* **Bounded request queue** — ``submit`` beyond ``max_queue_size`` either
+  raises ``QueueFull`` (policy ``"reject"``, the load-shedding default)
+  or blocks the submitter (policy ``"block"``).
+* **KV watermarks** — a new request is admitted only while, after its
+  prompt pages, the pool keeps ``kv_high_watermark`` of its blocks free;
+  decode growth may then drain the pool to ``kv_low_watermark`` before
+  preemption kicks in.  The hysteresis gap is what lets running requests
+  finish instead of thrashing against new arrivals.
+* **Preemption policy** — when an engine step raises ``KVCacheExhausted``,
+  ``choose_victim`` picks the lowest-priority, youngest-admitted running
+  request; its recompute requeue is the graceful-degradation path.
+
+Admission can overcommit on purpose (``reserve_decode=False``, the
+throughput default): reserving every request's worst-case output up front
+(what ``generate()`` does) caps concurrency at the pessimal bound, while
+optimistic admission + preemption tracks the *actual* output lengths.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Deque, Iterable, Optional
+
+from deepspeed_tpu.serving.request import GenerationRequest, QueueFull
+
+
+class AdmissionConfig:
+    def __init__(self, d: Optional[dict] = None, **kw):
+        d = {**(d or {}), **kw}
+        self.max_queue_size = int(d.get("max_queue_size", 256))
+        self.queue_policy = str(d.get("queue_policy", "reject"))
+        if self.queue_policy not in ("reject", "block"):
+            raise ValueError(f"queue_policy={self.queue_policy!r}: "
+                             "expected 'reject' or 'block'")
+        self.kv_low_watermark = float(d.get("kv_low_watermark", 0.0))
+        self.kv_high_watermark = float(d.get("kv_high_watermark", 0.05))
+        if not (0.0 <= self.kv_low_watermark
+                <= self.kv_high_watermark < 1.0):
+            raise ValueError(
+                f"watermarks must satisfy 0 <= low ({self.kv_low_watermark})"
+                f" <= high ({self.kv_high_watermark}) < 1")
+        # True = generate()-style worst-case output reservation (no
+        # preemption will ever fire, lower concurrency); False = admit on
+        # prompt need only and rely on preemption under pressure.
+        self.reserve_decode = bool(d.get("reserve_decode", False))
+        # A request preempted this many times fails instead of requeueing
+        # — the livelock backstop of last resort.  Victim choice already
+        # deprioritizes previously-preempted requests, so reaching this
+        # means sustained pressure rotated through every running peer.
+        self.max_preemptions = int(d.get("max_preemptions", 16))
+
+
+class AdmissionController:
+    """Thread-safe bounded queue + KV admission test + victim choice.
+
+    Producers (``offer``) run on caller threads; consumers (``pop_ready``
+    etc.) run on the serve loop only.
+    """
+
+    def __init__(self, cfg: AdmissionConfig):
+        self.cfg = cfg
+        self._lock = threading.Condition()
+        self._queue: Deque[GenerationRequest] = deque()
+        self._closed = False
+
+    # -- producer side ---------------------------------------------------
+    def offer(self, req: GenerationRequest,
+              timeout: Optional[float] = None) -> None:
+        """Enqueue or shed load per the queue policy."""
+        with self._lock:
+            if self.cfg.queue_policy == "block":
+                ok = self._lock.wait_for(
+                    lambda: self._closed
+                    or len(self._queue) < self.cfg.max_queue_size,
+                    timeout)
+                if not ok:
+                    raise QueueFull(
+                        f"queue full ({self.cfg.max_queue_size}) after "
+                        f"blocking {timeout}s")
+            if self._closed:
+                raise QueueFull("server not accepting requests")
+            if len(self._queue) >= self.cfg.max_queue_size:
+                raise QueueFull(
+                    f"queue full ({self.cfg.max_queue_size} waiting)")
+            self._queue.append(req)
+            self._lock.notify_all()
+
+    def close(self) -> None:
+        """Stop accepting new requests (graceful-drain entry point)."""
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+
+    # -- serve-loop side -------------------------------------------------
+    def requeue_front(self, req: GenerationRequest) -> None:
+        """Preempted request: back of nobody's line."""
+        with self._lock:
+            self._queue.appendleft(req)
+            self._lock.notify_all()
+
+    def peek(self) -> Optional[GenerationRequest]:
+        with self._lock:
+            return self._queue[0] if self._queue else None
+
+    def snapshot(self) -> list:
+        """Stable copy for sweeps (offers may race the serve loop)."""
+        with self._lock:
+            return list(self._queue)
+
+    def pop(self) -> Optional[GenerationRequest]:
+        with self._lock:
+            req = self._queue.popleft() if self._queue else None
+            if req is not None:
+                self._lock.notify_all()  # unblock 'block'-policy offers
+            return req
+
+    def drain(self) -> Iterable[GenerationRequest]:
+        """Remove and return everything queued (shutdown-without-drain)."""
+        with self._lock:
+            out = list(self._queue)
+            self._queue.clear()
+            self._lock.notify_all()
+            return out
+
+    def remove(self, req: GenerationRequest) -> bool:
+        """Drop a queued request (cancelled/expired before admission)."""
+        with self._lock:
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                return False
+            self._lock.notify_all()
+            return True
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def wait_for_work(self, timeout: float) -> None:
+        """Park the serve loop until a request arrives (or timeout — the
+        loop still needs to wake for deadline sweeps)."""
+        with self._lock:
+            if not self._queue:
+                self._lock.wait(timeout)
+
+    # -- policy ----------------------------------------------------------
+    def kv_admissible(self, engine, need_blocks: int) -> bool:
+        """Would admitting a prompt needing ``need_blocks`` keep the pool
+        above the high watermark?"""
+        total = engine.cfg.num_blocks - 1  # block 0 reserved
+        floor = int(self.cfg.kv_high_watermark * total)
+        return engine.free_blocks - need_blocks >= floor
+
+    def below_low_watermark(self, engine) -> bool:
+        total = engine.cfg.num_blocks - 1
+        return engine.free_blocks < int(self.cfg.kv_low_watermark * total)
+
+    @staticmethod
+    def choose_victim(active: Iterable[GenerationRequest]
+                      ) -> Optional[GenerationRequest]:
+        """Lowest priority first; within a class, fewest prior
+        preemptions, then youngest admission.  Preemption count outranks
+        age because a just-re-admitted request is always the youngest —
+        keying on age alone would bounce the same request until the
+        ``max_preemptions`` backstop failed it while never-preempted
+        peers kept running."""
+        victims = sorted(active,
+                         key=lambda r: (r.priority, r.preemptions,
+                                        -(r.admitted_at or 0.0)))
+        return victims[0] if victims else None

@@ -1,0 +1,151 @@
+"""Serving metrics: per-request latency decomposition + service gauges.
+
+Glossary (the standard LLM-serving vocabulary; see docs/SERVING.md):
+
+* **TTFT** — time to first token: submit → first token out the stream.
+* **TPOT** — time per output token: (last token − first token) / (n − 1),
+  the steady-state decode cadence one request observes.
+* **queue wait** — submit → admission into the SplitFuse scheduler.
+
+Everything is recorded under one lock (the serve loop is the writer; any
+thread may ``snapshot()``).  Distributions keep a bounded window of the
+most recent samples — a long-lived server must not grow without bound.
+Export goes through ``monitor.MonitorMaster`` as plain
+``(tag, value, step)`` events so TensorBoard/WandB/CSV all work unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
+
+import numpy as np
+
+Event = Tuple[str, float, int]
+
+_WINDOW = 2048  # per-distribution sample cap
+
+
+def _percentiles(xs: Deque[float]) -> Dict[str, float]:
+    if not xs:
+        return {"p50": 0.0, "p95": 0.0, "mean": 0.0, "count": 0}
+    a = np.asarray(xs, np.float64)
+    return {"p50": float(np.percentile(a, 50)),
+            "p95": float(np.percentile(a, 95)),
+            "mean": float(a.mean()), "count": int(a.size)}
+
+
+class ServingMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        # counters
+        self.submitted = 0
+        self.admitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.cancelled = 0
+        self.expired = 0
+        self.rejected = 0
+        self.preemptions = 0
+        self.tokens_out = 0
+        self.steps = 0
+        # distributions (seconds)
+        self._ttft: Deque[float] = deque(maxlen=_WINDOW)
+        self._tpot: Deque[float] = deque(maxlen=_WINDOW)
+        self._queue_wait: Deque[float] = deque(maxlen=_WINDOW)
+        # gauges (set by the serve loop each iteration)
+        self.queue_depth = 0
+        self.active_requests = 0
+        self.kv_utilization = 0.0
+
+    # -- recording (serve loop / submit path) ----------------------------
+    def record_submit(self) -> None:
+        with self._lock:
+            self.submitted += 1
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_admit(self, queue_wait_s: float) -> None:
+        with self._lock:
+            self.admitted += 1
+            self._queue_wait.append(queue_wait_s)
+
+    def record_first_token(self, ttft_s: float) -> None:
+        with self._lock:
+            self._ttft.append(ttft_s)
+
+    def record_tokens(self, n: int) -> None:
+        with self._lock:
+            self.tokens_out += n
+
+    def record_step(self) -> None:
+        with self._lock:
+            self.steps += 1
+
+    def record_preemption(self) -> None:
+        with self._lock:
+            self.preemptions += 1
+
+    def record_finish(self, outcome: str, n_tokens: int,
+                      first_token_at: Optional[float],
+                      finished_at: float) -> None:
+        """``outcome``: completed | failed | cancelled | expired."""
+        with self._lock:
+            setattr(self, outcome, getattr(self, outcome) + 1)
+            if (outcome == "completed" and n_tokens > 1
+                    and first_token_at is not None):
+                self._tpot.append(
+                    (finished_at - first_token_at) / (n_tokens - 1))
+
+    def set_gauges(self, queue_depth: int, active: int,
+                   kv_utilization: float) -> None:
+        with self._lock:
+            self.queue_depth = queue_depth
+            self.active_requests = active
+            self.kv_utilization = kv_utilization
+
+    # -- reading ---------------------------------------------------------
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            elapsed = max(time.monotonic() - self._t0, 1e-9)
+            return {
+                "submitted": self.submitted,
+                "admitted": self.admitted,
+                "completed": self.completed,
+                "failed": self.failed,
+                "cancelled": self.cancelled,
+                "expired": self.expired,
+                "rejected": self.rejected,
+                "preemptions": self.preemptions,
+                "tokens_out": self.tokens_out,
+                "steps": self.steps,
+                "tokens_per_sec": self.tokens_out / elapsed,
+                "queue_depth": self.queue_depth,
+                "active_requests": self.active_requests,
+                "kv_utilization": self.kv_utilization,
+                "ttft": _percentiles(self._ttft),
+                "tpot": _percentiles(self._tpot),
+                "queue_wait": _percentiles(self._queue_wait),
+            }
+
+    def events(self, step: int) -> List[Event]:
+        """Flatten the snapshot into MonitorMaster events."""
+        snap = self.snapshot()
+        out: List[Event] = []
+        for k, v in snap.items():
+            if isinstance(v, dict):
+                for sub, x in v.items():
+                    out.append((f"serving/{k}_{sub}", float(x), step))
+            else:
+                out.append((f"serving/{k}", float(v), step))
+        return out
+
+    def write_to(self, monitor, step: int) -> None:
+        """Export through a ``monitor.MonitorMaster`` (or anything with
+        ``write_events``)."""
+        monitor.write_events(self.events(step))

@@ -1,0 +1,426 @@
+"""MII-style async serving loop over ``InferenceEngineV2``.
+
+Analog of DeepSpeed-MII's ``RaggedBatchBase``/``MIIPipeline`` serve thread
+(mii/batching/ragged_batching.py): the server owns an engine on a
+background thread and exposes an async request API —
+
+    server = InferenceServer(engine)
+    server.start()
+    stream = server.submit([1, 2, 3], SamplingParams(max_new_tokens=16))
+    for tok in stream:          # tokens appear as they are decoded
+        ...
+    server.stop()               # graceful drain
+
+Loop anatomy (docs/SERVING.md has the diagram):
+
+    submit() → bounded queue → admission (slots + KV watermarks)
+             → SplitFuse scheduler → engine.step() → per-request streams
+
+Robustness: cancellation and deadlines are swept every iteration; KV
+exhaustion preempts the lowest-priority/youngest running request
+(recompute-style requeue at the front of the queue) instead of crashing;
+``stop()`` drains in-flight work before joining the thread.
+
+Threading contract: the engine is touched ONLY by the serve thread.
+``submit``/``cancel``/stream reads are safe from any thread.  Sampling
+runs on-device when every running request is greedy (one int32 per slot
+crosses to the host); any non-greedy request switches the step to the
+full-logits path with per-request host RNGs, so heterogeneous sampling
+params coexist in one ragged batch.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+from deepspeed_tpu.inference.v2.ragged import KVCacheExhausted
+from deepspeed_tpu.serving.admission import (AdmissionConfig,
+                                             AdmissionController)
+from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.request import (DeadlineExceeded,
+                                           GenerationRequest,
+                                           RequestCancelled, ResponseStream,
+                                           SamplingParams, ServingError)
+from deepspeed_tpu.utils.logging import log_dist
+
+
+def _softmax(x: np.ndarray) -> np.ndarray:
+    x = x - x.max()
+    e = np.exp(x)
+    return e / e.sum()
+
+
+def _host_sample(logits: np.ndarray, params: SamplingParams,
+                 rng: np.random.Generator) -> int:
+    """Numpy twin of ``model.sample_tokens`` for the heterogeneous-
+    sampling step (greedy argmax is bit-identical to the device path)."""
+    if params.greedy:
+        return int(np.argmax(logits))
+    x = logits.astype(np.float64) / max(params.temperature, 1e-6)
+    if params.top_k > 0:
+        kth = np.sort(x)[-min(params.top_k, x.size)]
+        x = np.where(x >= kth, x, -np.inf)
+    if params.top_p < 1.0:
+        order = np.argsort(-x)
+        p_sorted = _softmax(x[order])
+        keep = (np.cumsum(p_sorted) - p_sorted) < params.top_p
+        kept = order[keep]
+        masked = np.full_like(x, -np.inf)
+        masked[kept] = x[kept]
+        x = masked
+    return int(rng.choice(x.size, p=_softmax(x)))
+
+
+class ServerConfig:
+    def __init__(self, d: Optional[dict] = None, **kw):
+        d = {**(d or {}), **kw}
+        self.admission = AdmissionConfig(d.get("admission", {}))
+        # how long the idle loop parks before re-sweeping deadlines
+        self.idle_wait_s = float(d.get("idle_wait_s", 0.02))
+        # export metrics through `monitor` every N engine steps (0 = only
+        # at stop()); the monitor is any object with write_events()
+        self.metrics_interval_steps = int(d.get("metrics_interval_steps", 0))
+
+
+class InferenceServer:
+    """Continuous-batching serve loop owning one ``InferenceEngineV2``."""
+
+    def __init__(self, engine: InferenceEngineV2,
+                 config: Optional[dict] = None, monitor: Any = None):
+        self.engine = engine
+        self.cfg = ServerConfig(config)
+        self.monitor = monitor
+        self.metrics = ServingMetrics()
+        self.admission = AdmissionController(self.cfg.admission)
+        self._active: Dict[int, GenerationRequest] = {}
+        self._uid = itertools.count()
+        self._uid_lock = threading.Lock()
+        self._rngs: Dict[int, np.random.Generator] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop_requested = False
+        self._abort = False
+        self._loop_error: Optional[BaseException] = None
+        # per-seq hard cap, checked at submit so an impossible request
+        # fails fast instead of crashing the loop mid-decode (page
+        # accounting lives in the ENGINE — engine.seq_blocks — so
+        # admission and allocator can never disagree)
+        self._total_blocks = engine.cfg.num_blocks - 1
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "InferenceServer":
+        if self._thread is not None:
+            raise RuntimeError("server already started")
+        if self._stop_requested or self._loop_error is not None:
+            # stop() closed admission and left the terminal flags set; a
+            # "restarted" loop would exit immediately while submits get
+            # QueueFull — fail loudly instead of running dead
+            raise RuntimeError(
+                "server already stopped; create a new InferenceServer")
+        self._thread = threading.Thread(target=self._serve_loop,
+                                        name="ds-serve-loop", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Stop the loop.  ``drain=True`` finishes all queued + running
+        requests first; ``drain=False`` cancels them."""
+        self.admission.close()
+        self._stop_requested = True
+        if not drain:
+            self._abort = True
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError(f"serve loop still running after "
+                                   f"{timeout}s (drain={drain})")
+            self._thread = None
+        if self.monitor is not None:
+            self.metrics.write_to(self.monitor, self.metrics.snapshot()["steps"])
+        if self._loop_error is not None:
+            raise RuntimeError("serve loop died") from self._loop_error
+
+    def __enter__(self) -> "InferenceServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=not any(exc))
+
+    # -- client API ------------------------------------------------------
+    def submit(self, prompt: Sequence[int],
+               params: Optional[SamplingParams] = None, priority: int = 0,
+               deadline_s: Optional[float] = None,
+               timeout: Optional[float] = None) -> ResponseStream:
+        """Enqueue one generation request; returns its stream immediately.
+
+        ``deadline_s`` is a wall budget from now — queued or mid-decode,
+        the request fails with ``DeadlineExceeded`` once it passes.
+        ``timeout`` only applies to the enqueue itself under the "block"
+        queue policy.  Raises ``QueueFull`` (reject policy / closed
+        server) or ``ValueError`` for requests no admission order could
+        ever run.
+        """
+        params = params or SamplingParams()
+        if not len(prompt):
+            raise ValueError("empty prompt")
+        if params.max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {params.max_new_tokens}")
+        # same boundary contract as model.check_sampling_params — a
+        # degenerate value must fail HERE, not crash the serve loop at
+        # this request's first sampled token (top_p=0 masks every logit)
+        if not (0.0 < float(params.top_p) <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {params.top_p}")
+        if params.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {params.top_k}")
+        need = self.engine.seq_blocks(len(prompt) + params.max_new_tokens)
+        if need > self.engine.max_seq_blocks:
+            raise ValueError(
+                f"prompt+output needs {need} KV blocks but the engine "
+                f"allows {self.engine.max_seq_blocks} per sequence; raise "
+                "num_blocks/max_context or shorten the request")
+        with self._uid_lock:
+            uid = next(self._uid)
+        req = GenerationRequest(
+            uid=uid, prompt=list(prompt), params=params,
+            stream=ResponseStream(uid), priority=priority,
+            deadline=(None if deadline_s is None
+                      else time.monotonic() + deadline_s))
+        self.metrics.record_submit()
+        try:
+            self.admission.offer(req, timeout=timeout)
+        except ServingError:
+            self.metrics.record_reject()
+            raise
+        return req.stream
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int = 32, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None) -> List[List[int]]:
+        """Blocking convenience wrapper: ``engine.generate()`` parity
+        through the serving path (used by tests and the bench row)."""
+        streams = [self.submit(p, SamplingParams(
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, eos_token_id=eos_token_id, seed=i))
+            for i, p in enumerate(prompts)]
+        return [s.result() for s in streams]
+
+    # -- serve loop ------------------------------------------------------
+    def _serve_loop(self) -> None:
+        try:
+            while True:
+                if self._abort:
+                    self._fail_everything(
+                        RequestCancelled("server shutdown"))
+                    return
+                now = time.monotonic()
+                self._sweep_queue(now)
+                self._sweep_active(now)
+                self._try_admit(now)
+                self._update_gauges()
+                if self.engine.scheduler.has_work:
+                    self._step_once()
+                elif self._stop_requested and len(self.admission) == 0 \
+                        and not self._active:
+                    return
+                else:
+                    self.admission.wait_for_work(self.cfg.idle_wait_s)
+        except BaseException as e:  # never die silently: fail the streams
+            self._loop_error = e
+            # close FIRST: a submit() racing the cleanup must get
+            # QueueFull, not an accepted request nobody will ever serve
+            self.admission.close()
+            self._fail_everything(ServingError(f"serve loop died: {e!r}"))
+            log_dist(f"serving: loop crashed: {e!r}", level="error")
+
+    def _fail_everything(self, err: ServingError) -> None:
+        for req in self.admission.drain():
+            self._finish(req, error=err)
+        for uid in list(self._active):
+            req = self._active.pop(uid)
+            try:
+                if uid in self.engine.state_manager:
+                    self.engine.flush(uid)
+            except Exception:
+                # the crash handler may be running BECAUSE engine state
+                # is inconsistent — a failing flush must not leave the
+                # remaining streams unterminated
+                pass
+            self._finish(req, error=err)
+
+    def _sweep_queue(self, now: float) -> None:
+        """Cancelled/expired requests that never got admitted."""
+        # snapshot: drain() would drop healthy requests, so walk a copy
+        for req in self.admission.snapshot():
+            if req.stream.cancel_requested:
+                if self.admission.remove(req):
+                    self._finish(req, error=RequestCancelled(
+                        f"request {req.uid} cancelled while queued"))
+            elif req.expired(now):
+                if self.admission.remove(req):
+                    self._finish(req, error=DeadlineExceeded(
+                        f"request {req.uid} deadline passed while queued"))
+
+    def _sweep_active(self, now: float) -> None:
+        for uid in list(self._active):
+            req = self._active[uid]
+            err = None
+            if req.stream.cancel_requested:
+                err = RequestCancelled(f"request {uid} cancelled")
+            elif req.expired(now):
+                err = DeadlineExceeded(f"request {uid} deadline passed "
+                                       f"after {req.n_generated} tokens")
+            if err is not None:
+                del self._active[uid]
+                self.engine.flush(uid)
+                self._finish(req, error=err)
+
+    def _try_admit(self, now: float) -> None:
+        """Admit queue head while slots + KV watermark allow (FIFO — a
+        stuck head blocks later arrivals on purpose: skipping it would
+        starve big requests under steady small-request load)."""
+        eng = self.engine
+        while eng.state_manager.n_active < eng.state_manager.max_seqs:
+            req = self.admission.peek()
+            if req is None:
+                break
+            # A once-preempted request re-admits on its FULL remaining
+            # need: optimistic re-admission would just bounce it through
+            # another admit→exhaust→preempt cycle (observed thrash).
+            conservative = (self.cfg.admission.reserve_decode
+                            or req.preemptions > 0)
+            need = eng.seq_blocks(len(req.tokens)
+                                  + (req.remaining if conservative else 0))
+            if self.cfg.admission.reserve_decode:
+                need += self._reserved_decode_blocks()
+            if not self.admission.kv_admissible(eng, need):
+                if self._active:
+                    break  # running work will free pages; head waits
+                # Progress guarantee: with the engine idle nothing will
+                # ever free pages, so the watermark must yield — admit if
+                # the request fits at all, else it can never run.
+                if need > eng.free_blocks:
+                    assert self.admission.pop() is req
+                    self._finish(req, error=ServingError(
+                        f"request {req.uid} needs {need} KV blocks; only "
+                        f"{eng.free_blocks} exist even with the pool "
+                        "drained"))
+                    continue
+            popped = self.admission.pop()
+            assert popped is req
+            eng.admit(req.uid, req.tokens, priority=req.priority,
+                      front=req.preemptions > 0)
+            first_admission = req.admitted_at is None
+            req.admitted_at = now
+            self._rngs.setdefault(
+                req.uid, np.random.default_rng(req.params.seed))
+            if first_admission:
+                # re-admissions after preemption are service time, not
+                # queue wait — recording them would double-count the
+                # request and skew the distribution
+                self.metrics.record_admit(now - req.submitted_at)
+            self._active[req.uid] = req
+
+    def _reserved_decode_blocks(self) -> int:
+        """generate()-style worst-case growth of the running set (only
+        consulted under ``reserve_decode=True``)."""
+        eng = self.engine
+        reserved = 0
+        for req in self._active.values():
+            seq = eng.state_manager.get(req.uid)
+            final = eng.seq_blocks(len(seq.tokens) + req.remaining)
+            reserved += max(0, final - len(seq.blocks))
+        return reserved
+
+    def _step_once(self) -> None:
+        """One engine step; KV exhaustion preempts and retries next tick."""
+        if (self.admission.below_low_watermark(self.engine)
+                and len(self._active) > 1):
+            self._preempt_one()  # floor hit: shed proactively
+        all_greedy = all(r.params.greedy for r in self._active.values())
+        try:
+            if all_greedy:
+                results = self.engine.step(temperature=0.0)
+            else:
+                results = self.engine.step(return_logits=True)
+        except KVCacheExhausted:
+            self._preempt_one()
+            return
+        self.metrics.record_step()
+        if (self.monitor is not None and self.cfg.metrics_interval_steps
+                and self.metrics.steps
+                % self.cfg.metrics_interval_steps == 0):
+            self.metrics.write_to(self.monitor, self.metrics.steps)
+        now = time.monotonic()
+        for uid, out in results.items():
+            req = self._active.get(uid)
+            if req is None:       # flushed between schedule and fetch
+                continue          # (cannot happen today; belt+braces)
+            tok = (int(out) if all_greedy
+                   else _host_sample(out, req.params, self._rngs[uid]))
+            req.tokens.append(tok)
+            self.metrics.record_tokens(1)
+            if req.n_generated == 1:
+                req.first_token_at = now
+                self.metrics.record_first_token(now - req.submitted_at)
+            req.stream._put_token(tok)
+            eos_hit = (req.params.eos_token_id is not None
+                       and tok == req.params.eos_token_id)
+            if eos_hit or req.remaining <= 0:
+                del self._active[uid]
+                self.engine.flush(uid)
+                self._finish(req)
+            else:
+                self.engine.extend(uid, tok)
+
+    def _preempt_one(self) -> None:
+        """Evict the lowest-priority/youngest runner and requeue it with
+        prompt+generated-so-far (recompute-style degradation)."""
+        victim = self.admission.choose_victim(self._active.values())
+        if victim is None:
+            return
+        if len(self._active) <= 1 \
+                or victim.preemptions >= self.cfg.admission.max_preemptions:
+            # preempting the only runner (or a chronically-preempted one)
+            # cannot make progress — fail it instead of livelocking
+            del self._active[victim.uid]
+            self.engine.flush(victim.uid)
+            self._finish(victim, error=ServingError(
+                f"request {victim.uid} cannot fit the KV pool "
+                f"(preempted {victim.preemptions}×, "
+                f"{self.engine.free_blocks} blocks free)"))
+            return
+        tokens = self.engine.preempt(victim.uid)
+        victim.tokens = tokens
+        victim.preemptions += 1
+        del self._active[victim.uid]
+        self.admission.requeue_front(victim)
+        self.metrics.record_preemption()
+        log_dist(f"serving: preempted uid {victim.uid} "
+                 f"({victim.n_generated} tokens in, requeued)",
+                 level="warning")
+
+    def _finish(self, req: GenerationRequest,
+                error: Optional[ServingError] = None) -> None:
+        now = time.monotonic()
+        outcome = ("completed" if error is None else
+                   "cancelled" if isinstance(error, RequestCancelled) else
+                   "expired" if isinstance(error, DeadlineExceeded) else
+                   "failed")
+        self.metrics.record_finish(outcome, req.n_generated,
+                                   getattr(req, "first_token_at", None), now)
+        self._rngs.pop(req.uid, None)
+        req.stream._finish(error)
+
+    def _update_gauges(self) -> None:
+        free = self.engine.free_blocks
+        self.metrics.set_gauges(
+            queue_depth=len(self.admission),
+            active=len(self._active),
+            kv_utilization=1.0 - free / max(1, self._total_blocks))

@@ -75,6 +75,58 @@ def test_single_process_outputs(tmp_path):
     _check_outputs(str(tmp_path), 103)
 
 
+def test_merge_rejects_duplicate_sample_indices(tmp_path):
+    """Round-5 advisor finding: duplicate sample ids silently kept the
+    last-scattered value — the merge must raise instead."""
+    from deepspeed_tpu.runtime.data_pipeline import DistributedDataAnalyzer
+
+    ds = _Ds(6)
+    a = DistributedDataAnalyzer(
+        ds, str(tmp_path), metrics={"seqlen": lambda s: len(s["input_ids"])},
+        sample_indices=[0, 1, 2, 2, 4, 5])  # id 2 mapped twice
+    with pytest.raises(ValueError, match="duplicate sample_indices"):
+        a.run_map_reduce()
+
+
+def test_merge_sparse_ids_nan_not_zero(tmp_path):
+    """sample_indices into a larger corpus: ids absent from the gather
+    must be NaN in the dense table, distinguishable from a real 0.0."""
+    from deepspeed_tpu.runtime.data_pipeline import DistributedDataAnalyzer
+
+    ds = _Ds(4)
+    a = DistributedDataAnalyzer(
+        ds, str(tmp_path), metrics={"seqlen": lambda s: len(s["input_ids"])},
+        sample_indices=[10, 3, 7, 0])
+    a.run_map_reduce()
+    dense = np.load(os.path.join(str(tmp_path), "seqlen",
+                                 "seqlen_sample_to_metric.npy"))
+    assert dense.shape == (11,)
+    present = np.asarray([10, 3, 7, 0])
+    np.testing.assert_array_equal(dense[present], [4, 5, 6, 7])
+    absent = np.setdiff1d(np.arange(11), present)
+    assert np.all(np.isnan(dense[absent]))
+    # ...but the sampler-facing flat files stay finite: NaN difficulties
+    # would fail every threshold test and drop the samples silently
+    vals = np.load(os.path.join(str(tmp_path), "seqlen_values.npy"))
+    assert np.all(np.isfinite(vals))
+    np.testing.assert_array_equal(vals[present], [4, 5, 6, 7])
+    assert np.all(vals[absent] == 0.0)
+
+
+def test_merge_all_empty_accumulate_metric(tmp_path):
+    """Empty dataset: the accumulate merge must not collapse to a 0-d
+    scalar via np.sum([], axis=0)."""
+    from deepspeed_tpu.runtime.data_pipeline import DistributedDataAnalyzer
+
+    metrics, types = _metrics()
+    a = DistributedDataAnalyzer(_Ds(0), str(tmp_path), metrics=metrics,
+                                metric_types=types)
+    a.run_map_reduce()
+    tok = np.load(os.path.join(str(tmp_path), "tokens",
+                               "tokens_metric_value.npy"))
+    assert tok.ndim == 1 and tok.size == 0
+
+
 WORKER = textwrap.dedent("""
     import os, sys
     import numpy as np

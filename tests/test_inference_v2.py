@@ -27,6 +27,32 @@ def test_blocked_allocator():
         a.free([0])
 
 
+def test_blocked_allocator_rejects_double_free_and_bad_handles():
+    """A double-freed page would be handed to two sequences and silently
+    cross-write their KV — free() must reject it, plus handles that were
+    never valid, without mutating the free list."""
+    a = BlockedAllocator(8)
+    got = a.allocate(3)
+    a.free(got[:1])
+    with pytest.raises(ValueError, match="double free"):
+        a.free(got[:1])                    # already returned
+    with pytest.raises(ValueError, match="not allocated"):
+        a.free([5] if 5 not in got else [6])  # never handed out
+    with pytest.raises(ValueError, match="out of range"):
+        a.free([99])
+    with pytest.raises(ValueError, match="out of range"):
+        a.free([-1])
+    with pytest.raises(ValueError, match="duplicate"):
+        a.free([got[1], got[1]])
+    # failed frees must not have leaked: the two live handles still free
+    a.free(got[1:])
+    assert a.free_blocks == 7
+    from deepspeed_tpu.inference.v2 import KVCacheExhausted
+
+    with pytest.raises(KVCacheExhausted):  # typed for the serving layer
+        a.allocate(8)
+
+
 def test_state_manager_slots_and_pages():
     mgr = DSStateManager(max_seqs=2, num_blocks=8, block_size=4,
                          max_blocks_per_seq=4)
