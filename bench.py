@@ -24,6 +24,7 @@ Pass --smoke for a tiny-shape CPU plumbing check (no numbers of record).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -65,6 +66,18 @@ def _time_train(engine, batch, steps, warmup=3):
     return time.perf_counter() - t0
 
 
+def _telemetry_jsonl(name: str) -> str:
+    """Per-row StepRecord log path (docs/OBSERVABILITY.md): every bench
+    row leaves a machine-readable per-step trail next to its one summary
+    number."""
+    out_dir = os.environ.get("DSTPU_TELEMETRY_DIR", "./telemetry")
+    return os.path.join(out_dir, f"{name}.jsonl")
+
+
+def _telemetry_block(name: str) -> dict:
+    return {"enabled": True, "jsonl_path": _telemetry_jsonl(name)}
+
+
 def _fwd_flops_per_tok(model, seq):
     """Model fwd FLOPs/token: qkvo (GQA-aware) + ffn + lm_head + attn."""
     h, L, V = model.hidden_size, model.num_layers, model.vocab_size
@@ -104,6 +117,7 @@ def row_gpt2_350m():
         "gradient_clipping": 1.0,
         "steps_per_print": 10_000,
         "activation_checkpointing": {"remat_policy": "dots_flash_saveable"},
+        "telemetry": _telemetry_block("gpt2_350m"),
     }
     engine, _, _, _ = ds.initialize(model=model, config=config)
     rows = batch_size * gas
@@ -112,6 +126,7 @@ def row_gpt2_350m():
     batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
     dt = _time_train(engine, batch, steps)
     tps = steps * rows * seq / dt
+    engine.destroy()
     _reset_topology()
     # Baseline: GPT-2 350M-class on one A100, eager torch+DeepSpeed ZeRO-1,
     # ≈35k tokens/s (bf16, seq 1024): A100 312 TFLOPs at ~40% MFU.
@@ -120,6 +135,7 @@ def row_gpt2_350m():
         "value": round(tps, 1), "unit": "tokens/s",
         "vs_baseline": round(tps / 35_000.0, 3),
         "mfu": round(_mfu(tps, model, seq), 3),
+        "telemetry_jsonl": _telemetry_jsonl("gpt2_350m"),
     }
 
 
@@ -158,6 +174,7 @@ def row_llama8b_class_zero3():
         "gradient_clipping": 1.0,
         "steps_per_print": 10_000,
         "activation_checkpointing": {"remat_policy": "dots_flash_saveable"},
+        "telemetry": _telemetry_block("llama8b_class_zero3"),
     }
     engine, _, _, _ = ds.initialize(model=model, config=config)
     rows = batch_size * gas
@@ -167,6 +184,7 @@ def row_llama8b_class_zero3():
     seq_eff = min(seq, model.max_seq_len)
     dt = _time_train(engine, batch, steps)
     tps = steps * rows * seq_eff / dt
+    engine.destroy()
     _reset_topology()
     # A100 80G, Llama-class layers, ZeRO-3 bf16: ~55% MFU published for
     # well-tuned stacks ⇒ per-chip token rate for THIS depth:
@@ -176,6 +194,7 @@ def row_llama8b_class_zero3():
         "value": round(tps, 1), "unit": "tokens/s",
         "vs_baseline": round(tps / a100_tps, 3),
         "mfu": round(_mfu(tps, model, seq_eff), 3),
+        "telemetry_jsonl": _telemetry_jsonl("llama8b_class_zero3"),
     }
 
 
@@ -200,6 +219,7 @@ def _longseq_row(model, seed: int, label: str, steps: int = 3):
         "gradient_clipping": 1.0,
         "steps_per_print": 10_000,
         "activation_checkpointing": {"remat_policy": "flash_saveable"},
+        "telemetry": _telemetry_block(f"longseq_{label}"),
     }
     engine, _, _, _ = ds.initialize(model=model, config=config)
     rows = batch_size * gas
@@ -209,6 +229,7 @@ def _longseq_row(model, seed: int, label: str, steps: int = 3):
     batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
     dt = _time_train(engine, batch, steps, warmup=2)
     tps = steps * rows * seq / dt
+    engine.destroy()
     _reset_topology()
     mfu = _mfu(tps, model, seq)
     return {
@@ -216,6 +237,7 @@ def _longseq_row(model, seed: int, label: str, steps: int = 3):
         "value": round(tps, 1), "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.55, 3),
         "mfu": round(mfu, 3),
+        "telemetry_jsonl": _telemetry_jsonl(f"longseq_{label}"),
     }
 
 
@@ -292,6 +314,7 @@ def _longseq_ring_body():
         "gradient_clipping": 1.0,
         "mesh": mesh,
         "steps_per_print": 10_000,
+        "telemetry": _telemetry_block("longseq_ring"),
     }
     engine, _, _, _ = ds.initialize(model=model, config=config)
     seq = model.max_seq_len
@@ -303,6 +326,7 @@ def _longseq_ring_body():
     batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
     dt = _time_train(engine, batch, steps, warmup=warmup)
     tps_chip = steps * rows * seq / dt / max(1, n)
+    engine.destroy()
     _reset_topology()
     mfu = _mfu(tps_chip, model, seq)
     return {
@@ -311,6 +335,7 @@ def _longseq_ring_body():
         "vs_baseline": round(mfu / 0.55, 3),
         "mfu": round(mfu, 3),
         "placement": "striped",
+        "telemetry_jsonl": _telemetry_jsonl("longseq_ring"),
     }
 
 
@@ -415,6 +440,7 @@ def _peak_entry(idx: int) -> dict:
         "zero_optimization": zero,
         "steps_per_print": 10_000,
         "activation_checkpointing": {"remat_policy": "nothing_saveable"},
+        "telemetry": _telemetry_block("peak_params"),
     }
     engine, _, _, _ = ds.initialize(model=model, config=config)
     rng = np.random.default_rng(2)
@@ -468,6 +494,7 @@ def row_peak_params():
         "value": best["params_m"], "unit": "Mparams",
         "vs_baseline": round(best["params_m"] / 6500.0, 3),
         "model": best["name"],
+        "telemetry_jsonl": _telemetry_jsonl("peak_params"),
     }
 
 
@@ -539,8 +566,21 @@ def row_v2_decode():
     # ratio (1.0 = "as good as the reference, per byte/s of HBM").
     hw_bw_ratio = 0.82 / 2.0
     vs_raw = best / (bar_per_seq * n_seqs)
+    # the decode engine has no serve loop to stream records from; emit
+    # one summary StepRecord so this row leaves a JSONL trail too
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    from deepspeed_tpu.telemetry import Telemetry
+
+    tel = Telemetry(TelemetryConfig(
+        enabled=True, jsonl_path=_telemetry_jsonl("v2_decode")))
+    tel.record_serving_step(0, {
+        "tokens_out": n_seqs * gen_tokens, "tokens_per_sec": best,
+        "bf16_tokens_per_sec": tps, "int8_kv_tokens_per_sec": tps_int8,
+        "prefill_tokens_per_sec": prefill_tps})
+    tel.close()
     return {
         "metric": "v2_decode_tokens_per_sec",
+        "telemetry_jsonl": _telemetry_jsonl("v2_decode"),
         "value": round(best, 1), "unit": "tokens/s",
         "vs_baseline": round(vs_raw, 3),
         "vs_roofline": round(vs_raw / hw_bw_ratio, 3),
@@ -575,6 +615,11 @@ def row_serve_load():
         model = get_model_config("llama3-8b", num_layers=4, max_seq_len=2048)
         n_req, new, prompt_len, rate = 64, 64, 32, 32.0
         eng_cfg = {"memory_config": {"num_blocks": 1024}}
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    from deepspeed_tpu.telemetry import Telemetry
+
+    tel = Telemetry(TelemetryConfig(
+        enabled=True, jsonl_path=_telemetry_jsonl("serve_load")))
     eng = InferenceEngineV2(model, eng_cfg)
     rng = np.random.default_rng(9)
     prompts = [rng.integers(0, model.vocab_size, size=(prompt_len,)).tolist()
@@ -587,7 +632,8 @@ def row_serve_load():
     batch_dt = time.perf_counter() - t0
     batch_tps = n_req * new / batch_dt
 
-    srv = InferenceServer(eng).start()
+    srv = InferenceServer(eng, {"metrics_interval_steps": 32},
+                          telemetry=tel).start()
     arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
     t0 = time.perf_counter()
     streams = []
@@ -602,10 +648,12 @@ def row_serve_load():
     dt = time.perf_counter() - t0
     srv.stop()
     snap = srv.metrics.snapshot()
+    tel.close()
     _reset_topology()
     tps = n_req * new / dt
     return {
         "metric": "serve_load_tokens_per_sec",
+        "telemetry_jsonl": _telemetry_jsonl("serve_load"),
         "value": round(tps, 1), "unit": "tokens/s",
         "vs_baseline": round(tps / batch_tps, 3),
         "ttft_p50_ms": round(snap["ttft"]["p50"] * 1e3, 1),

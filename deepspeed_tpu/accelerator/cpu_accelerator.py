@@ -19,6 +19,7 @@ class CPU_Accelerator(TPU_Accelerator):
     def __init__(self):
         super().__init__(platform="cpu")
         self._communication_backend_name = "xla-cpu"
+        self._peak_rss = 0  # fallback watermark for kernels without VmHWM
 
     def is_synchronized_device(self) -> bool:
         return True
@@ -28,14 +29,22 @@ class CPU_Accelerator(TPU_Accelerator):
 
     def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, int]:
         # PJRT CPU devices report no memory stats; fall back to /proc.
+        # Fields are parsed independently: sandboxed kernels (gVisor)
+        # omit VmHWM, and the watermark must then be tracked here rather
+        # than dropping BOTH numbers.
         try:
             with open("/proc/self/status") as f:
                 status = f.read()
             rss_kb = int(status.split("VmRSS:")[1].split()[0])
-            peak_kb = int(status.split("VmHWM:")[1].split()[0])
-            return {"bytes_in_use": rss_kb * 1024, "peak_bytes_in_use": peak_kb * 1024}
         except Exception:
             return {}
+        bytes_in_use = rss_kb * 1024
+        try:
+            peak = int(status.split("VmHWM:")[1].split()[0]) * 1024
+        except Exception:
+            self._peak_rss = max(self._peak_rss, bytes_in_use)
+            peak = self._peak_rss
+        return {"bytes_in_use": bytes_in_use, "peak_bytes_in_use": peak}
 
     def total_memory(self, device_index: Optional[int] = None) -> int:
         try:

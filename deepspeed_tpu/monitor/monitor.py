@@ -127,5 +127,16 @@ class MonitorMaster(Monitor):
         if jax.process_index() != 0:
             return
         for m in self.monitors:
-            if m.enabled:
+            if not m.enabled:
+                continue
+            try:
                 m.write_events(event_list)
+            except Exception as e:
+                # one broken backend (full disk, dead wandb socket) must
+                # degrade to disabled, not take down the train loop or
+                # starve the remaining backends
+                m.enabled = False
+                logger.warning(
+                    f"monitor backend {type(m).__name__} failed and was "
+                    f"disabled: {e}")
+        self.enabled = any(m.enabled for m in self.monitors)

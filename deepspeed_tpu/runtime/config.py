@@ -312,6 +312,49 @@ class FlopsProfilerConfig:
 
 
 @dataclass
+class TelemetryCaptureConfig:
+    """``"telemetry": {"capture": {...}}`` — budgeted XPlane auto-capture
+    windows post-processed into overlap reports (telemetry/capture.py)."""
+    enabled: bool = False
+    capture_step: int = 0          # force a window at this step (0 = off)
+    num_steps: int = 1             # steps per capture window
+    budget: int = 2                # max captures per process
+    regression_factor: float = 0.0  # arm when p95 > k × trailing median
+    window: int = 32               # trailing step-time samples consulted
+    output_dir: str = "./dstpu_telemetry"
+    device_substr: str = "TPU"     # plane filter for the overlap report
+
+
+@dataclass
+class TelemetryConfig:
+    """``"telemetry"`` block — the unified per-step telemetry layer
+    (telemetry/: StepRecord JSONL + Prometheus + monitor bridge +
+    auto-capture; see docs/OBSERVABILITY.md).
+
+    Enabling adds one hard host sync per recorded step (the record needs
+    the loss value); ``interval_steps`` thins that cost on TPU — an
+    off-interval step skips record assembly (sync included) entirely,
+    unless a regression-triggered capture needs every step time.
+    ``measure_flops`` pays one extra AOT compile of the train step at
+    the first recorded step (exact fused-program FLOPs); set False for
+    the free analytic estimate."""
+    enabled: bool = False
+    jsonl_path: str = ""           # append-only StepRecord log ("" = off)
+    prometheus_path: str = ""      # textfile-collector exposition ("" = off)
+    interval_steps: int = 1        # record every Nth step
+    window: int = 2048             # shared-histogram sliding window
+    peak_flops_per_sec: float = 0.0  # MFU denominator (0 = auto-detect)
+    measure_flops: bool = True     # profile_compiled; analytic fallback
+    capture: TelemetryCaptureConfig = field(
+        default_factory=TelemetryCaptureConfig)
+
+    def __post_init__(self):
+        if isinstance(self.capture, dict):
+            self.capture = _from_dict(TelemetryCaptureConfig, self.capture,
+                                      "telemetry.capture")
+
+
+@dataclass
 class CommsLoggerConfig:
     enabled: bool = False
     verbose: bool = False
@@ -471,6 +514,7 @@ class DeepSpeedConfig:
         self.flops_profiler = _from_dict(FlopsProfilerConfig, d.get(C.FLOPS_PROFILER), "flops_profiler")
         self.profiler = _from_dict(ProfilerConfig, d.get(C.PROFILER), "profiler")
         self.comms_logger = _from_dict(CommsLoggerConfig, d.get(C.COMMS_LOGGER), "comms_logger")
+        self.telemetry = _from_dict(TelemetryConfig, d.get(C.TELEMETRY), "telemetry")
         self.tensor_parallel = _from_dict(TensorParallelConfig, d.get(C.TENSOR_PARALLEL), "tensor_parallel")
         self.pipeline = _from_dict(PipelineConfig, d.get(C.PIPELINE), "pipeline")
         self.checkpoint_config = _from_dict(CheckpointConfig, d.get(C.CHECKPOINT), "checkpoint")

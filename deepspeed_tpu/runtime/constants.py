@@ -91,6 +91,7 @@ COMET = "comet"
 FLOPS_PROFILER = "flops_profiler"
 PROFILER = "profiler"
 COMMS_LOGGER = "comms_logger"
+TELEMETRY = "telemetry"  # unified telemetry layer (telemetry/)
 
 #############################################
 # Parallel topology (TPU mesh extension + reference keys)

@@ -561,6 +561,25 @@ class DeepSpeedEngine:
                                           steps_per_output=cfg.steps_per_print)
         self.monitor = self._build_monitor(cfg)
 
+        # -- unified telemetry (telemetry/; docs/OBSERVABILITY.md) -------
+        self.telemetry = None
+        self._last_batch_tokens = 0
+        if cfg.telemetry.enabled:
+            from deepspeed_tpu.telemetry import Telemetry
+            from deepspeed_tpu.utils.comms_logging import get_comms_logger
+
+            self.telemetry = Telemetry(cfg.telemetry, monitor=self.monitor)
+            # the comm-volume field of every StepRecord reads the global
+            # CommsLogger; telemetry implies recording even when the
+            # verbose comms_logger block is off.  The logger is process-
+            # global, so records carry the DELTA vs this baseline (a
+            # second engine in the same process must not inherit the
+            # first one's traffic) and destroy() restores the flag.
+            cl = get_comms_logger()
+            self._comms_prev_enabled = cl.enabled
+            cl.enabled = True
+            self._comms_baseline = cl.totals()
+
         # -- data efficiency: curriculum learning (seqlen truncation) ----
         # Ref: engine curriculum integration — batches are truncated to the
         # schedule's current difficulty; difficulty_step rounding bounds the
@@ -1037,6 +1056,11 @@ class DeepSpeedEngine:
         self._cancel_prefetch()
         if self._trace_profiler is not None:
             self._trace_profiler.close()  # flush a capture cut short
+        if self.telemetry is not None:
+            self.telemetry.close()  # flush jsonl + any in-flight capture
+            from deepspeed_tpu.utils.comms_logging import get_comms_logger
+
+            get_comms_logger().enabled = self._comms_prev_enabled
         if self._swap_pool is not None:
             self._swap_pool.shutdown(wait=True)
             self._swap_pool = None
@@ -1103,6 +1127,11 @@ class DeepSpeedEngine:
         return NamedSharding(self.topology.mesh, P(*spec))
 
     def _put_batch(self, batch: Batch, stacked: bool) -> Batch:
+        if stacked and "input_ids" in batch:
+            # token count for this train batch (telemetry tokens/s) —
+            # shape-only, so curriculum truncation is accounted exactly
+            self._last_batch_tokens = int(
+                np.prod(np.shape(batch["input_ids"])))
         out = {}
         for k, v in batch.items():
             if k == "dropout_key":
@@ -1258,14 +1287,114 @@ class DeepSpeedEngine:
     def train_batch(self, data) -> jnp.ndarray:
         """Run one full train batch (gas micro-batches + optimizer step).
         Ref: PipelineEngine.train_batch / engine forward+backward+step."""
+        tel = self.telemetry
+        cap = tel.capture if tel is not None else None
+        if cap is not None:
+            cap.on_step_start(self.global_steps + 1)
+        t0 = time.perf_counter()
         if self._trace_profiler is not None:
             step = self.global_steps + 1
             self._trace_profiler.maybe_start(step)
             with self._trace_profiler.step(step):
                 loss = self._train_batch_traced_body(data)
             self._trace_profiler.maybe_stop(self.global_steps + 1)
-            return loss
-        return self._train_batch_traced_body(data)
+        else:
+            loss = self._train_batch_traced_body(data)
+        if tel is not None:
+            self._emit_telemetry(tel, t0)
+            if cap is not None:
+                # next_step: global_steps already advanced in the body
+                cap.on_step_end(self.global_steps + 1)
+        return loss
+
+    # ------------------------------------------------------------------
+    # Telemetry (unified per-step StepRecord; telemetry/)
+    # ------------------------------------------------------------------
+    def _step_flops(self, step_args=None):
+        """FLOPs for one whole train batch on this device: XLA cost
+        analysis of the compiled step when args are at hand (exact for
+        the fused program), analytic model profile fallback.
+
+        profile_compiled pays one extra AOT compile (lower().compile()
+        does not share the jit dispatch cache) — once per process, at
+        the first recorded step; a flops_profiler run that already
+        measured is reused instead."""
+        prof = self._last_flops_profile
+        if prof and prof.get("flops"):
+            return float(prof["flops"]), "measured"
+        if step_args is not None and self.config.telemetry.measure_flops:
+            try:
+                from deepspeed_tpu.profiling.flops_profiler import \
+                    profile_compiled
+
+                prof = profile_compiled(self._train_step_jit, *step_args)
+                if prof.get("flops"):
+                    return float(prof["flops"]), "measured"
+            except Exception as e:
+                logger.warning(f"telemetry: profile_compiled failed "
+                               f"({e}); using the analytic profile")
+        if self.model_config is not None:
+            from deepspeed_tpu.profiling.flops_profiler import \
+                get_model_profile
+
+            prof = get_model_profile(
+                self.model_config, self.micro_batch_size,
+                getattr(self.model_config, "max_seq_len", 0),
+                recompute_fwd_factor=self.config.flops_profiler
+                .recompute_fwd_factor)
+            return (prof["total_flops_per_step"]
+                    * self.gradient_accumulation_steps_value, "analytic")
+        return 0.0, "none"
+
+    def _emit_telemetry(self, tel, t0: float) -> None:
+        """Assemble this step's StepRecord.  Fetching the loss value is a
+        hard host sync — the price of a record; off-interval steps skip
+        the whole assembly (sync included), except when a regression-
+        triggered capture needs every step time (tel.should_record)."""
+        if not tel.should_record(self.global_steps):
+            return
+        metrics = self._last_metrics
+        if not tel.is_full_record_step(self.global_steps):
+            # regression-trigger bookkeeping only (capture still has
+            # budget): sync so the wall time is real, feed the trailing
+            # window, skip record assembly and export
+            np.asarray(metrics["loss"])
+            tel.observe_step_time(time.perf_counter() - t0)
+            return
+        if tel.needs_flops():     # paths without step args: analytic
+            tel.set_flops(*self._step_flops(None))
+
+        def _f(key):
+            v = metrics.get(key)
+            return None if v is None else float(np.asarray(v))
+
+        loss = _f("loss")
+        wall = time.perf_counter() - t0
+        skipped = metrics.get("skipped")
+        tel.record_train_step(
+            step=self.global_steps, wall_time_s=wall,
+            tokens=self._last_batch_tokens, loss=loss,
+            grad_norm=_f("grad_norm"),
+            lr=float(self.lr_scheduler(self.global_steps - 1)),
+            loss_scale=_f("loss_scale"),
+            skipped=bool(np.asarray(skipped)) if skipped is not None
+            else False,
+            comm=self._comm_delta())
+
+    def _comm_delta(self):
+        """Comm volume since THIS engine's construction (the CommsLogger
+        is process-global; the raw cumulative totals would include a
+        previous engine's traffic)."""
+        from deepspeed_tpu.utils.comms_logging import get_comms_logger
+
+        out = {}
+        for op, cur in get_comms_logger().totals().items():
+            base = self._comms_baseline.get(op, {"count": 0, "bytes": 0})
+            count = cur["count"] - base["count"]
+            nbytes = cur["bytes"] - base["bytes"]
+            if count or nbytes:
+                out[op] = {"count": count, "bytes": nbytes}
+        return out
 
     def _train_batch_traced_body(self, data) -> jnp.ndarray:
         if self._onebit is not None:
@@ -1303,6 +1432,13 @@ class DeepSpeedEngine:
         else:
             opt_state = self._swap_in_opt_state()
             self._swap_in_params()
+            if self.telemetry is not None and self.telemetry.needs_flops():
+                # before the step runs, while donated buffers are still
+                # live (lowering reads their shapes); the compile() behind
+                # profile_compiled is a one-time AOT cost — see _step_flops
+                self.telemetry.set_flops(*self._step_flops(
+                    (self.params, opt_state, self.loss_scale_state,
+                     batch_stack, lr)))
             if profiling:
                 self._last_flops_profile = \
                     self._flops_profiler.profile_engine_step(

@@ -7,131 +7,138 @@ Glossary (the standard LLM-serving vocabulary; see docs/SERVING.md):
   the steady-state decode cadence one request observes.
 * **queue wait** — submit → admission into the SplitFuse scheduler.
 
-Everything is recorded under one lock (the serve loop is the writer; any
-thread may ``snapshot()``).  Distributions keep a bounded window of the
-most recent samples — a long-lived server must not grow without bound.
-Export goes through ``monitor.MonitorMaster`` as plain
-``(tag, value, step)`` events so TensorBoard/WandB/CSV all work unchanged.
+All primitives come from the shared ``telemetry.registry`` — the same
+Counter/Gauge/Histogram the training engine exports — so "p95" means
+the same thing on both hot loops and a ``MetricsRegistry`` can be
+shared with a :class:`telemetry.Telemetry` hub (serving tags then land
+in the same Prometheus exposition).  Histograms keep a bounded sliding
+window of recent samples — a long-lived server must not grow without
+bound.  Export goes through ``monitor.MonitorMaster`` as plain
+``(tag, value, step)`` events so TensorBoard/WandB/CSV all work
+unchanged.
 """
 
 from __future__ import annotations
 
-import threading
 import time
-from collections import deque
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
-import numpy as np
+from deepspeed_tpu.telemetry.registry import MetricsRegistry
 
 Event = Tuple[str, float, int]
 
 _WINDOW = 2048  # per-distribution sample cap
 
-
-def _percentiles(xs: Deque[float]) -> Dict[str, float]:
-    if not xs:
-        return {"p50": 0.0, "p95": 0.0, "mean": 0.0, "count": 0}
-    a = np.asarray(xs, np.float64)
-    return {"p50": float(np.percentile(a, 50)),
-            "p95": float(np.percentile(a, 95)),
-            "mean": float(a.mean()), "count": int(a.size)}
+# outcome name (record_finish) → counter attribute
+_OUTCOMES = ("completed", "failed", "cancelled", "expired")
 
 
 class ServingMetrics:
-    def __init__(self):
-        self._lock = threading.Lock()
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or MetricsRegistry()
+        reg = self.registry
         self._t0 = time.monotonic()
         # counters
-        self.submitted = 0
-        self.admitted = 0
-        self.completed = 0
-        self.failed = 0
-        self.cancelled = 0
-        self.expired = 0
-        self.rejected = 0
-        self.preemptions = 0
-        self.tokens_out = 0
-        self.steps = 0
+        self._c = {name: reg.counter(f"serving_{name}_total")
+                   for name in ("submitted", "admitted", "rejected",
+                                "preemptions", "tokens_out", "steps")
+                   + _OUTCOMES}
         # distributions (seconds)
-        self._ttft: Deque[float] = deque(maxlen=_WINDOW)
-        self._tpot: Deque[float] = deque(maxlen=_WINDOW)
-        self._queue_wait: Deque[float] = deque(maxlen=_WINDOW)
+        self._ttft = reg.histogram("serving_ttft_seconds",
+                                   "submit to first token", window=_WINDOW)
+        self._tpot = reg.histogram("serving_tpot_seconds",
+                                   "steady-state time per output token",
+                                   window=_WINDOW)
+        self._queue_wait = reg.histogram("serving_queue_wait_seconds",
+                                         "submit to admission",
+                                         window=_WINDOW)
         # gauges (set by the serve loop each iteration)
-        self.queue_depth = 0
-        self.active_requests = 0
-        self.kv_utilization = 0.0
+        self._g_queue_depth = reg.gauge("serving_queue_depth")
+        self._g_active = reg.gauge("serving_active_requests")
+        self._g_kv_util = reg.gauge("serving_kv_utilization")
+
+    # counter values read by the serve loop / tests
+    def _cv(self, name: str) -> int:
+        return int(self._c[name].value)
+
+    submitted = property(lambda self: self._cv("submitted"))
+    admitted = property(lambda self: self._cv("admitted"))
+    completed = property(lambda self: self._cv("completed"))
+    failed = property(lambda self: self._cv("failed"))
+    cancelled = property(lambda self: self._cv("cancelled"))
+    expired = property(lambda self: self._cv("expired"))
+    rejected = property(lambda self: self._cv("rejected"))
+    preemptions = property(lambda self: self._cv("preemptions"))
+    tokens_out = property(lambda self: self._cv("tokens_out"))
+    steps = property(lambda self: self._cv("steps"))
+    queue_depth = property(lambda self: int(self._g_queue_depth.value))
+    active_requests = property(lambda self: int(self._g_active.value))
+    kv_utilization = property(lambda self: self._g_kv_util.value)
 
     # -- recording (serve loop / submit path) ----------------------------
     def record_submit(self) -> None:
-        with self._lock:
-            self.submitted += 1
+        self._c["submitted"].inc()
 
     def record_reject(self) -> None:
-        with self._lock:
-            self.rejected += 1
+        self._c["rejected"].inc()
 
     def record_admit(self, queue_wait_s: float) -> None:
-        with self._lock:
-            self.admitted += 1
-            self._queue_wait.append(queue_wait_s)
+        self._c["admitted"].inc()
+        self._queue_wait.observe(queue_wait_s)
 
     def record_first_token(self, ttft_s: float) -> None:
-        with self._lock:
-            self._ttft.append(ttft_s)
+        self._ttft.observe(ttft_s)
 
     def record_tokens(self, n: int) -> None:
-        with self._lock:
-            self.tokens_out += n
+        self._c["tokens_out"].inc(n)
 
     def record_step(self) -> None:
-        with self._lock:
-            self.steps += 1
+        self._c["steps"].inc()
 
     def record_preemption(self) -> None:
-        with self._lock:
-            self.preemptions += 1
+        self._c["preemptions"].inc()
 
     def record_finish(self, outcome: str, n_tokens: int,
                       first_token_at: Optional[float],
                       finished_at: float) -> None:
         """``outcome``: completed | failed | cancelled | expired."""
-        with self._lock:
-            setattr(self, outcome, getattr(self, outcome) + 1)
-            if (outcome == "completed" and n_tokens > 1
-                    and first_token_at is not None):
-                self._tpot.append(
-                    (finished_at - first_token_at) / (n_tokens - 1))
+        if outcome not in _OUTCOMES:
+            raise ValueError(f"unknown outcome {outcome!r}")
+        self._c[outcome].inc()
+        if (outcome == "completed" and n_tokens > 1
+                and first_token_at is not None):
+            self._tpot.observe(
+                (finished_at - first_token_at) / (n_tokens - 1))
 
     def set_gauges(self, queue_depth: int, active: int,
                    kv_utilization: float) -> None:
-        with self._lock:
-            self.queue_depth = queue_depth
-            self.active_requests = active
-            self.kv_utilization = kv_utilization
+        self._g_queue_depth.set(queue_depth)
+        self._g_active.set(active)
+        self._g_kv_util.set(kv_utilization)
 
     # -- reading ---------------------------------------------------------
     def snapshot(self) -> Dict[str, object]:
-        with self._lock:
-            elapsed = max(time.monotonic() - self._t0, 1e-9)
-            return {
-                "submitted": self.submitted,
-                "admitted": self.admitted,
-                "completed": self.completed,
-                "failed": self.failed,
-                "cancelled": self.cancelled,
-                "expired": self.expired,
-                "rejected": self.rejected,
-                "preemptions": self.preemptions,
-                "tokens_out": self.tokens_out,
-                "steps": self.steps,
-                "tokens_per_sec": self.tokens_out / elapsed,
-                "queue_depth": self.queue_depth,
-                "active_requests": self.active_requests,
-                "kv_utilization": self.kv_utilization,
-                "ttft": _percentiles(self._ttft),
-                "tpot": _percentiles(self._tpot),
-                "queue_wait": _percentiles(self._queue_wait),
-            }
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        tokens_out = self.tokens_out
+        return {
+            "submitted": self.submitted,
+            "admitted": self.admitted,
+            "completed": self.completed,
+            "failed": self.failed,
+            "cancelled": self.cancelled,
+            "expired": self.expired,
+            "rejected": self.rejected,
+            "preemptions": self.preemptions,
+            "tokens_out": tokens_out,
+            "steps": self.steps,
+            "tokens_per_sec": tokens_out / elapsed,
+            "queue_depth": self.queue_depth,
+            "active_requests": self.active_requests,
+            "kv_utilization": self.kv_utilization,
+            "ttft": self._ttft.snapshot(),
+            "tpot": self._tpot.snapshot(),
+            "queue_wait": self._queue_wait.snapshot(),
+        }
 
     def events(self, step: int) -> List[Event]:
         """Flatten the snapshot into MonitorMaster events."""

@@ -92,11 +92,17 @@ class InferenceServer:
     """Continuous-batching serve loop owning one ``InferenceEngineV2``."""
 
     def __init__(self, engine: InferenceEngineV2,
-                 config: Optional[dict] = None, monitor: Any = None):
+                 config: Optional[dict] = None, monitor: Any = None,
+                 telemetry: Any = None):
         self.engine = engine
         self.cfg = ServerConfig(config)
         self.monitor = monitor
-        self.metrics = ServingMetrics()
+        # a telemetry.Telemetry hub: serving histograms register in ITS
+        # registry (one Prometheus exposition for both hot loops) and the
+        # loop emits kind="serving" StepRecords to the same JSONL
+        self.telemetry = telemetry
+        self.metrics = ServingMetrics(
+            registry=telemetry.registry if telemetry is not None else None)
         self.admission = AdmissionController(self.cfg.admission)
         self._active: Dict[int, GenerationRequest] = {}
         self._uid = itertools.count()
@@ -142,6 +148,9 @@ class InferenceServer:
             self._thread = None
         if self.monitor is not None:
             self.metrics.write_to(self.monitor, self.metrics.snapshot()["steps"])
+        if self.telemetry is not None:
+            self.telemetry.record_serving_step(self.metrics.steps,
+                                               self.metrics.snapshot())
         if self._loop_error is not None:
             raise RuntimeError("serve loop died") from self._loop_error
 
@@ -353,10 +362,13 @@ class InferenceServer:
             self._preempt_one()
             return
         self.metrics.record_step()
-        if (self.monitor is not None and self.cfg.metrics_interval_steps
-                and self.metrics.steps
+        if (self.cfg.metrics_interval_steps and self.metrics.steps
                 % self.cfg.metrics_interval_steps == 0):
-            self.metrics.write_to(self.monitor, self.metrics.steps)
+            if self.monitor is not None:
+                self.metrics.write_to(self.monitor, self.metrics.steps)
+            if self.telemetry is not None:
+                self.telemetry.record_serving_step(self.metrics.steps,
+                                                   self.metrics.snapshot())
         now = time.monotonic()
         for uid, out in results.items():
             req = self._active.get(uid)

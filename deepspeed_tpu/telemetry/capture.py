@@ -1,0 +1,199 @@
+"""Budgeted auto-capture windows + persisted per-capture overlap report.
+
+Closes the loop the ISSUE's motivation describes: instead of
+hand-driving XProf, a capture window arms itself — on a configured step,
+or when the step-time distribution regresses (p95 > k × trailing
+median) — records an XPlane trace via :class:`TraceProfiler`, and
+post-processes it with ``utils/xplane`` into a small JSON report:
+collective-overlap fraction (the T3/Domino "was the all-reduce hidden?"
+number), the top-10 device ops, and an MFU cross-check against the
+analytic StepRecord.
+
+Captures are budgeted (``budget`` per process) because a trace is not
+free: stop_trace hard-syncs the device and the XPlane file can be
+hundreds of MB at scale.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import deque
+from typing import Deque, Dict, Optional
+
+from deepspeed_tpu.utils.logging import logger
+from deepspeed_tpu.utils.trace import TraceProfiler
+
+
+def build_capture_report(logdir: str, device_substr: str = "TPU",
+                         step_record=None) -> Dict:
+    """Pure post-processing of one capture directory → report dict.
+
+    Degrades explicitly when the capture has no device planes (CPU runs
+    carry host events only): overlap_fraction pins to 0.0 with a note,
+    and the top-ops table falls back to host planes."""
+    from deepspeed_tpu.utils import xplane
+
+    report: Dict = {"logdir": logdir, "device_substr": device_substr,
+                    "overlap_fraction": 0.0, "devices": {},
+                    "top_ops": [], "note": ""}
+    try:
+        files = xplane.find_xplane_files(logdir)
+        if not files:
+            report["note"] = f"no xplane files under {logdir}"
+        else:
+            res = xplane.analyze_logdir(logdir,
+                                        device_substr=device_substr)
+            if "error" in res:
+                report["note"] = res["error"]
+            else:
+                report["overlap_fraction"] = res["mean_overlap_fraction"]
+                report["devices"] = res["devices"]
+            tops: Dict[str, Dict] = {}
+            for path in files:
+                for op in xplane.top_device_ops(
+                        xplane.load_xspace(path),
+                        device_substr=device_substr):
+                    agg = tops.setdefault(op["name"],
+                                          {"name": op["name"],
+                                           "total_ms": 0.0, "count": 0})
+                    agg["total_ms"] = round(
+                        agg["total_ms"] + op["total_ms"], 4)
+                    agg["count"] += op["count"]
+            report["top_ops"] = sorted(tops.values(),
+                                       key=lambda o: -o["total_ms"])[:10]
+    except Exception as e:  # a broken trace must not kill training
+        report["note"] = f"capture post-processing failed: {e!r}"
+    if step_record is not None:
+        # MFU cross-check: the analytic record's number next to what the
+        # capture actually saw, so a disagreement is one diff away
+        dev = next(iter(report["devices"].values()), {})
+        report["mfu_cross_check"] = {
+            "record_step": step_record.step,
+            "analytic_mfu": step_record.mfu,
+            "analytic_step_time_ms": step_record.wall_time_s * 1e3,
+            "flops_source": step_record.flops_source,
+            "capture_compute_ms": dev.get("compute_ms", 0.0),
+            "capture_collective_ms": dev.get("collective_ms", 0.0),
+        }
+    return report
+
+
+class AutoCapture:
+    """Arms TraceProfiler windows and persists per-capture reports.
+
+    Engine contract (mirrors the ``profiler`` block's TraceProfiler):
+
+        cap.on_step_start(step)      # before dispatching step `step`
+        ... run the step ...
+        cap.on_step_end(next_step)   # after; next_step = step + 1
+
+    Triggers: ``capture_step`` forces a window at that step; with
+    ``regression_factor`` k > 0, a window also arms when the step-time
+    p95 over the trailing window exceeds k × its median (needs at least
+    8 samples).  Each finished window writes
+    ``<output_dir>/capture_step<N>/report.json``.
+    """
+
+    MIN_SAMPLES = 8
+
+    def __init__(self, cfg, telemetry=None):
+        self.cfg = cfg
+        self.telemetry = telemetry
+        self.output_dir = cfg.output_dir
+        self.num_steps = max(1, int(cfg.num_steps))
+        self.budget_left = max(0, int(cfg.budget))
+        self.capture_step = int(cfg.capture_step)
+        self.regression_factor = float(cfg.regression_factor)
+        self.device_substr = getattr(cfg, "device_substr", "TPU")
+        self._times: Deque[float] = deque(maxlen=max(8, int(cfg.window)))
+        self._profiler: Optional[TraceProfiler] = None
+        self._armed_at = 0
+        self.reports: list = []   # report paths written this process
+
+    # -- trigger logic ---------------------------------------------------
+    def _regressed(self) -> bool:
+        if self.regression_factor <= 0 \
+                or len(self._times) < self.MIN_SAMPLES:
+            return False
+        xs = sorted(self._times)
+        median = xs[len(xs) // 2]
+        p95 = xs[min(len(xs) - 1, int(0.95 * (len(xs) - 1)))]
+        return median > 0 and p95 > self.regression_factor * median
+
+    def observe_step_time(self, wall_time_s: float) -> None:
+        self._times.append(float(wall_time_s))
+
+    # -- engine hooks ----------------------------------------------------
+    def on_step_start(self, step: int) -> None:
+        if self._profiler is not None or self.budget_left <= 0:
+            return
+        forced = self.capture_step and step == self.capture_step
+        if not forced and not self._regressed():
+            return
+        reason = "forced" if forced else "regression"
+        logdir = os.path.join(self.output_dir, f"capture_step{step}")
+        prof = TraceProfiler(logdir, start_step=step,
+                             num_steps=self.num_steps)
+        prof.maybe_start(step)
+        if not prof.active:   # another profiler owns the backend
+            return
+        self._profiler = prof
+        self._armed_at = step
+        self.budget_left -= 1
+        logger.info(f"telemetry capture: armed at step {step} "
+                    f"({reason}; {self.budget_left} capture(s) left)")
+
+    def on_step_end(self, next_step: int,
+                    wall_time_s: Optional[float] = None) -> None:
+        if wall_time_s is not None:
+            self.observe_step_time(wall_time_s)
+        prof = self._profiler
+        if prof is None:
+            return
+        prof.maybe_stop(next_step)
+        if prof.active:
+            return          # window spans more steps
+        self._profiler = None
+        self._write_report(prof.output_dir)
+
+    def _write_report(self, logdir: str) -> Optional[str]:
+        rec = self.telemetry.last_record if self.telemetry else None
+        if rec is not None and not (self._armed_at <= rec.step
+                                    < self._armed_at + self.num_steps):
+            # interval-thinned telemetry: the last record describes an
+            # OLDER step than the capture window — cross-checking the
+            # trace against it would report a phantom MFU disagreement
+            rec = None
+        report = build_capture_report(logdir,
+                                      device_substr=self.device_substr,
+                                      step_record=rec)
+        if rec is None and self.telemetry is not None:
+            report["note"] = (report["note"] + "; no StepRecord inside "
+                              "the capture window (interval-thinned "
+                              "telemetry) — mfu_cross_check omitted"
+                              ).lstrip("; ")
+        report["armed_at_step"] = self._armed_at
+        report["num_steps"] = self.num_steps
+        path = os.path.join(logdir, "report.json")
+        try:
+            os.makedirs(logdir, exist_ok=True)
+            with open(path, "w", encoding="utf-8") as f:
+                json.dump(report, f, indent=1, sort_keys=True)
+        except OSError as e:
+            logger.warning(f"telemetry capture: report write failed: {e}")
+            return None
+        logger.info(
+            f"telemetry capture: report at {path} "
+            f"(overlap_fraction={report['overlap_fraction']})")
+        self.reports.append(path)
+        return path
+
+    def close(self) -> None:
+        """Flush a window cut short by the end of training."""
+        prof = self._profiler
+        if prof is None:
+            return
+        self._profiler = None
+        prof.close()
+        self._write_report(prof.output_dir)

@@ -29,11 +29,19 @@ def _msg_size_bytes(x: Any) -> int:
 
 
 def calc_bw_log(comm_op: str, size_bytes: int, duration_s: float, n: int) -> Dict[str, float]:
-    """Algorithmic vs bus bandwidth, matching ref ``get_bw`` semantics."""
+    """Algorithmic vs bus bandwidth, matching ref ``get_bw`` semantics.
+
+    ``n <= 1`` (single device, or a degenerate world) is clamped to a
+    volume factor of 1.0: the ring formulas give 0 (all_reduce:
+    ``2(n-1)/n``) which used to zero out busbw — there is no inter-chip
+    traffic, so bus == algorithmic is the honest number, not 0."""
     if duration_s <= 0:
         return {"algbw_gbps": 0.0, "busbw_gbps": 0.0}
     algbw = size_bytes * 8 / duration_s / 1e9
-    if comm_op in ("all_reduce",):
+    n = max(int(n), 1)
+    if n == 1:
+        factor = 1.0
+    elif comm_op in ("all_reduce",):
         factor = 2 * (n - 1) / n
     elif comm_op in ("all_gather", "reduce_scatter", "all_to_all"):
         factor = (n - 1) / n
@@ -73,12 +81,28 @@ class CommsLogger:
         if self.verbose:
             log_dist(f"comm op: {op_name} | msg size: {size} B | axis: {axis}")
 
+    def totals(self) -> Dict[str, Dict[str, int]]:
+        """Cumulative per-op volume: {op: {"count": n, "bytes": b}} —
+        the exact numbers the telemetry StepRecord's comm field carries."""
+        out: Dict[str, Dict[str, int]] = {}
+        for op_name, sizes in self.comms_dict.items():
+            count = sum(c for c, _ in sizes.values())
+            total = sum(b for _, b in sizes.values())
+            out[op_name] = {"count": count, "bytes": total}
+        return out
+
     def log_summary(self) -> None:
-        """Ref: dist.log_summary (comm/comm.py:435)."""
+        """Ref: dist.log_summary (comm/comm.py:435).  Each op also gets a
+        TOTAL row so overall bytes-per-collective is readable without
+        summing message-size buckets by hand."""
         lines = ["Comm. Op            Message Size        Count       Total Bytes"]
+        totals = self.totals()
         for op_name, sizes in sorted(self.comms_dict.items()):
             for size, (count, total) in sorted(sizes.items()):
                 lines.append(f"{op_name:<20}{size:<20}{count:<12}{total}")
+            tot = totals[op_name]
+            lines.append(f"{op_name:<20}{'TOTAL':<20}"
+                         f"{tot['count']:<12}{tot['bytes']}")
         log_dist("\n".join(lines))
 
     def reset(self) -> None:

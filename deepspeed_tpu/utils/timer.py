@@ -69,12 +69,22 @@ class Timer:
         self._records = []
 
     def elapsed(self, reset: bool = True) -> float:
-        """Total elapsed seconds since last reset."""
+        """Total elapsed seconds since last reset.
+
+        Reading with ``reset=True`` while the timer is RUNNING must not
+        kill the in-flight interval: the accumulators clear, but the
+        timer stays started with its start time rebased to now (so the
+        eventual ``stop()`` records only the post-read remainder)."""
+        now = time.perf_counter()
         value = self._elapsed
         if self.started:
-            value += time.perf_counter() - self._start_time
+            value += now - self._start_time
         if reset:
+            was_running = self.started
             self.reset()
+            if was_running:
+                self.started = True
+                self._start_time = now
         return value
 
     def mean(self) -> float:

@@ -104,6 +104,35 @@ def overlap_fraction(collective: Sequence[Tuple[int, int]],
     return covered / total
 
 
+def top_device_ops(xspace, device_substr: str = "TPU",
+                   k: int = 10) -> List[Dict]:
+    """Top-k device ops by total self time across matching planes.
+
+    Aggregates leaf op-line events by metadata name; returns
+    ``[{"name", "total_ms", "count"}, ...]`` sorted by total time.  When
+    no plane matches ``device_substr`` (e.g. a CPU capture, host events
+    only), falls back to every plane that has op-shaped lines so the
+    caller still sees *something* — flagged by the caller, not here."""
+    totals: Dict[str, List[float]] = {}
+
+    def scan(plane) -> None:
+        meta = plane.event_metadata
+        op_lines = [ln for ln in plane.lines if "op" in ln.name.lower()]
+        for line in (op_lines or plane.lines):
+            for ev in line.events:
+                name = meta[ev.metadata_id].name
+                rec = totals.setdefault(name, [0.0, 0])
+                rec[0] += ev.duration_ps / 1e9  # ps → ms
+                rec[1] += 1
+
+    matched = [p for p in xspace.planes if device_substr in p.name]
+    for plane in (matched or xspace.planes):
+        scan(plane)
+    ranked = sorted(totals.items(), key=lambda kv: -kv[1][0])[:k]
+    return [{"name": n, "total_ms": round(t, 4), "count": c}
+            for n, (t, c) in ranked]
+
+
 def analyze_logdir(logdir: str, device_substr: str = "TPU") -> Dict:
     """Aggregate overlap stats over every device plane in a capture."""
     files = find_xplane_files(logdir)

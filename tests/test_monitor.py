@@ -1,0 +1,100 @@
+"""Monitor fan-out (monitor/monitor.py): CSV round-trip, rank gating,
+and MonitorMaster degrading a failing backend to disabled instead of
+raising into the train loop."""
+
+import csv
+import os
+
+import jax
+import pytest
+
+from deepspeed_tpu.monitor.monitor import CSVMonitor, MonitorMaster
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+
+def _cfg(tmp_path, **monitor_blocks):
+    return DeepSpeedConfig({"train_batch_size": 8, **monitor_blocks})
+
+
+def _csv_block(tmp_path):
+    return {"enabled": True, "output_path": str(tmp_path),
+            "job_name": "job"}
+
+
+def test_csv_monitor_rows_round_trip(tmp_path):
+    cfg = _cfg(tmp_path, csv_monitor=_csv_block(tmp_path)).csv_monitor
+    mon = CSVMonitor(cfg)
+    events = [("Train/loss", 1.5, 1), ("Train/loss", 1.25, 2),
+              ("Train/lr", 1e-3, 1)]
+    mon.write_events(events)
+    loss_file = os.path.join(str(tmp_path), "job", "Train_loss.csv")
+    with open(loss_file, newline="") as f:
+        rows = [(int(s), float(v)) for s, v in csv.reader(f)]
+    assert rows == [(1, 1.5), (2, 1.25)]
+    assert os.path.exists(os.path.join(str(tmp_path), "job",
+                                       "Train_lr.csv"))
+
+
+def test_non_rank0_writers_stay_silent(tmp_path, monkeypatch):
+    cfg = _cfg(tmp_path, csv_monitor=_csv_block(tmp_path)).csv_monitor
+    mon = CSVMonitor(cfg)          # constructed as rank 0 (makedirs ok)
+    monkeypatch.setattr(jax, "process_index", lambda *a: 1)
+    mon.write_events([("Train/loss", 1.0, 1)])
+    assert not os.path.exists(os.path.join(str(tmp_path), "job",
+                                           "Train_loss.csv"))
+
+
+def test_master_fans_out_only_to_enabled_backends(tmp_path):
+    ds_config = _cfg(tmp_path, csv_monitor=_csv_block(tmp_path))
+    master = MonitorMaster(ds_config)
+    assert master.enabled
+    assert len(master.monitors) == 1   # only the csv block was enabled
+    master.write_events([("Train/loss", 2.0, 1)])
+    with open(os.path.join(str(tmp_path), "job", "Train_loss.csv"),
+              newline="") as f:
+        assert list(csv.reader(f)) == [["1", "2.0"]]
+
+
+def test_master_all_disabled_is_inert(tmp_path):
+    master = MonitorMaster(_cfg(tmp_path))
+    assert not master.enabled
+    master.write_events([("Train/loss", 1.0, 1)])  # no-op, no crash
+
+
+class _ExplodingBackend:
+    enabled = True
+
+    def write_events(self, events):
+        raise RuntimeError("disk full")
+
+
+def test_master_degrades_failing_backend_to_disabled(tmp_path):
+    ds_config = _cfg(tmp_path, csv_monitor=_csv_block(tmp_path))
+    master = MonitorMaster(ds_config)
+    bad = _ExplodingBackend()
+    master.monitors.insert(0, bad)     # fails BEFORE the healthy backend
+    master.write_events([("Train/loss", 3.0, 7)])
+    # the failing backend is now off, the healthy one still wrote
+    assert bad.enabled is False
+    assert master.enabled              # csv survives
+    with open(os.path.join(str(tmp_path), "job", "Train_loss.csv"),
+              newline="") as f:
+        assert list(csv.reader(f)) == [["7", "3.0"]]
+    # a second write is clean (the dead backend is skipped)
+    master.write_events([("Train/loss", 4.0, 8)])
+    # all backends dead → master reports disabled
+    master2 = MonitorMaster(_cfg(tmp_path))
+    bad2 = _ExplodingBackend()
+    master2.monitors.append(bad2)
+    master2.enabled = True
+    master2.write_events([("x", 1.0, 1)])
+    assert master2.enabled is False
+
+
+def test_unknown_outcome_keys_rejected_by_csv_path(tmp_path):
+    """Tags with path separators must be sanitized into one file name,
+    not create directories."""
+    cfg = _cfg(tmp_path, csv_monitor=_csv_block(tmp_path)).csv_monitor
+    mon = CSVMonitor(cfg)
+    mon.write_events([("a/b/c", 1.0, 1)])
+    assert os.path.exists(os.path.join(str(tmp_path), "job", "a_b_c.csv"))

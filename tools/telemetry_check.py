@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Telemetry docs/schema lint (runs in the tier-1 suite via
+tests/test_telemetry.py, and standalone: ``python tools/telemetry_check.py``).
+
+Checks:
+1. every MonitorMaster tag the telemetry bridge or the serving metrics
+   can emit appears in docs/OBSERVABILITY.md;
+2. every Prometheus metric name the train/serving registries create
+   appears in the docs;
+3. the StepRecord JSONL schema is stable: ``schema: 1``, keys sorted in
+   the serialized line, and the top-level key set matches the frozen
+   list below (update EXPECTED_RECORD_KEYS *and the docs table* in the
+   same commit as any schema change).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCS = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+
+# frozen with schema version 1 — tools/telemetry_check.py is the tripwire
+EXPECTED_SCHEMA_VERSION = 1
+EXPECTED_RECORD_KEYS = [
+    "achieved_flops_per_sec", "comm", "flops_per_step", "flops_source",
+    "goodput", "grad_norm", "hbm", "kind", "loss", "loss_scale", "lr",
+    "mfu", "peak_flops_per_sec", "schema", "serving", "skipped", "step",
+    "tokens", "tokens_per_sec", "wall_time_s",
+]
+
+
+def _exported_monitor_tags() -> List[str]:
+    from deepspeed_tpu.serving.metrics import ServingMetrics
+    from deepspeed_tpu.telemetry import EXPORT_TAGS
+
+    serving_tags = [tag for tag, _, _ in ServingMetrics().events(0)]
+    return sorted(set(EXPORT_TAGS) | set(serving_tags))
+
+
+def _registry_metric_names() -> List[str]:
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    from deepspeed_tpu.serving.metrics import ServingMetrics
+    from deepspeed_tpu.telemetry import Telemetry
+
+    tel = Telemetry(TelemetryConfig(enabled=True))
+    ServingMetrics(registry=tel.registry)
+    return [m.name for m in tel.registry.collect()]
+
+
+def check_tags_documented(docs_path: str = DOCS) -> List[str]:
+    """Every exported tag / metric name must appear in the docs tables.
+    Suffix-flattened serving distribution tags (serving/ttft_p50 …) are
+    accepted via their documented `serving/ttft_*` wildcard row."""
+    errors = []
+    try:
+        with open(docs_path, "r", encoding="utf-8") as f:
+            docs = f.read()
+    except OSError as e:
+        return [f"cannot read {docs_path}: {e}"]
+    for tag in _exported_monitor_tags():
+        base = tag.rsplit("_", 1)[0]
+        if tag not in docs and f"{base}_*" not in docs:
+            errors.append(f"monitor tag {tag!r} not documented in "
+                          f"{os.path.basename(docs_path)}")
+    for name in _registry_metric_names():
+        if name not in docs:
+            errors.append(f"prometheus metric {name!r} not documented")
+    return errors
+
+
+def check_schema() -> List[str]:
+    """JSONL schema stability: versioned, sorted, frozen key set."""
+    from deepspeed_tpu.telemetry import StepRecord, record_keys
+
+    errors = []
+    rec = StepRecord(step=1, wall_time_s=0.5, tokens=100,
+                     flops_per_step=1e9, peak_flops_per_sec=1e12)
+    d = json.loads(rec.to_json())
+    if d.get("schema") != EXPECTED_SCHEMA_VERSION:
+        errors.append(f"schema field is {d.get('schema')!r}, expected "
+                      f"{EXPECTED_SCHEMA_VERSION}")
+    keys = list(d.keys())
+    if keys != sorted(keys):
+        errors.append("JSONL keys are not sorted in serialized output")
+    if sorted(keys) != EXPECTED_RECORD_KEYS:
+        errors.append(
+            "StepRecord key set drifted from the frozen schema: "
+            f"extra={sorted(set(keys) - set(EXPECTED_RECORD_KEYS))}, "
+            f"missing={sorted(set(EXPECTED_RECORD_KEYS) - set(keys))} — "
+            "bump SCHEMA_VERSION and update EXPECTED_RECORD_KEYS + docs")
+    if record_keys() != EXPECTED_RECORD_KEYS:
+        errors.append("telemetry.record.record_keys() disagrees with the "
+                      "frozen key list")
+    # mfu/goodput invariants the docs promise
+    if not (0.0 < d["mfu"] <= 1.0):
+        errors.append(f"sample record mfu {d['mfu']} outside (0, 1]")
+    return errors
+
+
+def run_all() -> List[str]:
+    return check_tags_documented() + check_schema()
+
+
+def main() -> int:
+    errors = run_all()
+    for e in errors:
+        print(f"telemetry_check: ERROR: {e}", file=sys.stderr)
+    if not errors:
+        print("telemetry_check: OK")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, REPO)
+    sys.exit(main())
