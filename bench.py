@@ -74,8 +74,23 @@ def _telemetry_jsonl(name: str) -> str:
     return os.path.join(out_dir, f"{name}.jsonl")
 
 
+def _trace_json(name: str) -> str:
+    """Per-row Chrome trace-event export (Perfetto-viewable span trace;
+    docs/OBSERVABILITY.md 'Tracing & flight recorder')."""
+    out_dir = os.environ.get("DSTPU_TELEMETRY_DIR", "./telemetry")
+    return os.path.join(out_dir, f"{name}.trace.json")
+
+
 def _telemetry_block(name: str) -> dict:
-    return {"enabled": True, "jsonl_path": _telemetry_jsonl(name)}
+    return {"enabled": True, "jsonl_path": _telemetry_jsonl(name),
+            "tracing": {"enabled": True, "trace_path": _trace_json(name)}}
+
+
+def _span_breakdown(tracer, names) -> dict:
+    """Per-phase span-time rollup for a row summary: {phase: total_ms}."""
+    summary = tracer.summary()
+    return {short: summary.get(name, {}).get("total_ms", 0.0)
+            for short, name in names.items()}
 
 
 def _fwd_flops_per_tok(model, seq):
@@ -126,6 +141,9 @@ def row_gpt2_350m():
     batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
     dt = _time_train(engine, batch, steps)
     tps = steps * rows * seq / dt
+    span_ms = _span_breakdown(engine.telemetry.tracer, {
+        "ingest": "train.data_ingest", "dispatch": "train.dispatch",
+        "sync": "train.sync"})
     engine.destroy()
     _reset_topology()
     # Baseline: GPT-2 350M-class on one A100, eager torch+DeepSpeed ZeRO-1,
@@ -136,6 +154,8 @@ def row_gpt2_350m():
         "vs_baseline": round(tps / 35_000.0, 3),
         "mfu": round(_mfu(tps, model, seq), 3),
         "telemetry_jsonl": _telemetry_jsonl("gpt2_350m"),
+        "trace_json": _trace_json("gpt2_350m"),
+        "span_ms": span_ms,
     }
 
 
@@ -195,6 +215,7 @@ def row_llama8b_class_zero3():
         "vs_baseline": round(tps / a100_tps, 3),
         "mfu": round(_mfu(tps, model, seq_eff), 3),
         "telemetry_jsonl": _telemetry_jsonl("llama8b_class_zero3"),
+        "trace_json": _trace_json("llama8b_class_zero3"),
     }
 
 
@@ -238,6 +259,7 @@ def _longseq_row(model, seed: int, label: str, steps: int = 3):
         "vs_baseline": round(mfu / 0.55, 3),
         "mfu": round(mfu, 3),
         "telemetry_jsonl": _telemetry_jsonl(f"longseq_{label}"),
+        "trace_json": _trace_json(f"longseq_{label}"),
     }
 
 
@@ -336,6 +358,7 @@ def _longseq_ring_body():
         "mfu": round(mfu, 3),
         "placement": "striped",
         "telemetry_jsonl": _telemetry_jsonl("longseq_ring"),
+        "trace_json": _trace_json("longseq_ring"),
     }
 
 
@@ -495,6 +518,7 @@ def row_peak_params():
         "vs_baseline": round(best["params_m"] / 6500.0, 3),
         "model": best["name"],
         "telemetry_jsonl": _telemetry_jsonl("peak_params"),
+        "trace_json": _trace_json("peak_params"),
     }
 
 
@@ -619,7 +643,8 @@ def row_serve_load():
     from deepspeed_tpu.telemetry import Telemetry
 
     tel = Telemetry(TelemetryConfig(
-        enabled=True, jsonl_path=_telemetry_jsonl("serve_load")))
+        enabled=True, jsonl_path=_telemetry_jsonl("serve_load"),
+        tracing={"enabled": True, "trace_path": _trace_json("serve_load")}))
     eng = InferenceEngineV2(model, eng_cfg)
     rng = np.random.default_rng(9)
     prompts = [rng.integers(0, model.vocab_size, size=(prompt_len,)).tolist()
@@ -648,12 +673,17 @@ def row_serve_load():
     dt = time.perf_counter() - t0
     srv.stop()
     snap = srv.metrics.snapshot()
+    span_ms = _span_breakdown(tel.tracer, {
+        "queue": "serve.queue_wait", "prefill": "serve.prefill",
+        "decode": "serve.decode"})
     tel.close()
     _reset_topology()
     tps = n_req * new / dt
     return {
         "metric": "serve_load_tokens_per_sec",
         "telemetry_jsonl": _telemetry_jsonl("serve_load"),
+        "trace_json": _trace_json("serve_load"),
+        "span_ms": span_ms,
         "value": round(tps, 1), "unit": "tokens/s",
         "vs_baseline": round(tps / batch_tps, 3),
         "ttft_p50_ms": round(snap["ttft"]["p50"] * 1e3, 1),

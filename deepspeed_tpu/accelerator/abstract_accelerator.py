@@ -233,10 +233,23 @@ class DeepSpeedAccelerator(abc.ABC):
             pass
 
     def range_pop(self) -> None:
+        stack = self._ranges()
+        if not stack:
+            # unbalanced pop: warn, don't crash — instrumented code paths
+            # with early returns hit this, and dying inside a profiling
+            # annotation would turn a bookkeeping slip into an outage.
+            # Warn once per process: a balanced hot loop whose pushes
+            # silently failed (range_push swallows errors) would
+            # otherwise flood the log every iteration
+            if not getattr(self, "_unbalanced_pop_warned", False):
+                self._unbalanced_pop_warned = True
+                from deepspeed_tpu.utils.logging import logger
+
+                logger.warning("range_pop: unbalanced pop — accelerator "
+                               "range stack is empty (warning once)")
+            return
         try:
-            stack = self._ranges()
-            if stack:
-                stack.pop().__exit__(None, None, None)
+            stack.pop().__exit__(None, None, None)
         except Exception:
             pass
 

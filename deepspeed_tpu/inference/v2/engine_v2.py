@@ -147,6 +147,11 @@ class InferenceEngineV2:
         self.scheduler = SplitFuseScheduler(self.state_manager,
                                             token_budget=self.cfg.max_ragged_batch_size)
         self._step_key = jax.random.PRNGKey(seed ^ 0x57E9)  # step() default
+        # software-span tracer (telemetry/tracing.py) — the serving layer
+        # injects both so ragged dispatches appear in the request trace
+        # under the serve loop's trace id instead of one-off orphan ids
+        self.tracer = None
+        self.trace_id = ""
 
         pages = self.cfg.num_blocks * self.cfg.block_size
         # [L, nkv, P, d]: kv-head-major so the paged-attention kernel's page
@@ -307,6 +312,22 @@ class InferenceEngineV2:
         run) when the step needs more KV pages than remain — preempt a
         victim and retry.
         """
+        tr = self.tracer
+        sp = None
+        if tr is not None and tr.enabled:
+            if not self.trace_id:   # standalone use: one stable id
+                self.trace_id = tr.new_trace_id()
+            sp = tr.span("v2.ragged_step", self.trace_id)
+        try:
+            return self._step_impl(temperature, key, top_k, top_p,
+                                   return_logits)
+        finally:
+            if sp is not None:
+                sp.end()
+
+    def _step_impl(self, temperature: float, key: Optional[Any],
+                   top_k: int, top_p: float,
+                   return_logits: bool) -> Dict[int, Any]:
         if return_logits:
             rb, logits = self._ragged_step([], [])
             if rb is None:

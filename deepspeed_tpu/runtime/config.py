@@ -326,10 +326,35 @@ class TelemetryCaptureConfig:
 
 
 @dataclass
+class TracingConfig:
+    """``"telemetry": {"tracing": {...}}`` — software request/step spans
+    (telemetry/tracing.py): host-side monotonic-clock spans exported as
+    Chrome trace-event JSON (Perfetto-viewable).  Disabled tracing costs
+    one attribute check per span site and allocates nothing."""
+    enabled: bool = False
+    trace_path: str = ""           # Chrome trace JSON, written at close()
+    max_events: int = 100_000      # bounded in-memory event buffer
+
+
+@dataclass
+class FlightConfig:
+    """``"telemetry": {"flight": {...}}`` — flight recorder + hang
+    watchdog (telemetry/flight.py): a ring of recent span events plus a
+    deadline watchdog that dumps all-thread stacks / ring / telemetry
+    snapshot bundles on stalls and crashes."""
+    enabled: bool = False
+    deadline_s: float = 60.0       # no heartbeat for this long => dump
+    poll_s: float = 0.0   # watchdog poll (0 = deadline/4, capped at 1s)
+    ring_size: int = 2048          # span-event ring capacity
+    output_dir: str = "./dstpu_flight"
+
+
+@dataclass
 class TelemetryConfig:
     """``"telemetry"`` block — the unified per-step telemetry layer
     (telemetry/: StepRecord JSONL + Prometheus + monitor bridge +
-    auto-capture; see docs/OBSERVABILITY.md).
+    auto-capture + span tracing + flight recorder; see
+    docs/OBSERVABILITY.md).
 
     Enabling adds one hard host sync per recorded step (the record needs
     the loss value); ``interval_steps`` thins that cost on TPU — an
@@ -347,11 +372,19 @@ class TelemetryConfig:
     measure_flops: bool = True     # profile_compiled; analytic fallback
     capture: TelemetryCaptureConfig = field(
         default_factory=TelemetryCaptureConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
+    flight: FlightConfig = field(default_factory=FlightConfig)
 
     def __post_init__(self):
         if isinstance(self.capture, dict):
             self.capture = _from_dict(TelemetryCaptureConfig, self.capture,
                                       "telemetry.capture")
+        if isinstance(self.tracing, dict):
+            self.tracing = _from_dict(TracingConfig, self.tracing,
+                                      "telemetry.tracing")
+        if isinstance(self.flight, dict):
+            self.flight = _from_dict(FlightConfig, self.flight,
+                                     "telemetry.flight")
 
 
 @dataclass

@@ -92,6 +92,9 @@ FLOPS_PROFILER = "flops_profiler"
 PROFILER = "profiler"
 COMMS_LOGGER = "comms_logger"
 TELEMETRY = "telemetry"  # unified telemetry layer (telemetry/)
+# sub-blocks of the telemetry config (runtime/config.py TelemetryConfig)
+TELEMETRY_TRACING = "tracing"  # software spans -> Chrome trace JSON
+TELEMETRY_FLIGHT = "flight"    # span ring + hang watchdog + crash bundles
 
 #############################################
 # Parallel topology (TPU mesh extension + reference keys)

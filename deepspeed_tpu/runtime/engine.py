@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import sys
 import time
 from functools import partial
 from typing import Any, Callable, Dict, Iterator, Optional, Tuple, Union
@@ -579,6 +580,25 @@ class DeepSpeedEngine:
             self._comms_prev_enabled = cl.enabled
             cl.enabled = True
             self._comms_baseline = cl.totals()
+        # -- software spans + hang watchdog (telemetry/tracing, flight) --
+        # one unconditional code path: without telemetry the NULL tracer
+        # answers every span call with the shared no-op singleton
+        from deepspeed_tpu.telemetry.tracing import NULL_TRACER
+
+        self._tracer = (self.telemetry.tracer if self.telemetry is not None
+                        else NULL_TRACER)
+        self._train_trace_id = (self._tracer.new_trace_id()
+                                if self._tracer.enabled else "")
+        self._step_span = None
+        # created here, armed per-step from train_batch: monitoring only
+        # covers time spent *inside* a step (eval/checkpoint gaps are
+        # legitimate silence), and this process's first train_batch is
+        # skipped so a >60s XLA compile doesn't write a spurious hang
+        # bundle — per-process, not global_steps, because a checkpoint
+        # resume restores global_steps yet still pays the full compile
+        self._compiled_step_done = False
+        self._watchdog = (self.telemetry.make_watchdog("train")
+                          if self.telemetry is not None else None)
 
         # -- data efficiency: curriculum learning (seqlen truncation) ----
         # Ref: engine curriculum integration — batches are truncated to the
@@ -699,7 +719,18 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # Compiled step functions
     # ------------------------------------------------------------------
+    def _watchdog_expect_compile(self) -> None:
+        """Disarm the hang watchdog for the remainder of the current step:
+        the caller just changed the compiled functions or traced shapes,
+        so this step legitimately pays a fresh XLA compile that can
+        exceed any sane stall deadline (same reasoning as the per-process
+        first-step skip in train_batch).  Re-armed at the next step."""
+        wd = getattr(self, "_watchdog", None)
+        if wd is not None:
+            wd.pause()
+
     def _compile_steps(self) -> None:
+        self._watchdog_expect_compile()
         cfg = self.config
         clip = cfg.gradient_clipping
         gas = self.gradient_accumulation_steps_value
@@ -1054,10 +1085,22 @@ class DeepSpeedEngine:
         leaves one speculative store read in flight (whose NVMe buffer
         stays pinned until consumed).  Ref DeepSpeedEngine.destroy."""
         self._cancel_prefetch()
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        if self.telemetry is not None and sys.exc_info()[0] is not None:
+            # destroy() running while an exception propagates (the usual
+            # `finally: engine.destroy()` after a crashed step): leave
+            # forensics behind — same bundle the hang watchdog writes.
+            # Deliberately conservative: exc_info is also set inside an
+            # `except:` handler that already recovered, so a handled-
+            # error teardown writes a (harmless) bundle too — a spare
+            # bundle is noise, a missing one on a real crash is not.
+            self.telemetry.dump_flight("engine_crash",
+                                       error=sys.exc_info()[1])
         if self._trace_profiler is not None:
             self._trace_profiler.close()  # flush a capture cut short
         if self.telemetry is not None:
-            self.telemetry.close()  # flush jsonl + any in-flight capture
+            self.telemetry.close()  # flush jsonl + trace + capture
             from deepspeed_tpu.utils.comms_logging import get_comms_logger
 
             get_comms_logger().enabled = self._comms_prev_enabled
@@ -1196,6 +1239,11 @@ class DeepSpeedEngine:
         if self.curriculum_scheduler is None or self._curriculum_type != "seqlen":
             return data
         seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps)
+        # a difficulty change means new traced shapes → an implicit XLA
+        # recompile at dispatch; don't let the watchdog count it as a stall
+        if getattr(self, "_last_curriculum_seqlen", None) not in (None, seqlen):
+            self._watchdog_expect_compile()
+        self._last_curriculum_seqlen = seqlen
 
         def trunc(batch):
             out = {}
@@ -1291,20 +1339,35 @@ class DeepSpeedEngine:
         cap = tel.capture if tel is not None else None
         if cap is not None:
             cap.on_step_start(self.global_steps + 1)
+        tr = self._tracer
+        self._step_span = sp = tr.span("train.step", self._train_trace_id)
+        if tr.enabled:
+            sp.set(step=self.global_steps + 1)
+        wd = self._watchdog
+        if wd is not None and self._compiled_step_done:
+            wd.resume()     # arm for this step (no-op deadline otherwise)
         t0 = time.perf_counter()
-        if self._trace_profiler is not None:
-            step = self.global_steps + 1
-            self._trace_profiler.maybe_start(step)
-            with self._trace_profiler.step(step):
+        try:
+            if self._trace_profiler is not None:
+                step = self.global_steps + 1
+                self._trace_profiler.maybe_start(step)
+                with self._trace_profiler.step(step):
+                    loss = self._train_batch_traced_body(data)
+                self._trace_profiler.maybe_stop(self.global_steps + 1)
+            else:
                 loss = self._train_batch_traced_body(data)
-            self._trace_profiler.maybe_stop(self.global_steps + 1)
-        else:
-            loss = self._train_batch_traced_body(data)
-        if tel is not None:
-            self._emit_telemetry(tel, t0)
-            if cap is not None:
-                # next_step: global_steps already advanced in the body
-                cap.on_step_end(self.global_steps + 1)
+            if tel is not None:
+                self._emit_telemetry(tel, t0)
+                if cap is not None:
+                    # next_step: global_steps already advanced in the body
+                    cap.on_step_end(self.global_steps + 1)
+        finally:
+            sp.end()
+            self._step_span = None
+            if wd is not None:
+                wd.beat()
+                wd.pause()  # inter-step time is not a stall
+        self._compiled_step_done = True
         return loss
 
     # ------------------------------------------------------------------
@@ -1358,7 +1421,9 @@ class DeepSpeedEngine:
             # regression-trigger bookkeeping only (capture still has
             # budget): sync so the wall time is real, feed the trailing
             # window, skip record assembly and export
-            np.asarray(metrics["loss"])
+            with self._tracer.span("train.sync", self._train_trace_id,
+                                   self._step_span):
+                np.asarray(metrics["loss"])
             tel.observe_step_time(time.perf_counter() - t0)
             return
         if tel.needs_flops():     # paths without step args: analytic
@@ -1368,18 +1433,24 @@ class DeepSpeedEngine:
             v = metrics.get(key)
             return None if v is None else float(np.asarray(v))
 
-        loss = _f("loss")
+        with self._tracer.span("train.sync", self._train_trace_id,
+                               self._step_span):
+            # fetching the loss VALUE is the hard host sync — its span is
+            # the "how much overlap did the record cost" number
+            loss = _f("loss")
         wall = time.perf_counter() - t0
         skipped = metrics.get("skipped")
-        tel.record_train_step(
-            step=self.global_steps, wall_time_s=wall,
-            tokens=self._last_batch_tokens, loss=loss,
-            grad_norm=_f("grad_norm"),
-            lr=float(self.lr_scheduler(self.global_steps - 1)),
-            loss_scale=_f("loss_scale"),
-            skipped=bool(np.asarray(skipped)) if skipped is not None
-            else False,
-            comm=self._comm_delta())
+        with self._tracer.span("train.telemetry", self._train_trace_id,
+                               self._step_span):
+            tel.record_train_step(
+                step=self.global_steps, wall_time_s=wall,
+                tokens=self._last_batch_tokens, loss=loss,
+                grad_norm=_f("grad_norm"),
+                lr=float(self.lr_scheduler(self.global_steps - 1)),
+                loss_scale=_f("loss_scale"),
+                skipped=bool(np.asarray(skipped)) if skipped is not None
+                else False,
+                comm=self._comm_delta())
 
     def _comm_delta(self):
         """Comm volume since THIS engine's construction (the CommsLogger
@@ -1406,10 +1477,12 @@ class DeepSpeedEngine:
         self._maybe_recompile_compression()
         self.tput_timer.start()
         self.timers(TRAIN_BATCH_TIMER).start()
-        batch_stack = self._stack_micro_batches(data)
-        batch_stack = self._maybe_add_pld(batch_stack)
-        batch_stack = self._maybe_add_dropout_key(batch_stack)
-        batch_stack = self._put_batch(batch_stack, stacked=True)
+        with self._tracer.span("train.data_ingest", self._train_trace_id,
+                               self._step_span):
+            batch_stack = self._stack_micro_batches(data)
+            batch_stack = self._maybe_add_pld(batch_stack)
+            batch_stack = self._maybe_add_dropout_key(batch_stack)
+            batch_stack = self._put_batch(batch_stack, stacked=True)
         lr = jnp.float32(self.lr_scheduler(self.global_steps))
         profiling = (self._flops_profiler is not None
                      and not self._flops_profiler.profile_done
@@ -1422,12 +1495,14 @@ class DeepSpeedEngine:
             # NVMe/host transfer drains while the device computes, so step
             # time approaches max(compute, transfer) instead of the sum.
             self._swap_in_params()
-            loss, grads = self._grads_batch_store_jit(
-                self.params, batch_stack, self.loss_scale_state["scale"])
-            opt_state = self._swap_in_opt_state()
-            self.params, opt_state, self.loss_scale_state, metrics = \
-                self._apply_step_jit(self.params, opt_state,
-                                     self.loss_scale_state, grads, lr)
+            with self._tracer.span("train.dispatch", self._train_trace_id,
+                                   self._step_span):
+                loss, grads = self._grads_batch_store_jit(
+                    self.params, batch_stack, self.loss_scale_state["scale"])
+                opt_state = self._swap_in_opt_state()
+                self.params, opt_state, self.loss_scale_state, metrics = \
+                    self._apply_step_jit(self.params, opt_state,
+                                         self.loss_scale_state, grads, lr)
             metrics = {**metrics, "loss": loss}
         else:
             opt_state = self._swap_in_opt_state()
@@ -1445,9 +1520,12 @@ class DeepSpeedEngine:
                         self, self.params, opt_state, self.loss_scale_state,
                         batch_stack, lr)
                 self._flops_profiler.print_profile(self._last_flops_profile)
-            self.params, opt_state, self.loss_scale_state, metrics = \
-                self._train_step_jit(self.params, opt_state,
-                                     self.loss_scale_state, batch_stack, lr)
+            with self._tracer.span("train.dispatch", self._train_trace_id,
+                                   self._step_span):
+                self.params, opt_state, self.loss_scale_state, metrics = \
+                    self._train_step_jit(self.params, opt_state,
+                                         self.loss_scale_state, batch_stack,
+                                         lr)
         self._swap_out_opt_state(opt_state)
         self._swap_out_params()
         self._prefetch_stores()

@@ -68,17 +68,30 @@ class AdmissionController:
         self._lock = threading.Condition()
         self._queue: Deque[GenerationRequest] = deque()
         self._closed = False
+        # set by the server when tracing is enabled: the blocking-offer
+        # wait is a real request phase (serve.admission_block spans)
+        self.tracer = None
 
     # -- producer side ---------------------------------------------------
     def offer(self, req: GenerationRequest,
               timeout: Optional[float] = None) -> None:
         """Enqueue or shed load per the queue policy."""
         with self._lock:
-            if self.cfg.queue_policy == "block":
+            if self.cfg.queue_policy == "block" \
+                    and len(self._queue) >= self.cfg.max_queue_size \
+                    and not self._closed:
+                tr = self.tracer
+                sp = (tr.span("serve.admission_block", req.trace_id)
+                      if tr is not None and tr.enabled else None)
                 ok = self._lock.wait_for(
                     lambda: self._closed
                     or len(self._queue) < self.cfg.max_queue_size,
                     timeout)
+                if sp is not None:
+                    # close() also satisfies the wait predicate, but a
+                    # closed queue rejects below — that is not admission
+                    sp.end(uid=req.uid,
+                           admitted=bool(ok) and not self._closed)
                 if not ok:
                     raise QueueFull(
                         f"queue full ({self.cfg.max_queue_size}) after "

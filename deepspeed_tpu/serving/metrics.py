@@ -41,7 +41,8 @@ class ServingMetrics:
         # counters
         self._c = {name: reg.counter(f"serving_{name}_total")
                    for name in ("submitted", "admitted", "rejected",
-                                "preemptions", "tokens_out", "steps")
+                                "preemptions", "tokens_out", "steps",
+                                "flight_dumps")
                    + _OUTCOMES}
         # distributions (seconds)
         self._ttft = reg.histogram("serving_ttft_seconds",
@@ -71,6 +72,7 @@ class ServingMetrics:
     preemptions = property(lambda self: self._cv("preemptions"))
     tokens_out = property(lambda self: self._cv("tokens_out"))
     steps = property(lambda self: self._cv("steps"))
+    flight_dumps = property(lambda self: self._cv("flight_dumps"))
     queue_depth = property(lambda self: int(self._g_queue_depth.value))
     active_requests = property(lambda self: int(self._g_active.value))
     kv_utilization = property(lambda self: self._g_kv_util.value)
@@ -97,6 +99,11 @@ class ServingMetrics:
 
     def record_preemption(self) -> None:
         self._c["preemptions"].inc()
+
+    def record_flight_dump(self) -> None:
+        """A flight-recorder bundle was written for this server (watchdog
+        fire or crash handler) — the ops-alert counter."""
+        self._c["flight_dumps"].inc()
 
     def record_finish(self, outcome: str, n_tokens: int,
                       first_token_at: Optional[float],
@@ -129,6 +136,7 @@ class ServingMetrics:
             "expired": self.expired,
             "rejected": self.rejected,
             "preemptions": self.preemptions,
+            "flight_dumps": self.flight_dumps,
             "tokens_out": tokens_out,
             "steps": self.steps,
             "tokens_per_sec": tokens_out / elapsed,

@@ -17,7 +17,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Iterator, List, Optional
+from typing import Any, Iterator, List, Optional
 
 
 class ServingError(RuntimeError):
@@ -68,6 +68,12 @@ class GenerationRequest:
     # prompt + generated-so-far; rebuilt as the re-prefill prompt after a
     # preemption (recompute-style: KV is rebuilt, not migrated)
     tokens: List[int] = field(default_factory=list)
+    # distributed-tracing identity: every span this request emits shares
+    # this id ("" = tracing disabled; see telemetry/tracing.py).  The
+    # span handles are serve-loop-internal (only it starts/ends them).
+    trace_id: str = ""
+    span_request: Any = None       # root span: enqueue -> terminal
+    span_phase: Any = None         # current phase: queue_wait|prefill|decode
 
     def __post_init__(self):
         self.prompt = [int(t) for t in self.prompt]
@@ -100,6 +106,9 @@ class ResponseStream:
 
     def __init__(self, uid: int):
         self.uid = uid
+        # set by the server at submit when tracing is enabled, so callers
+        # can cross-link their stream to the exported Perfetto trace
+        self.trace_id = ""
         self._cond = threading.Condition()
         self._tokens: List[int] = []
         self._done = False

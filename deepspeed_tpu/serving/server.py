@@ -47,6 +47,9 @@ from deepspeed_tpu.serving.request import (DeadlineExceeded,
                                            GenerationRequest,
                                            RequestCancelled, ResponseStream,
                                            SamplingParams, ServingError)
+from deepspeed_tpu.telemetry.flight import (Watchdog, dump_bundle,
+                                            make_span_recorder,
+                                            make_watchdog)
 from deepspeed_tpu.utils.logging import log_dist
 
 
@@ -86,6 +89,12 @@ class ServerConfig:
         # export metrics through `monitor` every N engine steps (0 = only
         # at stop()); the monitor is any object with write_events()
         self.metrics_interval_steps = int(d.get("metrics_interval_steps", 0))
+        # standalone span tracing / flight recorder (same keys as the
+        # engine's telemetry.tracing / telemetry.flight blocks); ignored
+        # when a telemetry hub is passed — the hub's tracer/ring win so
+        # train + serve spans land in ONE trace file
+        self.tracing = dict(d.get("tracing", {}))
+        self.flight = dict(d.get("flight", {}))
 
 
 class InferenceServer:
@@ -104,6 +113,50 @@ class InferenceServer:
         self.metrics = ServingMetrics(
             registry=telemetry.registry if telemetry is not None else None)
         self.admission = AdmissionController(self.cfg.admission)
+        # -- spans + flight recorder (telemetry/tracing.py, flight.py) --
+        # one hub predicate (`telemetry is not None`) at every site — it
+        # must agree with stop()'s standalone-trace-export gate or a hub
+        # that took this branch would record spans nobody exports
+        if telemetry is not None:
+            self.tracer = telemetry.tracer
+            self._flight_ring = telemetry.flight_ring
+        else:
+            # same bootstrap rule as the Telemetry hub (one shared
+            # factory: flight alone also enables span recording so
+            # bundle rings are populated)
+            self.tracer, self._flight_ring = make_span_recorder(
+                tracing_enabled=self.cfg.tracing.get("enabled", False),
+                flight_enabled=self.cfg.flight.get("enabled", False),
+                max_events=self.cfg.tracing.get("max_events", 0),
+                ring_size=self.cfg.flight.get("ring_size", 0))
+        self.admission.tracer = self.tracer
+        # trace export gated on the tracing block itself (flight-only
+        # configs record spans for the ring but write no trace file)
+        self._trace_path = (str(self.cfg.tracing.get("trace_path", ""))
+                            if self.cfg.tracing.get("enabled") else "")
+        self._loop_trace_id = (self.tracer.new_trace_id()
+                               if self.tracer.enabled else "")
+        self._watchdog: Optional[Watchdog] = None
+        self._flight_dir: Optional[str] = None
+        # the watchdog skips this process's first engine.step (jit
+        # compile time is not a stall) — see _step_once
+        self._first_engine_step_done = False
+        if telemetry is not None:
+            # hub present: its flight block decides, server blocks are
+            # ignored end-to-end — building a watchdog from the server's
+            # flight config here would pair it with the hub's (possibly
+            # disabled) tracer and dump forever-empty rings
+            self._watchdog = telemetry.make_watchdog("serve")
+            if self._watchdog is not None:
+                self._flight_dir = self._watchdog.output_dir
+        else:
+            # same factory as the hub: falsy config values (deadline_s 0,
+            # empty output_dir) must fall back identically on both paths
+            self._watchdog = make_watchdog(
+                "serve", self.cfg.flight, ring=self._flight_ring,
+                telemetry=telemetry, tracer=self.tracer)
+            if self._watchdog is not None:
+                self._flight_dir = self._watchdog.output_dir
         self._active: Dict[int, GenerationRequest] = {}
         self._uid = itertools.count()
         self._uid_lock = threading.Lock()
@@ -128,6 +181,15 @@ class InferenceServer:
             # QueueFull — fail loudly instead of running dead
             raise RuntimeError(
                 "server already stopped; create a new InferenceServer")
+        if self._watchdog is not None:
+            self._watchdog.on_fire = \
+                lambda _bundle: self.metrics.record_flight_dump()
+            self._watchdog.start()
+        # the engine annotates its ragged dispatch into the same trace,
+        # chained to this loop's trace id
+        if hasattr(self.engine, "tracer"):
+            self.engine.tracer = self.tracer
+            self.engine.trace_id = self._loop_trace_id
         self._thread = threading.Thread(target=self._serve_loop,
                                         name="ds-serve-loop", daemon=True)
         self._thread.start()
@@ -135,17 +197,45 @@ class InferenceServer:
 
     def stop(self, drain: bool = True, timeout: Optional[float] = None) -> None:
         """Stop the loop.  ``drain=True`` finishes all queued + running
-        requests first; ``drain=False`` cancels them."""
+        requests first; ``drain=False`` cancels them.
+
+        Fail-fast contract: a crashed loop must not make a draining
+        ``stop()`` wait out the full timeout — the join polls, and the
+        moment ``_loop_error`` is set (the crash handler records it
+        FIRST, before any cleanup that might itself wedge on the broken
+        engine) the wait collapses to a short grace period and the loop
+        error is raised, chained."""
         self.admission.close()
         self._stop_requested = True
         if not drain:
             self._abort = True
-        if self._thread is not None:
-            self._thread.join(timeout)
-            if self._thread.is_alive():
-                raise TimeoutError(f"serve loop still running after "
-                                   f"{timeout}s (drain={drain})")
+        thread = self._thread
+        if thread is not None:
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            while thread.is_alive():
+                if self._loop_error is not None:
+                    # dead loop: give its crash handler a short grace to
+                    # terminate the streams, then surface the error
+                    # below instead of waiting out the drain timeout
+                    thread.join(1.0)
+                    break
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise TimeoutError(f"serve loop still running after "
+                                       f"{timeout}s (drain={drain})")
+                thread.join(0.05)
             self._thread = None
+        if self._watchdog is not None:
+            self._watchdog.stop()
+        if (self.telemetry is None and self._trace_path
+                and self.tracer.enabled):
+            # standalone tracer: nobody else will flush the trace file
+            # (with a hub, Telemetry.close() owns the export)
+            try:
+                self.tracer.export_chrome_trace(self._trace_path)
+            except OSError as e:
+                log_dist(f"serving: trace export failed: {e}",
+                         level="warning")
         if self.monitor is not None:
             self.metrics.write_to(self.monitor, self.metrics.snapshot()["steps"])
         if self.telemetry is not None:
@@ -200,11 +290,23 @@ class InferenceServer:
             stream=ResponseStream(uid), priority=priority,
             deadline=(None if deadline_s is None
                       else time.monotonic() + deadline_s))
+        tr = self.tracer
+        if tr.enabled:
+            req.trace_id = req.stream.trace_id = tr.new_trace_id()
+            req.span_request = tr.span("serve.request", req.trace_id).set(
+                uid=uid, prompt_tokens=len(req.prompt),
+                max_new_tokens=params.max_new_tokens)
+            tr.instant("serve.enqueue", req.trace_id, uid=uid)
+            req.span_phase = tr.span("serve.queue_wait", req.trace_id,
+                                     req.span_request)
         self.metrics.record_submit()
         try:
             self.admission.offer(req, timeout=timeout)
         except ServingError:
             self.metrics.record_reject()
+            if req.span_request is not None:
+                req.span_phase.end(rejected=True)
+                req.span_request.end(outcome="rejected")
             raise
         return req.stream
 
@@ -222,8 +324,11 @@ class InferenceServer:
 
     # -- serve loop ------------------------------------------------------
     def _serve_loop(self) -> None:
+        wd = self._watchdog
         try:
             while True:
+                if wd is not None:
+                    wd.beat()
                 if self._abort:
                     self._fail_everything(
                         RequestCancelled("server shutdown"))
@@ -241,12 +346,32 @@ class InferenceServer:
                 else:
                     self.admission.wait_for_work(self.cfg.idle_wait_s)
         except BaseException as e:  # never die silently: fail the streams
+            # error FIRST: stop() fail-fasts on this flag, and the
+            # cleanup below may itself wedge on the broken engine
             self._loop_error = e
-            # close FIRST: a submit() racing the cleanup must get
+            # close next: a submit() racing the cleanup must get
             # QueueFull, not an accepted request nobody will ever serve
             self.admission.close()
-            self._fail_everything(ServingError(f"serve loop died: {e!r}"))
+            if wd is not None:
+                # a dead loop stops beating by definition — silence the
+                # watchdog so the crash isn't double-reported as a stall
+                wd.pause()
             log_dist(f"serving: loop crashed: {e!r}", level="error")
+            self._dump_flight("serve_crash", e)
+            self._fail_everything(ServingError(f"serve loop died: {e!r}"))
+
+    def _dump_flight(self, reason: str,
+                     error: Optional[BaseException] = None) -> None:
+        """Crash forensics: ring + stacks + telemetry snapshot bundle
+        (no flight config ⇒ no-op)."""
+        if self._flight_dir is None:
+            return
+        try:
+            dump_bundle(self._flight_dir, reason, ring=self._flight_ring,
+                        telemetry=self.telemetry, error=error)
+            self.metrics.record_flight_dump()
+        except Exception:
+            pass  # forensics must never mask the original failure
 
     def _fail_everything(self, err: ServingError) -> None:
         for req in self.admission.drain():
@@ -327,6 +452,14 @@ class InferenceServer:
                       front=req.preemptions > 0)
             first_admission = req.admitted_at is None
             req.admitted_at = now
+            if req.span_phase is not None:
+                # queue_wait (or post-preemption requeue wait) ends here;
+                # the prefill phase runs until this request's next token
+                req.span_phase.end()
+                req.span_phase = self.tracer.span(
+                    "serve.prefill", req.trace_id, req.span_request).set(
+                        uid=req.uid, tokens=len(req.tokens),
+                        readmission=not first_admission)
             self._rngs.setdefault(
                 req.uid, np.random.default_rng(req.params.seed))
             if first_admission:
@@ -353,14 +486,41 @@ class InferenceServer:
                 and len(self._active) > 1):
             self._preempt_one()  # floor hit: shed proactively
         all_greedy = all(r.params.greedy for r in self._active.values())
+        tr = self.tracer
+        step_span = tr.span("serve.step", self._loop_trace_id)
+        if tr.enabled:
+            step_span.set(n_active=len(self._active), greedy=all_greedy)
+        # the first engine.step of the process pays the jit compile,
+        # which can legitimately exceed any sane stall deadline — keep
+        # the watchdog disarmed for it (same per-process rule as the
+        # train engine's first-step skip)
+        warm = not self._first_engine_step_done
+        if warm and self._watchdog is not None:
+            self._watchdog.pause()
         try:
-            if all_greedy:
-                results = self.engine.step(temperature=0.0)
-            else:
-                results = self.engine.step(return_logits=True)
+            try:
+                if all_greedy:
+                    results = self.engine.step(temperature=0.0)
+                else:
+                    results = self.engine.step(return_logits=True)
+                # only a step that actually ran proves the compile is
+                # behind us — KVCacheExhausted rolls back with nothing
+                # run, so the retry still pays the first jit compile and
+                # must keep the watchdog disarmed for it
+                self._first_engine_step_done = True
+            finally:
+                if warm and self._watchdog is not None:
+                    self._watchdog.resume()
         except KVCacheExhausted:
+            step_span.end(kv_exhausted=True)
             self._preempt_one()
             return
+        except BaseException:
+            # close the span before the crash handler runs so the dying
+            # step is present in the flight ring it dumps
+            step_span.end(crashed=True)
+            raise
+        step_span.end()
         self.metrics.record_step()
         if (self.cfg.metrics_interval_steps and self.metrics.steps
                 % self.cfg.metrics_interval_steps == 0):
@@ -381,7 +541,18 @@ class InferenceServer:
             if req.n_generated == 1:
                 req.first_token_at = now
                 self.metrics.record_first_token(now - req.submitted_at)
+                if req.span_request is not None:
+                    tr.instant("serve.first_token", req.trace_id, uid=uid)
+            if (req.span_phase is not None
+                    and req.span_phase.name == "serve.prefill"):
+                # prefill → decode at this request's first token of the
+                # current admission (re-prefills transition here too)
+                req.span_phase.end()
+                req.span_phase = tr.span("serve.decode", req.trace_id,
+                                         req.span_request).set(uid=uid)
             req.stream._put_token(tok)
+            if req.span_request is not None:
+                tr.instant("serve.emit", req.trace_id, uid=uid, token=tok)
             eos_hit = (req.params.eos_token_id is not None
                        and tok == req.params.eos_token_id)
             if eos_hit or req.remaining <= 0:
@@ -412,6 +583,16 @@ class InferenceServer:
         victim.tokens = tokens
         victim.preemptions += 1
         del self._active[victim.uid]
+        if victim.span_request is not None:
+            self.tracer.instant("serve.preempt", victim.trace_id,
+                                uid=victim.uid,
+                                n_generated=victim.n_generated)
+            if victim.span_phase is not None:
+                victim.span_phase.end(preempted=True)
+            # back to waiting: the requeue wait is queue time again
+            victim.span_phase = self.tracer.span(
+                "serve.queue_wait", victim.trace_id, victim.span_request
+            ).set(uid=victim.uid, after_preemption=True)
         self.admission.requeue_front(victim)
         self.metrics.record_preemption()
         log_dist(f"serving: preempted uid {victim.uid} "
@@ -428,6 +609,16 @@ class InferenceServer:
         self.metrics.record_finish(outcome, req.n_generated,
                                    getattr(req, "first_token_at", None), now)
         self._rngs.pop(req.uid, None)
+        if req.span_phase is not None:
+            req.span_phase.end()
+            req.span_phase = None
+        if req.span_request is not None:
+            self.tracer.instant("serve.finish", req.trace_id, uid=req.uid,
+                                outcome=outcome)
+            req.span_request.end(outcome=outcome,
+                                 generated=req.n_generated,
+                                 preemptions=req.preemptions)
+            req.span_request = None
         req.stream._finish(error)
 
     def _update_gauges(self) -> None:

@@ -12,12 +12,18 @@ from deepspeed_tpu.telemetry.export import (EXPORT_TAGS, JsonlExporter,
                                             Telemetry, events_from_record,
                                             read_jsonl, render_prometheus,
                                             write_prometheus_textfile)
+from deepspeed_tpu.telemetry.flight import (FLIGHT_REASONS, FlightRecorder,
+                                            Watchdog, dump_bundle,
+                                            make_span_recorder)
 from deepspeed_tpu.telemetry.record import (SCHEMA_VERSION, StepRecord,
                                             collect_hbm_stats,
                                             detect_peak_flops_per_sec,
                                             record_keys)
 from deepspeed_tpu.telemetry.registry import (Counter, Gauge, Histogram,
                                               MetricsRegistry)
+from deepspeed_tpu.telemetry.tracing import (EVENT_NAMES, NULL_SPAN,
+                                             NULL_TRACER, SPAN_NAMES, Span,
+                                             Tracer)
 
 _LAZY = ("AutoCapture", "build_capture_report")
 
@@ -31,9 +37,12 @@ def __getattr__(name):
 
 
 __all__ = [
-    "AutoCapture", "Counter", "EXPORT_TAGS", "Gauge", "Histogram",
-    "JsonlExporter", "MetricsRegistry", "SCHEMA_VERSION", "StepRecord",
-    "Telemetry", "build_capture_report", "collect_hbm_stats",
-    "detect_peak_flops_per_sec", "events_from_record", "read_jsonl",
-    "record_keys", "render_prometheus", "write_prometheus_textfile",
+    "AutoCapture", "Counter", "EVENT_NAMES", "EXPORT_TAGS",
+    "FLIGHT_REASONS", "FlightRecorder", "Gauge", "Histogram",
+    "JsonlExporter", "MetricsRegistry", "NULL_SPAN", "NULL_TRACER",
+    "SCHEMA_VERSION", "SPAN_NAMES", "Span", "StepRecord", "Telemetry",
+    "Tracer", "Watchdog", "build_capture_report", "collect_hbm_stats",
+    "detect_peak_flops_per_sec", "dump_bundle", "events_from_record",
+    "make_span_recorder", "read_jsonl", "record_keys",
+    "render_prometheus", "write_prometheus_textfile",
 ]

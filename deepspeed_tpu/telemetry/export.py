@@ -17,6 +17,9 @@ import tempfile
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
+from deepspeed_tpu.telemetry.flight import (dump_bundle,
+                                            make_span_recorder,
+                                            make_watchdog)
 from deepspeed_tpu.telemetry.record import (StepRecord, collect_hbm_stats,
                                             detect_peak_flops_per_sec)
 from deepspeed_tpu.telemetry.registry import (Counter, Gauge, Histogram,
@@ -198,6 +201,48 @@ class Telemetry:
 
             self.capture = AutoCapture(cap_cfg, telemetry=self)
 
+        # -- software spans + flight recorder (tracing.py / flight.py) --
+        tr_cfg = getattr(cfg, "tracing", None)
+        self._flight_cfg = fl_cfg = getattr(cfg, "flight", None)
+        self.tracer, self.flight_ring = make_span_recorder(
+            tracing_enabled=getattr(tr_cfg, "enabled", False),
+            flight_enabled=getattr(fl_cfg, "enabled", False),
+            max_events=getattr(tr_cfg, "max_events", 0) or 0,
+            ring_size=getattr(fl_cfg, "ring_size", 0) or 0)
+        # the trace *file* is gated on the tracing block itself: a
+        # flight-only config records spans (for the ring) but a user who
+        # disabled tracing must not get a trace written at shutdown
+        self.trace_path = (getattr(tr_cfg, "trace_path", "") or ""
+                           if getattr(tr_cfg, "enabled", False) else "")
+
+    # -- tracing / flight recorder ---------------------------------------
+    def make_watchdog(self, name: str):
+        """A hang :class:`Watchdog` for one hot loop (``None`` unless the
+        ``telemetry.flight`` block is enabled).  The caller owns
+        start()/beat()/stop()."""
+        return make_watchdog(name, self._flight_cfg,
+                             ring=self.flight_ring, telemetry=self,
+                             tracer=self.tracer)
+
+    def dump_flight(self, reason: str,
+                    error: Optional[BaseException] = None) -> Optional[str]:
+        """Crash-forensics bundle on demand (serve-loop crash handler,
+        ``engine.destroy()`` during exception unwind).  No flight config
+        ⇒ no bundle."""
+        fl = self._flight_cfg
+        if fl is None or not getattr(fl, "enabled", False):
+            return None
+        return dump_bundle(fl.output_dir, reason, ring=self.flight_ring,
+                           telemetry=self, error=error)
+
+    def export_trace(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the Chrome trace-event JSON (Perfetto-viewable); returns
+        the path, or ``None`` when tracing never recorded anything."""
+        path = path or self.trace_path
+        if not path or not self.tracer.enabled:
+            return None
+        return self.tracer.export_chrome_trace(path)
+
     # -- flops handshake (engine) ---------------------------------------
     def _capture_wants_times(self) -> bool:
         return (self.capture is not None
@@ -332,6 +377,10 @@ class Telemetry:
             self.capture.close()
         if self.jsonl is not None:
             self.jsonl.close()
+        try:
+            self.export_trace()
+        except OSError as e:
+            logger.warning(f"telemetry: trace export failed: {e}")
 
 
 def read_jsonl(path: str) -> List[Dict[str, Any]]:

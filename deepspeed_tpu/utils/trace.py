@@ -52,6 +52,9 @@ def range_push(msg: str) -> None:
 
 
 def range_pop() -> None:
+    """Pop the innermost accelerator range.  Unbalanced pops (empty
+    stack) warn and no-op rather than raising — see
+    ``abstract_accelerator.range_pop``."""
     from deepspeed_tpu.accelerator import get_accelerator
 
     get_accelerator().range_pop()

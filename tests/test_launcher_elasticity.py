@@ -206,9 +206,12 @@ def test_launch_bind_cores_spawns(tmp_path):
     timeout_s = 120 if len(avail) >= 4 else 360
 
     script = tmp_path / "probe.py"
+    # Single os.write (atomic for < PIPE_BUF) — concurrent ranks sharing the
+    # pipe must not interleave mid-token, or the count below miscounts.
     script.write_text(
-        "import os, sys\n"
-        "print('OMP', os.environ.get('OMP_NUM_THREADS'))\n")
+        "import os\n"
+        "os.write(1, ('OMP=%s;' % os.environ.get('OMP_NUM_THREADS'))"
+        ".encode())\n")
     r = subprocess.run(
         [_sys.executable, "-m", "deepspeed_tpu.launcher.launch",
          "--nproc", str(nproc), "--bind_cores_to_rank",
@@ -216,4 +219,69 @@ def test_launch_bind_cores_spawns(tmp_path):
          "--pid_dir", str(tmp_path), str(script)],
         capture_output=True, text=True, timeout=timeout_s)
     assert r.returncode == 0, r.stdout + r.stderr
-    assert r.stdout.count("OMP 1") == nproc, r.stdout
+    assert r.stdout.count("OMP=1;") == nproc, r.stdout
+
+
+# ----------------------------------------------------------------------
+# DSElasticAgent restart path (ref tests for elastic_agent.py; the
+# watchdog→agent story: a dead worker triggers a supervised group
+# restart, max_restarts bounds the retry budget)
+# ----------------------------------------------------------------------
+def test_elastic_agent_restarts_dead_worker_and_recovers(tmp_path):
+    """First run fails (simulated worker death), the agent restarts the
+    group, the retry succeeds — run() returns 0 with one restart."""
+    from deepspeed_tpu.elasticity import DSElasticAgent, WorkerSpec
+
+    sentinel = tmp_path / "died_once"
+    code = (
+        "import os, sys\n"
+        f"p = {str(sentinel)!r}\n"
+        "if not os.path.exists(p):\n"
+        "    open(p, 'w').close()\n"
+        "    sys.exit(3)\n"          # first incarnation dies
+        "sys.exit(0)\n")
+    agent = DSElasticAgent(WorkerSpec([sys.executable, "-c", code]),
+                           max_restarts=3, monitor_interval=0.05)
+    assert agent.run() == 0
+    assert agent.restarts == 1
+
+
+def test_elastic_agent_max_restarts_honored(tmp_path):
+    """A worker that always dies exhausts the restart budget: run()
+    returns 1 after exactly max_restarts + 1 failed incarnations."""
+    from deepspeed_tpu.elasticity import DSElasticAgent, WorkerSpec
+
+    counter = tmp_path / "attempts"
+    code = (
+        "import sys\n"
+        f"p = {str(counter)!r}\n"
+        "with open(p, 'a') as f:\n"
+        "    f.write('x')\n"
+        "sys.exit(5)\n")
+    agent = DSElasticAgent(WorkerSpec([sys.executable, "-c", code]),
+                           max_restarts=2, monitor_interval=0.05)
+    assert agent.run() == 1
+    assert agent.restarts == 3           # budget exhausted (2) + final
+    assert len(counter.read_text()) == 3  # initial + 2 restarts
+
+
+def test_elastic_agent_group_env_layout(tmp_path):
+    """Each worker sees its rank/world layout (the contract workers use
+    to rebuild the mesh after a restart or resize)."""
+    from deepspeed_tpu.elasticity import DSElasticAgent, WorkerSpec
+
+    code = (
+        "import os\n"
+        f"d = {str(tmp_path)!r}\n"
+        "rank = os.environ['RANK']\n"
+        "with open(os.path.join(d, 'r' + rank), 'w') as f:\n"
+        "    f.write(os.environ['WORLD_SIZE'] + ' '\n"
+        "            + os.environ['DSTPU_NUM_PROCS'] + ' '\n"
+        "            + os.environ['DSTPU_PROC_ID'])\n")
+    agent = DSElasticAgent(
+        WorkerSpec([sys.executable, "-c", code], local_world_size=2),
+        max_restarts=0, monitor_interval=0.05)
+    assert agent.run() == 0
+    for rank in (0, 1):
+        out = (tmp_path / f"r{rank}").read_text().split()
+        assert out == ["2", "2", str(rank)]

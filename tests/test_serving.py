@@ -312,6 +312,39 @@ def test_loop_crash_fails_streams_and_sheds_new_load(monkeypatch):
         srv.stop()                     # surfaces the original failure
 
 
+def test_stop_drain_fails_fast_on_dead_loop(monkeypatch):
+    """A crashed loop must not make stop(drain=True) wait out the drain
+    timeout: the crash handler can itself wedge on the broken engine
+    (flush on inconsistent state), so stop() polls and raises the loop
+    error as soon as it is recorded."""
+    model, eng = _tiny_engine()
+    srv = InferenceServer(eng).start()
+    [p] = _prompts(model, (4,))
+    release = threading.Event()
+
+    def boom(*a, **k):
+        raise RuntimeError("injected engine failure")
+
+    def wedged_flush(uid):
+        # the crash handler's flush hangs on the broken engine — exactly
+        # the state stop() must not wait out
+        release.wait(30)
+
+    monkeypatch.setattr(eng, "step", boom)
+    monkeypatch.setattr(eng, "flush", wedged_flush)
+    srv.submit(p, SamplingParams(max_new_tokens=4))
+    deadline = time.monotonic() + 10
+    while srv._loop_error is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert srv._loop_error is not None
+    t0 = time.monotonic()
+    with pytest.raises(RuntimeError, match="serve loop died"):
+        srv.stop(drain=True, timeout=60)
+    # fail-fast: seconds (the 1s handler grace), not the 60s drain wait
+    assert time.monotonic() - t0 < 5.0
+    release.set()
+
+
 def test_metrics_monitor_export():
     """ServingMetrics events flow through a MonitorMaster-shaped sink."""
     class Sink:

@@ -57,6 +57,107 @@ def test_engine_windowed_capture(tmp_path):
     assert any(f.endswith(".xplane.pb") for f in found), found
 
 
+def test_range_pop_empty_stack_warns_not_crashes():
+    """Unbalanced pop on an empty accelerator range stack: a warning,
+    never an exception (dying inside a profiling annotation would turn a
+    bookkeeping slip into an outage)."""
+    import logging
+
+    from deepspeed_tpu.accelerator import get_accelerator
+    from deepspeed_tpu.utils.logging import logger
+
+    acc = get_accelerator()
+    while acc._ranges():                 # drain any leftover ranges
+        acc._ranges().pop()
+    acc._unbalanced_pop_warned = False   # other tests may have tripped it
+
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = Capture(level=logging.WARNING)
+    logger.addHandler(handler)
+    try:
+        range_pop()                      # empty stack: warn + no-op
+        range_pop()                      # repeat pops are throttled:
+        range_pop()                      # one warning per process, not
+        range_pop()                      # one per hot-loop iteration
+    finally:
+        logger.removeHandler(handler)
+    assert sum("unbalanced" in r.getMessage() for r in records) == 1
+    # balanced usage does not warn
+    records.clear()
+    acc._unbalanced_pop_warned = False
+    logger.addHandler(handler)
+    try:
+        range_push("outer")
+        range_pop()
+    finally:
+        logger.removeHandler(handler)
+    assert not any("unbalanced" in r.getMessage() for r in records)
+
+
+def test_resume_past_window_marks_done_without_capturing(tmp_path,
+                                                         monkeypatch):
+    """Checkpoint resume past the configured window: no capture, done."""
+    import jax
+
+    calls = []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda *a, **k: calls.append(a))
+    tp = TraceProfiler(str(tmp_path / "t"), start_step=1, num_steps=3)
+    tp.maybe_start(10)                   # resumed at step 10
+    assert tp.done and not tp.active
+    assert calls == []                   # start_trace never touched
+    tp.maybe_start(2)                    # done is sticky
+    assert calls == [] and not tp.active
+
+
+def test_start_trace_failure_degrades_to_disabled(tmp_path, monkeypatch):
+    """A profiler already active elsewhere must not kill the train loop:
+    the window degrades to disabled and every later call is a no-op."""
+    import jax
+
+    def boom(*a, **k):
+        raise RuntimeError("profiler already active")
+
+    stops = []
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: stops.append(1))
+    tp = TraceProfiler(str(tmp_path / "t"), start_step=1, num_steps=2)
+    tp.maybe_start(1)
+    assert tp.done and not tp.active
+    with tp.step(1):                     # degraded: nullcontext
+        pass
+    tp.maybe_stop(3)
+    tp.close()
+    assert stops == []                   # nothing was ever started
+
+
+def test_close_flushes_in_window_run(tmp_path, monkeypatch):
+    """A run that ends inside the capture window still writes its trace:
+    close() stops exactly once, then becomes a no-op."""
+    import jax
+
+    starts, stops = [], []
+    monkeypatch.setattr(jax.profiler, "start_trace",
+                        lambda *a, **k: starts.append(a))
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: stops.append(1))
+    tp = TraceProfiler(str(tmp_path / "t"), start_step=2, num_steps=5)
+    tp.maybe_start(2)
+    assert tp.active and len(starts) == 1
+    tp.close()                           # run ended at step 3 of 7
+    assert stops == [1]
+    assert tp.done and not tp.active
+    tp.close()                           # idempotent
+    tp.maybe_start(3)                    # and sticky-done
+    assert stops == [1] and len(starts) == 1
+
+
 def test_standalone_window_bounds(tmp_path):
     tp = TraceProfiler(str(tmp_path / "t"), start_step=3, num_steps=1)
     tp.maybe_start(1)

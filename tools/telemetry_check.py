@@ -10,7 +10,14 @@ Checks:
 3. the StepRecord JSONL schema is stable: ``schema: 1``, keys sorted in
    the serialized line, and the top-level key set matches the frozen
    list below (update EXPECTED_RECORD_KEYS *and the docs table* in the
-   same commit as any schema change).
+   same commit as any schema change);
+4. the tracing vocabulary is stable and documented: span / instant-event
+   names (telemetry/tracing.py) and flight-recorder bundle reasons
+   (telemetry/flight.py) match the frozen lists below AND appear in the
+   docs span table;
+5. an exported trace is well-formed Chrome trace-event JSON — a sample
+   trace covering every span/event name is generated and validated
+   (``validate_chrome_trace`` is also importable for ad-hoc files).
 """
 
 from __future__ import annotations
@@ -18,7 +25,8 @@ from __future__ import annotations
 import json
 import os
 import sys
-from typing import List
+import tempfile
+from typing import Any, List
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DOCS = os.path.join(REPO, "docs", "OBSERVABILITY.md")
@@ -31,6 +39,22 @@ EXPECTED_RECORD_KEYS = [
     "mfu", "peak_flops_per_sec", "schema", "serving", "skipped", "step",
     "tokens", "tokens_per_sec", "wall_time_s",
 ]
+
+# frozen tracing vocabulary (telemetry/tracing.py SPAN_NAMES/EVENT_NAMES
+# and telemetry/flight.py FLIGHT_REASONS must match, and every name must
+# appear in the docs span table — same contract as the record keys)
+EXPECTED_SPAN_NAMES = [
+    "serve.admission_block", "serve.decode", "serve.prefill",
+    "serve.queue_wait", "serve.request", "serve.step", "train.data_ingest",
+    "train.dispatch", "train.step", "train.sync", "train.telemetry",
+    "v2.ragged_step",
+]
+EXPECTED_EVENT_NAMES = [
+    "serve.emit", "serve.enqueue", "serve.finish", "serve.first_token",
+    "serve.preempt", "watchdog.fire",
+]
+EXPECTED_FLIGHT_REASONS = ["watchdog", "serve_crash", "engine_crash",
+                           "manual"]
 
 
 def _exported_monitor_tags() -> List[str]:
@@ -101,8 +125,109 @@ def check_schema() -> List[str]:
     return errors
 
 
+def check_span_names() -> List[str]:
+    """Tracing vocabulary: frozen lists match the modules, every name is
+    in the docs span table."""
+    from deepspeed_tpu.telemetry.flight import FLIGHT_REASONS
+    from deepspeed_tpu.telemetry.tracing import EVENT_NAMES, SPAN_NAMES
+
+    errors = []
+    if sorted(SPAN_NAMES) != sorted(EXPECTED_SPAN_NAMES):
+        errors.append(
+            "tracing.SPAN_NAMES drifted from the frozen list: "
+            f"extra={sorted(set(SPAN_NAMES) - set(EXPECTED_SPAN_NAMES))}, "
+            f"missing={sorted(set(EXPECTED_SPAN_NAMES) - set(SPAN_NAMES))}"
+            " — update EXPECTED_SPAN_NAMES + the docs span table together")
+    if sorted(EVENT_NAMES) != sorted(EXPECTED_EVENT_NAMES):
+        errors.append(
+            "tracing.EVENT_NAMES drifted from the frozen list: "
+            f"extra={sorted(set(EVENT_NAMES) - set(EXPECTED_EVENT_NAMES))},"
+            f" missing="
+            f"{sorted(set(EXPECTED_EVENT_NAMES) - set(EVENT_NAMES))}")
+    if sorted(FLIGHT_REASONS) != sorted(EXPECTED_FLIGHT_REASONS):
+        errors.append("flight.FLIGHT_REASONS drifted from the frozen list")
+    try:
+        with open(DOCS, "r", encoding="utf-8") as f:
+            docs = f.read()
+    except OSError as e:
+        return errors + [f"cannot read {DOCS}: {e}"]
+    for name in list(SPAN_NAMES) + list(EVENT_NAMES):
+        if f"`{name}`" not in docs:
+            errors.append(f"span/event {name!r} not documented in "
+                          f"{os.path.basename(DOCS)}")
+    for reason in FLIGHT_REASONS:
+        if f"`{reason}`" not in docs:
+            errors.append(f"flight reason {reason!r} not documented")
+    return errors
+
+
+def validate_chrome_trace(obj: Any) -> List[str]:
+    """Structural validation of a Chrome trace-event JSON object (pass a
+    path or the loaded dict).  Perfetto/chrome://tracing both accept the
+    object form: ``{"traceEvents": [...]}`` with per-event ``name``,
+    ``ph``, ``ts`` (µs), ``pid``/``tid``, and ``dur`` on complete ("X")
+    events."""
+    if isinstance(obj, str):
+        try:
+            with open(obj, "r", encoding="utf-8") as f:
+                obj = json.load(f)
+        except (OSError, ValueError) as e:
+            return [f"trace file unreadable / not JSON: {e}"]
+    errors: List[str] = []
+    if not isinstance(obj, dict) or not isinstance(
+            obj.get("traceEvents"), list):
+        return ["trace is not an object with a 'traceEvents' list"]
+    for i, ev in enumerate(obj["traceEvents"]):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            errors.append(f"{where}: missing/empty name")
+        ph = ev.get("ph")
+        if ph not in ("X", "i", "M"):
+            errors.append(f"{where}: unsupported ph {ph!r}")
+        if not isinstance(ev.get("ts"), (int, float)) or ev["ts"] < 0:
+            errors.append(f"{where}: bad ts {ev.get('ts')!r}")
+        for key in ("pid", "tid"):
+            if not isinstance(ev.get(key), int):
+                errors.append(f"{where}: bad {key} {ev.get(key)!r}")
+        if ph == "X" and (not isinstance(ev.get("dur"), (int, float))
+                          or ev["dur"] < 0):
+            errors.append(f"{where}: X event without valid dur")
+        if ph == "X" and not isinstance(
+                ev.get("args", {}).get("trace_id"), str):
+            errors.append(f"{where}: span without args.trace_id")
+    return errors
+
+
+def check_trace_export() -> List[str]:
+    """Generate a sample trace touching every span/event name and assert
+    the exported file is well-formed."""
+    from deepspeed_tpu.telemetry.tracing import (EVENT_NAMES, SPAN_NAMES,
+                                                 Tracer)
+
+    tracer = Tracer(enabled=True)
+    tid = tracer.new_trace_id()
+    for name in SPAN_NAMES:
+        tracer.span(name, tid).set(sample=True).end()
+    for name in EVENT_NAMES:
+        tracer.instant(name, tid)
+    with tempfile.TemporaryDirectory() as d:
+        path = tracer.export_chrome_trace(os.path.join(d, "t.trace.json"))
+        errors = validate_chrome_trace(path)
+        with open(path, "r", encoding="utf-8") as f:
+            seen = {ev["name"] for ev in json.load(f)["traceEvents"]
+                    if ev.get("ph") in ("X", "i")}
+    missing = (set(SPAN_NAMES) | set(EVENT_NAMES)) - seen
+    if missing:
+        errors.append(f"exported trace lost events: {sorted(missing)}")
+    return errors
+
+
 def run_all() -> List[str]:
-    return check_tags_documented() + check_schema()
+    return (check_tags_documented() + check_schema() + check_span_names()
+            + check_trace_export())
 
 
 def main() -> int:
