@@ -159,6 +159,115 @@ def row_gpt2_350m():
     }
 
 
+def _commquant_once(wire: str, steps: int):
+    """One comm-quant training run: explicit quantized DP grad reduce with
+    ``wire`` on the wire (comm/quantized.py), fixed data, returns
+    (tokens/s/chip, per-step losses, grad-reduce wire bytes)."""
+    import jax
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.comm.quantized import QUANT_COMM_OPS
+    from deepspeed_tpu.models import get_model_config
+
+    n = jax.device_count()
+    if SMOKE:
+        model = get_model_config("gpt2-tiny", num_layers=2)
+        batch_size, gas, seq, run_steps = 1, 2, 32, max(3, steps)
+    else:
+        model = get_model_config("gpt2-350m", max_seq_len=1024)
+        batch_size, gas, seq, run_steps = 8, 8, 1024, steps
+    name = f"gpt2_350m_commquant_{wire}"
+    config = {
+        "train_micro_batch_size_per_gpu": batch_size,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "bf16": {"enabled": not SMOKE},
+        "zero_optimization": {"stage": 1},
+        "gradient_clipping": 1.0,
+        "mesh": {"data": n},
+        "comm_quantization": {"enabled": True, "grad_reduce": wire},
+        "steps_per_print": 10_000,
+        "activation_checkpointing": {"remat_policy": "dots_flash_saveable"},
+        "telemetry": _telemetry_block(name),
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+    assert engine._comm_quant is not None, "explicit reduce path not active"
+    rows = batch_size * gas * engine.topology.dp_size
+    rng = np.random.default_rng(0)  # IDENTICAL data across wire dtypes
+    ids = rng.integers(0, model.vocab_size, size=(rows, seq + 1),
+                       dtype=np.int32)
+    batch = {"input_ids": ids[:, :-1], "labels": ids[:, 1:].astype(np.int32)}
+    losses = [_sync(engine.train_batch(batch)) for _ in range(run_steps)]
+    # the loss loop above compiled + warmed the step; warmup=1 re-syncs
+    dt = _time_train(engine, batch, run_steps, warmup=1)
+    comm = engine._comm_delta()
+    grad_bytes = sum(comm.get(op, {}).get("bytes", 0)
+                     for op in QUANT_COMM_OPS)
+    engine.destroy()
+    _reset_topology()
+    tps = run_steps * rows * seq / dt / max(1, n)
+    return tps, losses, grad_bytes
+
+
+def _commquant_body():
+    """Comm-quant variant of the gpt2_350m row: the SAME model/step with
+    the DP gradient reduction routed through the explicit collective path
+    (comm_quantization), int8 wire vs an explicit-fp32-wire control.
+    Verification rides the per-collective comm-volume telemetry: the row
+    reports the measured grad-reduce byte reduction AND the N-step
+    loss-curve delta vs the fp32 reduce (docs/QUANTIZED_COMM.md)."""
+    steps = 3 if SMOKE else 8
+    tps_q, losses_q, bytes_q = _commquant_once("int8", steps)
+    tps_f, losses_f, bytes_f = _commquant_once("fp32", steps)
+    loss_delta = max(abs(a - b) for a, b in zip(losses_q, losses_f))
+    return {
+        "metric": "gpt2_350m_commquant_int8_train_tokens_per_sec_per_chip",
+        "value": round(tps_q, 1), "unit": "tokens/s",
+        # quantized wire vs the explicit fp32-wire control (same schedule)
+        "vs_baseline": round(tps_q / tps_f, 3) if tps_f else 0.0,
+        "grad_reduce_bytes_fp32": int(bytes_f),
+        "grad_reduce_bytes_quant": int(bytes_q),
+        "bytes_reduction": round(bytes_f / bytes_q, 2) if bytes_q else 0.0,
+        "loss_delta": round(loss_delta, 5),
+        "loss_final_fp32": round(losses_f[-1], 5),
+        "loss_final_int8": round(losses_q[-1], 5),
+        "telemetry_jsonl": _telemetry_jsonl("gpt2_350m_commquant_int8"),
+        "trace_json": _trace_json("gpt2_350m_commquant_int8"),
+    }
+
+
+def row_gpt2_350m_commquant():
+    """Quantized-collective row.  Explicit DP grad reduce needs dp > 1;
+    smoke mode pins the in-process backend to ONE cpu device, so the
+    smoke variant re-execs itself on a virtual 8-device CPU mesh (same
+    pattern as longseq_ring)."""
+    if SMOKE and "--commquant-inner" not in sys.argv:
+        import os
+        import subprocess
+
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        env["JAX_PLATFORMS"] = "cpu"
+        cmd = [sys.executable, __file__, "--row", "gpt2_350m_commquant",
+               "--smoke", "--commquant-inner"]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=900, env=env)
+        except subprocess.TimeoutExpired:
+            return {"metric": "gpt2_350m_commquant", "error": "smoke timed out"}
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return {"metric": "gpt2_350m_commquant",
+                "error": ("no result line; " + " | ".join(tail[-3:]))[:300]}
+    return _commquant_body()
+
+
 def row_llama8b_class_zero3():
     """Llama-3-8B geometry (hidden 4096, GQA 32:8, swiglu 14336) with depth
     and vocab scaled to one chip, ZeRO-3 sharding specs active
@@ -703,6 +812,7 @@ def _device_probe_error(timeout_s: float = 120.0):
 
 
 _ROWS = {
+    "gpt2_350m_commquant": row_gpt2_350m_commquant,
     "llama8b_class_zero3": row_llama8b_class_zero3,
     "longseq_flash": row_longseq_flash,
     "longseq_llama": row_longseq_llama,
@@ -776,7 +886,8 @@ def main() -> None:
         return
     rows = []
     for name in ("llama8b_class_zero3", "longseq_flash", "longseq_llama",
-                 "longseq_ring", "peak_params", "v2_decode", "serve_load"):
+                 "longseq_ring", "gpt2_350m_commquant", "peak_params",
+                 "v2_decode", "serve_load"):
         if SMOKE:
             try:
                 r = _ROWS[name]()

@@ -1,5 +1,8 @@
 """deepspeed_tpu.comm — collectives façade (ref: deepspeed/comm)."""
 
+from deepspeed_tpu.comm.quantized import (QUANT_COMM_OPS, WIRE_DTYPES,
+                                          quantized_all_reduce,
+                                          quantized_reduce_scatter)
 from deepspeed_tpu.comm.comm import (ReduceOp, all_gather, all_gather_object,
                                      all_reduce, all_to_all, allgather,
                                      allreduce, axis_index, barrier, broadcast,

@@ -34,10 +34,18 @@ AxisName = Union[str, Sequence[str]]
 
 
 def pack_signs(sign01: jnp.ndarray) -> jnp.ndarray:
-    """Pack {0,1} sign bits, 8 per byte (ref csrc/xpu/packbits analog)."""
+    """Pack {0,1} sign bits, 8 per byte (ref csrc/xpu/packbits analog).
+
+    Lengths not divisible by 8 are zero-padded internally — the true
+    length travels with the caller (``_decompress`` slices ``[..., :n]``),
+    so arbitrary flat buffers compress.  ``unpack_signs`` returns the
+    padded length (a whole number of bytes); callers slice back."""
     n = sign01.shape[-1]
-    if n % 8:
-        raise ValueError("length must be divisible by 8 to pack bits")
+    pad = (-n) % 8
+    if pad:
+        widths = [(0, 0)] * (sign01.ndim - 1) + [(0, pad)]
+        sign01 = jnp.pad(sign01, widths)
+        n += pad
     b = sign01.reshape(sign01.shape[:-1] + (n // 8, 8)).astype(jnp.uint8)
     weights = (1 << jnp.arange(8, dtype=jnp.uint8))
     return jnp.sum(b * weights, axis=-1).astype(jnp.uint8)
@@ -66,10 +74,14 @@ def compressed_allreduce(x: jnp.ndarray, worker_err: jnp.ndarray,
     """Error-feedback 1-bit mean-allreduce of flat ``x`` (≡ ref
     CompressedBackend.compressed_allreduce, runtime/comm/compressed.py:13).
 
-    ``x`` [N] with N divisible by world*8; ``worker_err`` [N];
+    ``x`` [N] with N divisible by ``world`` (chunks of any length
+    compress — pack_signs pads to whole bytes internally and the true
+    length rides through ``_decompress``); ``worker_err`` [N];
     ``server_err`` [N/world].  Returns (avg, new_worker_err, new_server_err).
     """
     n = x.size
+    if n % world:
+        raise ValueError(f"buffer size {n} not divisible by world {world}")
     m = n // world
     c = x + worker_err
 
@@ -80,7 +92,8 @@ def compressed_allreduce(x: jnp.ndarray, worker_err: jnp.ndarray,
     # exchange compressed chunks: rank r receives chunk r from every rank
     bits_t = lax.all_to_all(bits, axis, split_axis=0, concat_axis=0, tiled=True)
     scales_t = lax.all_to_all(scales, axis, split_axis=0, concat_axis=0, tiled=True)
-    recv = _decompress(bits_t.reshape(world, m // 8), scales_t.reshape(world), m)
+    recv = _decompress(bits_t.reshape(world, -(-m // 8)),
+                       scales_t.reshape(world), m)
 
     server_chunk = jnp.mean(recv, axis=0) + server_err
     s_bits, s_scale = _compress(server_chunk[None, :])

@@ -1,0 +1,204 @@
+"""Quantized ZeRO collectives — block-scaled gradient reduce-scatter /
+all-reduce with a selectable wire dtype.
+
+EQuARX-style (arXiv:2506.17615) in-program quantized collectives that a
+plain Adam + ZeRO-1/2 data-parallel run can turn on, generalising the
+machinery that previously lived only inside the Onebit optimizers
+(``comm/compressed.py``) and the qgZ all-to-all
+(``comm/coalesced_collectives.py``):
+
+* **reduce-scatter**: chunk the flat gradient buffer into ``world``
+  pieces, block-quantize each chunk (fp32 per-block scales), all-to-all
+  the quantized payload + scales, dequantize and reduce **in fp32**.
+  Wire traffic is the quantized dtype; accumulation never is.
+* **all-reduce**: reduce-scatter, then re-quantize the reduced shard and
+  all-gather it (the EQuARX two-phase schedule — both phases move the
+  quantized payload).
+* **error feedback**: optionally carry the first-send quantization
+  residual into the next step (LoCo-style; the gather-phase requantize
+  error is NOT compensated — same contract as LoCo/qgZ).
+
+Wire dtypes:
+  ``fp32``  — no quantization; the *explicit* collective still runs and
+              logs its volume, giving an apples-to-apples telemetry
+              baseline for the quantized modes.
+  ``int8``  — blockwise symmetric int8 (ops/quantizer).
+  ``fp8``   — float8_e4m3fn with fp32 per-block scales; the payload is
+              bitcast to uint8 for the collective itself so every
+              backend (including the CPU test mesh) moves plain bytes.
+
+All functions are **in-jit** collectives over flat fp32 buffers: call
+them inside ``shard_map`` (the engine's explicit-reduce path does) with
+the relevant mesh axis names.  Comm volume is recorded at trace time in
+the process ``CommsLogger`` under the frozen :data:`QUANT_COMM_OPS`
+names, so per-collective byte reduction shows up directly in the
+telemetry ``StepRecord.comm`` field (docs/QUANTIZED_COMM.md).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import lax
+
+from deepspeed_tpu.ops.quantizer import dequantize_blockwise, quantize_blockwise
+from deepspeed_tpu.utils.comms_logging import get_comms_logger
+
+AxisName = Union[str, Sequence[str]]
+
+# Wire dtypes a comm_quantization config block may select per collective.
+WIRE_DTYPES = ("fp32", "int8", "fp8")
+
+# Frozen comm-op vocabulary (linted against docs/QUANTIZED_COMM.md by
+# tools/telemetry_check.py, same contract as the StepRecord schema):
+# every wire movement of the quantized collectives is recorded under one
+# of these names in CommsLogger — payload and scales both.
+QUANT_COMM_OPS = ("quant_reduce_scatter", "quant_all_gather")
+
+# float8_e4m3fn: absent on ancient jax builds; gate instead of crashing.
+_FP8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+_FP8_MAX = 448.0  # e4m3fn finite max
+
+
+def fp8_supported() -> bool:
+    return _FP8_DTYPE is not None
+
+
+def validate_wire_dtype(name: str) -> str:
+    if name not in WIRE_DTYPES:
+        raise ValueError(
+            f"wire dtype {name!r} not in {WIRE_DTYPES}")
+    if name == "fp8" and not fp8_supported():
+        raise ValueError("wire dtype 'fp8' requires jnp.float8_e4m3fn, "
+                         "which this jax build lacks")
+    return name
+
+
+def _log_wire(op: str, payload, scale, axis) -> None:
+    """Trace-time comm-volume record of what actually travels the wire
+    (payload and, for quantized dtypes, the fp32 scales)."""
+    cl = get_comms_logger()
+    if not cl.enabled:
+        return
+    cl.record(op, payload, axis)
+    if scale is not None:
+        cl.record(op, scale, axis)
+
+
+def _block(m: int, group_size: int) -> int:
+    gs = min(group_size, m) if group_size > 0 else m
+    if m % gs:
+        gs = m
+    return gs
+
+
+def _wire_encode(x2d: jnp.ndarray, wire_dtype: str, group_size: int,
+                 backend: str = "auto", num_bits: int = 8
+                 ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Encode last-dim blocks of an fp32 buffer for the wire.
+
+    Returns ``(payload, scales)``; ``scales`` is None for fp32.  The fp8
+    payload is bitcast to uint8 so the collective moves plain bytes on
+    every backend.  ``backend`` routes the int8 quantizer ("jnp" is
+    load-bearing for GSPMD call sites — see qwz_weight_gather);
+    ``num_bits`` narrows the integer wire format (int4 values ride the
+    int8 payload's low nibble range) and is ignored for fp8/fp32.
+    """
+    if wire_dtype == "fp32":
+        return x2d, None
+    m = x2d.shape[-1]
+    gs = _block(m, group_size)
+    if wire_dtype == "int8":
+        q, scale, _ = quantize_blockwise(x2d, num_bits=num_bits,
+                                         group_size=gs, backend=backend)
+        return q, scale
+    if _FP8_DTYPE is None:
+        raise ValueError("fp8 wire dtype unavailable on this jax build")
+    g = x2d.reshape(x2d.shape[:-1] + (m // gs, gs))
+    absmax = jnp.max(jnp.abs(g), axis=-1, keepdims=True)
+    scale = absmax / _FP8_MAX
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = (g / scale).astype(_FP8_DTYPE).reshape(x2d.shape)
+    return lax.bitcast_convert_type(q, jnp.uint8), scale.squeeze(-1)
+
+
+def _wire_decode(payload: jnp.ndarray, scale: Optional[jnp.ndarray],
+                 wire_dtype: str, backend: str = "auto") -> jnp.ndarray:
+    """Inverse of :func:`_wire_encode`; always returns fp32."""
+    if wire_dtype == "fp32":
+        return payload
+    if wire_dtype == "int8":
+        return dequantize_blockwise(payload, scale, backend=backend)
+    f8 = lax.bitcast_convert_type(payload, _FP8_DTYPE)
+    m = f8.shape[-1]
+    gs = m // scale.shape[-1]
+    g = f8.astype(jnp.float32).reshape(f8.shape[:-1] + (scale.shape[-1], gs))
+    return (g * scale[..., None]).reshape(f8.shape)
+
+
+def quantized_reduce_scatter(x: jnp.ndarray, axis: AxisName, world: int,
+                             wire_dtype: str = "int8", group_size: int = 256,
+                             residual: Optional[jnp.ndarray] = None,
+                             mean: bool = True
+                             ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Block-scaled quantized reduce-scatter of flat ``x`` [N] (N divisible
+    by ``world``): quantize → all-to-all → fp32 dequant-reduce.
+
+    Rank r returns its [N/world] reduced chunk.  ``residual`` (same shape
+    as ``x``) enables error feedback: it is folded into the send and the
+    new first-send quantization residual is returned (None when no
+    residual was passed).  ``mean`` divides by ``world`` (gradient
+    averaging); ``False`` leaves the sum.
+    """
+    n = x.size
+    if n % world:
+        raise ValueError(f"buffer size {n} not divisible by world {world}")
+    m = n // world
+    c = x + residual if residual is not None else x
+    chunks = c.reshape(world, m)
+    payload, scale = _wire_encode(chunks, wire_dtype, group_size)
+    _log_wire("quant_reduce_scatter", payload, scale, axis)
+    new_residual = None
+    if residual is not None:
+        sent = _wire_decode(payload, scale, wire_dtype).reshape(-1)
+        new_residual = c - sent
+    # rank r receives chunk r from every rank: [world, m], rows = src rank
+    p_t = lax.all_to_all(payload, axis, split_axis=0, concat_axis=0,
+                         tiled=True)
+    s_t = None
+    if scale is not None:
+        s_t = lax.all_to_all(scale, axis, split_axis=0, concat_axis=0,
+                             tiled=True)
+    deq = _wire_decode(p_t, s_t, wire_dtype)
+    red = jnp.mean(deq, axis=0) if mean else jnp.sum(deq, axis=0)
+    return red, new_residual
+
+
+def quantized_all_reduce(x: jnp.ndarray, axis: AxisName, world: int,
+                         wire_dtype: str = "int8", group_size: int = 256,
+                         residual: Optional[jnp.ndarray] = None,
+                         mean: bool = True
+                         ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Two-phase quantized all-reduce (EQuARX schedule): quantized
+    reduce-scatter, then re-quantize the reduced shard and all-gather it.
+    Both phases move the quantized payload; reduction stays fp32.
+
+    Returns ``(out [N], new_residual or None)``.  Error feedback covers
+    the reduce-scatter send only (the gather-phase requantize error is
+    uncompensated, like LoCo/qgZ).
+    """
+    shard, new_residual = quantized_reduce_scatter(
+        x, axis, world, wire_dtype=wire_dtype, group_size=group_size,
+        residual=residual, mean=mean)
+    payload, scale = _wire_encode(shard[None, :], wire_dtype, group_size)
+    _log_wire("quant_all_gather", payload, scale, axis)
+    g = lax.all_gather(payload[0], axis, axis=0, tiled=True)
+    m = shard.size
+    if scale is not None:
+        s = lax.all_gather(scale[0], axis, axis=0, tiled=True)
+        s = s.reshape(world, -1)
+    else:
+        s = None
+    out = _wire_decode(g.reshape(world, m), s, wire_dtype)
+    return out.reshape(-1), new_residual

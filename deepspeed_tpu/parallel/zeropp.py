@@ -32,7 +32,6 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from deepspeed_tpu.ops.quantizer import dequantize_blockwise, quantize_blockwise
 from deepspeed_tpu.parallel.sharding import ShardingRules
 
 
@@ -45,15 +44,27 @@ def gathered_rules(rules: ShardingRules) -> ShardingRules:
 
 
 def qwz_weight_gather(params: Any, rules: ShardingRules,
-                      num_bits: int = 8, group_size: int = 256) -> Any:
+                      num_bits: int = 8, group_size: int = 256,
+                      wire_dtype: str = "int8") -> Any:
     """Quantized stage-3 weight gather with straight-through gradients.
 
     Apply inside the jitted train step to the (fsdp-sharded) params before
     the loss: the resharding constraint sits between quantize and
-    dequantize, so the all-gather XLA inserts moves int8+scales — the same
-    wire format as qwZ's quantized_gather (ref partition_parameters.py:823
-    CUDAQuantizer + all_gather_coalesced).
+    dequantize, so the all-gather XLA inserts moves the quantized payload
+    + scales — the same wire format as qwZ's quantized_gather (ref
+    partition_parameters.py:823 CUDAQuantizer + all_gather_coalesced).
+
+    ``wire_dtype``: "int8" (qwZ classic) or "fp8" (float8_e4m3fn blocks,
+    bitcast to uint8 around the resharding constraint so the gather moves
+    plain bytes on every backend) — selected by the ``comm_quantization``
+    config block's ``zero3_gather`` entry.
     """
+    from deepspeed_tpu.comm.quantized import (_wire_decode, _wire_encode,
+                                              validate_wire_dtype)
+
+    validate_wire_dtype(wire_dtype)
+    if wire_dtype == "fp32":
+        return params
     g_rules = gathered_rules(rules)
     mesh = rules.topo.mesh
 
@@ -66,16 +77,15 @@ def qwz_weight_gather(params: Any, rules: ShardingRules,
         gs = group_size if p.shape[-1] % group_size == 0 else p.shape[-1]
         # backend="jnp" is load-bearing: this runs in-jit on SHARDED
         # params — GSPMD partitions the jnp ops and fuses them into the
-        # int8 all-gather, while a pallas_call here would not partition
-        # automatically (it would force a gather of the bf16 payload,
-        # exactly what qwZ exists to avoid)
-        q, s, _ = quantize_blockwise(p.astype(jnp.float32), num_bits, gs,
-                                     backend="jnp")
+        # quantized all-gather, while a pallas_call here would not
+        # partition automatically (it would force a gather of the bf16
+        # payload, exactly what qwZ exists to avoid)
+        q, s = _wire_encode(p.astype(jnp.float32), wire_dtype, gs,
+                            backend="jnp", num_bits=num_bits)
         q = lax.with_sharding_constraint(q, NamedSharding(mesh, spec))
         s_spec = P(*(list(spec)[:-1] + [None])) if len(spec) else P()
         s = lax.with_sharding_constraint(s, NamedSharding(mesh, s_spec))
-        w = dequantize_blockwise(q, s, num_bits=num_bits,
-                                 backend="jnp").astype(p.dtype)
+        w = _wire_decode(q, s, wire_dtype, backend="jnp").astype(p.dtype)
         # straight-through: forward sees quantized-gathered weights, grads
         # flow to the master param untouched
         return p + lax.stop_gradient(w - p)

@@ -388,6 +388,64 @@ class TelemetryConfig:
 
 
 @dataclass
+class CommQuantizationConfig:
+    """``"comm_quantization"`` block — quantized ZeRO collectives
+    (comm/quantized.py; docs/QUANTIZED_COMM.md).
+
+    Selects a wire dtype per collective:
+
+    * ``grad_reduce`` — the data-parallel gradient reduction of the
+      train step.  Any non-default setting (including explicit
+      ``"fp32"``) routes the reduction through the engine's explicit
+      shard_map collective path, whose wire volume is recorded
+      per-collective in telemetry; ``int8``/``fp8`` quantize the
+      payload (EQuARX-style block scaling, fp32 accumulation).
+    * ``zero3_gather`` — the stage-3 parameter all-gather (the qwZ
+      straight-through gather, parallel/zeropp.py); ``int8``/``fp8``
+      move quantized payloads on the wire.
+
+    ``error_feedback`` carries the grad-reduce quantization residual
+    into the next step (LoCo-style; ignored for fp32 wire).  The
+    ``collectives`` dict is an equivalent per-collective spelling
+    (``{"grad_reduce": "int8"}``); unknown collective names are
+    rejected."""
+    enabled: bool = False
+    grad_reduce: str = "fp32"      # fp32 | int8 | fp8
+    zero3_gather: str = "fp32"     # fp32 | int8 | fp8
+    group_size: int = 256          # block size per fp32 scale
+    error_feedback: bool = True
+    collectives: Optional[Dict[str, str]] = None
+
+    COLLECTIVES = ("grad_reduce", "zero3_gather")
+    WIRE_DTYPES = ("fp32", "int8", "fp8")
+
+    def __post_init__(self):
+        if self.collectives is not None:
+            if not isinstance(self.collectives, dict):
+                raise DeepSpeedConfigError(
+                    "comm_quantization.collectives must be a dict of "
+                    "{collective: wire_dtype}")
+            for name, dtype in self.collectives.items():
+                if name not in self.COLLECTIVES:
+                    raise DeepSpeedConfigError(
+                        f"comm_quantization.collectives: unknown collective "
+                        f"{name!r} (known: {list(self.COLLECTIVES)})")
+                setattr(self, name, dtype)
+        for name in self.COLLECTIVES:
+            val = str(getattr(self, name)).lower()
+            if val not in self.WIRE_DTYPES:
+                raise DeepSpeedConfigError(
+                    f"comm_quantization.{name} must be one of "
+                    f"{list(self.WIRE_DTYPES)}, got {val!r}")
+            setattr(self, name, val)
+        if int(self.group_size) <= 0:
+            raise DeepSpeedConfigError(
+                f"comm_quantization.group_size must be positive, got "
+                f"{self.group_size}")
+        self.group_size = int(self.group_size)
+
+
+@dataclass
 class CommsLoggerConfig:
     enabled: bool = False
     verbose: bool = False
@@ -547,6 +605,9 @@ class DeepSpeedConfig:
         self.flops_profiler = _from_dict(FlopsProfilerConfig, d.get(C.FLOPS_PROFILER), "flops_profiler")
         self.profiler = _from_dict(ProfilerConfig, d.get(C.PROFILER), "profiler")
         self.comms_logger = _from_dict(CommsLoggerConfig, d.get(C.COMMS_LOGGER), "comms_logger")
+        self.comm_quantization = _from_dict(
+            CommQuantizationConfig, d.get("comm_quantization"),
+            "comm_quantization")
         self.telemetry = _from_dict(TelemetryConfig, d.get(C.TELEMETRY), "telemetry")
         self.tensor_parallel = _from_dict(TensorParallelConfig, d.get(C.TENSOR_PARALLEL), "tensor_parallel")
         self.pipeline = _from_dict(PipelineConfig, d.get(C.PIPELINE), "pipeline")

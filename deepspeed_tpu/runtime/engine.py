@@ -705,6 +705,66 @@ class DeepSpeedEngine:
                 "compressed-DP optimizers (their step wraps the raw loss, "
                 "so compression masks would silently not apply)")
 
+        # -- quantized ZeRO collectives (comm_quantization block;
+        # comm/quantized.py, docs/QUANTIZED_COMM.md) -------------------
+        # grad_reduce: the engine grows an EXPLICIT reduce path — the DP
+        # gradient reduction leaves GSPMD's implicit insertion and runs
+        # as a shard_map quantized all-reduce whose wire volume (int8/
+        # fp8/fp32 payload + scales) is recorded per-collective in
+        # telemetry.  zero3_gather is wired in _compile_steps (the qwZ
+        # straight-through gather with a selectable wire dtype).
+        self._comm_quant = None         # active grad-reduce config
+        self._comm_quant_state = None   # error-feedback residual state
+        cqc = cfg.comm_quantization
+        if cqc.enabled:
+            from deepspeed_tpu.comm.quantized import fp8_supported
+
+            for coll in cqc.COLLECTIVES:
+                if getattr(cqc, coll) == "fp8" and not fp8_supported():
+                    raise DeepSpeedConfigError(
+                        f"comm_quantization.{coll}='fp8' requires "
+                        "jnp.float8_e4m3fn, which this jax build lacks — "
+                        "use 'int8'")
+            _quant_dp = (_dp_only and self.zero_stage <= 2
+                         and self._onebit is None
+                         and self._super_opt is None
+                         and self._opt_store is None)
+            if _quant_dp:
+                self._comm_quant = cqc
+                n_total = sum(int(np.prod(x.shape))
+                              for x in jax.tree.leaves(self.params))
+                world = self.topology.dp_size
+                base = world * cqc.group_size
+                self._comm_quant_padded = -(-n_total // base) * base
+                from deepspeed_tpu.parallel.topology import BATCH_AXES as _BA
+
+                self._comm_quant_res_sharding = NamedSharding(
+                    self.topology.mesh, P(_BA))
+                if cqc.error_feedback and cqc.grad_reduce != "fp32":
+                    # per-rank first-send quantization residual, carried
+                    # step to step (LoCo-style).  Stored [world, padded]
+                    # with the leading axis sharded over the DP axes —
+                    # the same layout as the onebit error state.  Not
+                    # checkpointed: a resume re-accumulates it within a
+                    # step at no quality cost.
+                    self._comm_quant_state = {
+                        "residual": jax.device_put(
+                            jnp.zeros((world, self._comm_quant_padded),
+                                      jnp.float32),
+                            self._comm_quant_res_sharding)}
+                log_dist(
+                    f"comm_quantization: explicit grad reduce over "
+                    f"dp={world} wire={cqc.grad_reduce} "
+                    f"group_size={cqc.group_size} "
+                    f"error_feedback={self._comm_quant_state is not None}")
+            elif cqc.grad_reduce != "fp32":
+                logger.warning(
+                    "comm_quantization.grad_reduce: unsupported with this "
+                    "configuration (needs a >1 data-parallel mesh without "
+                    "TP/PP/SP, ZeRO stage <= 2, no param streaming / "
+                    "SuperOffload / optimizer store / 1-bit optimizer) — "
+                    "falling back to the implicit fp32 reduction")
+
         self._compile_steps()
 
     # ------------------------------------------------------------------
@@ -755,7 +815,18 @@ class DeepSpeedEngine:
         ls_window, ls_min = self._ls_window, self._ls_min
         fp16 = self.fp16_enabled
 
-        qwz = (cfg.zero_config.zero_quantized_weights and self.zero_stage >= 3)
+        # stage-3 gather quantization: the comm_quantization block's
+        # zero3_gather selects the wire dtype; the legacy ZeRO++
+        # zero_quantized_weights flag keeps meaning int8
+        cqc = cfg.comm_quantization
+        qwz_dtype = None
+        if self.zero_stage >= 3:
+            if cqc.enabled and cqc.zero3_gather != "fp32":
+                qwz_dtype = cqc.zero3_gather
+            elif cfg.zero_config.zero_quantized_weights:
+                qwz_dtype = "int8"
+        qwz = qwz_dtype is not None
+        qwz_group = cqc.group_size if cqc.enabled else 256
         rules = self.rules
 
         # -- sparse gradients (ref runtime/sparse_tensor.py + the sparse
@@ -770,12 +841,13 @@ class DeepSpeedEngine:
                         and not mc.tie_embeddings
                         and self.topology.pp_size == 1
                         and not self._param_stream and not qwz
-                        and self._compression is None)
+                        and self._compression is None
+                        and self._comm_quant is None)
         if cfg.sparse_gradients_enabled and not sparse_grads:
             logger.warning(
                 "sparse_gradients: unsupported with this configuration "
-                "(tied embeddings, pipeline, param streaming, or qwZ) — "
-                "falling back to dense gradients")
+                "(tied embeddings, pipeline, param streaming, qwZ, or "
+                "comm_quantization) — falling back to dense gradients")
         topo = self.topology
 
         def micro_grads_dense(params, batch, scale):
@@ -783,7 +855,8 @@ class DeepSpeedEngine:
                 if qwz:
                     from deepspeed_tpu.parallel.zeropp import qwz_weight_gather
 
-                    p = qwz_weight_gather(p, rules)
+                    p = qwz_weight_gather(p, rules, group_size=qwz_group,
+                                          wire_dtype=qwz_dtype)
                 loss = loss_fn(p, batch)
                 return loss * scale.astype(loss.dtype)
 
@@ -908,6 +981,86 @@ class DeepSpeedEngine:
                 body, (zeros, jnp.float32(0.0)), batch_stack)
             return grads, loss_sum
 
+        # -- explicit quantized DP gradient reduction (comm_quantization;
+        # comm/quantized.py) -------------------------------------------
+        cq = self._comm_quant
+        cq_ef = self._comm_quant_state is not None
+        if cq is not None:
+            from deepspeed_tpu.comm.quantized import quantized_all_reduce
+            from deepspeed_tpu.parallel.topology import BATCH_AXES as _Q_AXES
+            from deepspeed_tpu.utils.jax_compat import shard_map as _shard_map
+
+            q_world = topo.dp_size
+            q_pad = self._comm_quant_padded
+            q_wire, q_gs = cq.grad_reduce, cq.group_size
+            q_param_specs = jax.tree.map(lambda s: s.spec,
+                                         self.param_shardings)
+            q_grad_out_specs = jax.tree.map(lambda _: P(), q_param_specs,
+                                            is_leaf=lambda x: isinstance(x, P))
+
+            def accum_grads_quant(params, batch_stack, scale, residual):
+                """Explicit-reduce variant of accum_grads: gradients
+                accumulate LOCALLY inside a shard_map over the DP axes (no
+                implicit GSPMD reduction), then ONE quantized all-reduce
+                moves the flat buffer — int8/fp8 payload + fp32 block
+                scales on the wire, fp32 accumulation, optional LoCo-style
+                error-feedback residual carried across steps."""
+                batch_specs = {
+                    k: (P() if k == "dropout_key" or np.ndim(v) < 2
+                        else P(*([None, _Q_AXES]
+                                 + [None] * (np.ndim(v) - 2))))
+                    for k, v in batch_stack.items()}
+                err_spec = P(_Q_AXES) if cq_ef else P()
+
+                def local(params, batch_stack, scale, res):
+                    def body(carry, mb):
+                        grad_acc, loss_acc = carry
+                        loss, grads = micro_grads(params, mb, scale)
+                        grad_acc = jax.tree.map(
+                            lambda a, g: a + g.astype(jnp.float32),
+                            grad_acc, grads)
+                        return (grad_acc, loss_acc + loss), None
+
+                    zeros = jax.tree.map(
+                        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                    (grads, loss_sum), _ = lax.scan(
+                        body, (zeros, jnp.float32(0.0)), batch_stack)
+                    # local loss is a mean over this shard's rows; the
+                    # pmean restores the global-batch mean
+                    loss_sum = lax.pmean(loss_sum, _Q_AXES)
+                    leaves, treedef = jax.tree.flatten(grads)
+                    shapes = [x.shape for x in leaves]
+                    sizes = [int(np.prod(s)) for s in shapes]
+                    flat = jnp.concatenate([jnp.ravel(x) for x in leaves])
+                    flat = jnp.pad(flat, (0, q_pad - flat.size))
+                    # the residual is stored in UNSCALED grad units — the
+                    # flat buffer carries the fp16 loss-scale factor, and
+                    # a dynamic-scale change between steps would otherwise
+                    # mis-weight the carried compensation by old/new
+                    avg, new_r = quantized_all_reduce(
+                        flat, _Q_AXES, q_world, wire_dtype=q_wire,
+                        group_size=q_gs,
+                        residual=res[0] * scale if cq_ef else None)
+                    out, off = [], 0
+                    for shape, size in zip(shapes, sizes):
+                        out.append(avg[off:off + size].reshape(shape))
+                        off += size
+                    new_res = (new_r / scale)[None] if cq_ef else res
+                    return jax.tree.unflatten(treedef, out), loss_sum, new_res
+
+                res_in = residual if cq_ef else jnp.zeros((1, 1), jnp.float32)
+                mapped = _shard_map(
+                    local, mesh=topo.mesh,
+                    in_specs=(q_param_specs, batch_specs, P(), err_spec),
+                    out_specs=(q_grad_out_specs, P(), err_spec),
+                    check_vma=False)
+                grads, loss_sum, new_res = mapped(params, batch_stack, scale,
+                                                  res_in)
+                # stage-2 configs keep their sharded grad layout downstream
+                # (slicing a replicated mean is local — no extra comm)
+                grads = lax.with_sharding_constraint(grads, grad_shardings)
+                return grads, loss_sum, new_res
+
         def train_step(params, opt_state, ls_state, batch_stack, lr):
             """One full train batch: scan over gas micro-batches + update.
             micro_grads returns grads of scale·loss; apply_update divides the
@@ -955,6 +1108,41 @@ class DeepSpeedEngine:
         if self._param_stream:
             train_step = stream_train_step
 
+        if cq is not None:
+            def _quant_step_core(params, opt_state, ls_state, batch_stack,
+                                 lr, cq_res):
+                """One comm-quant train batch: grads → explicit quantized
+                reduce → the shared update; the residual rides the step
+                signature so one jitted program owns the whole thing."""
+                grads, loss_sum, new_res = accum_grads_quant(
+                    params, batch_stack, ls_state["scale"], cq_res)
+                new_params, new_opt, new_ls, grad_norm, finite = \
+                    apply_update(params, opt_state, grads, lr, ls_state)
+                metrics = {"loss": loss_sum / gas, "grad_norm": grad_norm,
+                           "loss_scale": ls_state["scale"],
+                           "skipped": jnp.logical_not(finite)}
+                return new_params, new_opt, new_ls, new_res, metrics, finite
+
+            if cq_ef:
+                def train_step(params, opt_state, ls_state, cq_res,  # noqa: F811
+                               batch_stack, lr):
+                    new_params, new_opt, new_ls, new_res, metrics, finite = \
+                        _quant_step_core(params, opt_state, ls_state,
+                                         batch_stack, lr, cq_res)
+                    # an overflow-skipped step must not poison the carried
+                    # residual (its compensation buffer contains the very
+                    # inf/NaN grads that made the step skip) — keep the
+                    # previous residual, matching the params/opt rollback
+                    new_res = jnp.where(finite, new_res, cq_res)
+                    return new_params, new_opt, new_ls, new_res, metrics
+            else:
+                def train_step(params, opt_state, ls_state,  # noqa: F811
+                               batch_stack, lr):
+                    new_params, new_opt, new_ls, _, metrics, _ = \
+                        _quant_step_core(params, opt_state, ls_state,
+                                         batch_stack, lr, None)
+                    return new_params, new_opt, new_ls, metrics
+
         if self._super_opt is not None:
             # SuperOffload path: device computes grads + norm + finite in
             # one jit; the optimizer step runs on the host (pipelined
@@ -986,12 +1174,21 @@ class DeepSpeedEngine:
                 grads_batch_store,
                 out_shardings=(self._replicated, self.grad_shardings))
 
-        state_out = (self.param_shardings, self.opt_shardings, self._replicated,
-                     jax.tree.map(lambda _: self._replicated,
-                                  {"loss": 0, "grad_norm": 0, "loss_scale": 0, "skipped": 0}))
+        metrics_sh = jax.tree.map(
+            lambda _: self._replicated,
+            {"loss": 0, "grad_norm": 0, "loss_scale": 0, "skipped": 0})
+        if cq is not None and cq_ef:
+            state_out = (self.param_shardings, self.opt_shardings,
+                         self._replicated, self._comm_quant_res_sharding,
+                         metrics_sh)
+            donate = (0, 1, 2, 3)
+        else:
+            state_out = (self.param_shardings, self.opt_shardings,
+                         self._replicated, metrics_sh)
+            donate = (0, 1, 2)
         self._train_step_jit = jax.jit(
             train_step,
-            donate_argnums=(0, 1, 2),
+            donate_argnums=donate,
             out_shardings=state_out)
 
         def micro_step(params, grad_acc, batch, scale):
@@ -1467,6 +1664,16 @@ class DeepSpeedEngine:
                 out[op] = {"count": count, "bytes": nbytes}
         return out
 
+    def _train_step_args(self, opt_state, batch_stack, lr):
+        """Argument tuple matching the active ``_train_step_jit``
+        signature (the comm-quant error-feedback path threads its
+        residual state between loss-scale state and the batch)."""
+        if self._comm_quant_state is not None:
+            return (self.params, opt_state, self.loss_scale_state,
+                    self._comm_quant_state["residual"], batch_stack, lr)
+        return (self.params, opt_state, self.loss_scale_state, batch_stack,
+                lr)
+
     def _train_batch_traced_body(self, data) -> jnp.ndarray:
         if self._onebit is not None:
             return self._train_batch_onebit(data)
@@ -1507,25 +1714,26 @@ class DeepSpeedEngine:
         else:
             opt_state = self._swap_in_opt_state()
             self._swap_in_params()
+            step_args = self._train_step_args(opt_state, batch_stack, lr)
             if self.telemetry is not None and self.telemetry.needs_flops():
                 # before the step runs, while donated buffers are still
                 # live (lowering reads their shapes); the compile() behind
                 # profile_compiled is a one-time AOT cost — see _step_flops
-                self.telemetry.set_flops(*self._step_flops(
-                    (self.params, opt_state, self.loss_scale_state,
-                     batch_stack, lr)))
+                self.telemetry.set_flops(*self._step_flops(step_args))
             if profiling:
                 self._last_flops_profile = \
                     self._flops_profiler.profile_engine_step(
-                        self, self.params, opt_state, self.loss_scale_state,
-                        batch_stack, lr)
+                        self, *step_args)
                 self._flops_profiler.print_profile(self._last_flops_profile)
             with self._tracer.span("train.dispatch", self._train_trace_id,
                                    self._step_span):
-                self.params, opt_state, self.loss_scale_state, metrics = \
-                    self._train_step_jit(self.params, opt_state,
-                                         self.loss_scale_state, batch_stack,
-                                         lr)
+                if self._comm_quant_state is not None:
+                    (self.params, opt_state, self.loss_scale_state,
+                     self._comm_quant_state["residual"], metrics) = \
+                        self._train_step_jit(*step_args)
+                else:
+                    self.params, opt_state, self.loss_scale_state, metrics = \
+                        self._train_step_jit(*step_args)
         self._swap_out_opt_state(opt_state)
         self._swap_out_params()
         self._prefetch_stores()

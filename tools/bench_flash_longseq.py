@@ -14,8 +14,17 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+if "--smoke" in sys.argv:
+    # CPU plumbing check — pin the platform BEFORE any backend touch (a
+    # down TPU tunnel would otherwise block forever; see bench.py)
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+
 import jax
 import jax.numpy as jnp
+
+if "--smoke" in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
 
 
 ITERS = 8
@@ -39,6 +48,71 @@ def attn_flops(b, h, s, d, causal=True):
     return f / 2 if causal else f
 
 
+def ring_sweep(fm, smoke: bool):
+    """The queued `_RING_BLK` 512-vs-1024 sweep (ROADMAP item 2 /
+    BENCH_MEASURED r06-r07): time one ring hop — a fused
+    ``flash_carry_block`` online-softmax update of the (m, l, acc) carry
+    against a visiting K/V block — at per-shard S_l >= 4k, d=128 GQA
+    geometry, per candidate block edge.  ``--smoke`` runs a tiny shape
+    through the Pallas interpreter (plumbing check only, no numbers of
+    record); on-chip: ``python tools/bench_flash_longseq.py --sweep``."""
+    if smoke:
+        fm.INTERPRET = True
+        cases = [(1, 4, 2, 256, 64)]       # b, hq, hkv, S_l, d
+        blocks = [128, 256]
+        hops = 2
+    else:
+        cases = [(1, 16, 8, 4096, 128), (1, 16, 8, 8192, 128)]
+        blocks = [512, 1024]
+        hops = ITERS
+    neg = float(np.finfo(np.float32).min)
+    for (b, hq, hkv, s_l, d) in cases:
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((b, hq, s_l, d)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((b, hkv, s_l, d)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((b, hkv, s_l, d)), jnp.bfloat16)
+        for blk in blocks:
+            prev = fm._RING_BLK
+            fm._RING_BLK = blk
+            try:
+                s_pad = fm.ring_carry_pad(s_l)
+                pad = lambda x: jnp.pad(  # noqa: E731
+                    x, ((0, 0), (0, 0), (0, s_pad - s_l), (0, 0)))
+                qp, kp, vp = pad(q), pad(k), pad(v)
+
+                @jax.jit
+                def one(qp, kp, vp):
+                    m0 = jnp.full((b, hq, s_pad, 128), neg, jnp.float32)
+                    l0 = jnp.zeros((b, hq, s_pad, 128), jnp.float32)
+                    a0 = jnp.zeros((b, hq, s_pad, d), jnp.float32)
+
+                    def hop(carry, src):
+                        m, l, acc = carry
+                        m, l, acc = fm.flash_carry_block(
+                            qp, kp, vp, m, l, acc,
+                            jnp.int32((hops - 1) * s_l),  # causally live q
+                            src * s_l, s_real=s_l, causal=True)
+                        return (m, l, acc), None
+
+                    (m, l, acc), _ = jax.lax.scan(
+                        hop, (m0, l0, a0),
+                        jnp.arange(hops, dtype=jnp.int32))
+                    return jnp.sum(acc) + jnp.sum(l[..., :1]) \
+                        + jnp.sum(m[..., :1])
+
+                t = timeit(one, qp, kp, vp) / max(1, hops) * ITERS
+            except Exception as e:
+                print(f"ring S_l={s_l} d={d} blk={blk}: FAILED "
+                      f"{str(e)[:200]}", flush=True)
+                fm._RING_BLK = prev
+                continue
+            fm._RING_BLK = prev
+            fl = attn_flops(b, hq, s_l, d, causal=False)  # one full hop
+            print(f"ring S_l={s_l} d={d} hq:hkv={hq}:{hkv} blk={blk}: "
+                  f"{t*1e3:.2f} ms/hop = {fl/t/1e12:.1f} TF/s "
+                  f"({fl/t/197e12:.1%})", flush=True)
+
+
 def main():
     # the package re-exports the flash_mha FUNCTION over the submodule
     # name — import the module itself for the _BLK_* knobs
@@ -47,6 +121,14 @@ def main():
     fm = importlib.import_module("deepspeed_tpu.ops.pallas.flash_mha")
 
     sweep = "--sweep" in sys.argv
+    smoke = "--smoke" in sys.argv
+    if sweep and smoke:
+        # CPU plumbing check of the ring sweep only (the MHA sweep below
+        # needs a real chip; interpreted 32k shapes would run for hours)
+        ring_sweep(fm, smoke=True)
+        return
+    if sweep:
+        ring_sweep(fm, smoke=False)
     blocks = [(None, None)]  # None → the shipped _choose_blocks heuristic
     if sweep:
         blocks = [(None, None), (512, 512), (512, 1024), (1024, 512),

@@ -56,6 +56,17 @@ EXPECTED_EVENT_NAMES = [
 EXPECTED_FLIGHT_REASONS = ["watchdog", "serve_crash", "engine_crash",
                            "manual"]
 
+# frozen quantized-collective comm-op vocabulary (comm/quantized.py
+# QUANT_COMM_OPS): every wire movement of the quantized ZeRO collectives
+# is recorded in CommsLogger — and therefore surfaces in the StepRecord
+# `comm` field — under one of these names.  Each must be documented in
+# docs/QUANTIZED_COMM.md; the bench comm-quant row keys below must appear
+# both in bench.py (so the lint trips when the row drifts) and the docs.
+QUANT_DOCS = os.path.join(REPO, "docs", "QUANTIZED_COMM.md")
+EXPECTED_QUANT_COMM_OPS = ["quant_all_gather", "quant_reduce_scatter"]
+QUANT_BENCH_KEYS = ["grad_reduce_bytes_fp32", "grad_reduce_bytes_quant",
+                    "bytes_reduction", "loss_delta"]
+
 
 def _exported_monitor_tags() -> List[str]:
     from deepspeed_tpu.serving.metrics import ServingMetrics
@@ -161,6 +172,54 @@ def check_span_names() -> List[str]:
     return errors
 
 
+def check_quant_comm() -> List[str]:
+    """Quantized-collective telemetry: frozen comm-op vocabulary matches
+    the module, every op and bench key is documented, and the bench row
+    actually emits the documented keys."""
+    from deepspeed_tpu.comm.quantized import QUANT_COMM_OPS
+
+    errors = []
+    if sorted(QUANT_COMM_OPS) != sorted(EXPECTED_QUANT_COMM_OPS):
+        errors.append(
+            "quantized.QUANT_COMM_OPS drifted from the frozen list: "
+            f"extra={sorted(set(QUANT_COMM_OPS) - set(EXPECTED_QUANT_COMM_OPS))}, "
+            f"missing={sorted(set(EXPECTED_QUANT_COMM_OPS) - set(QUANT_COMM_OPS))}"
+            " — update EXPECTED_QUANT_COMM_OPS + docs/QUANTIZED_COMM.md "
+            "together")
+    try:
+        with open(QUANT_DOCS, "r", encoding="utf-8") as f:
+            qdocs = f.read()
+    except OSError as e:
+        return errors + [f"cannot read {QUANT_DOCS}: {e}"]
+    for op in QUANT_COMM_OPS:
+        if f"`{op}`" not in qdocs:
+            errors.append(f"quant comm op {op!r} not documented in "
+                          f"{os.path.basename(QUANT_DOCS)}")
+    try:
+        with open(os.path.join(REPO, "bench.py"), "r",
+                  encoding="utf-8") as f:
+            bench_src = f.read()
+    except OSError as e:
+        return errors + [f"cannot read bench.py: {e}"]
+    for key in QUANT_BENCH_KEYS:
+        if f"`{key}`" not in qdocs:
+            errors.append(f"comm-quant bench key {key!r} not documented in "
+                          f"{os.path.basename(QUANT_DOCS)}")
+        if f'"{key}"' not in bench_src:
+            errors.append(f"comm-quant bench key {key!r} not emitted by "
+                          "bench.py (frozen QUANT_BENCH_KEYS drifted)")
+    # the observability comm-volume section must point readers at the
+    # quantized-collective docs (cross-link contract)
+    try:
+        with open(DOCS, "r", encoding="utf-8") as f:
+            if "QUANTIZED_COMM.md" not in f.read():
+                errors.append("docs/OBSERVABILITY.md does not cross-link "
+                              "QUANTIZED_COMM.md from its comm section")
+    except OSError as e:
+        errors.append(f"cannot read {DOCS}: {e}")
+    return errors
+
+
 def validate_chrome_trace(obj: Any) -> List[str]:
     """Structural validation of a Chrome trace-event JSON object (pass a
     path or the loaded dict).  Perfetto/chrome://tracing both accept the
@@ -227,7 +286,7 @@ def check_trace_export() -> List[str]:
 
 def run_all() -> List[str]:
     return (check_tags_documented() + check_schema() + check_span_names()
-            + check_trace_export())
+            + check_quant_comm() + check_trace_export())
 
 
 def main() -> int:
