@@ -425,6 +425,13 @@ def _longseq_ring_body():
                                  attn_impl="xla")
         batch_size, gas, steps, warmup = 2, 1, 2, 1
         mesh = {"seq": sp}
+        # route the ring inner block through the interpreted Pallas
+        # kernels so the smoke run exercises the FUSED fwd+bwd ring path
+        # (on TPU _kernel_enabled() selects it natively)
+        import importlib
+
+        importlib.import_module(
+            "deepspeed_tpu.ops.pallas.flash_mha").INTERPRET = True
     else:
         # d=128 GQA llama geometry (the longseq_llama row's model) with the
         # 32k sequence sharded over every chip in one ring
@@ -460,12 +467,15 @@ def _longseq_ring_body():
     engine.destroy()
     _reset_topology()
     mfu = _mfu(tps_chip, model, seq)
+    from deepspeed_tpu.sequence.ring import _kernel_enabled
+
     return {
         "metric": f"longseq_{seq}_ring_sp{sp}_train_tokens_per_sec_per_chip",
         "value": round(tps_chip, 1), "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.55, 3),
         "mfu": round(mfu, 3),
         "placement": "striped",
+        "ring_backward": "fused" if _kernel_enabled() else "xla",
         "telemetry_jsonl": _telemetry_jsonl("longseq_ring"),
         "trace_json": _trace_json("longseq_ring"),
     }

@@ -473,20 +473,12 @@ def _carry_kernel(info_ref, q_ref, k_ref, v_ref, mi_ref, li_ref, acci_ref,
         l_scr[...] = li_ref[0, 0]
         acc_scr[...] = acci_ref[0, 0]
 
-    # tile liveness/interiority from the hop's global position ranges
-    # (strides are positive, so block corners bound the tile's positions)
-    q_lo = q_off + q_stride * (iq * bq)
-    q_hi = q_off + q_stride * (iq * bq + bq - 1)
-    k_lo = k_off + k_stride * (ik * bk)
-    k_hi = k_off + k_stride * (ik * bk + bk - 1)
-    live = jnp.bool_(True)
-    interior = (ik * bk + bk <= s_real) & (iq * bq + bq <= s_real)
-    if causal:
-        live &= k_lo <= q_hi
-        interior &= k_hi <= q_lo
-    if window is not None:
-        live &= q_lo - k_hi < window
-        interior &= q_hi - k_lo < window
+    # tile liveness/interiority from the hop's global position ranges —
+    # the same shared predicates the backward kernels use, so the
+    # forward and backward masks cannot drift
+    live, interior = _ring_tile_liveness(
+        iq, ik, q_off, k_off, bq=bq, bk=bk, q_stride=q_stride,
+        k_stride=k_stride, s_real=s_real, causal=causal, window=window)
 
     def compute(masked):
         q = q_ref[0, 0]
@@ -494,15 +486,10 @@ def _carry_kernel(info_ref, q_ref, k_ref, v_ref, mi_ref, li_ref, acci_ref,
         v = v_ref[0, 0]
         s = _scores(q, k, sm_scale)
         if masked:
-            rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            rpos = q_off + q_stride * (iq * bq + rows)
-            cpos = k_off + k_stride * (ik * bk + cols)
-            valid = ((iq * bq + rows < s_real) & (ik * bk + cols < s_real))
-            if causal:
-                valid &= cpos <= rpos
-            if window is not None:
-                valid &= rpos - cpos < window
+            valid = _ring_tile_mask(
+                iq, ik, q_off, k_off, bq=bq, bk=bk, q_stride=q_stride,
+                k_stride=k_stride, s_real=s_real, causal=causal,
+                window=window)
             s = jnp.where(valid, s, NEG_INF)
         m_prev = m_scr[:, 0:1]
         l_prev = l_scr[:, 0:1]
@@ -605,6 +592,290 @@ def flash_carry_block(q, k, v, m, l, acc, q_off, k_off, *, q_stride=1,
         # it through so the per-hop scan never copies the running state
         input_output_aliases={4: 0, 5: 1, 6: 2},
     )(info, q, k, v, m, l, acc)
+
+
+# ----------------------------------------------------------------------
+# Ring-hop BACKWARD kernels: offset-aware dq / dkv flash passes.
+#
+# The ring backward (sequence/ring.py _ring_bwd_rule) reuses the saved
+# (o, lse) residuals, so each hop only needs p = exp(s - lse) — no
+# online-softmax carry.  What it does need, exactly like the forward's
+# flash_carry_block, is position-decoupled masking: the hop's q/k blocks
+# live at global positions ``off + stride·i`` with TRACED offsets riding
+# in SMEM (they derive from lax.axis_index inside shard_map) and static
+# strides (1 = contiguous shards, sp = striped placement).
+#
+# Both kernels ACCUMULATE: the running dq (resp. the traveling dk/dv)
+# ride in as fp32 HBM buffers aliased onto the outputs — scratch is
+# seeded from the incoming grad at the first sequential step and written
+# back at the last, so a hop updates the accumulators in place with no
+# copy and no score-shaped transient ever reaching HBM (the whole point:
+# the XLA fallback materializes four fp32 [S_l, S_l] blocks per hop).
+# Tiles fully excluded by the causal triangle / sliding window skip all
+# compute at grid level via ``pl.when`` on the offset arithmetic; unlike
+# the local backward, their DMA cannot be clamped away because liveness
+# depends on the traced offsets, which BlockSpec index maps never see.
+# ----------------------------------------------------------------------
+def _ring_tile_liveness(iq, ik, q_off, k_off, *, bq, bk, q_stride,
+                        k_stride, s_real, causal, window):
+    """(live, interior) predicates of a (iq, ik) tile from the hop's
+    global position ranges (strides are positive, so the block corners
+    bound every position in the tile)."""
+    q_lo = q_off + q_stride * (iq * bq)
+    q_hi = q_off + q_stride * (iq * bq + bq - 1)
+    k_lo = k_off + k_stride * (ik * bk)
+    k_hi = k_off + k_stride * (ik * bk + bk - 1)
+    live = jnp.bool_(True)
+    interior = (ik * bk + bk <= s_real) & (iq * bq + bq <= s_real)
+    if causal:
+        live &= k_lo <= q_hi
+        interior &= k_hi <= q_lo
+    if window is not None:
+        live &= q_lo - k_hi < window
+        interior &= q_hi - k_lo < window
+    return live, interior
+
+
+def _ring_tile_mask(iq, ik, q_off, k_off, *, bq, bk, q_stride, k_stride,
+                    s_real, causal, window):
+    """Elementwise validity of an edge tile (offset-aware analogue of
+    _block_mask, rows always range-checked — pad query rows carry lse = 0
+    garbage and must never contribute)."""
+    rows = lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    valid = (iq * bq + rows < s_real) & (ik * bk + cols < s_real)
+    rpos = q_off + q_stride * (iq * bq + rows)
+    cpos = k_off + k_stride * (ik * bk + cols)
+    if causal:
+        valid &= cpos <= rpos
+    if window is not None:
+        valid &= rpos - cpos < window
+    return valid
+
+
+def _ring_dq_kernel(info_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dqi_ref, dqo_ref, dq_scr, *, sm_scale,
+                    causal, window, bq, bk, q_stride, k_stride, s_real):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    nk = pl.num_programs(3)
+    q_off = info_ref[0]
+    k_off = info_ref[1]
+
+    @pl.when(ik == 0)
+    def _():
+        dq_scr[...] = dqi_ref[0, 0]
+
+    live, interior = _ring_tile_liveness(
+        iq, ik, q_off, k_off, bq=bq, bk=bk, q_stride=q_stride,
+        k_stride=k_stride, s_real=s_real, causal=causal, window=window)
+
+    def compute(masked):
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0][:, 0:1]
+        delta = delta_ref[0, 0][:, 0:1]
+        s = _scores(q, k, sm_scale)
+        if masked:
+            valid = _ring_tile_mask(
+                iq, ik, q_off, k_off, bq=bq, bk=bk, q_stride=q_stride,
+                k_stride=k_stride, s_real=s_real, causal=causal,
+                window=window)
+            s = jnp.where(valid, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        if masked:
+            # pad query rows carry lse = 0: exp(s - 0) on a pad row is
+            # garbage unless the mask kills it first
+            p = jnp.where(valid, p, 0.0)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    pl.when(jnp.logical_and(live, interior))(lambda: compute(False))
+    pl.when(jnp.logical_and(live, jnp.logical_not(interior)))(
+        lambda: compute(True))
+
+    @pl.when(ik == nk - 1)
+    def _():
+        dqo_ref[0, 0] = dq_scr[...]
+
+
+def _ring_dkv_kernel(info_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                     delta_ref, dki_ref, dvi_ref, dko_ref, dvo_ref,
+                     dk_scr, dv_scr, *, sm_scale, causal, window, bq, bk,
+                     q_stride, k_stride, s_real, group):
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+    nq = pl.num_programs(3)
+    q_off = info_ref[0]
+    k_off = info_ref[1]
+
+    @pl.when(iq == 0)
+    def _():
+        dk_scr[...] = dki_ref[0, 0]
+        dv_scr[...] = dvi_ref[0, 0]
+
+    live, interior = _ring_tile_liveness(
+        iq, ik, q_off, k_off, bq=bq, bk=bk, q_stride=q_stride,
+        k_stride=k_stride, s_real=s_real, causal=causal, window=window)
+
+    def compute(masked):
+        k = k_ref[0, 0]                                     # [bk, d]
+        v = v_ref[0, 0]
+        if masked:
+            valid = _ring_tile_mask(
+                iq, ik, q_off, k_off, bq=bq, bk=bk, q_stride=q_stride,
+                k_stride=k_stride, s_real=s_real, causal=causal,
+                window=window)
+        for g in range(group):                              # static loop
+            q = q_ref[0, g]                                 # [bq, d]
+            do = do_ref[0, g]
+            lse = lse_ref[0, g][:, 0:1]
+            delta = delta_ref[0, g][:, 0:1]
+            s = _scores(q, k, sm_scale)                     # [bq, bk]
+            if masked:
+                s = jnp.where(valid, s, NEG_INF)
+            p = jnp.exp(s - lse)
+            if masked:
+                # pad query rows carry garbage lse; kill them
+                p = jnp.where(valid, p, 0.0)
+            dv_scr[...] += jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta) * sm_scale
+            dk_scr[...] += jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    pl.when(jnp.logical_and(live, interior))(lambda: compute(False))
+    pl.when(jnp.logical_and(live, jnp.logical_not(interior)))(
+        lambda: compute(True))
+
+    @pl.when(iq == nq - 1)
+    def _():
+        dko_ref[0, 0] = dk_scr[...]
+        dvo_ref[0, 0] = dv_scr[...]
+
+
+def _ring_bwd_blocks(s_pad: int, group: int):
+    """Block edges for the ring backward: the dq kernel tiles at the
+    forward carry's `_RING_BLK`; the grouped dkv kernel halves its q-edge
+    under GQA (it holds the whole [group, bq] q-side — q/do plus the
+    128-lane fp32 lse/delta — per program; same VMEM reasoning as
+    _choose_blocks).  Both divide ring_carry_pad(s_l) by construction:
+    s_pad ≤ _RING_BLK is returned whole, larger s_pad is a multiple of
+    _RING_BLK and the halved edge divides the power-of-two block."""
+    bk = min(_RING_BLK, s_pad)
+    bq = bk if group == 1 else max(128, bk // 2)
+    return bq, bk
+
+
+def flash_ring_dq_block(q, k, v, do, lse, delta, dq, q_off, k_off, *,
+                        q_stride=1, k_stride=1, s_real=None, sm_scale=None,
+                        causal=True, window=None):
+    """One ring backward hop, dq side: accumulate this hop's dq
+    contribution against the visiting K/V block into ``dq`` in place.
+
+    ``q/do [B, Hq, S_pad, D]``; ``k/v [B, Hkv, S_pad, D]`` (GQA folded in
+    the index map); ``lse/delta [B, Hq, S_pad, 128]`` fp32 lane-replicated
+    (see :func:`bwd_lane_residuals`); ``dq [B, Hq, S_pad, D]`` fp32
+    running accumulator, aliased through.  ``q_off/k_off`` traced int32
+    global position offsets, ``q_stride/k_stride`` static strides — the
+    same contract as :func:`flash_carry_block`.  S_pad must be
+    ``ring_carry_pad(s_real)``.  Returns the updated ``dq``."""
+    b, hq, s_pad, d = q.shape
+    hkv = k.shape[1]
+    group = hq // hkv
+    s_real = s_pad if s_real is None else s_real
+    sm_scale = 1.0 / math.sqrt(d) if sm_scale is None else sm_scale
+    bq = bk = min(_RING_BLK, s_pad)
+    if s_pad % bq:
+        raise ValueError(f"S_pad={s_pad} not a multiple of the ring block "
+                         f"({bq}); pad with ring_carry_pad")
+    info = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+    grid = (b, hq, s_pad // bq, s_pad // bk)
+    q_spec = pl.BlockSpec((1, 1, bq, d),
+                          lambda ib, ih, iq, ik: (ib, ih, iq, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, d),
+                           lambda ib, ih, iq, ik: (ib, ih // group, ik, 0))
+    lane_spec = pl.BlockSpec((1, 1, bq, 128),
+                             lambda ib, ih, iq, ik: (ib, ih, iq, 0))
+    return pl.pallas_call(
+        functools.partial(_ring_dq_kernel, sm_scale=sm_scale, causal=causal,
+                          window=window, bq=bq, bk=bk, q_stride=q_stride,
+                          k_stride=k_stride, s_real=s_real),
+        grid=grid,
+        interpret=INTERPRET,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            q_spec, kv_spec, kv_spec, q_spec, lane_spec, lane_spec, q_spec,
+        ],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, hq, s_pad, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        # dq is read once (ik == 0) and rewritten in place — the per-hop
+        # scan never copies the accumulator
+        input_output_aliases={7: 0},
+    )(info, q, k, v, do, lse, delta, dq)
+
+
+def flash_ring_dkv_block(q, k, v, do, lse, delta, dk, dv, q_off, k_off, *,
+                         q_stride=1, k_stride=1, s_real=None, sm_scale=None,
+                         causal=True, window=None):
+    """One ring backward hop, dk/dv side: accumulate this hop's grads for
+    the VISITING K/V block into the traveling ``dk/dv`` buffers in place
+    (they rotate with their block; sequence/ring.py delivers them home).
+    Same layout/offset contract as :func:`flash_ring_dq_block`;
+    ``dk/dv [B, Hkv, S_pad, D]`` fp32, aliased through.  Returns the
+    updated ``(dk, dv)``."""
+    b, hq, s_pad, d = q.shape
+    hkv = k.shape[1]
+    group = hq // hkv
+    s_real = s_pad if s_real is None else s_real
+    sm_scale = 1.0 / math.sqrt(d) if sm_scale is None else sm_scale
+    bq, bk = _ring_bwd_blocks(s_pad, group)
+    if s_pad % bq or s_pad % bk:
+        raise ValueError(f"S_pad={s_pad} not a multiple of the ring "
+                         f"backward blocks ({bq}, {bk}); pad with "
+                         "ring_carry_pad")
+    info = jnp.stack([jnp.asarray(q_off, jnp.int32),
+                      jnp.asarray(k_off, jnp.int32)])
+    grid = (b, hkv, s_pad // bk, s_pad // bq)   # iq innermost-sequential
+    grp_spec = pl.BlockSpec((1, group, bq, d),
+                            lambda ib, ihkv, ik, iq: (ib, ihkv, iq, 0))
+    grp_lane_spec = pl.BlockSpec((1, group, bq, 128),
+                                 lambda ib, ihkv, ik, iq: (ib, ihkv, iq, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, d),
+                           lambda ib, ihkv, ik, iq: (ib, ihkv, ik, 0))
+    return pl.pallas_call(
+        functools.partial(_ring_dkv_kernel, sm_scale=sm_scale,
+                          causal=causal, window=window, bq=bq, bk=bk,
+                          q_stride=q_stride, k_stride=k_stride,
+                          s_real=s_real, group=group),
+        grid=grid,
+        interpret=INTERPRET,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            grp_spec, kv_spec, kv_spec, grp_spec, grp_lane_spec,
+            grp_lane_spec, kv_spec, kv_spec,
+        ],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, s_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, s_pad, d), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        input_output_aliases={7: 0, 8: 1},
+    )(info, q, k, v, do, lse, delta, dk, dv)
 
 
 # ----------------------------------------------------------------------
@@ -723,6 +994,23 @@ def _lanes(x, s_pad):  # [B, H, S] -> [B, H, s_pad, 128] lane-broadcast
     return jnp.broadcast_to(x[..., None], x.shape + (128,))
 
 
+def attn_delta(o, do):
+    """``delta = sum(do·o)`` per query row in fp32 — the shared softmax-
+    backward correction term of EVERY flash backward (local resident,
+    local KV-blocked, and the ring's fused and XLA paths), computed once
+    per shard from the saved output."""
+    return jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+
+def bwd_lane_residuals(o, do, lse, s_pad):
+    """Shared backward-residual prep for the flash dq/dkv kernels:
+    ``o/do [B, H, S, D]``, ``lse [B, H, S]`` fp32 → lane-replicated,
+    tail-padded ``(lse, delta) [B, H, s_pad, 128]`` fp32.  One helper so
+    the local backward and the ring backward (sequence/ring.py) cannot
+    drift in how they reshape the saved residuals."""
+    return _lanes(lse, s_pad), _lanes(attn_delta(o, do), s_pad)
+
+
 def _bwd_blocked(q, k, v, o, lse, g, causal, sm_scale, window=None):
     b, hq, s_real, d = q.shape
     hkv = k.shape[1]
@@ -730,11 +1018,10 @@ def _bwd_blocked(q, k, v, o, lse, g, causal, sm_scale, window=None):
     bq, bk = _choose_blocks(group)
     step = max(bq, bk)
     s_pad = -(-s_real // step) * step
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
 
     qp, kp, vp = _pad_seq(q, s_pad), _pad_seq(k, s_pad), _pad_seq(v, s_pad)
     gp = _pad_seq(g, s_pad)
-    lsep, deltap = _lanes(lse, s_pad), _lanes(delta, s_pad)
+    lsep, deltap = bwd_lane_residuals(o, g, lse, s_pad)
 
     q_spec = pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0))
     kv_spec = pl.BlockSpec((1, 1, bk, d),
@@ -819,11 +1106,10 @@ def _bwd_impl(q, k, v, o, lse, g, causal, sm_scale, window=None):
     s_pad = s_pad128
     bq = _choose_bq(s_pad)
     s_pad = -(-s_real // bq) * bq
-    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
 
     qp, kp, vp = _pad_seq(q, s_pad), _pad_seq(k, s_pad), _pad_seq(v, s_pad)
     gp = _pad_seq(g, s_pad)
-    lsep, deltap = _lanes(lse, s_pad), _lanes(delta, s_pad)
+    lsep, deltap = bwd_lane_residuals(o, g, lse, s_pad)
 
     kv_spec = pl.BlockSpec((1, 1, s_pad, d),
                            lambda ib, ih, iq: (ib, ih // group, 0, 0))

@@ -15,13 +15,15 @@ Perf-grade inner block: on TPU (or under the Pallas interpreter) each
 FORWARD hop is ONE fused flash pass — :func:`flash_carry_block` threads
 the online softmax carry (m, l, acc) through the kernel, so no fp32
 ``[S_l, S_l]`` score block reaches HBM on the forward and causally-dead
-tiles are skipped at the grid level.  Off-TPU the same math runs as XLA
-einsums (the CPU test mesh), so parity tests cover both paths.  The
-BACKWARD hops are currently XLA einsums and do materialize per-hop
-score-shaped fp32 intermediates — fusing them through offset-aware
-variants of the existing dq/dkv flash kernels is the queued next step
-(BENCH_MEASURED_r06.json); until then long-sequence training memory is
-bounded by the backward, not the forward.
+tiles are skipped at the grid level.  The BACKWARD hops are fused the
+same way: offset-aware dq/dkv flash kernels
+(:func:`flash_ring_dq_block` / :func:`flash_ring_dkv_block`) reuse the
+saved (o, lse) residuals, compute ``delta = sum(do·o)`` ONCE per shard,
+and accumulate straight into HBM buffers aliased in place — backward
+transient memory drops from score-shaped (four fp32 [S_l, S_l] blocks
+per hop) to block-shaped ([blk, blk] VMEM tiles).  Off-TPU the same
+math runs as XLA einsums (the CPU test mesh) behind the same
+``_kernel_enabled()`` gate, so parity tests cover both paths.
 
 Causal scheduling: with the default ``contiguous`` placement, hops whose
 source block lies entirely in the masked future are skipped outright
@@ -55,6 +57,7 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
@@ -169,6 +172,52 @@ def _kernel_enabled() -> bool:
 
 
 # ----------------------------------------------------------------------
+# Hop rotation: every buffer that travels the ring in one hop moves in
+# ONE collective launch.
+# ----------------------------------------------------------------------
+def _to_words(x):
+    """Flatten to raw 32-bit words (bit-exact; 2-byte dtypes pack in
+    pairs, so no wire inflation for bf16 K/V next to fp32 grads)."""
+    if x.dtype.itemsize == 4:
+        flat = x.reshape(-1)
+        return flat if x.dtype == jnp.uint32 \
+            else lax.bitcast_convert_type(flat, jnp.uint32)
+    return lax.bitcast_convert_type(x.reshape(-1, 2), jnp.uint32)
+
+
+def _from_words(w, shape, dtype):
+    if dtype.itemsize == 4:
+        return w if dtype == jnp.uint32 \
+            else lax.bitcast_convert_type(w, dtype)
+    return lax.bitcast_convert_type(w, dtype).reshape(shape)
+
+
+def _rotate_together(perm, *xs):
+    """Rotate every traveling buffer one ring neighbour in a SINGLE
+    ``lax.ppermute``: flatten each to raw 32-bit words, concatenate,
+    permute once, split and bitcast back.  ``lax.ppermute`` on a tuple
+    tree-maps into one collective per leaf — on the backward ring that
+    was four serialized collective-permute launches per hop for
+    (kc, vc, dk_t, dv_t); one fused message keeps the ICI pipe busy with
+    a single transfer the compiler can overlap with the hop's kernels.
+    Byte-exact for 4-byte and even-sized 2-byte dtypes; anything else
+    falls back to per-buffer permutes."""
+    if any(x.dtype.itemsize not in (2, 4)
+           or (x.dtype.itemsize == 2 and int(np.prod(x.shape)) % 2)
+           for x in xs):  # pragma: no cover - no such dtype travels today
+        return tuple(lax.ppermute(x, SEQ_AXIS, perm) for x in xs)
+    words = lax.ppermute(jnp.concatenate([_to_words(x) for x in xs]),
+                         SEQ_AXIS, perm)
+    out, i = [], 0
+    for x in xs:
+        n = int(np.prod(x.shape)) * x.dtype.itemsize // 4
+        out.append(_from_words(words[i:i + n], x.shape, x.dtype)
+                   .reshape(x.shape))
+        i += n
+    return tuple(out)
+
+
+# ----------------------------------------------------------------------
 # Local (per-shard) forward: XLA einsum path and Pallas flash path.
 # Both return (o [b, s_l, nh, d], lse [b, nkv, rep, s_l] fp32).
 # ----------------------------------------------------------------------
@@ -221,8 +270,7 @@ def _ring_fwd_xla(ql, kl, vl, spec: _RingSpec):
         m, l, acc, kc, vc = carry
         src = lax.rem(idx - t + spec.sp, spec.sp)
         m, l, acc = maybe_attend(m, l, acc, kc, vc, src)
-        kc = lax.ppermute(kc, SEQ_AXIS, perm)
-        vc = lax.ppermute(vc, SEQ_AXIS, perm)
+        kc, vc = _rotate_together(perm, kc, vc)
         return (m, l, acc, kc, vc), None
 
     m0 = jnp.full((b, nkv, rep, s_l, 1), _NEG, jnp.float32)
@@ -286,8 +334,7 @@ def _ring_fwd_flash(ql, kl, vl, spec: _RingSpec):
         m, l, acc, kc, vc = carry
         src = lax.rem(idx - t + spec.sp, spec.sp)
         m, l, acc = maybe_attend(m, l, acc, kc, vc, src)
-        kc = lax.ppermute(kc, SEQ_AXIS, perm)
-        vc = lax.ppermute(vc, SEQ_AXIS, perm)
+        kc, vc = _rotate_together(perm, kc, vc)
         return (m, l, acc, kc, vc), None
 
     m0 = jnp.full((b, nh, s_pad, 128), _NEG, jnp.float32)
@@ -330,12 +377,24 @@ def _ring_bwd_rule(spec: _RingSpec, res, do):
     hop recomputes only its own p = exp(s - lse) block and accumulates
     dq locally while dk/dv TRAVEL WITH their K/V block; one final
     ppermute delivers them to their owner shard.  Dead hops (fully-masked
-    source blocks) are skipped like the forward.
+    source blocks) are skipped like the forward, and every hop moves all
+    four traveling buffers (kc, vc, dk_t, dv_t) in ONE stacked permute
+    (:func:`_rotate_together`).
 
-    The per-hop grads are XLA einsums (s/p/dp/ds are score-shaped fp32
-    transients, ~4·s_l²·nkv·rep·4 B per hop) — the fused-kernel backward
-    (offset-aware dq/dkv flash kernels) is the queued follow-up; see the
-    module docstring."""
+    On TPU / under the Pallas interpreter (``spec.use_flash``, the same
+    gate as the forward) each hop's grads are TWO fused flash passes —
+    offset-aware dq and dkv kernels accumulating in place — so no
+    score-shaped fp32 transient reaches HBM.  Off-TPU the grads are XLA
+    einsums (the CPU parity fallback), which do materialize the four
+    fp32 [S_l, S_l] blocks per hop."""
+    if spec.use_flash:
+        return _ring_bwd_flash(spec, res, do)
+    return _ring_bwd_xla(spec, res, do)
+
+
+def _ring_bwd_xla(spec: _RingSpec, res, do):
+    """XLA einsum backward hop (CPU/parity fallback): score-shaped fp32
+    transients (s/p/dp/ds, ~4·s_l²·nkv·rep·4 B per hop)."""
     ql, kl, vl, o, lse = res
     masked = spec.causal or spec.window is not None
     idx = lax.axis_index(SEQ_AXIS) if masked else jnp.int32(0)
@@ -345,8 +404,10 @@ def _ring_bwd_rule(spec: _RingSpec, res, do):
     q5 = ql.astype(jnp.float32).reshape(b, s_l, nkv, rep, d)
     do5 = do.astype(jnp.float32).reshape(b, s_l, nkv, rep, d)
     o5 = o.astype(jnp.float32).reshape(b, s_l, nkv, rep, d)
+    from deepspeed_tpu.ops.pallas.flash_mha import attn_delta
+
     # delta = sum(do * o) per query row — [b, nkv, rep, s_l, 1]
-    delta = jnp.sum(do5 * o5, axis=-1).transpose(0, 2, 3, 1)[..., None]
+    delta = attn_delta(o5, do5).transpose(0, 2, 3, 1)[..., None]
     lse_ = lse[..., None]                            # [b, nkv, rep, s_l, 1]
     q_pos = _block_positions(idx, s_l, spec.sp, spec.placement)
     perm = [(i, (i + 1) % spec.sp) for i in range(spec.sp)]
@@ -379,31 +440,110 @@ def _ring_bwd_rule(spec: _RingSpec, res, do):
 
     zq = jnp.zeros((b, s_l, nkv, rep, d), jnp.float32)
     zk = jnp.zeros((b, s_l, nkv, d), jnp.float32)
+    # distinct zero block for dv: shape-identical to zk today, but dk/dv
+    # layouts must be free to diverge without silently wrong grads
+    zv = jnp.zeros((b, s_l, nkv, d), jnp.float32)
 
     def hop(carry, t):
         dq, dk_t, dv_t, kc, vc = carry
         src = lax.rem(idx - t + spec.sp, spec.sp)
-        dq_c, dk_c, dv_c = maybe_grads(kc, vc, src, zq, zk, zk)
+        dq_c, dk_c, dv_c = maybe_grads(kc, vc, src, zq, zk, zv)
         dq = dq + dq_c
         dk_t = dk_t + dk_c
         dv_t = dv_t + dv_c
-        # K/V and their accumulated grads rotate together
-        kc = lax.ppermute(kc, SEQ_AXIS, perm)
-        vc = lax.ppermute(vc, SEQ_AXIS, perm)
-        dk_t = lax.ppermute(dk_t, SEQ_AXIS, perm)
-        dv_t = lax.ppermute(dv_t, SEQ_AXIS, perm)
+        # K/V and their accumulated grads rotate together, in one launch
+        kc, vc, dk_t, dv_t = _rotate_together(perm, kc, vc, dk_t, dv_t)
         return (dq, dk_t, dv_t, kc, vc), None
 
     (dq, dk_t, dv_t, kc, vc), _ = lax.scan(
-        hop, (zq, zk, zk, kl, vl), jnp.arange(spec.sp - 1))
+        hop, (zq, zk, zv, kl, vl), jnp.arange(spec.sp - 1))
     src_last = lax.rem(idx + 1, spec.sp)
-    dq_c, dk_c, dv_c = maybe_grads(kc, vc, src_last, zq, zk, zk)
+    dq_c, dk_c, dv_c = maybe_grads(kc, vc, src_last, zq, zk, zv)
     dq = dq + dq_c
     # the traveling grads sit one rank behind their owner — deliver home
-    dk_t = lax.ppermute(dk_t + dk_c, SEQ_AXIS, perm)
-    dv_t = lax.ppermute(dv_t + dv_c, SEQ_AXIS, perm)
+    dk_t, dv_t = _rotate_together(perm, dk_t + dk_c, dv_t + dv_c)
     return (dq.reshape(b, s_l, nh, d).astype(ql.dtype),
             dk_t.astype(kl.dtype), dv_t.astype(vl.dtype))
+
+
+def _ring_bwd_flash(spec: _RingSpec, res, do):
+    """Fused backward hop: offset-aware dq/dkv flash kernels
+    (flash_ring_dq_block / flash_ring_dkv_block) reuse the saved
+    (o, lse), consume ``delta = sum(do·o)`` computed ONCE per shard, and
+    accumulate into fp32 HBM buffers aliased in place — per-hop
+    transients are [blk, blk] VMEM tiles, never an [S_l, S_l] score
+    block.  Dead tiles inside a live hop are skipped at the kernel grid
+    level from the same traced offsets the forward carry kernel uses."""
+    from deepspeed_tpu.ops.pallas.flash_mha import (bwd_lane_residuals,
+                                                    flash_ring_dq_block,
+                                                    flash_ring_dkv_block,
+                                                    ring_carry_pad)
+
+    ql, kl, vl, o, lse = res
+    b, s_l, nh, d = ql.shape
+    nkv = kl.shape[2]
+    masked = spec.causal or spec.window is not None
+    idx = lax.axis_index(SEQ_AXIS) if masked else jnp.int32(0)
+    stride = spec.sp if spec.placement == "striped" else 1
+    s_pad = ring_carry_pad(s_l)
+
+    def to_kernel(x):  # [b, s, h, d] -> [b, h, s_pad, d]
+        x = x.swapaxes(1, 2)
+        if s_pad != s_l:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, s_pad - s_l), (0, 0)))
+        return x
+
+    qk, kk, vk, dok = (to_kernel(x) for x in (ql, kl, vl, do))
+    # residual prep shared with the local flash backward (one helper so
+    # the two paths can't drift): lane-replicated lse + per-shard delta
+    lsep, deltap = bwd_lane_residuals(
+        o.swapaxes(1, 2), do.swapaxes(1, 2), lse.reshape(b, nh, s_l),
+        s_pad)
+    q_off = (idx if spec.placement == "striped"
+             else idx * s_l).astype(jnp.int32)
+    perm = [(i, (i + 1) % spec.sp) for i in range(spec.sp)]
+
+    def hop_grads(dq, dk_t, dv_t, kc, vc, src):
+        k_off = (src if spec.placement == "striped"
+                 else src * s_l).astype(jnp.int32)
+        kw = dict(q_stride=stride, k_stride=stride, s_real=s_l,
+                  sm_scale=spec.scale, causal=spec.causal,
+                  window=spec.window)
+        dq = flash_ring_dq_block(qk, kc, vc, dok, lsep, deltap, dq,
+                                 q_off, k_off, **kw)
+        dk_t, dv_t = flash_ring_dkv_block(qk, kc, vc, dok, lsep, deltap,
+                                          dk_t, dv_t, q_off, k_off, **kw)
+        return dq, dk_t, dv_t
+
+    def maybe_grads(dq, dk_t, dv_t, kc, vc, src):
+        if not masked:
+            return hop_grads(dq, dk_t, dv_t, kc, vc, src)
+        return lax.cond(_hop_dead(idx, src, s_l, spec),
+                        lambda: (dq, dk_t, dv_t),
+                        lambda: hop_grads(dq, dk_t, dv_t, kc, vc, src))
+
+    dq0 = jnp.zeros((b, nh, s_pad, d), jnp.float32)
+    zk = jnp.zeros((b, nkv, s_pad, d), jnp.float32)
+    zv = jnp.zeros((b, nkv, s_pad, d), jnp.float32)
+
+    def hop(carry, t):
+        dq, dk_t, dv_t, kc, vc = carry
+        src = lax.rem(idx - t + spec.sp, spec.sp)
+        dq, dk_t, dv_t = maybe_grads(dq, dk_t, dv_t, kc, vc, src)
+        # K/V and their accumulated grads rotate together, in one launch
+        kc, vc, dk_t, dv_t = _rotate_together(perm, kc, vc, dk_t, dv_t)
+        return (dq, dk_t, dv_t, kc, vc), None
+
+    (dq, dk_t, dv_t, kc, vc), _ = lax.scan(
+        hop, (dq0, zk, zv, kk, vk), jnp.arange(spec.sp - 1))
+    src_last = lax.rem(idx + 1, spec.sp)
+    dq, dk_t, dv_t = maybe_grads(dq, dk_t, dv_t, kc, vc, src_last)
+    # the traveling grads sit one rank behind their owner — deliver home
+    dk_t, dv_t = _rotate_together(perm, dk_t, dv_t)
+    dq = dq[:, :, :s_l].swapaxes(1, 2).astype(ql.dtype)
+    dk = dk_t[:, :, :s_l].swapaxes(1, 2).astype(kl.dtype)
+    dv = dv_t[:, :, :s_l].swapaxes(1, 2).astype(vl.dtype)
+    return dq, dk, dv
 
 
 _ring_local.defvjp(_ring_fwd_rule, _ring_bwd_rule)

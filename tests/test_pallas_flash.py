@@ -439,3 +439,84 @@ def test_carry_kernel_chains_to_full_attention(causal, stride):
     np.testing.assert_allclose(np.asarray(out),
                                np.asarray(ref[:, :, ref_rows]),
                                rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal,stride", [(True, 1), (False, 1), (True, 4)])
+def test_ring_bwd_kernels_chain_to_reference_grads(causal, stride):
+    """flash_ring_dq_block / flash_ring_dkv_block (the fused ring
+    backward): accumulating per-block grads over key blocks fed in
+    ARBITRARY hop order must reproduce the dense-attention gradients —
+    dq for the local query shard (aliased accumulator across hops) and
+    dk/dv per visiting block.  stride=4 exercises the striped-placement
+    position arithmetic shared with the forward carry kernel."""
+    ks = jax.random.split(jax.random.PRNGKey(12), 4)
+    b, h, s_l, d, hops = 1, 2, 128, 32, 4
+    s = s_l * hops
+    scale = 1.0 / np.sqrt(d)
+    k = jax.random.normal(ks[1], (b, h, s, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, h, s, d), jnp.float32)
+    qk = jax.random.normal(ks[0], (b, h, s_l, d), jnp.float32)
+    do = jax.random.normal(ks[3], (b, h, s_l, d), jnp.float32)
+
+    # one query shard at global positions q_stride*i against the FULL
+    # key sequence (dq is row-independent; dk/dv from a single shard are
+    # exactly this reference's dk/dv)
+    if stride == 1:
+        q_stride, qpos = 1, np.arange(s_l)
+        blocks = [(j * s_l, k[:, :, j * s_l:(j + 1) * s_l],
+                   v[:, :, j * s_l:(j + 1) * s_l]) for j in range(hops)]
+        merge = lambda parts: jnp.concatenate(  # noqa: E731
+            [p for _, p in sorted(parts.items())], axis=2)
+    else:
+        q_stride, qpos = hops, np.arange(0, s, hops)
+        blocks = [(j, k[:, :, j::hops], v[:, :, j::hops])
+                  for j in range(hops)]
+
+        def merge(parts):
+            out = np.zeros((b, h, s, d), np.float32)
+            for j, p in parts.items():
+                out[:, :, j::hops] = np.asarray(p)
+            return jnp.asarray(out)
+
+    kpos = np.arange(s)
+    valid = np.ones((s_l, s), bool)
+    if causal:
+        valid = qpos[:, None] >= kpos[None, :]
+    vmask = jnp.asarray(valid)[None, None]
+
+    def ref_out(qk, k, v):
+        sc = jnp.einsum("bhqd,bhkd->bhqk", qk, k) * scale
+        sc = jnp.where(vmask, sc, -1e30)
+        return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(sc, -1), v)
+
+    dq_ref, dk_ref, dv_ref = jax.grad(
+        lambda qk, k, v: jnp.sum(ref_out(qk, k, v) * do),
+        argnums=(0, 1, 2))(qk, k, v)
+
+    # the kernels consume the saved forward residuals: o, lse, delta
+    sc = jnp.einsum("bhqd,bhkd->bhqk", qk, k) * scale
+    sc = jnp.where(vmask, sc, -1e30)
+    lse = jax.scipy.special.logsumexp(sc, axis=-1)        # [b, h, s_l]
+    o = ref_out(qk, k, v)
+    lsep, deltap = fm.bwd_lane_residuals(o, do, lse, s_l)
+
+    dq = jnp.zeros((b, h, s_l, d), jnp.float32)
+    dk_parts, dv_parts = {}, {}
+    for k_off, kc, vc in reversed(blocks):   # arbitrary order on purpose
+        kw = dict(q_stride=q_stride, k_stride=stride if stride > 1 else 1,
+                  s_real=s_l, sm_scale=scale, causal=causal)
+        dq = fm.flash_ring_dq_block(qk, kc, vc, do, lsep, deltap, dq,
+                                    jnp.int32(0), jnp.int32(k_off), **kw)
+        zk = jnp.zeros((b, h, s_l, d), jnp.float32)
+        zv = jnp.zeros((b, h, s_l, d), jnp.float32)
+        dk_b, dv_b = fm.flash_ring_dkv_block(
+            qk, kc, vc, do, lsep, deltap, zk, zv,
+            jnp.int32(0), jnp.int32(k_off), **kw)
+        dk_parts[k_off], dv_parts[k_off] = dk_b, dv_b
+
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_ref),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(merge(dk_parts)),
+                               np.asarray(dk_ref), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(merge(dv_parts)),
+                               np.asarray(dv_ref), rtol=2e-4, atol=2e-4)

@@ -6,6 +6,7 @@ Not part of the suite."""
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
@@ -113,6 +114,128 @@ def ring_sweep(fm, smoke: bool):
                   f"({fl/t/197e12:.1%})", flush=True)
 
 
+def bwd_sweep(fm, smoke: bool):
+    """--bwd: per-hop ring BACKWARD timing (ROADMAP item 2 acceptance) —
+    the fused offset-aware dq/dkv flash kernels vs the XLA einsum hop of
+    the ``sequence/ring.py`` fallback, on the same fully-live causal hop,
+    plus an estimated peak per-hop transient-bytes figure for each path:
+    SCORE-shaped for the einsums (s/p/dp/ds fp32, 4·S_l²·hkv·rep·4 B) vs
+    BLOCK-shaped for the kernels (≈4 fp32 [bq, bk] tiles per program,
+    grid-sequential so they never coexist across programs).  One JSON row
+    per case with the frozen keys linted by tools/telemetry_check.py
+    ``RING_BWD_BENCH_KEYS``.  ``--bwd --smoke`` runs a tiny shape through
+    the Pallas interpreter and asserts the fused estimate really is
+    block-shaped; on-chip: ``python tools/bench_flash_longseq.py --bwd``."""
+    if smoke:
+        fm.INTERPRET = True
+        cases = [(1, 4, 2, 256, 64)]       # b, hq, hkv, S_l, d
+        hops = 2
+    else:
+        cases = [(1, 16, 8, 4096, 128), (1, 16, 8, 8192, 128)]
+        hops = ITERS
+    for (b, hq, hkv, s_l, d) in cases:
+        rep = hq // hkv
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((b, hq, s_l, d)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((b, hkv, s_l, d)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((b, hkv, s_l, d)), jnp.bfloat16)
+        do = jnp.asarray(rng.standard_normal((b, hq, s_l, d)), jnp.bfloat16)
+        s_pad = fm.ring_carry_pad(s_l)
+        assert s_pad == s_l, "bench cases are block-aligned"
+        # q one block AHEAD of the visiting K/V block: every tile of the
+        # causal hop is live — the worst-case (dense) per-hop cost
+        q_off, k_off = jnp.int32(s_l), jnp.int32(0)
+        neg = float(np.finfo(np.float32).min)
+
+        # forward residuals the backward consumes: one carry hop -> o, lse
+        m0 = jnp.full((b, hq, s_l, 128), neg, jnp.float32)
+        l0 = jnp.zeros((b, hq, s_l, 128), jnp.float32)
+        a0 = jnp.zeros((b, hq, s_l, d), jnp.float32)
+        m, l, acc = jax.jit(fm.flash_carry_block, static_argnames=(
+            "q_stride", "k_stride", "s_real", "sm_scale", "causal",
+            "window"))(q, k, v, m0, l0, a0, q_off, k_off, s_real=s_l,
+                       causal=True)
+        l1 = jnp.maximum(l[..., 0], 1e-20)
+        o = (acc / l1[..., None]).astype(q.dtype)
+        lse = m[..., 0] + jnp.log(l1)
+        lsep, deltap = fm.bwd_lane_residuals(o, do, lse, s_l)
+
+        @jax.jit
+        def fused(q, k, v, do, lsep, deltap):
+            dq0 = jnp.zeros((b, hq, s_l, d), jnp.float32)
+            dk0 = jnp.zeros((b, hkv, s_l, d), jnp.float32)
+            dv0 = jnp.zeros((b, hkv, s_l, d), jnp.float32)
+
+            def hop(carry, _):
+                dq, dk, dv = carry
+                dq = fm.flash_ring_dq_block(
+                    q, k, v, do, lsep, deltap, dq, q_off, k_off,
+                    s_real=s_l, causal=True)
+                dk, dv = fm.flash_ring_dkv_block(
+                    q, k, v, do, lsep, deltap, dk, dv, q_off, k_off,
+                    s_real=s_l, causal=True)
+                return (dq, dk, dv), None
+
+            (dq, dk, dv), _ = jax.lax.scan(
+                hop, (dq0, dk0, dv0), None, length=hops)
+            return jnp.sum(dq) + jnp.sum(dk) + jnp.sum(dv)
+
+        @jax.jit
+        def xla(q, k, v, do, lse, o):
+            # the einsum hop of sequence/ring.py _ring_bwd_xla, dense
+            q5 = q.astype(jnp.float32).reshape(b, hkv, rep, s_l, d)
+            do5 = do.astype(jnp.float32).reshape(b, hkv, rep, s_l, d)
+            o5 = o.astype(jnp.float32).reshape(b, hkv, rep, s_l, d)
+            delta = jnp.sum(do5 * o5, -1)[..., None]
+            lse_ = lse.reshape(b, hkv, rep, s_l)[..., None]
+            kf = k.astype(jnp.float32).swapaxes(1, 2)     # [b, s, c, d]
+            vf = v.astype(jnp.float32).swapaxes(1, 2)
+            scale = 1.0 / np.sqrt(d)
+
+            def hop(carry, _):
+                dq, dk, dv = carry
+                s = jnp.einsum("bcgqd,bscd->bcgqs", q5, kf) * scale
+                p = jnp.exp(s - lse_)
+                dv_c = jnp.einsum("bcgqs,bcgqd->bscd", p, do5)
+                dp = jnp.einsum("bcgqd,bscd->bcgqs", do5, vf)
+                ds = p * (dp - delta) * scale
+                dq_c = jnp.einsum("bcgqs,bscd->bcgqd", ds, kf)
+                dk_c = jnp.einsum("bcgqs,bcgqd->bscd", ds, q5)
+                return (dq + dq_c, dk + dk_c, dv + dv_c), None
+
+            z_q = jnp.zeros((b, hkv, rep, s_l, d), jnp.float32)
+            z_kv = jnp.zeros((b, s_l, hkv, d), jnp.float32)
+            (dq, dk, dv), _ = jax.lax.scan(
+                hop, (z_q, z_kv, z_kv), None, length=hops)
+            return jnp.sum(dq) + jnp.sum(dk) + jnp.sum(dv)
+
+        try:
+            t_f = timeit(fused, q, k, v, do, lsep, deltap) \
+                / max(1, hops) * ITERS
+            t_x = timeit(xla, q, k, v, do, lse, o) / max(1, hops) * ITERS
+        except Exception as e:
+            print(f"ring bwd S_l={s_l} d={d}: FAILED {str(e)[:200]}",
+                  flush=True)
+            continue
+        # peak fused transient = the LARGER of the two kernels' tile
+        # geometries: dq tiles at the full ring edge, the grouped dkv
+        # halves its q-edge under GQA (_ring_bwd_blocks)
+        bq_dkv, bk = fm._ring_bwd_blocks(s_l, rep)
+        bk_dq = min(fm._RING_BLK, s_l)
+        bytes_fused = 4 * max(bk_dq * bk_dq, bq_dkv * bk) * 4
+        bytes_xla = 4 * b * s_l * s_l * hkv * rep * 4
+        row = {
+            "metric": f"ring_bwd_hop_S{s_l}_d{d}_gqa{hq}:{hkv}",
+            "bwd_ms_per_hop_fused": round(t_f * 1e3, 3),
+            "bwd_ms_per_hop_xla": round(t_x * 1e3, 3),
+            "transient_bytes_fused": bytes_fused,
+            "transient_bytes_xla": bytes_xla,
+            "transient_reduction": round(bytes_xla / bytes_fused, 1),
+        }
+        assert bytes_fused < bytes_xla, row  # block-shaped, not score-
+        print(json.dumps(row), flush=True)
+
+
 def main():
     # the package re-exports the flash_mha FUNCTION over the submodule
     # name — import the module itself for the _BLK_* knobs
@@ -122,6 +245,10 @@ def main():
 
     sweep = "--sweep" in sys.argv
     smoke = "--smoke" in sys.argv
+    if "--bwd" in sys.argv:
+        # backward-hop mode: fused dq/dkv kernels vs the XLA einsum hop
+        bwd_sweep(fm, smoke=smoke)
+        return
     if sweep and smoke:
         # CPU plumbing check of the ring sweep only (the MHA sweep below
         # needs a real chip; interpreted 32k shapes would run for hours)

@@ -67,6 +67,17 @@ EXPECTED_QUANT_COMM_OPS = ["quant_all_gather", "quant_reduce_scatter"]
 QUANT_BENCH_KEYS = ["grad_reduce_bytes_fp32", "grad_reduce_bytes_quant",
                     "bytes_reduction", "loss_delta"]
 
+# frozen ring bench-row vocabulary (same contract as QUANT_BENCH_KEYS):
+# the longseq_ring row keys (bench.py) and the fused-backward hop keys
+# (tools/bench_flash_longseq.py --bwd) must each be emitted by their
+# bench source AND documented in the docs/RING_ATTENTION.md key table —
+# the lint trips when either side drifts.
+RING_DOCS = os.path.join(REPO, "docs", "RING_ATTENTION.md")
+RING_BENCH_KEYS = ["mfu", "placement", "ring_backward", "vs_baseline"]
+RING_BWD_BENCH_KEYS = ["bwd_ms_per_hop_fused", "bwd_ms_per_hop_xla",
+                       "transient_bytes_fused", "transient_bytes_xla",
+                       "transient_reduction"]
+
 
 def _exported_monitor_tags() -> List[str]:
     from deepspeed_tpu.serving.metrics import ServingMetrics
@@ -220,6 +231,38 @@ def check_quant_comm() -> List[str]:
     return errors
 
 
+def check_ring_bench() -> List[str]:
+    """Ring bench-row vocabulary: every frozen longseq_ring / --bwd key
+    is emitted by its bench source and documented in the
+    docs/RING_ATTENTION.md bench-key table."""
+    errors = []
+    try:
+        with open(RING_DOCS, "r", encoding="utf-8") as f:
+            rdocs = f.read()
+    except OSError as e:
+        return [f"cannot read {RING_DOCS}: {e}"]
+    for path, keys in (
+            (os.path.join(REPO, "bench.py"), RING_BENCH_KEYS),
+            (os.path.join(REPO, "tools", "bench_flash_longseq.py"),
+             RING_BWD_BENCH_KEYS)):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                src = f.read()
+        except OSError as e:
+            errors.append(f"cannot read {path}: {e}")
+            continue
+        for key in keys:
+            if f'"{key}"' not in src:
+                errors.append(
+                    f"ring bench key {key!r} not emitted by "
+                    f"{os.path.basename(path)} (frozen RING_BENCH_KEYS/"
+                    "RING_BWD_BENCH_KEYS drifted)")
+            if f"`{key}`" not in rdocs:
+                errors.append(f"ring bench key {key!r} not documented in "
+                              f"{os.path.basename(RING_DOCS)}")
+    return errors
+
+
 def validate_chrome_trace(obj: Any) -> List[str]:
     """Structural validation of a Chrome trace-event JSON object (pass a
     path or the loaded dict).  Perfetto/chrome://tracing both accept the
@@ -286,7 +329,8 @@ def check_trace_export() -> List[str]:
 
 def run_all() -> List[str]:
     return (check_tags_documented() + check_schema() + check_span_names()
-            + check_quant_comm() + check_trace_export())
+            + check_quant_comm() + check_ring_bench()
+            + check_trace_export())
 
 
 def main() -> int:
