@@ -17,6 +17,10 @@ Rows (BASELINE.json milestone configs scaled to one chip):
   5. serve_load — the async serving layer (deepspeed_tpu/serving) under
      an open-loop arrival process: tokens/s, p50/p95 TTFT, preemption
      rate; vs_baseline = served tokens/s / one-shot batch generate()
+  6. serve_load_multi — the multi-replica tier: a Router over 2 replicas
+     on disjoint mesh slices, shared-system-prompt workload with and
+     without the paged prefix cache; aggregate tokens/s + p95 TTFT +
+     prefix_hit_rate + prefill_tokens_saved
 
 Pass --smoke for a tiny-shape CPU plumbing check (no numbers of record).
 """
@@ -813,6 +817,149 @@ def row_serve_load():
     }
 
 
+def _serve_load_multi_body():
+    """Multi-replica serving tier (serving/replica.py + router.py +
+    prefix_cache.py): open-loop exponential arrivals against a Router
+    over 2 replicas on DISJOINT virtual mesh slices, every prompt
+    sharing one system prefix (the dominant production shape).  Two
+    sub-runs on identical workloads — prefix reuse ON vs OFF — report
+    aggregate delivered tokens/s and p95 TTFT (measured router-side:
+    submit → first token on the routed stream), plus the cache's
+    hit-rate and prefill-tokens-saved counters.  Frozen keys linted by
+    tools/telemetry_check.py against docs/SERVING.md."""
+    import threading
+
+    from deepspeed_tpu.models import get_model_config
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    from deepspeed_tpu.serving import ReplicaSet, Router, SamplingParams
+    from deepspeed_tpu.telemetry import Telemetry
+
+    n_rep = 2
+    if SMOKE:
+        model = get_model_config("llama-tiny")
+        n_req, new, sys_len, uniq_len, rate = 12, 8, 16, 7, 100.0
+        eng_cfg = {"dtype": "float32",
+                   "memory_config": {"num_blocks": 64, "block_size": 4},
+                   "max_context": 64}
+    else:
+        model = get_model_config("llama3-8b", num_layers=4,
+                                 max_seq_len=2048)
+        n_req, new, sys_len, uniq_len, rate = 128, 64, 512, 32, 64.0
+        eng_cfg = {"memory_config": {"num_blocks": 1024}}
+    rng = np.random.default_rng(11)
+    shared = rng.integers(1, model.vocab_size, size=sys_len).tolist()
+    prompts = [shared + rng.integers(1, model.vocab_size,
+                                     size=uniq_len).tolist()
+               for _ in range(n_req)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
+
+    def run_once(prefix_enabled, telemetry=None):
+        srv_cfg = {"prefix_cache": {"enabled": prefix_enabled}}
+        rs = ReplicaSet.build(model, n_rep, eng_cfg, srv_cfg, seed=0)
+        router = Router(rs, telemetry=telemetry).start()
+        # warmup: compile every replica's buckets off the clock
+        router.generate(prompts[:n_rep], max_new_tokens=new)
+        # baseline the cache counters so the reported hit rate / tokens
+        # saved cover only the measured window (warmup hits the cache too)
+        warm = rs.snapshot()
+        first_at = [0.0] * n_req
+        threads = []
+
+        def consume(i, stream):
+            for _tok in stream:
+                if first_at[i] == 0.0:
+                    first_at[i] = time.perf_counter()
+
+        t0 = time.perf_counter()
+        for i in range(n_req):
+            lag = arrivals[i] - (time.perf_counter() - t0)
+            if lag > 0:
+                time.sleep(lag)
+            s = router.submit(prompts[i],
+                              SamplingParams(max_new_tokens=new))
+            th = threading.Thread(target=consume, args=(i, s))
+            th.start()
+            threads.append(th)
+        submit_at = [t0 + a for a in arrivals]
+        for th in threads:
+            th.join(timeout=600)
+        dt = time.perf_counter() - t0
+        ttft_ms = sorted((f - s) * 1e3
+                         for f, s in zip(first_at, submit_at) if f > 0)
+        p95 = (ttft_ms[min(len(ttft_ms) - 1,
+                           int(0.95 * (len(ttft_ms) - 1)))]
+               if ttft_ms else 0.0)
+        snap = router.snapshot()
+        for key in ("prefix_hits", "prefix_misses", "prefill_tokens_saved"):
+            snap["aggregate"][key] -= warm[key]
+        router.stop()
+        _reset_topology()
+        return n_req * new / dt, p95, snap
+
+    tel = Telemetry(TelemetryConfig(
+        enabled=True, jsonl_path=_telemetry_jsonl("serve_load_multi"),
+        tracing={"enabled": True,
+                 "trace_path": _trace_json("serve_load_multi")}))
+    # reuse run FIRST: the second run inherits this process's warm XLA
+    # compile cache, so running the no-reuse control second biases the
+    # comparison AGAINST the cache — the reported win is conservative
+    tps_on, p95_on, snap = run_once(True, telemetry=tel)
+    tps_off, p95_off, _ = run_once(False)
+    tel.close()
+    agg = snap["aggregate"]
+    hits, misses = agg["prefix_hits"], agg["prefix_misses"]
+    return {
+        "metric": "serve_load_multi_tokens_per_sec",
+        "telemetry_jsonl": _telemetry_jsonl("serve_load_multi"),
+        "trace_json": _trace_json("serve_load_multi"),
+        "value": round(tps_on, 1), "unit": "tokens/s",
+        "agg_tokens_per_sec": round(tps_on, 1),
+        "agg_tokens_per_sec_noreuse": round(tps_off, 1),
+        # reuse vs no-reuse on the identical workload
+        "vs_baseline": round(tps_on / tps_off, 3) if tps_off else 0.0,
+        "ttft_p95_ms": round(p95_on, 1),
+        "ttft_p95_ms_noreuse": round(p95_off, 1),
+        "prefix_hit_rate": round(hits / max(1, hits + misses), 3),
+        "prefill_tokens_saved": int(agg["prefill_tokens_saved"]),
+        "n_replicas": n_rep,
+        "routed": snap["routed"],
+        "failovers": snap["failovers"],
+    }
+
+
+def row_serve_load_multi():
+    """Multi-replica serving row.  Disjoint replica slices need > 1
+    device; smoke mode pins the in-process backend to ONE cpu device,
+    so the smoke variant re-execs itself on a virtual 8-device CPU mesh
+    (same pattern as longseq_ring)."""
+    if SMOKE and "--multi-inner" not in sys.argv:
+        import os
+        import subprocess
+
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        env["JAX_PLATFORMS"] = "cpu"
+        cmd = [sys.executable, __file__, "--row", "serve_load_multi",
+               "--smoke", "--multi-inner"]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=900, env=env)
+        except subprocess.TimeoutExpired:
+            return {"metric": "serve_load_multi",
+                    "error": "smoke timed out"}
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return {"metric": "serve_load_multi",
+                "error": ("no result line; " + " | ".join(tail[-3:]))[:300]}
+    return _serve_load_multi_body()
+
+
 def _device_probe_error(timeout_s: float = 120.0):
     """A hung bench run records nothing at all (worse than an error row) —
     probe the backend with a deadline before touching it."""
@@ -830,6 +977,7 @@ _ROWS = {
     "peak_params": row_peak_params,
     "v2_decode": row_v2_decode,
     "serve_load": row_serve_load,
+    "serve_load_multi": row_serve_load_multi,
     "gpt2_350m": row_gpt2_350m,
 }
 
@@ -897,7 +1045,7 @@ def main() -> None:
     rows = []
     for name in ("llama8b_class_zero3", "longseq_flash", "longseq_llama",
                  "longseq_ring", "gpt2_350m_commquant", "peak_params",
-                 "v2_decode", "serve_load"):
+                 "v2_decode", "serve_load", "serve_load_multi"):
         if SMOKE:
             try:
                 r = _ROWS[name]()

@@ -26,7 +26,13 @@ def _lib():
     global _LIB, _LIB_FAILED
     if _LIB is None and not _LIB_FAILED:
         try:
-            lib = load_op("ds_shm_comm", ["shm_comm/shm_comm.cpp"])
+            # -lrt: on glibc < 2.34 shm_open lives in librt, and without
+            # the explicit link the .so only dlopens when some OTHER
+            # module already pulled librt in globally (order-dependent
+            # test failures); glibc >= 2.34 keeps librt as a stub, so the
+            # flag is harmless there
+            lib = load_op("ds_shm_comm", ["shm_comm/shm_comm.cpp"],
+                          extra_flags=["-lrt"])
             lib.ds_shm_create.restype = ctypes.c_void_p
             lib.ds_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                           ctypes.c_int, ctypes.c_int64,

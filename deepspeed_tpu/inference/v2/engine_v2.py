@@ -111,7 +111,8 @@ class RaggedInferenceEngineConfig:
 class InferenceEngineV2:
     def __init__(self, model: TransformerConfig,
                  config: Optional[Dict[str, Any]] = None,
-                 model_params: Optional[Any] = None, seed: int = 0, **kw):
+                 model_params: Optional[Any] = None, seed: int = 0,
+                 devices: Optional[Sequence[Any]] = None, **kw):
         self.cfg = RaggedInferenceEngineConfig(config, **kw)
         dt = jnp.bfloat16 if "bf" in str(self.cfg.dtype) else jnp.float32
         self.model_config = model.replace(dtype=dt)
@@ -123,7 +124,10 @@ class InferenceEngineV2:
             mesh_sizes["tensor"] = self.cfg.tp_size
         if self.cfg.ep_size > 1:
             mesh_sizes["expert"] = self.cfg.ep_size
-        self.topology = MeshTopology(mesh_sizes or None)
+        # `devices` pins this engine to a mesh SLICE — the replica tier
+        # (serving/replica.py) builds N engines on disjoint slices of one
+        # host's devices.  None keeps the whole-world default.
+        self.topology = MeshTopology(mesh_sizes or None, devices=devices)
         set_topology(self.topology)
         self.rules = ShardingRules(self.topology, zero_stage=0)
 
@@ -282,19 +286,28 @@ class InferenceEngineV2:
         return {uid: logits_np[slot] for slot, uid in rb.uids_by_slot.items()}
 
     def admit(self, uid: int, tokens: Sequence[int], priority: int = 0,
-              front: bool = False) -> None:
+              front: bool = False, cached_blocks: Sequence[int] = (),
+              num_cached: int = 0) -> None:
         """Open a sequence and schedule it WITHOUT running a step.
 
         The serving layer's admission controller decides *when* to call
         this; ``step()`` decides when work runs.  ``priority`` orders the
         SplitFuse queues (higher first); ``front=True`` requeues ahead of
         every waiting prompt (preempted-request requeue).
+
+        ``cached_blocks``/``num_cached`` seed the sequence with adopted
+        prefix-cache pages whose KV already holds the first ``num_cached``
+        tokens (pre-acquired by the caller; ownership transfers to the
+        sequence — see ``DSStateManager.open``).  Prefill then starts at
+        ``num_cached`` instead of 0: the adopted tokens never re-run.
         """
         if uid in self.state_manager:
             raise ValueError(f"uid {uid} already active")
         if not len(tokens):
             raise ValueError(f"uid {uid}: empty prompt")
-        self.state_manager.open(uid, [int(x) for x in tokens])
+        self.state_manager.open(uid, [int(x) for x in tokens],
+                                cached_blocks=cached_blocks,
+                                num_cached=num_cached)
         self.scheduler.add(uid, priority=priority, front=front)
 
     def step(self, temperature: float = 0.0, key: Optional[Any] = None,

@@ -37,50 +37,78 @@ class KVCacheExhausted(RuntimeError):
 
 
 class BlockedAllocator:
-    """Free-list page allocator (ref blocked_allocator.py:11).
+    """Refcounted free-list page allocator (ref blocked_allocator.py:11).
 
     Block 0 is reserved (garbage page for padding); valid handles are
     1..num_blocks-1.  ``free()`` rejects double-frees and out-of-range
     handles — a double-freed page would be handed to two live sequences
     and silently cross-write their KV.
+
+    Pages are **refcounted** so the serving layer's paged prefix cache
+    can share read-only KV pages between sequences: ``allocate`` hands a
+    page out at refcount 1, ``acquire`` adds an owner, and ``free``
+    drops one owner — the page returns to the free list only when the
+    LAST owner releases it.  A caller that never shares pages sees the
+    pre-refcount semantics unchanged.
     """
 
     def __init__(self, num_blocks: int):
         if num_blocks < 2:
             raise ValueError("need at least 2 blocks (block 0 is reserved)")
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-        self._allocated: set = set()
+        self._refs: Dict[int, int] = {}        # handle -> owner count
         self.num_blocks = num_blocks
 
     @property
     def free_blocks(self) -> int:
         return len(self._free)
 
+    def refcount(self, block: int) -> int:
+        """Current owner count (0 = on the free list)."""
+        return self._refs.get(block, 0)
+
     def allocate(self, n: int) -> List[int]:
         if n > len(self._free):
             raise KVCacheExhausted(f"KV cache exhausted: want {n} blocks, "
                                    f"have {len(self._free)}")
         out = [self._free.pop() for _ in range(n)]
-        self._allocated.update(out)
+        for b in out:
+            self._refs[b] = 1
         return out
 
-    def free(self, blocks: Sequence[int]) -> None:
+    def _validate(self, blocks: Sequence[int], op: str) -> None:
         # Validate the whole batch before mutating: a partially-applied
-        # free() would leave the caller unable to retry safely.
+        # free()/acquire() would leave the caller unable to retry safely.
         if len(set(blocks)) != len(blocks):
-            raise ValueError(f"duplicate handles in free(): {list(blocks)}")
+            raise ValueError(f"duplicate handles in {op}(): {list(blocks)}")
         for b in blocks:
             if b == 0:
                 raise ValueError("block 0 is reserved")
             if not (0 < b < self.num_blocks):
                 raise ValueError(f"block {b} out of range "
                                  f"(1..{self.num_blocks - 1})")
-            if b not in self._allocated:
+            if b not in self._refs:
                 raise ValueError(f"block {b} is not allocated "
-                                 "(double free?)")
+                                 f"({op} of a free page"
+                                 f"{' — double free?' if op == 'free' else ''})")
+
+    def acquire(self, blocks: Sequence[int]) -> None:
+        """Add one owner to each live page (prefix-cache sharing: a
+        sequence adopting cached pages, or the cache pinning a donor's
+        pages past the donor's flush)."""
+        self._validate(blocks, "acquire")
         for b in blocks:
-            self._allocated.discard(b)
-            self._free.append(b)
+            self._refs[b] += 1
+
+    def free(self, blocks: Sequence[int]) -> None:
+        """Drop one owner per handle; pages return to the free list at
+        owner count zero."""
+        self._validate(blocks, "free")
+        for b in blocks:
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._free.append(b)
 
 
 @dataclass
@@ -123,13 +151,45 @@ class DSStateManager:
     def n_active(self) -> int:
         return len(self._seqs)
 
-    def open(self, uid: int, tokens: Sequence[int]) -> SequenceDescriptor:
+    def open(self, uid: int, tokens: Sequence[int],
+             cached_blocks: Sequence[int] = (),
+             num_cached: int = 0) -> SequenceDescriptor:
+        """Open a sequence, optionally seeded with **pre-owned** KV pages.
+
+        ``cached_blocks`` are prefix-cache pages whose KV already holds
+        the first ``num_cached`` tokens (the caller must have ``acquire``d
+        one owner per page for this sequence — ownership transfers here,
+        and ``flush`` releases it).  ``num_cached`` must be block-aligned
+        and strictly smaller than ``len(tokens)`` so at least one token
+        remains to prefill (the step that samples needs a real row).
+        Adopted pages are never written: the first uncached token lands
+        at position ``num_cached``, which block-aligns to a FRESH page.
+        """
         if uid in self._seqs:
             raise ValueError(f"uid {uid} already active")
         if not self._free_slots:
             raise RuntimeError("no free sequence slots")
+        if num_cached:
+            if num_cached % self.block_size != 0:
+                raise ValueError(
+                    f"uid {uid}: num_cached {num_cached} not aligned to "
+                    f"block_size {self.block_size} — a partially-filled "
+                    "shared page would be appended into by this sequence")
+            if num_cached >= len(tokens):
+                raise ValueError(
+                    f"uid {uid}: num_cached {num_cached} >= prompt length "
+                    f"{len(tokens)}; at least one token must prefill")
+            if len(cached_blocks) * self.block_size != num_cached:
+                raise ValueError(
+                    f"uid {uid}: {len(cached_blocks)} cached blocks cover "
+                    f"{len(cached_blocks) * self.block_size} tokens, "
+                    f"num_cached says {num_cached}")
+        elif cached_blocks:
+            raise ValueError(f"uid {uid}: cached_blocks without num_cached")
         seq = SequenceDescriptor(uid=uid, slot=self._free_slots.pop(),
-                                 tokens=list(tokens))
+                                 tokens=list(tokens),
+                                 num_cached=int(num_cached),
+                                 blocks=list(cached_blocks))
         self._seqs[uid] = seq
         return seq
 

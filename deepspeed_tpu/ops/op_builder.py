@@ -61,7 +61,11 @@ def build_op(name: str, sources: List[str],
     os.makedirs(CACHE_DIR, exist_ok=True)
     so_path = os.path.join(CACHE_DIR, f"lib{name}-{tag}.so")
     if not os.path.exists(so_path):
-        cmd = ["g++"] + flags + srcs + ["-o", so_path]
+        # library flags (-lrt etc.) must FOLLOW the objects that need
+        # their symbols, or the linker discards them as unused
+        libs = [f for f in flags if f.startswith("-l")]
+        cmd = (["g++"] + [f for f in flags if not f.startswith("-l")]
+               + srcs + libs + ["-o", so_path])
         logger.info(f"building native op '{name}': {' '.join(cmd)}")
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:

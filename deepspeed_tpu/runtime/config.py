@@ -388,6 +388,110 @@ class TelemetryConfig:
 
 
 @dataclass
+class RouterServingConfig:
+    """``"serving": {"router": {...}}`` — the replica-set front door
+    (serving/router.py; docs/SERVING.md "Router & prefix cache"):
+    KV-headroom-aware least-loaded dispatch, sticky sessions, fail-over
+    with bit-identical greedy continuation."""
+    queue_weight: float = 0.05     # score penalty per outstanding request
+    max_failovers: int = 2         # re-dispatches before the error sticks
+    sticky_sessions: bool = True   # session key -> replica affinity
+    max_sessions: int = 4096       # affinity-map bound (oldest evicted)
+
+    def __post_init__(self):
+        if self.queue_weight < 0:
+            raise DeepSpeedConfigError(
+                f"serving.router.queue_weight={self.queue_weight}: "
+                "must be >= 0")
+        if self.max_failovers < 0:
+            raise DeepSpeedConfigError(
+                f"serving.router.max_failovers={self.max_failovers}: "
+                "must be >= 0")
+        if self.max_sessions < 1:
+            raise DeepSpeedConfigError(
+                f"serving.router.max_sessions={self.max_sessions}: "
+                "must be >= 1")
+
+
+@dataclass
+class PrefixCacheServingConfig:
+    """``"serving": {"prefix_cache": {...}}`` — paged prefix cache
+    (serving/prefix_cache.py): token-block-aligned prompt prefixes map
+    to refcounted KV pages, so shared-system-prompt requests adopt
+    already-written KV instead of re-prefilling; eviction is LRU over
+    cache-only pages under the admission watermarks."""
+    enabled: bool = False
+    max_blocks: int = 0            # page cap (0 = watermark-bounded only)
+    min_prefix_blocks: int = 1     # don't cache prefixes shorter than this
+
+    def __post_init__(self):
+        if self.max_blocks < 0:
+            raise DeepSpeedConfigError(
+                f"serving.prefix_cache.max_blocks={self.max_blocks}: "
+                "must be >= 0 (0 = unbounded)")
+        if self.min_prefix_blocks < 1:
+            raise DeepSpeedConfigError(
+                "serving.prefix_cache.min_prefix_blocks="
+                f"{self.min_prefix_blocks}: must be >= 1")
+
+
+@dataclass
+class ServingTierConfig:
+    """``"serving"`` block — the multi-replica serving tier: N
+    data-parallel replicas on disjoint mesh slices behind one router
+    (serving/replica.py + router.py), each with an optional paged
+    prefix cache.  ``server_config()``/``router.__dict__`` feed the
+    serving classes directly, so the block round-trips into
+    ``ReplicaSet.build`` + ``Router`` with no translation layer."""
+    n_replicas: int = 1
+    router: RouterServingConfig = field(
+        default_factory=RouterServingConfig)
+    prefix_cache: PrefixCacheServingConfig = field(
+        default_factory=PrefixCacheServingConfig)
+
+    def __post_init__(self):
+        if isinstance(self.router, dict):
+            self.router = _from_dict(RouterServingConfig, self.router,
+                                     "serving.router")
+        if isinstance(self.prefix_cache, dict):
+            self.prefix_cache = _from_dict(PrefixCacheServingConfig,
+                                           self.prefix_cache,
+                                           "serving.prefix_cache")
+        if self.n_replicas < 1:
+            raise DeepSpeedConfigError(
+                f"serving.n_replicas={self.n_replicas}: must be >= 1")
+        # drift tripwire: the serving-side parsers (serving/router.py
+        # RouterConfig, serving/prefix_cache.py PrefixCacheConfig) accept
+        # these dicts and silently IGNORE unknown keys — a field added
+        # here but not there would validate at config load and then be
+        # dropped at runtime.  Round-trip through them and require every
+        # block key to come back as an attribute.
+        from deepspeed_tpu.serving.prefix_cache import PrefixCacheConfig
+        from deepspeed_tpu.serving.router import RouterConfig
+        for block, cls in ((self.router_config(), RouterConfig),
+                           (self.prefix_cache_config(), PrefixCacheConfig)):
+            parsed = cls(block)
+            missing = set(block) - set(vars(parsed))
+            if missing:
+                raise DeepSpeedConfigError(
+                    f"serving config keys {sorted(missing)} are not "
+                    f"understood by {cls.__name__} — add them to the "
+                    "serving-side parser in the same commit")
+
+    def prefix_cache_config(self) -> Dict[str, Any]:
+        """Per-replica prefix-cache config dict."""
+        return dict(vars(self.prefix_cache))
+
+    def server_config(self) -> Dict[str, Any]:
+        """Per-replica ``InferenceServer`` config dict."""
+        return {"prefix_cache": self.prefix_cache_config()}
+
+    def router_config(self) -> Dict[str, Any]:
+        """``Router`` config dict."""
+        return dict(vars(self.router))
+
+
+@dataclass
 class CommQuantizationConfig:
     """``"comm_quantization"`` block — quantized ZeRO collectives
     (comm/quantized.py; docs/QUANTIZED_COMM.md).
@@ -609,6 +713,8 @@ class DeepSpeedConfig:
             CommQuantizationConfig, d.get("comm_quantization"),
             "comm_quantization")
         self.telemetry = _from_dict(TelemetryConfig, d.get(C.TELEMETRY), "telemetry")
+        self.serving = _from_dict(ServingTierConfig, d.get("serving"),
+                                  "serving")
         self.tensor_parallel = _from_dict(TensorParallelConfig, d.get(C.TENSOR_PARALLEL), "tensor_parallel")
         self.pipeline = _from_dict(PipelineConfig, d.get(C.PIPELINE), "pipeline")
         self.checkpoint_config = _from_dict(CheckpointConfig, d.get(C.CHECKPOINT), "checkpoint")

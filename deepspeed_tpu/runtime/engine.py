@@ -296,11 +296,57 @@ class DeepSpeedEngine:
                     msg + " — zero_optimization.strict_sharding is set")
             log_dist(msg, level="warning")
 
+        def _init_sharding_unsafe() -> bool:
+            """True when jitting rng init straight into the param
+            shardings is known-miscompiled on jax 0.4.37: some leaf is
+            sharded over a proper subset of the >1-sized mesh axes
+            (fully-replicated leaves and leaves covering every big axis
+            are observed-correct — see the init branch below)."""
+            big = {ax for ax, sz in self.topology.sizes.items() if sz > 1}
+            if not big:
+                return False
+            for shd in jax.tree.leaves(self.param_shardings):
+                used = set()
+                for part in getattr(shd, "spec", ()) or ():
+                    if part is None:
+                        continue
+                    if isinstance(part, (tuple, list)):
+                        used.update(part)
+                    else:
+                        used.add(part)
+                if used and (big - used):
+                    return True
+            return False
+
+        self._init_sharding_unsafe = _init_sharding_unsafe
+
         if model_params is not None:
             if self._compression is not None:
                 # teacher checkpoint → layer-reduced student rows
                 model_params = self._compression.reduce_layers(model_params)
             self.params = jax.device_put(model_params, self.param_shardings)
+        elif self._init_sharding_unsafe():
+            # jax 0.4.37 / XLA SPMD miscompiles rng-based init when jitted
+            # straight into out_shardings where some leaf is sharded over
+            # a PROPER SUBSET of the >1-sized mesh axes: P(pipe) stacked
+            # layers on a pipe×data mesh come back scaled by the data-axis
+            # size (exactly 4x at data=4 — summed over the replica group
+            # instead of selected from it), and P(tensor) leaves on a
+            # data×tensor×seq mesh come back as different draws entirely.
+            # A hot/wrong init trains visibly slower while every
+            # grad-parity test still passes (the schedules are correct;
+            # the weights aren't).  Materialize unsharded, then place —
+            # device_put is pure data movement and cannot rescale.  The
+            # fast sharded-init path is kept when every sharded leaf
+            # covers all big axes (pure-data ZeRO-3: the peak-params
+            # ladder must not materialize its models replicated).
+            # Known tradeoff: this branch peaks at full-model size on ONE
+            # device — a pipe/TP model sharded precisely because it
+            # exceeds one chip should load params from a checkpoint
+            # (model_params path above) rather than rng-init here; wrong
+            # silent init was strictly worse than a loud OOM.
+            self.params = jax.device_put(jax.jit(self._init_fn)(rng),
+                                         self.param_shardings)
         else:
             init_jit = jax.jit(self._init_fn, out_shardings=self.param_shardings)
             self.params = init_jit(rng)

@@ -30,13 +30,25 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from deepspeed_tpu.parallel.topology import (BATCH_AXES, SEQ_AXIS,
                                              TENSOR_AXIS, MeshTopology,
                                              get_topology)
-from deepspeed_tpu.utils.jax_compat import shard_map
+from deepspeed_tpu.utils.jax_compat import manual_axis_names, shard_map
 
 
 def _constraint(x, spec):
     topo = get_topology()
     if topo is None:
         return x
+    manual = manual_axis_names()
+    if manual:
+        # inside a shard_map body (e.g. the pipeline stage_fn on 0.4.x,
+        # where the compat shard_map is FULL-manual): a constraint naming
+        # a manually-bound axis is a hard partitioner error, and inside a
+        # manual region per-shard layouts are explicit so the hint buys
+        # nothing — skip it
+        named = {a for part in spec if part is not None
+                 for a in (part if isinstance(part, (tuple, list))
+                           else (part,))}
+        if named & manual:
+            return x
     return lax.with_sharding_constraint(x, NamedSharding(topo.mesh, spec))
 
 

@@ -6,16 +6,21 @@ See docs/SERVING.md for the architecture (queue → admission → SplitFuse
 
 from deepspeed_tpu.serving.admission import (AdmissionConfig,
                                              AdmissionController)
-from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.metrics import RouterMetrics, ServingMetrics
+from deepspeed_tpu.serving.prefix_cache import PrefixCache, PrefixCacheConfig
+from deepspeed_tpu.serving.replica import ReplicaSet, ServingReplica
 from deepspeed_tpu.serving.request import (DeadlineExceeded,
                                            GenerationRequest, QueueFull,
                                            RequestCancelled, ResponseStream,
                                            SamplingParams, ServingError)
+from deepspeed_tpu.serving.router import Router, RouterConfig
 from deepspeed_tpu.serving.server import InferenceServer, ServerConfig
 
 __all__ = [
     "AdmissionConfig", "AdmissionController", "DeadlineExceeded",
-    "GenerationRequest", "InferenceServer", "QueueFull", "RequestCancelled",
-    "ResponseStream", "SamplingParams", "ServerConfig", "ServingError",
-    "ServingMetrics",
+    "GenerationRequest", "InferenceServer", "PrefixCache",
+    "PrefixCacheConfig", "QueueFull", "ReplicaSet", "RequestCancelled",
+    "ResponseStream", "Router", "RouterConfig", "RouterMetrics",
+    "SamplingParams", "ServerConfig", "ServingError", "ServingMetrics",
+    "ServingReplica",
 ]

@@ -163,16 +163,31 @@ class AdmissionController:
                 self._lock.wait(timeout)
 
     # -- policy ----------------------------------------------------------
+    def kv_floor(self, engine, watermark: float) -> int:
+        """Blocks that must stay free under ``watermark`` — THE floor
+        formula; the server's eviction shortfalls use it so reclaiming
+        exactly a shortfall always satisfies the matching test below."""
+        return int(watermark * (engine.cfg.num_blocks - 1))  # block 0 rsvd
+
     def kv_admissible(self, engine, need_blocks: int) -> bool:
         """Would admitting a prompt needing ``need_blocks`` keep the pool
         above the high watermark?"""
-        total = engine.cfg.num_blocks - 1  # block 0 reserved
-        floor = int(self.cfg.kv_high_watermark * total)
+        floor = self.kv_floor(engine, self.cfg.kv_high_watermark)
         return engine.free_blocks - need_blocks >= floor
 
+    def admission_shortfall(self, engine, need_blocks: int) -> int:
+        """Blocks short of admitting ``need_blocks`` at the high floor
+        (<= 0 when admissible) — the eviction target."""
+        floor = self.kv_floor(engine, self.cfg.kv_high_watermark)
+        return need_blocks + floor - engine.free_blocks
+
+    def low_watermark_deficit(self, engine) -> int:
+        """Blocks below the low floor (<= 0 when healthy)."""
+        return (self.kv_floor(engine, self.cfg.kv_low_watermark)
+                - engine.free_blocks)
+
     def below_low_watermark(self, engine) -> bool:
-        total = engine.cfg.num_blocks - 1
-        return engine.free_blocks < int(self.cfg.kv_low_watermark * total)
+        return self.low_watermark_deficit(engine) > 0
 
     @staticmethod
     def choose_victim(active: Iterable[GenerationRequest]

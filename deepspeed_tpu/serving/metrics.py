@@ -34,15 +34,23 @@ _OUTCOMES = ("completed", "failed", "cancelled", "expired")
 
 
 class ServingMetrics:
-    def __init__(self, registry: Optional[MetricsRegistry] = None):
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 label: str = ""):
+        """``label`` namespaces the MONITOR tags (``serving/<label>/…``)
+        for per-replica export under a router; metric names are
+        unchanged, so per-replica instances must use per-replica
+        registries (the default) — sharing one registry would merge the
+        replicas' counters."""
         self.registry = registry or MetricsRegistry()
+        self.label = label
         reg = self.registry
         self._t0 = time.monotonic()
         # counters
         self._c = {name: reg.counter(f"serving_{name}_total")
                    for name in ("submitted", "admitted", "rejected",
                                 "preemptions", "tokens_out", "steps",
-                                "flight_dumps")
+                                "flight_dumps", "prefix_hits",
+                                "prefix_misses", "prefill_tokens_saved")
                    + _OUTCOMES}
         # distributions (seconds)
         self._ttft = reg.histogram("serving_ttft_seconds",
@@ -57,6 +65,7 @@ class ServingMetrics:
         self._g_queue_depth = reg.gauge("serving_queue_depth")
         self._g_active = reg.gauge("serving_active_requests")
         self._g_kv_util = reg.gauge("serving_kv_utilization")
+        self._g_prefix_blocks = reg.gauge("serving_prefix_cached_blocks")
 
     # counter values read by the serve loop / tests
     def _cv(self, name: str) -> int:
@@ -73,6 +82,10 @@ class ServingMetrics:
     tokens_out = property(lambda self: self._cv("tokens_out"))
     steps = property(lambda self: self._cv("steps"))
     flight_dumps = property(lambda self: self._cv("flight_dumps"))
+    prefix_hits = property(lambda self: self._cv("prefix_hits"))
+    prefix_misses = property(lambda self: self._cv("prefix_misses"))
+    prefill_tokens_saved = property(
+        lambda self: self._cv("prefill_tokens_saved"))
     queue_depth = property(lambda self: int(self._g_queue_depth.value))
     active_requests = property(lambda self: int(self._g_active.value))
     kv_utilization = property(lambda self: self._g_kv_util.value)
@@ -105,6 +118,17 @@ class ServingMetrics:
         fire or crash handler) — the ops-alert counter."""
         self._c["flight_dumps"].inc()
 
+    def record_prefix(self, tokens_saved: int) -> None:
+        """One admission's prefix-cache outcome: a hit adopted
+        ``tokens_saved`` tokens of already-written KV (prefill skipped
+        them); zero is a miss.  Re-admissions count again — a preempted
+        victim re-adopting its prefix really does skip that prefill."""
+        if tokens_saved > 0:
+            self._c["prefix_hits"].inc()
+            self._c["prefill_tokens_saved"].inc(tokens_saved)
+        else:
+            self._c["prefix_misses"].inc()
+
     def record_finish(self, outcome: str, n_tokens: int,
                       first_token_at: Optional[float],
                       finished_at: float) -> None:
@@ -118,10 +142,12 @@ class ServingMetrics:
                 (finished_at - first_token_at) / (n_tokens - 1))
 
     def set_gauges(self, queue_depth: int, active: int,
-                   kv_utilization: float) -> None:
+                   kv_utilization: float,
+                   prefix_cached_blocks: int = 0) -> None:
         self._g_queue_depth.set(queue_depth)
         self._g_active.set(active)
         self._g_kv_util.set(kv_utilization)
+        self._g_prefix_blocks.set(prefix_cached_blocks)
 
     # -- reading ---------------------------------------------------------
     def snapshot(self) -> Dict[str, object]:
@@ -143,24 +169,89 @@ class ServingMetrics:
             "queue_depth": self.queue_depth,
             "active_requests": self.active_requests,
             "kv_utilization": self.kv_utilization,
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "prefix_hit_rate": (self.prefix_hits
+                                / max(1, self.prefix_hits
+                                      + self.prefix_misses)),
+            "prefill_tokens_saved": self.prefill_tokens_saved,
+            "prefix_cached_blocks": int(self._g_prefix_blocks.value),
             "ttft": self._ttft.snapshot(),
             "tpot": self._tpot.snapshot(),
             "queue_wait": self._queue_wait.snapshot(),
         }
 
     def events(self, step: int) -> List[Event]:
-        """Flatten the snapshot into MonitorMaster events."""
+        """Flatten the snapshot into MonitorMaster events.  With a
+        ``label`` (per-replica export under a router) tags nest one
+        level deeper: ``serving/<label>/<key>``."""
         snap = self.snapshot()
+        prefix = f"serving/{self.label}" if self.label else "serving"
         out: List[Event] = []
         for k, v in snap.items():
             if isinstance(v, dict):
                 for sub, x in v.items():
-                    out.append((f"serving/{k}_{sub}", float(x), step))
+                    out.append((f"{prefix}/{k}_{sub}", float(x), step))
             else:
-                out.append((f"serving/{k}", float(v), step))
+                out.append((f"{prefix}/{k}", float(v), step))
         return out
 
     def write_to(self, monitor, step: int) -> None:
         """Export through a ``monitor.MonitorMaster`` (or anything with
         ``write_events``)."""
         monitor.write_events(self.events(step))
+
+
+class RouterMetrics:
+    """Router-tier counters over the shared registry.
+
+    Per-replica dispatch counts get per-replica metric NAMES
+    (``router_routed_r<i>_total`` — documented as the
+    ``router_routed_r*_total`` wildcard row) because the registry has no
+    label dimension; everything else is a flat counter/gauge.  The
+    replicas' own ``ServingMetrics`` live in per-replica registries —
+    this class only holds what exists *above* them."""
+
+    def __init__(self, n_replicas: int,
+                 registry: Optional[MetricsRegistry] = None):
+        self.registry = registry or MetricsRegistry()
+        reg = self.registry
+        self.n_replicas = n_replicas
+        self._requests = reg.counter("router_requests_total")
+        self._rejected = reg.counter("router_rejected_total")
+        self._failovers = reg.counter("router_failovers_total")
+        self._routed = [reg.counter(f"router_routed_r{i}_total")
+                        for i in range(n_replicas)]
+        self._g_alive = reg.gauge("router_replicas_alive")
+
+    requests = property(lambda self: int(self._requests.value))
+    rejected = property(lambda self: int(self._rejected.value))
+    failovers = property(lambda self: int(self._failovers.value))
+
+    def routed(self, i: int) -> int:
+        return int(self._routed[i].value)
+
+    def record_submit(self) -> None:
+        self._requests.inc()
+
+    def record_reject(self) -> None:
+        self._rejected.inc()
+
+    def record_route(self, replica: int) -> None:
+        self._routed[replica].inc()
+
+    def record_failover(self) -> None:
+        self._failovers.inc()
+
+    def set_alive(self, n: int) -> None:
+        self._g_alive.set(n)
+
+    def snapshot(self) -> Dict[str, object]:
+        return {
+            "requests": self.requests,
+            "rejected": self.rejected,
+            "failovers": self.failovers,
+            "replicas_alive": int(self._g_alive.value),
+            "routed": {f"r{i}": self.routed(i)
+                       for i in range(self.n_replicas)},
+        }

@@ -1,0 +1,180 @@
+"""Serving replicas: N engines on disjoint mesh slices, one server each.
+
+The tier between one ``InferenceServer`` (PR 2) and "millions of users":
+a :class:`ReplicaSet` owns N data-parallel serving replicas, each an
+``InferenceEngineV2`` pinned to a **disjoint slice** of the host's
+devices (the replication-over-slices half of the placement composition
+in arXiv:2601.02311) plus its own continuous-batching serve loop.  On
+the CPU smoke mesh the slices are virtual — 8 forced host devices split
+4+4 — but the construction is the same one a multi-chip host uses.
+
+Replicas are fully independent: separate KV pools, separate prefix
+caches, separate metrics registries (shared registries would merge
+counters), separate serve threads.  The :class:`~.router.Router` above
+them is the only component that sees more than one.
+
+This module deliberately imports no jax — engines are built by the
+caller (or by :meth:`ReplicaSet.build`, which imports the engine module
+lazily), so ``serving/`` stays importable without an accelerator stack.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from deepspeed_tpu.serving.server import InferenceServer
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class ServingReplica:
+    """One engine + serve loop on its mesh slice."""
+
+    def __init__(self, index: int, engine: Any, server: InferenceServer):
+        self.index = index
+        self.name = f"r{index}"
+        self.engine = engine
+        self.server = server
+
+    @property
+    def alive(self) -> bool:
+        """Accepting and making progress: serve thread running, no loop
+        error, not stopping.  The router consults this for dispatch and
+        for the failover decision."""
+        s = self.server
+        return (s._thread is not None and s._thread.is_alive()
+                and s._loop_error is None and not s._stop_requested)
+
+    @property
+    def kv_headroom(self) -> float:
+        """Fraction of the replica's KV pool on the free list — the
+        always-current half of the dispatch score (gauges lag one loop
+        tick; the free list does not)."""
+        eng = self.engine
+        return eng.free_blocks / max(1, eng.cfg.num_blocks - 1)
+
+    @property
+    def queue_load(self) -> int:
+        """Requests this replica already owes: queued + running."""
+        return len(self.server.admission) + len(self.server._active)
+
+    def snapshot(self) -> Dict[str, Any]:
+        snap = self.server.metrics.snapshot()
+        snap["replica"] = self.index
+        snap["alive"] = self.alive
+        return snap
+
+    def kill(self) -> None:
+        """Hard-stop this replica (tests / chaos drills): aborts the
+        serve loop without drain — in-flight requests fail over through
+        the router.  A crashed loop's error is swallowed here; the
+        router's job is to survive it, not to re-raise it."""
+        try:
+            self.server.stop(drain=False, timeout=30.0)
+        except Exception as e:  # already-dead loop re-raises its error
+            log_dist(f"replica {self.name}: kill: {e!r}", level="warning")
+
+
+class ReplicaSet:
+    """Owns N replicas; start/stop fan out, build slices the devices."""
+
+    def __init__(self, replicas: Sequence[ServingReplica]):
+        if not replicas:
+            raise ValueError("ReplicaSet needs at least one replica")
+        self.replicas: List[ServingReplica] = list(replicas)
+
+    def __len__(self) -> int:
+        return len(self.replicas)
+
+    def __iter__(self):
+        return iter(self.replicas)
+
+    def __getitem__(self, i: int) -> ServingReplica:
+        return self.replicas[i]
+
+    @property
+    def alive(self) -> List[ServingReplica]:
+        return [r for r in self.replicas if r.alive]
+
+    @classmethod
+    def build(cls, model: Any, n_replicas: int,
+              engine_config: Optional[dict] = None,
+              server_config: Optional[dict] = None, seed: int = 0,
+              devices: Optional[Sequence[Any]] = None) -> "ReplicaSet":
+        """Build N engines on disjoint device slices + one server each.
+
+        Every replica gets the SAME model/config/seed, so weights are
+        identical and a greedy request finishes bit-identically on any
+        replica — the property failover rests on.  ``devices`` defaults
+        to all of ``jax.devices()``; the first ``n·(len//n)`` are split
+        into N contiguous slices (``mesh_utils`` orders them
+        ICI-adjacent, so contiguous slices are intra-slice-fast).
+        """
+        import jax  # lazy: serving/ imports no jax at module scope
+
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+
+        devices = list(devices if devices is not None else jax.devices())
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas={n_replicas}: must be >= 1")
+        ep = dict(engine_config or {}).get("expert_parallel", {})
+        ep_size = int(ep.get("ep_size", 1) if isinstance(ep, dict) else ep)
+        if n_replicas > 1 and ep_size > 1:
+            # the MoE expert-parallel ragged branch consults the PROCESS-
+            # GLOBAL topology at trace time (inference/v2/model.py), and N
+            # engines each set_topology() on construction — every replica
+            # but the last would trace expert dispatch against the wrong
+            # mesh slice.  Refuse loudly until the engine threads its own
+            # topology into the forward.
+            raise NotImplementedError(
+                "multi-replica serving with expert_parallel.ep_size > 1 "
+                "is not supported: the MoE dispatch reads the global mesh "
+                "topology, which replicas on disjoint slices would "
+                "clobber (run one replica, or ep_size=1)")
+        per = len(devices) // n_replicas
+        if per < 1:
+            raise ValueError(
+                f"{len(devices)} device(s) cannot host {n_replicas} "
+                "replicas on disjoint slices")
+        replicas = []
+        for i in range(n_replicas):
+            slice_i = devices[i * per:(i + 1) * per]
+            engine = InferenceEngineV2(model, dict(engine_config or {}),
+                                       seed=seed, devices=slice_i)
+            scfg = dict(server_config or {})
+            scfg.setdefault("metrics_label", f"r{i}")
+            server = InferenceServer(engine, scfg)
+            replicas.append(ServingReplica(i, engine, server))
+            log_dist(f"replica r{i}: {per} device(s) "
+                     f"[{i * per}..{(i + 1) * per - 1}]", level="info")
+        return cls(replicas)
+
+    def start(self) -> "ReplicaSet":
+        for r in self.replicas:
+            r.server.start()
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        first_error: Optional[BaseException] = None
+        for r in self.replicas:
+            try:
+                r.server.stop(drain=drain, timeout=timeout)
+            except Exception as e:
+                # stop every replica before surfacing anything — a dead
+                # first replica must not leave the rest running
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
+
+    def snapshot(self) -> Dict[str, Any]:
+        per = {r.name: r.snapshot() for r in self.replicas}
+        return {
+            "replicas": per,
+            "alive": len(self.alive),
+            "tokens_out": sum(s["tokens_out"] for s in per.values()),
+            "prefix_hits": sum(s["prefix_hits"] for s in per.values()),
+            "prefix_misses": sum(s["prefix_misses"] for s in per.values()),
+            "prefill_tokens_saved": sum(s["prefill_tokens_saved"]
+                                        for s in per.values()),
+        }

@@ -68,6 +68,10 @@ class GenerationRequest:
     # prompt + generated-so-far; rebuilt as the re-prefill prompt after a
     # preemption (recompute-style: KV is rebuilt, not migrated)
     tokens: List[int] = field(default_factory=list)
+    # tokens prefilled by the CURRENT admission, pending prefix-cache
+    # insertion at the first sampled token (0 = nothing pending; only
+    # set when the server runs a prefix cache)
+    pending_insert: int = 0
     # distributed-tracing identity: every span this request emits shares
     # this id ("" = tracing disabled; see telemetry/tracing.py).  The
     # span handles are serve-loop-internal (only it starts/ends them).

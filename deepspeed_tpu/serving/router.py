@@ -1,0 +1,435 @@
+"""Router: one front door over N serving replicas.
+
+The DeepSpeed-MII deployment shape — a load-balancer in front of N
+data-parallel model replicas — with the same ``submit()/generate()``
+surface as a single :class:`~.server.InferenceServer`, so callers never
+know how many engines sit behind it.
+
+Dispatch policy (docs/SERVING.md has the table):
+
+* **Least-loaded, KV-headroom-aware.**  Each replica is scored
+  ``kv_headroom − queue_weight · (queued + running + router-inflight)``;
+  the highest score wins.  KV headroom comes straight off the replica's
+  allocator free list (always current); the load term folds in the
+  router's own not-yet-terminal dispatches so a burst between serve-loop
+  ticks doesn't pile onto one replica.
+* **Sticky routing.**  A streamed request is pumped from the ONE replica
+  it was dispatched to (its KV lives there).  Optionally, a caller's
+  ``session`` key pins successive requests to the same replica while it
+  stays healthy — that is what makes the replica-local prefix cache hit
+  on the session's shared prompt.
+* **Fail-over.**  When a replica dies mid-request (serve-loop crash,
+  hard stop), the pump re-submits prompt + tokens-delivered-so-far to a
+  surviving replica and keeps streaming into the SAME caller-held
+  stream; under greedy sampling the continuation is bit-identical
+  (weights are identical across replicas, and generated-so-far re-enters
+  as prompt — the same recompute contract preemption uses).  The dead
+  replica's flight-recorder bundle, if configured, was already dumped by
+  its own crash handler.
+
+Threading: ``submit`` may be called from any thread.  Each routed
+request owns one daemon pump thread that blocks on the replica stream —
+the per-request-thread model matches the caller side of the serving API
+(callers block on streams anyway) and keeps fail-over logic local to
+the request it serves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence
+
+from deepspeed_tpu.serving.metrics import RouterMetrics
+from deepspeed_tpu.serving.replica import ReplicaSet, ServingReplica
+from deepspeed_tpu.serving.request import (DeadlineExceeded, QueueFull,
+                                           RequestCancelled, ResponseStream,
+                                           SamplingParams, ServingError)
+from deepspeed_tpu.telemetry.flight import make_span_recorder
+from deepspeed_tpu.utils.logging import log_dist
+
+
+class RouterConfig:
+    def __init__(self, d: Optional[dict] = None, **kw):
+        d = {**(d or {}), **kw}
+        # score penalty per queued/running/in-flight request, in units of
+        # KV-headroom fraction: 0.05 means ~20 outstanding requests
+        # outweigh a fully-free pool
+        self.queue_weight = float(d.get("queue_weight", 0.05))
+        if self.queue_weight < 0:
+            raise ValueError(f"router.queue_weight={self.queue_weight}: "
+                             "must be >= 0")
+        # a request is failed over at most this many times before its
+        # last error propagates to the caller
+        self.max_failovers = int(d.get("max_failovers", 2))
+        # session -> replica affinity map bound (oldest evicted)
+        self.sticky_sessions = bool(d.get("sticky_sessions", True))
+        self.max_sessions = int(d.get("max_sessions", 4096))
+
+
+class RoutedStream(ResponseStream):
+    """Caller-facing stream that survives replica fail-over: the pump
+    re-points ``_inner`` at the new replica's stream; ``cancel()``
+    reaches whichever replica currently serves the request."""
+
+    def __init__(self, uid: int):
+        super().__init__(uid)
+        self._inner: Optional[ResponseStream] = None
+
+    def _attach(self, inner: ResponseStream) -> None:
+        with self._cond:
+            self._inner = inner
+            cancelled = self._cancel_requested
+        if cancelled:  # cancel raced the (re)dispatch
+            inner.cancel()
+
+    def cancel(self) -> None:
+        super().cancel()
+        with self._cond:
+            inner = self._inner
+        if inner is not None:
+            inner.cancel()
+
+
+class _RoutedRequest:
+    """Router-side bookkeeping for one in-flight request."""
+
+    __slots__ = ("uid", "prompt", "params", "priority", "deadline",
+                 "stream", "replica", "inner", "delivered", "failovers",
+                 "trace_id", "span")
+
+    def __init__(self, uid: int, prompt: List[int], params: SamplingParams,
+                 priority: int, deadline: Optional[float],
+                 stream: RoutedStream):
+        self.uid = uid
+        self.prompt = prompt
+        self.params = params
+        self.priority = priority
+        self.deadline = deadline            # absolute time.monotonic()
+        self.stream = stream
+        self.replica: Optional[ServingReplica] = None
+        self.inner: Optional[ResponseStream] = None
+        self.delivered: List[int] = []
+        self.failovers = 0
+        self.trace_id = ""
+        self.span = None
+
+
+class Router:
+    """Replica-set front door with the ``InferenceServer`` surface."""
+
+    def __init__(self, replicas: ReplicaSet, config: Optional[dict] = None,
+                 telemetry=None):
+        self.replicas = replicas
+        self.cfg = RouterConfig(config)
+        self.telemetry = telemetry
+        if telemetry is not None:
+            self.tracer = telemetry.tracer
+            registry = telemetry.registry
+        else:
+            self.tracer, _ = make_span_recorder(False, False, 0, 0)
+            registry = None
+        self.metrics = RouterMetrics(len(replicas), registry=registry)
+        self._lock = threading.Lock()
+        self._uid = 0
+        self._inflight: Dict[int, int] = {r.index: 0 for r in replicas}
+        self._sessions: "OrderedDict[str, int]" = OrderedDict()
+        self._pumps: List[threading.Thread] = []
+        self._started = False
+        self._stop_requested = False
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "Router":
+        if self._started:
+            raise RuntimeError("router already started")
+        self._started = True
+        self.replicas.start()
+        self.metrics.set_alive(len(self.replicas.alive))
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Drain (or abort) every replica, then join the pumps."""
+        self._stop_requested = True
+        try:
+            self.replicas.stop(drain=drain, timeout=timeout)
+        except Exception as e:
+            # a replica that died mid-run re-raises its loop error here —
+            # but its requests were already failed over (or terminated
+            # through their streams), which is the contract that matters
+            # at the router tier.  Surface it as a warning, not a crash.
+            log_dist(f"router: replica stop raised: {e!r}",
+                     level="warning")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            pumps = list(self._pumps)
+        for t in pumps:
+            t.join(None if deadline is None
+                   else max(0.1, deadline - time.monotonic()))
+        if self.telemetry is not None:
+            snap = self.snapshot()
+            agg = snap["aggregate"]
+            flat = _flatten(snap)
+            # record_serving_step reads tokens_out / tokens_per_sec at the
+            # TOP level (the flattened copies carry aggregate_ prefixes)
+            flat["tokens_out"] = float(agg["tokens_out"])
+            flat["tokens_per_sec"] = float(sum(
+                r["tokens_per_sec"] for r in agg["replicas"].values()))
+            self.telemetry.record_serving_step(self.metrics.requests, flat)
+
+    def __enter__(self) -> "Router":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop(drain=not any(exc))
+
+    # -- dispatch policy -------------------------------------------------
+    def _score(self, rep: ServingReplica) -> float:
+        with self._lock:
+            inflight = self._inflight[rep.index]
+        return rep.kv_headroom - self.cfg.queue_weight * (rep.queue_load
+                                                          + inflight)
+
+    def _choose(self, exclude: Sequence[int] = (),
+                session: Optional[str] = None) -> ServingReplica:
+        alive = [r for r in self.replicas.alive if r.index not in exclude]
+        if not alive:
+            raise ServingError("no live replica to dispatch to")
+        if session is not None and self.cfg.sticky_sessions:
+            with self._lock:
+                idx = self._sessions.get(session)
+                if idx is not None:
+                    # refresh on HIT too: an actively-used session must
+                    # not be the first one the bound evicts
+                    self._sessions.move_to_end(session)
+            if idx is not None and idx not in exclude:
+                for r in alive:
+                    if r.index == idx:
+                        return r
+        # max score; ties broken by replica index for determinism
+        best = max(alive, key=lambda r: (self._score(r), -r.index))
+        if session is not None and self.cfg.sticky_sessions:
+            with self._lock:
+                self._sessions[session] = best.index
+                self._sessions.move_to_end(session)
+                while len(self._sessions) > self.cfg.max_sessions:
+                    self._sessions.popitem(last=False)
+        return best
+
+    def _dispatch(self, rr: _RoutedRequest, exclude: Sequence[int] = (),
+                  session: Optional[str] = None) -> None:
+        """Pick a replica and submit (the remainder of) the request to
+        it.  Replicas whose queue rejects are excluded and the next one
+        tried; raises the last error when every live replica refused."""
+        remaining = rr.params.max_new_tokens - len(rr.delivered)
+        params = (rr.params if not rr.delivered else
+                  dataclasses.replace(rr.params, max_new_tokens=remaining))
+        prompt = rr.prompt + rr.delivered
+        tried = list(exclude)
+        last_error: Optional[ServingError] = None
+        while True:
+            try:
+                rep = self._choose(exclude=tried, session=session)
+            except ServingError:
+                raise (last_error or
+                       ServingError("no live replica to dispatch to"))
+            deadline_s = (None if rr.deadline is None
+                          else rr.deadline - time.monotonic())
+            try:
+                inner = rep.server.submit(prompt, params,
+                                          priority=rr.priority,
+                                          deadline_s=deadline_s)
+            except QueueFull as e:
+                tried.append(rep.index)
+                last_error = e
+                continue
+            rr.replica = rep
+            rr.inner = inner
+            rr.stream._attach(inner)
+            with self._lock:
+                self._inflight[rep.index] += 1
+            self.metrics.record_route(rep.index)
+            if self.tracer.enabled:
+                self.tracer.instant("router.dispatch", rr.trace_id,
+                                    uid=rr.uid, replica=rep.index,
+                                    failovers=rr.failovers)
+            return
+
+    # -- client API ------------------------------------------------------
+    def submit(self, prompt: Sequence[int],
+               params: Optional[SamplingParams] = None, priority: int = 0,
+               deadline_s: Optional[float] = None,
+               session: Optional[str] = None) -> ResponseStream:
+        """Same contract as ``InferenceServer.submit`` plus ``session``:
+        requests sharing a session key stick to one replica while it
+        lives, which is what lets its replica-local prefix cache serve
+        the session's shared prompt."""
+        if not self._started or self._stop_requested:
+            raise QueueFull("router not accepting requests")
+        params = params or SamplingParams()
+        self.metrics.record_submit()
+        with self._lock:
+            uid = self._uid
+            self._uid += 1
+        rr = _RoutedRequest(
+            uid=uid, prompt=[int(t) for t in prompt], params=params,
+            priority=priority,
+            deadline=(None if deadline_s is None
+                      else time.monotonic() + deadline_s),
+            stream=RoutedStream(uid))
+        if self.tracer.enabled:
+            rr.trace_id = rr.stream.trace_id = self.tracer.new_trace_id()
+            rr.span = self.tracer.span("router.request", rr.trace_id).set(
+                uid=uid, prompt_tokens=len(rr.prompt),
+                max_new_tokens=params.max_new_tokens)
+        try:
+            self._dispatch(rr, session=session)
+        except (ServingError, ValueError):
+            # ValueError = per-request validation from the replica server
+            # (empty prompt, bad sampling params, impossible KV need) —
+            # it must close the books like any rejection or the root span
+            # leaks open and requests/rejected counters drift apart
+            self.metrics.record_reject()
+            if rr.span is not None:
+                rr.span.end(outcome="rejected")
+                rr.span = None
+            raise
+        pump = threading.Thread(target=self._pump, args=(rr, session),
+                                name=f"ds-router-pump-{uid}", daemon=True)
+        with self._lock:
+            # prune finished pumps so a long-lived router stays O(inflight)
+            self._pumps = [t for t in self._pumps if t.is_alive()]
+            self._pumps.append(pump)
+        pump.start()
+        return rr.stream
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int = 32, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None) -> List[List[int]]:
+        """Blocking convenience wrapper (``InferenceServer.generate``
+        parity through the routed path)."""
+        streams = [self.submit(p, SamplingParams(
+            max_new_tokens=max_new_tokens, temperature=temperature,
+            top_k=top_k, top_p=top_p, eos_token_id=eos_token_id, seed=i))
+            for i, p in enumerate(prompts)]
+        return [s.result() for s in streams]
+
+    # -- pump ------------------------------------------------------------
+    def _pump(self, rr: _RoutedRequest, session: Optional[str]) -> None:
+        try:
+            self._pump_loop(rr, session)
+        except BaseException as e:  # noqa: BLE001 — last-resort backstop
+            # anything escaping the leg loop (a replica's plain ValueError
+            # on re-submit, a bug in the router itself) must still reach
+            # the caller: a silently-dead pump leaves the stream open and
+            # the caller blocked forever
+            log_dist(f"router: pump for request {rr.uid} died: {e!r}",
+                     level="error")
+            self._finish(rr, ServingError(
+                f"request {rr.uid}: router pump died: {e!r}"))
+
+    def _pump_loop(self, rr: _RoutedRequest, session: Optional[str]) -> None:
+        out = rr.stream
+        while True:
+            leg = (self.tracer.span("router.leg", rr.trace_id, rr.span)
+                   .set(uid=rr.uid, replica=rr.replica.index)
+                   if self.tracer.enabled else None)
+            try:
+                for tok in rr.inner:
+                    rr.delivered.append(tok)
+                    out._put_token(tok)
+                self._leg_done(rr)
+                if leg is not None:
+                    leg.end(outcome="completed")
+                self._finish(rr, None)
+                return
+            except ServingError as e:
+                self._leg_done(rr)
+                if leg is not None:
+                    leg.end(outcome=type(e).__name__)
+                err = self._on_leg_error(rr, e, session)
+                if err is not _RETRY:
+                    self._finish(rr, err)
+                    return
+
+    def _leg_done(self, rr: _RoutedRequest) -> None:
+        """Exactly-once inflight release per dispatched leg."""
+        with self._lock:
+            self._inflight[rr.replica.index] -= 1
+
+    def _on_leg_error(self, rr: _RoutedRequest, e: ServingError,
+                      session: Optional[str]):
+        """Decide: propagate (returns the terminal error / None) or
+        fail over (returns _RETRY after re-dispatching)."""
+        rep = rr.replica
+        self.metrics.set_alive(len(self.replicas.alive))
+        if rr.stream.cancel_requested:
+            return RequestCancelled(f"request {rr.uid} cancelled")
+        if isinstance(e, DeadlineExceeded):
+            return e
+        delivered = rr.delivered
+        eos = rr.params.eos_token_id
+        if (len(delivered) >= rr.params.max_new_tokens
+                or (eos is not None and delivered and delivered[-1] == eos)):
+            # the output was already complete when the replica died —
+            # nothing left to recompute
+            return None
+        if rep.alive:
+            # a healthy replica failed THIS request for per-request
+            # reasons (impossible KV need, max_preemptions, …); another
+            # replica with the same config would fail it the same way
+            return e
+        if self._stop_requested:
+            return e
+        if rr.failovers >= self.cfg.max_failovers:
+            return ServingError(
+                f"request {rr.uid} failed over {rr.failovers}x, giving "
+                f"up") if rr.failovers else e
+        rr.failovers += 1
+        self.metrics.record_failover()
+        if self.tracer.enabled:
+            self.tracer.instant("router.failover", rr.trace_id, uid=rr.uid,
+                                from_replica=rep.index,
+                                delivered=len(delivered))
+        log_dist(f"router: replica r{rep.index} died with request "
+                 f"{rr.uid} in flight ({len(delivered)} tokens out) — "
+                 "failing over", level="warning")
+        try:
+            self._dispatch(rr, exclude=[rep.index], session=session)
+        except ServingError as e2:
+            return e2
+        return _RETRY
+
+    def _finish(self, rr: _RoutedRequest,
+                error: Optional[ServingError]) -> None:
+        if rr.span is not None:
+            rr.span.end(outcome=("completed" if error is None
+                                 else type(error).__name__),
+                        generated=len(rr.delivered),
+                        failovers=rr.failovers)
+            rr.span = None
+        rr.stream._finish(error)
+
+    # -- reading ---------------------------------------------------------
+    def snapshot(self) -> Dict[str, object]:
+        snap = self.metrics.snapshot()
+        snap["aggregate"] = self.replicas.snapshot()
+        return snap
+
+
+_RETRY = object()  # sentinel: _on_leg_error re-dispatched, keep pumping
+
+
+def _flatten(d: Dict, prefix: str = "") -> Dict[str, float]:
+    """Nested snapshot -> flat float dict for record_serving_step."""
+    out: Dict[str, float] = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, f"{key}_"))
+        elif isinstance(v, (int, float, bool)):
+            out[key] = float(v)
+    return out

@@ -43,6 +43,7 @@ from deepspeed_tpu.inference.v2.ragged import KVCacheExhausted
 from deepspeed_tpu.serving.admission import (AdmissionConfig,
                                              AdmissionController)
 from deepspeed_tpu.serving.metrics import ServingMetrics
+from deepspeed_tpu.serving.prefix_cache import PrefixCache, PrefixCacheConfig
 from deepspeed_tpu.serving.request import (DeadlineExceeded,
                                            GenerationRequest,
                                            RequestCancelled, ResponseStream,
@@ -84,8 +85,14 @@ class ServerConfig:
     def __init__(self, d: Optional[dict] = None, **kw):
         d = {**(d or {}), **kw}
         self.admission = AdmissionConfig(d.get("admission", {}))
+        # paged prefix cache (serving/prefix_cache.py): shared-prefix
+        # requests adopt already-written KV pages instead of re-prefilling
+        self.prefix_cache = PrefixCacheConfig(d.get("prefix_cache", {}))
         # how long the idle loop parks before re-sweeping deadlines
         self.idle_wait_s = float(d.get("idle_wait_s", 0.02))
+        # namespaces monitor-export tags (serving/<label>/…) so N replica
+        # servers under one router stay distinguishable
+        self.metrics_label = str(d.get("metrics_label", ""))
         # export metrics through `monitor` every N engine steps (0 = only
         # at stop()); the monitor is any object with write_events()
         self.metrics_interval_steps = int(d.get("metrics_interval_steps", 0))
@@ -111,8 +118,15 @@ class InferenceServer:
         # loop emits kind="serving" StepRecords to the same JSONL
         self.telemetry = telemetry
         self.metrics = ServingMetrics(
-            registry=telemetry.registry if telemetry is not None else None)
+            registry=telemetry.registry if telemetry is not None else None,
+            label=self.cfg.metrics_label)
         self.admission = AdmissionController(self.cfg.admission)
+        # owned and touched ONLY by the serve thread (like the engine);
+        # refcounts on the engine's allocator keep shared pages safe
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.cfg.prefix_cache, engine.state_manager.allocator,
+                        engine.cfg.block_size)
+            if self.cfg.prefix_cache.enabled else None)
         # -- spans + flight recorder (telemetry/tracing.py, flight.py) --
         # one hub predicate (`telemetry is not None`) at every site — it
         # must agree with stop()'s standalone-trace-export gate or a hub
@@ -227,6 +241,10 @@ class InferenceServer:
             self._thread = None
         if self._watchdog is not None:
             self._watchdog.stop()
+        if self.prefix_cache is not None:
+            # every sequence is flushed by now, so all entries are
+            # cache-only owners — return the pool whole to the engine
+            self.prefix_cache.clear()
         if (self.telemetry is None and self._trace_path
                 and self.tracer.enabled):
             # standalone tracer: nobody else will flush the trace file
@@ -420,26 +438,44 @@ class InferenceServer:
         stuck head blocks later arrivals on purpose: skipping it would
         starve big requests under steady small-request load)."""
         eng = self.engine
+        pc = self.prefix_cache
         while eng.state_manager.n_active < eng.state_manager.max_seqs:
             req = self.admission.peek()
             if req is None:
                 break
+            # Adopt the cached prefix FIRST: the acquired refs (>= 2 with
+            # the cache's own) pin those pages against the eviction pass
+            # below — and against this very request's need (adopted pages
+            # are not new allocations).  If admission is abandoned this
+            # tick, the refs are released before breaking.
+            adopted, n_cached = pc.adopt(req.tokens) if pc else ([], 0)
             # A once-preempted request re-admits on its FULL remaining
             # need: optimistic re-admission would just bounce it through
             # another admit→exhaust→preempt cycle (observed thrash).
             conservative = (self.cfg.admission.reserve_decode
                             or req.preemptions > 0)
             need = eng.seq_blocks(len(req.tokens)
-                                  + (req.remaining if conservative else 0))
+                                  + (req.remaining if conservative else 0)) \
+                - len(adopted)
             if self.cfg.admission.reserve_decode:
                 need += self._reserved_decode_blocks()
+            if not self.admission.kv_admissible(eng, need) and pc:
+                # reclaim idle cache pages down to the admission floor
+                # before making anyone wait (or preempting live work)
+                shortfall = self.admission.admission_shortfall(eng, need)
+                if shortfall > 0:
+                    pc.evict(shortfall)
             if not self.admission.kv_admissible(eng, need):
                 if self._active:
+                    if pc:
+                        pc.release(adopted)
                     break  # running work will free pages; head waits
                 # Progress guarantee: with the engine idle nothing will
                 # ever free pages, so the watermark must yield — admit if
                 # the request fits at all, else it can never run.
                 if need > eng.free_blocks:
+                    if pc:
+                        pc.release(adopted)
                     assert self.admission.pop() is req
                     self._finish(req, error=ServingError(
                         f"request {req.uid} needs {need} KV blocks; only "
@@ -449,7 +485,17 @@ class InferenceServer:
             popped = self.admission.pop()
             assert popped is req
             eng.admit(req.uid, req.tokens, priority=req.priority,
-                      front=req.preemptions > 0)
+                      front=req.preemptions > 0, cached_blocks=adopted,
+                      num_cached=n_cached)
+            if pc:
+                self.metrics.record_prefix(n_cached)
+                if n_cached and self.tracer.enabled:
+                    self.tracer.instant("serve.prefix_hit", req.trace_id,
+                                        uid=req.uid, tokens_saved=n_cached)
+                # everything known at admission prefills this admission —
+                # its full pages become cacheable at the first sampled
+                # token (see _step_once)
+                req.pending_insert = len(req.tokens)
             first_admission = req.admitted_at is None
             req.admitted_at = now
             if req.span_phase is not None:
@@ -480,11 +526,24 @@ class InferenceServer:
             reserved += max(0, final - len(seq.blocks))
         return reserved
 
+    def _reclaim_cache(self, n_blocks: int) -> int:
+        """Evict up to ``n_blocks`` idle prefix-cache pages (0 without a
+        cache) — always tried before preempting live work: recomputing a
+        cached prefix later is cheaper than recomputing a live request
+        now."""
+        if self.prefix_cache is None or n_blocks <= 0:
+            return 0
+        return self.prefix_cache.evict(n_blocks)
+
     def _step_once(self) -> None:
-        """One engine step; KV exhaustion preempts and retries next tick."""
-        if (self.admission.below_low_watermark(self.engine)
-                and len(self._active) > 1):
-            self._preempt_one()  # floor hit: shed proactively
+        """One engine step; KV exhaustion reclaims cache pages, then
+        preempts, and retries next tick."""
+        deficit = self.admission.low_watermark_deficit(self.engine)
+        if deficit > 0 and len(self._active) > 1:
+            # floor hit: reclaim idle cache pages first, shed live work
+            # only if that was not enough
+            if self._reclaim_cache(deficit) < deficit:
+                self._preempt_one()
         all_greedy = all(r.params.greedy for r in self._active.values())
         tr = self.tracer
         step_span = tr.span("serve.step", self._loop_trace_id)
@@ -513,7 +572,12 @@ class InferenceServer:
                     self._watchdog.resume()
         except KVCacheExhausted:
             step_span.end(kv_exhausted=True)
-            self._preempt_one()
+            # a step's worth of pages from the cache buys a retry without
+            # touching live work; preempt only if the cache came up dry
+            want = max(1, self.engine.seq_blocks(
+                self.engine.scheduler.token_budget))
+            if self._reclaim_cache(want) == 0:
+                self._preempt_one()
             return
         except BaseException:
             # close the span before the crash handler runs so the dying
@@ -537,6 +601,15 @@ class InferenceServer:
             tok = (int(out) if all_greedy
                    else _host_sample(out, req.params, self._rngs[uid]))
             req.tokens.append(tok)
+            if self.prefix_cache is not None and req.pending_insert:
+                # first sampled token of this admission ⇒ its prefill is
+                # complete: every full page under the admitted prefix now
+                # holds final KV and becomes shareable.  Must run before
+                # any flush below — insert acquires the cache's refs.
+                seq = self.engine.state_manager.get(uid)
+                self.prefix_cache.insert(req.tokens[:req.pending_insert],
+                                         seq.blocks)
+                req.pending_insert = 0
             self.metrics.record_tokens(1)
             if req.n_generated == 1:
                 req.first_token_at = now
@@ -626,4 +699,6 @@ class InferenceServer:
         self.metrics.set_gauges(
             queue_depth=len(self.admission),
             active=len(self._active),
-            kv_utilization=1.0 - free / max(1, self._total_blocks))
+            kv_utilization=1.0 - free / max(1, self._total_blocks),
+            prefix_cached_blocks=(self.prefix_cache.cached_blocks
+                                  if self.prefix_cache is not None else 0))

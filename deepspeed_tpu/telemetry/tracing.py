@@ -43,6 +43,8 @@ from typing import Any, Dict, List, Optional
 
 # Duration spans (Chrome "X" complete events).
 SPAN_NAMES = (
+    "router.leg",              # one replica attempt of a routed request
+    "router.request",          # whole routed-request lifetime (root span)
     "serve.admission_block",   # submit blocked on a full queue ('block' policy)
     "serve.decode",            # first token -> terminal (per request)
     "serve.prefill",           # admission -> first token (per request)
@@ -59,11 +61,14 @@ SPAN_NAMES = (
 
 # Instant events (Chrome "i" events).
 EVENT_NAMES = (
+    "router.dispatch",         # routed request bound to a replica
+    "router.failover",         # replica died; request re-dispatched
     "serve.emit",              # one token handed to a response stream
     "serve.enqueue",           # request entered the admission queue
     "serve.finish",            # request reached a terminal state
     "serve.first_token",       # request's first decoded token
     "serve.preempt",           # request evicted for KV pressure
+    "serve.prefix_hit",        # admission adopted cached prefix pages
     "watchdog.fire",           # hang watchdog dumped a flight bundle
 )
 

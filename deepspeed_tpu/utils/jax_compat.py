@@ -102,3 +102,31 @@ def tpu_compiler_params(**kwargs):
     cls = getattr(pltpu, "CompilerParams", None) \
         or getattr(pltpu, "TPUCompilerParams")
     return cls(**kwargs)
+
+
+def set_num_cpu_devices(n: int) -> None:
+    """``jax.config.update("jax_num_cpu_devices", n)`` where the option
+    exists (jax >= 0.5); on 0.4.x the option is absent and the caller's
+    ``--xla_force_host_platform_device_count`` XLA_FLAGS entry (read at
+    CPU-client creation) is the only mechanism — a silent no-op here."""
+    try:
+        jax.config.update("jax_num_cpu_devices", max(int(n), 1))
+    except AttributeError:
+        pass
+
+
+def manual_axis_names():
+    """Mesh axes currently bound MANUALLY (i.e. we are inside a shard_map
+    body over them).  On 0.4.x the compat ``shard_map`` above falls back
+    to full-manual, where a ``with_sharding_constraint`` naming any bound
+    axis is a hard error — layout-hint call sites consult this set and
+    skip the hint instead (inside a manual region per-shard layouts are
+    explicit, so the hint is meaningless there anyway).  Returns the
+    empty set when the introspection API is absent (newer jax: partial-
+    manual makes the constraint legal, so applying it stays correct)."""
+    try:
+        from jax._src import core as _core
+
+        return set(_core.get_axis_env().axis_sizes)
+    except Exception:
+        return set()

@@ -126,3 +126,39 @@ def test_1f1b_live_activation_bound():
     assert big < small * 1.15, (small, big)
     gpipe_big = temp_bytes("gpipe", 16)
     assert big < gpipe_big, (big, gpipe_big)
+
+
+def test_pipe_sharded_init_matches_eager_init():
+    """Regression: jitting init straight into P(pipe) stacked-layer
+    out_shardings on a mesh with an unused data axis returned the
+    pipe-sharded leaves scaled by the data-axis size (4x at data=4 on
+    jax 0.4.37) — a silently-hot init that trained ~2x slower.  The
+    engine now materializes unsharded and device_puts; a pipe-mesh
+    engine's params must be bit-identical to the eager init."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import get_model_config
+    from deepspeed_tpu.models import transformer as tf
+
+    model = get_model_config("gpt2-tiny")
+    config = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "mesh": {"pipe": 2, "data": 4},
+        "steps_per_print": 10_000,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=config, seed=11)
+    # eager run of the engine's own init fn — no jit, no shardings, so
+    # XLA partitioning cannot touch the drawn values
+    ref = engine._init_fn(jax.random.PRNGKey(11))
+    got = jax.tree.map(lambda a: np.asarray(a, np.float32), engine.params)
+    ref = jax.tree.map(lambda a: np.asarray(a, np.float32), ref)
+    flat_got = jax.tree_util.tree_leaves_with_path(got)
+    flat_ref = jax.tree_util.tree_leaves_with_path(ref)
+    assert len(flat_got) == len(flat_ref)
+    for (path, a), (_, b) in zip(flat_got, flat_ref):
+        # allclose, not array_equal: eager-vs-jit rng lowering may differ
+        # in the last ulp — the bug being regressed is a 4x SCALE, which
+        # no tolerance this tight lets through
+        np.testing.assert_allclose(
+            a, b, rtol=1e-5, atol=1e-7,
+            err_msg=f"init drifted at {jax.tree_util.keystr(path)}")

@@ -1,0 +1,364 @@
+"""Multi-replica serving tier: router, replicas, paged prefix cache.
+
+Correctness oracle, same as test_serving: everything the routed path
+produces under greedy sampling must be BIT-IDENTICAL to a single
+engine's one-shot ``generate()`` with the same weights — across replica
+choice, fail-over re-dispatch, prefix-cache adoption, and
+preemption-then-re-adoption.  The shared-page safety tests pin the
+refcount invariant: no page is ever freed (or handed to a new owner)
+while another live sequence still reads it.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import build_engine
+from deepspeed_tpu.inference.v2.ragged import BlockedAllocator
+from deepspeed_tpu.models import get_model_config
+from deepspeed_tpu.serving import (InferenceServer, PrefixCache,
+                                   PrefixCacheConfig, ReplicaSet, Router,
+                                   SamplingParams)
+
+ENG_CFG = {"dtype": "float32",
+           "memory_config": {"num_blocks": 64, "block_size": 4},
+           "max_context": 64}
+
+
+def _model():
+    return get_model_config("llama-tiny", num_layers=1)
+
+
+def _prompts(model, sizes, seed=0, shared=0):
+    rng = np.random.default_rng(seed)
+    head = rng.integers(1, model.vocab_size, size=shared).tolist()
+    return [head + rng.integers(1, model.vocab_size,
+                                size=n - shared).tolist()
+            for n in sizes]
+
+
+# ---------------------------------------------------------------------------
+# allocator refcounts (the invariant everything above rests on)
+# ---------------------------------------------------------------------------
+
+def test_allocator_refcount_shared_pages():
+    al = BlockedAllocator(8)
+    blocks = al.allocate(3)
+    assert all(al.refcount(b) == 1 for b in blocks)
+    al.acquire(blocks[:2])                 # a second owner (prefix cache)
+    al.free(blocks)                        # first owner releases all 3
+    # shared pages survive at refcount 1; the unshared one is free again
+    assert al.refcount(blocks[0]) == 1 and al.refcount(blocks[1]) == 1
+    assert al.refcount(blocks[2]) == 0
+    assert al.free_blocks == 5
+    # a freed page cannot be re-released or re-acquired
+    with pytest.raises(ValueError):
+        al.free([blocks[2]])
+    with pytest.raises(ValueError):
+        al.acquire([blocks[2]])
+    al.free(blocks[:2])                    # last owner: back on free list
+    assert al.free_blocks == 7
+    with pytest.raises(ValueError):        # double free still rejected
+        al.free([blocks[0]])
+
+
+def test_prefix_cache_adopt_insert_evict_refcounts():
+    """Eviction can never free a page a live sequence shares (rc >= 2);
+    LRU evicts leaves first and exposed parents after."""
+    al = BlockedAllocator(16)
+    pc = PrefixCache(PrefixCacheConfig(enabled=True), al, block_size=4)
+    donor = al.allocate(3)                 # 8 prompt tokens + 1 decode page
+    tokens = list(range(100, 112))         # 12 tokens -> 3 blocks, 2 full+1
+    assert pc.insert(tokens[:9], donor) == 2   # 9 prefilled -> 2 full blocks
+    al.free(donor)                         # donor flushes
+    assert al.refcount(donor[0]) == 1 and al.refcount(donor[1]) == 1
+    assert al.refcount(donor[2]) == 0      # partial block was never cached
+
+    adopted, n = pc.adopt(tokens)          # new request, same prefix
+    assert adopted == donor[:2] and n == 8
+    assert al.refcount(donor[0]) == 2
+    # eviction under pressure must skip shared pages entirely
+    assert pc.evict(10) == 0
+    assert al.refcount(donor[0]) == 2 and al.refcount(donor[1]) == 2
+    pc.release(adopted)                    # adopter flushed
+    # now reclaimable: leaf (block 1) goes first, exposing block 0
+    assert pc.evict(1) == 1
+    assert al.refcount(donor[1]) == 0 and al.refcount(donor[0]) == 1
+    assert pc.evict(1) == 1
+    assert al.free_blocks == 15
+    assert pc.cached_blocks == 0
+
+
+def test_prefix_cache_adoption_reserves_one_prefill_token():
+    """A prompt fully covered by the cache still prefills >= 1 token
+    (the sampling step needs a real row)."""
+    al = BlockedAllocator(16)
+    pc = PrefixCache(PrefixCacheConfig(enabled=True), al, block_size=4)
+    blocks = al.allocate(2)
+    tokens = list(range(8))
+    pc.insert(tokens, blocks)              # both blocks cached
+    adopted, n = pc.adopt(tokens)          # SAME 8 tokens: cap at 1 block
+    assert n == 4 and len(adopted) == 1
+    pc.release(adopted)
+
+
+# ---------------------------------------------------------------------------
+# single-server prefix cache behavior
+# ---------------------------------------------------------------------------
+
+def test_warm_request_skips_shared_prefill_bit_identical():
+    """Acceptance: a warm shared-system-prompt request skips >= the
+    shared blocks of prefill (prefill_tokens_saved) and greedy output
+    stays bit-identical to the cold path."""
+    model = _model()
+    shared = 16                            # 4 full blocks at bs=4
+    prompts = _prompts(model, [22, 23], seed=5, shared=shared)
+    ref_eng = build_engine(model, dict(ENG_CFG), seed=0)
+    ref = ref_eng.generate(prompts, max_new_tokens=6)
+
+    eng = build_engine(model, dict(ENG_CFG), seed=0)
+    srv = InferenceServer(eng, {"prefix_cache": {"enabled": True}}).start()
+    try:
+        cold = srv.submit(prompts[0], SamplingParams(max_new_tokens=6))
+        assert cold.result(timeout=120) == ref[0]
+        warm = srv.submit(prompts[1], SamplingParams(max_new_tokens=6))
+        assert warm.result(timeout=120) == ref[1]
+        snap = srv.metrics.snapshot()
+        assert snap["prefix_hits"] == 1 and snap["prefix_misses"] == 1
+        assert snap["prefill_tokens_saved"] >= shared
+    finally:
+        srv.stop()
+    # stop() clears the cache: the pool returns whole to the engine
+    assert eng.free_blocks == eng.cfg.num_blocks - 1
+
+
+def test_preempted_victim_readopts_prefix_bit_identical():
+    """Satellite: recompute-preempted victims re-adopt their cached
+    prefix on re-admission (prefix_hits exceed the admission count) and
+    outputs stay bit-identical through preemption + re-adoption."""
+    n_req, new, shared = 8, 12, 8
+    model = _model()
+    cfg = {"dtype": "float32",
+           "state_manager": {"max_tracked_sequences": 8,
+                             "max_ragged_batch_size": 32},
+           "memory_config": {"num_blocks": 28, "block_size": 4},
+           "max_context": 32}
+    prompts = _prompts(model, [12] * n_req, seed=7, shared=shared)
+    ref_eng = build_engine(model, dict(cfg), seed=0)
+    ref = ref_eng.generate(prompts, max_new_tokens=new)
+
+    eng = build_engine(model, dict(cfg), seed=0)
+    srv = InferenceServer(eng, {"prefix_cache": {"enabled": True}}).start()
+    try:
+        streams = [srv.submit(p, SamplingParams(max_new_tokens=new))
+                   for p in prompts]
+        outs = [s.result(timeout=300) for s in streams]
+        snap = srv.metrics.snapshot()
+    finally:
+        srv.stop()
+    assert outs == ref                     # bit-identical through it all
+    assert snap["preemptions"] >= 1        # the tight pool really preempted
+    # every re-admission of a preempted victim re-adopts its prefix, so
+    # hits exceed what first admissions alone could produce
+    assert snap["prefix_hits"] > 0
+    assert (snap["prefix_hits"] + snap["prefix_misses"]
+            == snap["admitted"] + snap["preemptions"])
+    assert eng.free_blocks == eng.cfg.num_blocks - 1
+
+
+def test_eviction_under_admission_pressure_frees_cache_first():
+    """When the watermark blocks admission, idle cache pages are evicted
+    before anyone waits — and the engine keeps its page-safety (the
+    refcounting allocator raises on any double-free, so a clean run IS
+    the invariant check)."""
+    model = _model()
+    cfg = {"dtype": "float32",
+           "state_manager": {"max_tracked_sequences": 4,
+                             "max_ragged_batch_size": 32},
+           "memory_config": {"num_blocks": 20, "block_size": 4},
+           "max_context": 64}
+    eng = build_engine(model, dict(cfg), seed=0)
+    # kv_high_watermark 0.5: a 19-block pool must keep 9 free at
+    # admission, so the 12-block request below cannot admit until the
+    # cache's idle pages are reclaimed
+    srv = InferenceServer(eng, {
+        "prefix_cache": {"enabled": True},
+        "admission": {"kv_high_watermark": 0.5}}).start()
+    try:
+        # fill the cache: a long prompt whose pages go idle after finish
+        a = _prompts(model, [16], seed=1)[0]
+        srv.submit(a, SamplingParams(max_new_tokens=2)).result(timeout=120)
+        time.sleep(0.05)                   # let gauges settle
+        cached = srv.metrics.snapshot()["prefix_cached_blocks"]
+        assert cached >= 4                 # 16 tokens = 4 full blocks held
+        # now a big unrelated request that needs those pages back
+        b = _prompts(model, [40], seed=2)[0]
+        out = srv.submit(b, SamplingParams(max_new_tokens=8))
+        res = out.result(timeout=120)
+        assert len(res) == 8
+    finally:
+        srv.stop()
+    assert eng.free_blocks == eng.cfg.num_blocks - 1
+
+
+# ---------------------------------------------------------------------------
+# router + replicas
+# ---------------------------------------------------------------------------
+
+def test_router_e2e_failover_streamed_sticky():
+    """Acceptance: router over 2 replicas, concurrent streamed requests
+    land sticky (each pumped from one replica), one replica killed
+    mid-run -> its in-flight requests fail over and FINISH, outputs
+    bit-identical to one-shot generate()."""
+    model = _model()
+    n_req, new = 4, 24
+    prompts = _prompts(model, [8] * n_req, seed=3)
+    ref_eng = build_engine(model, dict(ENG_CFG), seed=0)
+    ref = ref_eng.generate(prompts, max_new_tokens=new)
+
+    rs = ReplicaSet.build(model, 2, ENG_CFG, seed=0)
+    router = Router(rs).start()
+    outs = {}
+
+    def consume(i, stream):
+        outs[i] = [tok for tok in stream]  # incremental iterator
+
+    streams = [router.submit(p, SamplingParams(max_new_tokens=new))
+               for p in prompts]
+    threads = [threading.Thread(target=consume, args=(i, s))
+               for i, s in enumerate(streams)]
+    for t in threads:
+        t.start()
+    # wait until BOTH replicas hold active work AND every stream has
+    # tokens flowing (so the kill is demonstrably mid-stream), then
+    # kill r0
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if (all(len(r.server._active) > 0 for r in rs)
+                and all(len(s.tokens) >= 2 for s in streams)):
+            break
+        time.sleep(0.01)
+    assert all(len(r.server._active) > 0 for r in rs), \
+        "both replicas should be serving before the kill"
+    assert all(len(s.tokens) >= 2 for s in streams), \
+        "every request should be streaming before the kill"
+    rs[0].kill()
+    for t in threads:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads)
+    snap = router.snapshot()
+    router.stop()
+
+    assert [outs[i] for i in range(n_req)] == ref   # bit-identical
+    # sticky dispatch spread the streams over BOTH replicas, and the
+    # pre-kill wait proved every one of them was mid-stream
+    assert snap["routed"]["r0"] > 0 and snap["routed"]["r1"] > 0
+    assert snap["failovers"] >= 1                   # r0's work moved
+    assert snap["replicas_alive"] == 1
+
+
+def test_router_sticky_sessions_warm_prefix():
+    """Session affinity pins requests to one replica, so its local
+    prefix cache serves the session's shared prompt."""
+    model = _model()
+    shared = 16
+    prompts = _prompts(model, [22, 23, 24], seed=9, shared=shared)
+    rs = ReplicaSet.build(model, 2, ENG_CFG,
+                          {"prefix_cache": {"enabled": True}}, seed=0)
+    router = Router(rs).start()
+    try:
+        before = [router.metrics.routed(i) for i in range(2)]
+        for p in prompts:
+            router.submit(p, SamplingParams(max_new_tokens=4),
+                          session="user-1").result(timeout=120)
+        delta = [router.metrics.routed(i) - before[i] for i in range(2)]
+        assert sorted(delta) == [0, 3]     # all three on ONE replica
+        agg = router.snapshot()["aggregate"]
+        assert agg["prefix_hits"] >= 2     # warm after the first
+        assert agg["prefill_tokens_saved"] >= 2 * shared
+    finally:
+        router.stop()
+
+
+def test_router_spreads_load_and_aggregates():
+    model = _model()
+    prompts = _prompts(model, [8] * 6, seed=4)
+    ref_eng = build_engine(model, dict(ENG_CFG), seed=0)
+    ref = ref_eng.generate(prompts, max_new_tokens=6)
+    rs = ReplicaSet.build(model, 2, ENG_CFG, seed=0)
+    router = Router(rs).start()
+    try:
+        outs = router.generate(prompts, max_new_tokens=6)
+        snap = router.snapshot()
+    finally:
+        router.stop()
+    assert outs == ref
+    assert snap["routed"]["r0"] > 0 and snap["routed"]["r1"] > 0
+    assert snap["aggregate"]["tokens_out"] == 6 * 6
+    assert snap["failovers"] == 0
+
+
+def test_router_cancel_reaches_current_replica():
+    model = _model()
+    rs = ReplicaSet.build(model, 2, ENG_CFG, seed=0)
+    router = Router(rs).start()
+    try:
+        p = _prompts(model, [8], seed=6)[0]
+        stream = router.submit(p, SamplingParams(max_new_tokens=40))
+        it = iter(stream)
+        next(it)                           # first token proves it's live
+        stream.cancel()
+        from deepspeed_tpu.serving import RequestCancelled
+        with pytest.raises(RequestCancelled):
+            stream.result(timeout=120)
+    finally:
+        router.stop()
+
+
+def test_serving_config_block():
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        "serving": {"n_replicas": 2,
+                    "router": {"queue_weight": 0.1, "max_failovers": 3},
+                    "prefix_cache": {"enabled": True, "max_blocks": 128}},
+    })
+    assert cfg.serving.n_replicas == 2
+    assert cfg.serving.router.max_failovers == 3
+    assert cfg.serving.prefix_cache.enabled
+    # the round-trip dicts feed the serving classes directly
+    assert cfg.serving.server_config()["prefix_cache"]["max_blocks"] == 128
+    assert cfg.serving.router_config()["queue_weight"] == 0.1
+    for bad in ({"n_replicas": 0},
+                {"router": {"queue_weight": -1}},
+                {"prefix_cache": {"min_prefix_blocks": 0}}):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                             "serving": bad})
+
+
+def test_router_invalid_request_rejected_cleanly():
+    """Per-request validation errors from the replica server (plain
+    ValueError) propagate through Router.submit AND close the books:
+    rejected counter matches, no pump/inflight leak."""
+    model = _model()
+    rs = ReplicaSet.build(model, 2, ENG_CFG, seed=0)
+    router = Router(rs).start()
+    try:
+        with pytest.raises(ValueError):
+            router.submit([], SamplingParams(max_new_tokens=4))
+        with pytest.raises(ValueError):
+            router.submit([1, 2, 3], SamplingParams(top_p=0.0))
+        snap = router.snapshot()
+        assert snap["requests"] == 2 and snap["rejected"] == 2
+        assert sum(snap["routed"].values()) == 0
+        # a valid request still works afterwards
+        p = _prompts(model, [6], seed=8)[0]
+        out = router.submit(p, SamplingParams(max_new_tokens=3))
+        assert len(out.result(timeout=120)) == 3
+    finally:
+        router.stop()

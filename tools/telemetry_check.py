@@ -44,14 +44,15 @@ EXPECTED_RECORD_KEYS = [
 # and telemetry/flight.py FLIGHT_REASONS must match, and every name must
 # appear in the docs span table — same contract as the record keys)
 EXPECTED_SPAN_NAMES = [
-    "serve.admission_block", "serve.decode", "serve.prefill",
-    "serve.queue_wait", "serve.request", "serve.step", "train.data_ingest",
-    "train.dispatch", "train.step", "train.sync", "train.telemetry",
-    "v2.ragged_step",
+    "router.leg", "router.request", "serve.admission_block", "serve.decode",
+    "serve.prefill", "serve.queue_wait", "serve.request", "serve.step",
+    "train.data_ingest", "train.dispatch", "train.step", "train.sync",
+    "train.telemetry", "v2.ragged_step",
 ]
 EXPECTED_EVENT_NAMES = [
-    "serve.emit", "serve.enqueue", "serve.finish", "serve.first_token",
-    "serve.preempt", "watchdog.fire",
+    "router.dispatch", "router.failover", "serve.emit", "serve.enqueue",
+    "serve.finish", "serve.first_token", "serve.preempt",
+    "serve.prefix_hit", "watchdog.fire",
 ]
 EXPECTED_FLIGHT_REASONS = ["watchdog", "serve_crash", "engine_crash",
                            "manual"]
@@ -77,6 +78,16 @@ RING_BENCH_KEYS = ["mfu", "placement", "ring_backward", "vs_baseline"]
 RING_BWD_BENCH_KEYS = ["bwd_ms_per_hop_fused", "bwd_ms_per_hop_xla",
                        "transient_bytes_fused", "transient_bytes_xla",
                        "transient_reduction"]
+
+# frozen multi-replica serving vocabulary (same contract): the
+# serve_load_multi bench row keys must be emitted by bench.py and
+# documented in docs/SERVING.md; every router-tier Prometheus metric
+# (RouterMetrics over a fresh registry; per-replica counters normalized
+# to their documented `router_routed_r*_total` wildcard) must appear in
+# docs/SERVING.md too.
+SERVING_DOCS = os.path.join(REPO, "docs", "SERVING.md")
+SERVE_MULTI_BENCH_KEYS = ["agg_tokens_per_sec", "ttft_p95_ms",
+                          "prefix_hit_rate", "prefill_tokens_saved"]
 
 
 def _exported_monitor_tags() -> List[str]:
@@ -263,6 +274,43 @@ def check_ring_bench() -> List[str]:
     return errors
 
 
+def check_router_serving() -> List[str]:
+    """Router-tier vocabulary: every RouterMetrics Prometheus name is
+    documented in docs/SERVING.md (per-replica counters via their
+    ``_r*_`` wildcard), and the frozen serve_load_multi bench keys are
+    both emitted by bench.py and documented."""
+    import re
+
+    from deepspeed_tpu.serving.metrics import RouterMetrics
+
+    errors = []
+    try:
+        with open(SERVING_DOCS, "r", encoding="utf-8") as f:
+            sdocs = f.read()
+    except OSError as e:
+        return [f"cannot read {SERVING_DOCS}: {e}"]
+    for m in RouterMetrics(n_replicas=2).registry.collect():
+        wildcard = re.sub(r"_r\d+_", "_r*_", m.name)
+        if f"`{m.name}`" not in sdocs and f"`{wildcard}`" not in sdocs:
+            errors.append(f"router metric {m.name!r} not documented in "
+                          f"{os.path.basename(SERVING_DOCS)}")
+    try:
+        with open(os.path.join(REPO, "bench.py"), "r",
+                  encoding="utf-8") as f:
+            bench_src = f.read()
+    except OSError as e:
+        return errors + [f"cannot read bench.py: {e}"]
+    for key in SERVE_MULTI_BENCH_KEYS:
+        if f'"{key}"' not in bench_src:
+            errors.append(f"serve_load_multi bench key {key!r} not emitted "
+                          "by bench.py (frozen SERVE_MULTI_BENCH_KEYS "
+                          "drifted)")
+        if f"`{key}`" not in sdocs:
+            errors.append(f"serve_load_multi bench key {key!r} not "
+                          f"documented in {os.path.basename(SERVING_DOCS)}")
+    return errors
+
+
 def validate_chrome_trace(obj: Any) -> List[str]:
     """Structural validation of a Chrome trace-event JSON object (pass a
     path or the loaded dict).  Perfetto/chrome://tracing both accept the
@@ -330,7 +378,7 @@ def check_trace_export() -> List[str]:
 def run_all() -> List[str]:
     return (check_tags_documented() + check_schema() + check_span_names()
             + check_quant_comm() + check_ring_bench()
-            + check_trace_export())
+            + check_router_serving() + check_trace_export())
 
 
 def main() -> int:
