@@ -1,9 +1,17 @@
-"""Autotuning (ref deepspeed/autotuning/)."""
+"""Autotuning (ref deepspeed/autotuning/) + overlap-driven step scheduling."""
 
 from deepspeed_tpu.autotuning.autotuner import (Autotuner, ModelInfo,
                                                 TrialResult,
                                                 estimate_memory_per_device,
                                                 generate_tuning_space)
+from deepspeed_tpu.autotuning.overlap_scheduler import (SCHEDULE_DECISIONS,
+                                                        OverlapScheduler,
+                                                        ScheduleDecision,
+                                                        decide,
+                                                        ensure_schedule,
+                                                        extract_evidence)
 
 __all__ = ["Autotuner", "ModelInfo", "TrialResult",
-           "estimate_memory_per_device", "generate_tuning_space"]
+           "estimate_memory_per_device", "generate_tuning_space",
+           "OverlapScheduler", "ScheduleDecision", "SCHEDULE_DECISIONS",
+           "decide", "ensure_schedule", "extract_evidence"]

@@ -1,0 +1,384 @@
+"""Overlap-driven step scheduling: probe → decide → pin.
+
+Closes the loop left open by the telemetry layer: ``telemetry/capture.py``
+auto-captures collective-overlap reports (``overlap_fraction`` +
+``top_device_ops`` from ``utils/xplane``), but nothing *acted* on them.
+This module runs k probe steps with a forced capture, reads the report,
+and picks a **step schedule** — the T3 move (arXiv:2401.16677: fine-grained
+compute/collective overlap is the lever once wire bytes are already
+quantized) combined with automatic cross-replica weight-update sharding
+(arXiv:2004.13336: decompose the optimizer step over the replica axis when
+it serializes behind the gradient reduce).
+
+Three knob families are actuated (runtime/engine.py reads the pinned
+``step_schedule`` config block):
+
+* ``zero3_prefetch`` — ZeRO-3 gather scheduling: ``gather_prefetch_depth``
+  (the layer-scan unroll window XLA's latency-hiding scheduler can hoist a
+  parameter all-gather across), ``param_persistence_threshold`` (small
+  params stay gathered — fewer per-use all-gathers), and
+  ``prefetch_bucket_size`` (recorded with the schedule for launch-config
+  parity; under XLA the bucketing itself belongs to the scheduler).
+* ``ring_interleave`` — ring-attention hop schedule: depth 2 issues the
+  next hop's ``ppermute`` *before* the current hop's attend, so the
+  K/V transfer is dataflow-independent of the hop's kernels and the
+  compiler can overlap the two (sequence/ring.py).
+* ``decomposed_update`` — the 2004.13336 schedule: optimizer state and the
+  gradient accumulator shard over the ZeRO axes even at stage 0/1, so the
+  gradient all-reduce becomes reduce-scatter + a 1/world optimizer step +
+  an all-gather of updated params that XLA overlaps with neighbouring
+  update compute (at stage 3 the schedule is already decomposed — the
+  re-gather happens lazily at the next step's forward, per layer).
+
+Every decision is a typed :class:`ScheduleDecision` carrying the evidence
+that justified it (overlap fraction + its source, dominant collective,
+estimated exposed-comm ms, probe step).  The chosen schedule is written
+into a frozen ``step_schedule`` config block with ``mode: "pinned"`` —
+a tuned run is reproducible without re-probing.
+
+CPU degradation: XPlane captures on the CPU mesh carry no device planes,
+so the report's ``spans`` block (software-span overlap estimate from the
+PR-4 tracer) feeds the same decision logic — the probe→decide→pin loop is
+exercisable end-to-end in CI.  Like the autotuner's trials, a CPU-mesh
+probe validates *plumbing*, not chip timings; re-probe on hardware before
+committing a launch schedule.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from deepspeed_tpu.utils.logging import logger
+
+# Frozen decision vocabulary — linted against docs/AUTOTUNING.md by
+# tools/telemetry_check.py (same contract as the telemetry span names).
+SCHEDULE_DECISIONS = ("decomposed_update", "noop", "ring_interleave",
+                      "zero3_prefetch")
+
+# Frozen evidence key set: every ScheduleDecision carries exactly these.
+EVIDENCE_KEYS = ("dominant_collective", "exposed_comm_ms",
+                 "overlap_fraction", "overlap_source", "probe_step")
+
+# param_persistence_threshold rungs (same ladder as the DeepCompile
+# SelectiveUnshardPass — compile/backend.py): each step trades spare HBM
+# for fewer per-use all-gathers of small ZeRO-3 params.
+PERSIST_LADDER = (0, 100_000, 1_000_000, 10_000_000)
+
+MAX_PREFETCH_DEPTH = 4
+
+
+@dataclass
+class ScheduleDecision:
+    """One typed scheduling decision with the evidence that justified it.
+
+    ``knobs`` maps ``step_schedule`` keys to their pinned values (empty
+    for ``noop``); ``evidence`` carries exactly :data:`EVIDENCE_KEYS`.
+    """
+    decision: str
+    knobs: Dict[str, Any] = field(default_factory=dict)
+    evidence: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.decision not in SCHEDULE_DECISIONS:
+            raise ValueError(
+                f"unknown schedule decision {self.decision!r} "
+                f"(known: {list(SCHEDULE_DECISIONS)})")
+        missing = set(EVIDENCE_KEYS) - set(self.evidence)
+        if missing:
+            raise ValueError(
+                f"ScheduleDecision {self.decision!r} evidence is missing "
+                f"{sorted(missing)} (frozen keys: {list(EVIDENCE_KEYS)})")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"decision": self.decision, "knobs": dict(self.knobs),
+                "evidence": dict(self.evidence)}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "ScheduleDecision":
+        return cls(decision=d["decision"], knobs=dict(d.get("knobs", {})),
+                   evidence=dict(d.get("evidence", {})))
+
+
+def extract_evidence(report: Dict[str, Any],
+                     context: Dict[str, Any]) -> Dict[str, Any]:
+    """Evidence fields from one capture report.
+
+    Prefers the XPlane device-plane numbers (on-chip truth); degrades to
+    the report's ``spans`` block (software-span estimate) when the
+    capture carried no device planes (CPU mesh).  Raises ``ValueError``
+    when the report carries neither — the scheduler refuses to decide on
+    no evidence.
+    """
+    devices = report.get("devices") or {}
+    if devices:
+        overlap = float(report.get("overlap_fraction", 0.0))
+        source = "xplane"
+        # per-device MEAN, matching mean_overlap_fraction: summing the
+        # planes would scale the evidence with the device (and, on
+        # multi-host captures, host-file) count instead of describing
+        # one step on one chip
+        coll_ms = (sum(float(d.get("collective_ms", 0.0))
+                       for d in devices.values()) / len(devices))
+        exposed_ms = coll_ms * (1.0 - overlap)
+    else:
+        spans = report.get("spans") or {}
+        if float(spans.get("step_ms", 0.0)) <= 0.0:
+            raise ValueError(
+                "capture report carries neither device planes nor a spans "
+                "block — nothing to schedule on (was tracing enabled "
+                "during the probe?)")
+        overlap = float(spans.get("overlap_estimate", 0.0))
+        source = "spans"
+        exposed_ms = float(spans.get("exposed_ms", 0.0))
+
+    dom = report.get("dominant_collective") or {}
+    name = dom.get("name", "") if isinstance(dom, dict) else str(dom)
+    if not name:
+        # No collective op surfaced in the capture (CPU host planes, or
+        # post-processing degraded): infer the schedule-implied dominant
+        # collective from the config so the decision table still has a
+        # gate.  Marked "(inferred)" so pinned evidence is honest.
+        if context.get("zero_stage", 0) >= 3:
+            name = "all-gather (inferred)"
+        elif context.get("sp", 1) > 1 and context.get("seq_impl") == "ring":
+            name = "collective-permute (inferred)"
+        elif context.get("dp", 1) > 1:
+            name = "all-reduce (inferred)"
+        else:
+            name = "none"
+    return {
+        "dominant_collective": name,
+        "exposed_comm_ms": round(float(exposed_ms), 3),
+        "overlap_fraction": round(float(overlap), 4),
+        "overlap_source": source,
+        "probe_step": int(report.get("step",
+                                     report.get("armed_at_step", 0))),
+    }
+
+
+def _next_persist_rung(current: int) -> int:
+    for rung in PERSIST_LADDER:
+        if rung > current:
+            return rung
+    return PERSIST_LADDER[-1]
+
+
+def decide(report: Dict[str, Any], context: Dict[str, Any],
+           overlap_threshold: float = 0.5
+           ) -> Tuple[Dict[str, Any], List[ScheduleDecision]]:
+    """Pure decision table: capture report + config context → schedule.
+
+    Returns ``(updates, decisions)`` where ``updates`` maps
+    ``step_schedule`` keys to their new pinned values.  The three knob
+    families are evaluated independently; when nothing fires a single
+    ``noop`` decision records the evidence that justified leaving the
+    schedule alone.
+
+    ``context``: ``{"zero_stage", "dp", "sp", "seq_impl", "base": {...}}``
+    where ``base`` carries the effective pre-decision knob values.
+    """
+    ev = extract_evidence(report, context)
+    base = dict(context.get("base", {}))
+    overlap = ev["overlap_fraction"]
+    dom = ev["dominant_collective"]
+    low = overlap < float(overlap_threshold)
+    updates: Dict[str, Any] = {}
+    decisions: List[ScheduleDecision] = []
+
+    # (a) ZeRO-3 gather scheduling: exposed param gathers → prefetch
+    # deeper and persist more small params.
+    if low and context.get("zero_stage", 0) >= 3:
+        depth = int(base.get("gather_prefetch_depth", 1))
+        persist = int(base.get("param_persistence_threshold") or 0)
+        bucket = int(base.get("prefetch_bucket_size") or 50_000_000)
+        knobs = {
+            "gather_prefetch_depth": min(MAX_PREFETCH_DEPTH, depth * 2),
+            "param_persistence_threshold": _next_persist_rung(persist),
+            "prefetch_bucket_size": bucket * 2,
+        }
+        updates.update(knobs)
+        decisions.append(ScheduleDecision("zero3_prefetch", knobs, ev))
+
+    # (b) ring hop/compute interleave: an exposed ring rotation → issue
+    # the next hop's permute before the current hop's attend.
+    if (low and context.get("sp", 1) > 1
+            and context.get("seq_impl") == "ring"
+            and int(base.get("ring_interleave", 1)) < 2):
+        knobs = {"ring_interleave": 2}
+        updates.update(knobs)
+        decisions.append(ScheduleDecision("ring_interleave", knobs, ev))
+
+    # (c) decomposed weight update (2004.13336): the optimizer step
+    # serializes behind a dominant gradient reduce → shard the update
+    # over the ZeRO axes (stage ≥ 2 is already decomposed by layout).
+    if (low and context.get("zero_stage", 0) <= 1
+            and context.get("dp", 1) > 1
+            and ("reduce" in dom)
+            and base.get("weight_update", "fused") != "decomposed"):
+        knobs = {"weight_update": "decomposed"}
+        updates.update(knobs)
+        decisions.append(ScheduleDecision("decomposed_update", knobs, ev))
+
+    if not decisions:
+        decisions.append(ScheduleDecision("noop", {}, ev))
+    return updates, decisions
+
+
+class OverlapScheduler:
+    """The probe→decide→pin driver (wired into ``autotuning/``).
+
+    ``tune(batch)`` builds an engine from ``base_config`` with a forced
+    telemetry capture + tracing injected, runs ``probe_steps`` compiled
+    steps (plus one compile warmup outside the window), reads the overlap
+    report, runs :func:`decide`, and returns the base config with a
+    frozen ``step_schedule`` block (``mode: "pinned"``) holding the
+    chosen knobs and the full decision records.
+    """
+
+    def __init__(self, model, base_config: Dict[str, Any],
+                 probe_steps: Optional[int] = None,
+                 overlap_threshold: Optional[float] = None,
+                 output_dir: Optional[str] = None):
+        if not isinstance(base_config, dict):
+            raise TypeError("OverlapScheduler needs the config as a dict "
+                            "(the pinned schedule is written back into it)")
+        self.model = model
+        self.base_config = copy.deepcopy(base_config)
+        ss = dict(self.base_config.get("step_schedule") or {})
+        self.probe_steps = int(probe_steps if probe_steps is not None
+                               else ss.get("probe_steps", 3))
+        self.overlap_threshold = float(
+            overlap_threshold if overlap_threshold is not None
+            else ss.get("overlap_threshold", 0.5))
+        self.output_dir = output_dir or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "dstpu_overlap_probe")
+        self.last_report: Optional[Dict[str, Any]] = None
+        self.last_context: Optional[Dict[str, Any]] = None
+
+    # ------------------------------------------------------------------
+    def _probe_config(self) -> Dict[str, Any]:
+        cfg = copy.deepcopy(self.base_config)
+        tel = dict(cfg.get("telemetry") or {})
+        tel["enabled"] = True
+        cap = dict(tel.get("capture") or {})
+        # the first step pays the XLA compile — capture the LAST probe
+        # step so the window sees steady-state scheduling
+        cap.update({"enabled": True, "capture_step": self.probe_steps + 1,
+                    "num_steps": 1, "budget": 1,
+                    "output_dir": self.output_dir})
+        tel["capture"] = cap
+        tr = dict(tel.get("tracing") or {})
+        tr["enabled"] = True   # spans feed the CPU-degraded estimate
+        tel["tracing"] = tr
+        cfg["telemetry"] = tel
+        return cfg
+
+    @staticmethod
+    def _context_from_engine(engine) -> Dict[str, Any]:
+        cfg = engine.config
+        ss = cfg.step_schedule
+        zc = cfg.zero_config
+        persist = (ss.param_persistence_threshold
+                   if ss.param_persistence_threshold is not None
+                   else zc.param_persistence_threshold)
+        bucket = (ss.prefetch_bucket_size
+                  if ss.prefetch_bucket_size is not None
+                  else zc.prefetch_bucket_size)
+        mc = engine.model_config
+        return {
+            "zero_stage": engine.zero_stage,
+            "dp": engine.topology.dp_size,
+            "sp": engine.topology.sp_size,
+            "seq_impl": getattr(mc, "seq_impl", "") if mc is not None else "",
+            "base": {
+                "gather_prefetch_depth": ss.gather_prefetch_depth,
+                "param_persistence_threshold": persist,
+                "prefetch_bucket_size": bucket,
+                "ring_interleave": ss.ring_interleave,
+                "weight_update": ss.weight_update,
+            },
+        }
+
+    def probe(self, batch) -> Dict[str, Any]:
+        """Run the probe steps under a forced capture; → the report dict.
+
+        Also stashes ``last_context`` (read off the built engine, so the
+        decision table sees the *effective* stage/mesh, not the raw
+        JSON).
+        """
+        import deepspeed_tpu as ds
+        from deepspeed_tpu.parallel import topology as topo_mod
+
+        engine, _, _, _ = ds.initialize(model=self.model,
+                                        config=self._probe_config())
+        try:
+            self.last_context = self._context_from_engine(engine)
+            for _ in range(self.probe_steps + 1):
+                engine.train_batch(batch)
+        finally:
+            # a failed probe step must still release the engine — a
+            # leaked armed TraceProfiler would make a RETRIED probe fail
+            # with "no capture report" (another profiler owns the
+            # backend) instead of the real error.  destroy() also
+            # flushes a window cut short + the telemetry exporters.
+            try:
+                engine.destroy()
+            finally:
+                topo_mod._GLOBAL_TOPOLOGY = None
+        paths = (engine.telemetry.capture.reports
+                 if engine.telemetry and engine.telemetry.capture
+                 else [])
+        if not paths:
+            raise RuntimeError(
+                "overlap probe produced no capture report "
+                f"(output_dir={self.output_dir})")
+        with open(paths[-1], "r", encoding="utf-8") as f:
+            self.last_report = json.load(f)
+        return self.last_report
+
+    def pin(self, updates: Dict[str, Any],
+            decisions: List[ScheduleDecision]) -> Dict[str, Any]:
+        """→ the base config with a frozen ``step_schedule`` block."""
+        cfg = copy.deepcopy(self.base_config)
+        ss = dict(cfg.get("step_schedule") or {})
+        ss.update(updates)
+        ss["mode"] = "pinned"
+        ss["probe_steps"] = self.probe_steps
+        ss["overlap_threshold"] = self.overlap_threshold
+        ss["decisions"] = [d.to_dict() for d in decisions]
+        cfg["step_schedule"] = ss
+        return cfg
+
+    def tune(self, batch) -> Tuple[Dict[str, Any], List[ScheduleDecision]]:
+        """probe → decide → pin; → (pinned config, decisions)."""
+        report = self.probe(batch)
+        updates, decisions = decide(report, self.last_context,
+                                    overlap_threshold=self.overlap_threshold)
+        for d in decisions:
+            logger.info(f"overlap_scheduler: {d.decision} knobs={d.knobs} "
+                        f"evidence={d.evidence}")
+        return self.pin(updates, decisions), decisions
+
+
+def ensure_schedule(model, config: Dict[str, Any], batch,
+                    **scheduler_kwargs
+                    ) -> Tuple[Dict[str, Any], List[ScheduleDecision]]:
+    """Launch-path entry: honor the config's ``step_schedule.mode``.
+
+    * ``"static"`` (default) and ``"pinned"`` pass through unchanged —
+      a pinned config NEVER re-probes, which is what makes a tuned run
+      reproducible.
+    * ``"probe"`` runs the probe→decide→pin loop and returns the pinned
+      config plus the decisions.
+    """
+    ss = dict((config or {}).get("step_schedule") or {})
+    if ss.get("mode", "static") != "probe":
+        decisions = [ScheduleDecision.from_dict(d)
+                     for d in ss.get("decisions") or []]
+        return config, decisions
+    sched = OverlapScheduler(model, config, **scheduler_kwargs)
+    return sched.tune(batch)

@@ -163,6 +163,11 @@ class TransformerConfig:
     # applies the permutation host-side and forward() derives matching
     # positions, so training is turnkey (sequence/ring.py helpers).
     ring_placement: str = "contiguous"
+    # ring hop/compute interleave depth (step_schedule.ring_interleave;
+    # sequence/ring.py): 1 = attend then rotate, 2 = rotate-ahead (next
+    # hop's ppermute issued before the current hop's attend so the
+    # transfer overlaps the hop's kernels)
+    ring_interleave: int = 1
     # layer-scan unroll factor (XLA overlaps across unrolled iterations)
     scan_unroll: int = 1
     # residual/embedding dropout rate (GPT-2/BERT-class training; llama
@@ -589,7 +594,8 @@ def _attn_block(x, p, positions, cfg: TransformerConfig,
         out = ring_attention(q, k, v, topo, causal=cfg.causal,
                              sm_scale=cfg.attn_scale,
                              window=cfg.sliding_window or None,
-                             placement=cfg.ring_placement)
+                             placement=cfg.ring_placement,
+                             interleave=cfg.ring_interleave)
         out = out.reshape(b, s, nh * d)
         out = out @ p["wo"].astype(dt)
         if p.get("bo") is not None:

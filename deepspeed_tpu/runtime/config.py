@@ -492,6 +492,111 @@ class ServingTierConfig:
 
 
 @dataclass
+class StepScheduleConfig:
+    """``"step_schedule"`` block — the overlap-driven step schedule
+    (autotuning/overlap_scheduler.py; docs/AUTOTUNING.md).
+
+    ``mode``:
+
+    * ``"static"`` — the defaults below (or explicit values) apply as-is;
+      no probing.
+    * ``"probe"`` — a launch path that honors the block (bench rows,
+      ``ensure_schedule``) runs ``probe_steps`` compiled steps under a
+      forced telemetry capture, reads the overlap report, and rewrites
+      the block to ``"pinned"`` with the chosen knobs.
+    * ``"pinned"`` — a tuned schedule frozen by a previous probe; never
+      re-probes, so a tuned run is reproducible.  ``decisions`` carries
+      the :class:`ScheduleDecision` records (evidence included) that
+      justified the pinned values.
+
+    Knob families (each actuated by ``runtime/engine.py``):
+
+    * ``gather_prefetch_depth`` — ZeRO-3 gather prefetch window: the
+      layer-scan unroll factor, which bounds how far XLA's
+      latency-hiding scheduler can hoist a parameter all-gather ahead of
+      its use (models/transformer.py ``scan_unroll``).
+    * ``param_persistence_threshold`` / ``prefetch_bucket_size`` —
+      overrides for the static ``zero_optimization`` values (``None`` =
+      keep the zero block's setting).  The persistence threshold feeds
+      the sharding rules directly (small ZeRO-3 params stay gathered).
+    * ``ring_interleave`` — ring-attention hop schedule: 1 = attend then
+      rotate (serial), 2 = issue the next hop's ppermute before the
+      attend so transfer and compute are dataflow-independent
+      (sequence/ring.py).
+    * ``weight_update`` — ``"fused"`` (the stage's native layout) or
+      ``"decomposed"`` (shard optimizer state + grad accumulator over
+      the ZeRO axes even at stage 0/1: reduce-scatter + 1/world update +
+      params all-gather, arXiv:2004.13336).
+    """
+    mode: str = "static"            # static | probe | pinned
+    probe_steps: int = 3            # compiled steps per probe (+1 warmup)
+    overlap_threshold: float = 0.5  # overlap below this ⇒ act
+    gather_prefetch_depth: int = 1
+    param_persistence_threshold: Optional[int] = None
+    prefetch_bucket_size: Optional[int] = None
+    ring_interleave: int = 1
+    weight_update: str = "fused"    # fused | decomposed
+    decisions: Optional[List[Dict[str, Any]]] = None
+
+    MODES = ("static", "probe", "pinned")
+    WEIGHT_UPDATES = ("fused", "decomposed")
+    RING_INTERLEAVES = (1, 2)
+
+    def __post_init__(self):
+        if self.mode not in self.MODES:
+            raise DeepSpeedConfigError(
+                f"step_schedule.mode must be one of {list(self.MODES)}, "
+                f"got {self.mode!r}")
+        if self.weight_update not in self.WEIGHT_UPDATES:
+            raise DeepSpeedConfigError(
+                f"step_schedule.weight_update must be one of "
+                f"{list(self.WEIGHT_UPDATES)}, got {self.weight_update!r}")
+        if int(self.ring_interleave) not in self.RING_INTERLEAVES:
+            raise DeepSpeedConfigError(
+                f"step_schedule.ring_interleave must be one of "
+                f"{list(self.RING_INTERLEAVES)}, got {self.ring_interleave}")
+        self.ring_interleave = int(self.ring_interleave)
+        if int(self.probe_steps) < 1:
+            raise DeepSpeedConfigError(
+                f"step_schedule.probe_steps must be >= 1, got "
+                f"{self.probe_steps}")
+        self.probe_steps = int(self.probe_steps)
+        if int(self.gather_prefetch_depth) < 1:
+            raise DeepSpeedConfigError(
+                "step_schedule.gather_prefetch_depth must be >= 1, got "
+                f"{self.gather_prefetch_depth}")
+        self.gather_prefetch_depth = int(self.gather_prefetch_depth)
+        if not 0.0 <= float(self.overlap_threshold) <= 1.0:
+            raise DeepSpeedConfigError(
+                "step_schedule.overlap_threshold must be in [0, 1], got "
+                f"{self.overlap_threshold}")
+        if self.param_persistence_threshold is not None:
+            if int(self.param_persistence_threshold) < 0:
+                raise DeepSpeedConfigError(
+                    "step_schedule.param_persistence_threshold must be >= 0")
+            self.param_persistence_threshold = \
+                int(self.param_persistence_threshold)
+        if self.prefetch_bucket_size is not None:
+            if int(self.prefetch_bucket_size) <= 0:
+                raise DeepSpeedConfigError(
+                    "step_schedule.prefetch_bucket_size must be positive")
+            self.prefetch_bucket_size = int(self.prefetch_bucket_size)
+        if self.decisions is not None:
+            # decision records round-trip through the frozen vocabulary —
+            # a hand-edited pinned block with a bogus decision fails at
+            # config load, not at some later analysis step
+            from deepspeed_tpu.autotuning.overlap_scheduler import \
+                ScheduleDecision
+
+            try:
+                for d in self.decisions:
+                    ScheduleDecision.from_dict(d)
+            except (KeyError, TypeError, ValueError) as e:
+                raise DeepSpeedConfigError(
+                    f"step_schedule.decisions: invalid record ({e})") from e
+
+
+@dataclass
 class CommQuantizationConfig:
     """``"comm_quantization"`` block — quantized ZeRO collectives
     (comm/quantized.py; docs/QUANTIZED_COMM.md).
@@ -712,6 +817,8 @@ class DeepSpeedConfig:
         self.comm_quantization = _from_dict(
             CommQuantizationConfig, d.get("comm_quantization"),
             "comm_quantization")
+        self.step_schedule = _from_dict(
+            StepScheduleConfig, d.get("step_schedule"), "step_schedule")
         self.telemetry = _from_dict(TelemetryConfig, d.get(C.TELEMETRY), "telemetry")
         self.serving = _from_dict(ServingTierConfig, d.get("serving"),
                                   "serving")

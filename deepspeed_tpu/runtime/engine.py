@@ -49,6 +49,12 @@ from deepspeed_tpu.utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIM
 
 Batch = Dict[str, Any]
 
+# once-per-process throttle for the discarded-prefetch warning (same
+# pattern as the accelerator's unbalanced range_pop throttle): every
+# checkpoint load cancels prefetches, and a store whose reads reliably
+# fail would otherwise warn once per load for the rest of the run
+_DISCARDED_PREFETCH_WARNED = False
+
 
 def _tree_zeros_like(tree, dtype=None):
     return jax.tree.map(lambda x: jnp.zeros(x.shape, dtype or x.dtype), tree)
@@ -259,6 +265,30 @@ class DeepSpeedEngine:
                          "nothing_saveable -> flash_saveable (saves the "
                          "ring's (o, lse) so the backward never re-runs "
                          "the forward ppermute chain)", level="info")
+            ss = cfg.step_schedule
+            if ss.gather_prefetch_depth > 1:
+                # gather-prefetch depth (step_schedule): unrolling the
+                # layer scan widens the window XLA's latency-hiding
+                # scheduler can hoist a ZeRO-3 param all-gather (or a
+                # streamed-layer H2D fetch) across — layer i+1's gather
+                # overlaps layer i's compute.  The scan only honors a
+                # divisor of its length (transformer falls back to 1
+                # otherwise), so clamp to the largest divisor <= the
+                # pinned depth rather than record a silently-no-op knob.
+                depth = ss.gather_prefetch_depth
+                while mc.num_layers % depth:
+                    depth -= 1
+                if depth != ss.gather_prefetch_depth:
+                    logger.warning(
+                        f"step_schedule.gather_prefetch_depth="
+                        f"{ss.gather_prefetch_depth} does not divide "
+                        f"num_layers={mc.num_layers}; clamped to {depth}")
+                if depth > 1:
+                    mc = mc.replace(scan_unroll=max(mc.scan_unroll, depth))
+            if ss.ring_interleave > 1 and mc.seq_impl == "ring":
+                # ring hop schedule (step_schedule): issue the next hop's
+                # ppermute before the current hop's attend
+                mc = mc.replace(ring_interleave=ss.ring_interleave)
             if cfg.pipeline.num_microbatches:
                 mc = mc.replace(pipeline_microbatches=cfg.pipeline.num_microbatches)
             if self._param_stream:
@@ -271,10 +301,16 @@ class DeepSpeedEngine:
             self._loss_fn = model.loss
 
         # -- sharding rules --------------------------------------------
+        # persistence threshold: a pinned step_schedule overrides the
+        # static zero_optimization value (overlap_scheduler raises it
+        # when the capture shows exposed small-param gathers)
+        persist = cfg.zero_config.param_persistence_threshold
+        if cfg.step_schedule.param_persistence_threshold is not None:
+            persist = cfg.step_schedule.param_persistence_threshold
         self.rules = ShardingRules(
             topology, zero_stage=self.zero_stage,
             secondary_mode=self._secondary_mode,
-            persist_threshold=cfg.zero_config.param_persistence_threshold)
+            persist_threshold=persist)
         rng = jax.random.PRNGKey(self.seed)
 
         params_shape = jax.eval_shape(self._init_fn, rng)
@@ -355,6 +391,47 @@ class DeepSpeedEngine:
                  f"| mesh={topology.sizes} | micro_bs={self.micro_batch_size} "
                  f"| gas={self.gradient_accumulation_steps_value}")
 
+        # -- decomposed weight-update schedule (step_schedule block;
+        # autotuning/overlap_scheduler.py; arXiv:2004.13336) ------------
+        # "decomposed" shards the optimizer state AND the gradient
+        # accumulator over the ZeRO axes even at stage 0/1: XLA then
+        # compiles the DP gradient reduction as reduce-scatter, each
+        # replica steps its 1/world shard of the optimizer, and the
+        # updated params are re-gathered — the all-gathers of early
+        # tensors overlap the update compute of later ones under the
+        # latency-hiding scheduler.  Stage ≥ 2 already has this layout
+        # (the knob is a no-op there); stage 3 additionally defers the
+        # re-gather to the next step's per-layer forward gathers.
+        self._decomposed_update = False
+        if cfg.step_schedule.weight_update == "decomposed":
+            off_opt_pre = cfg.zero_config.offload_optimizer
+            onebit_opt = (cfg.optimizer is not None and cfg.optimizer.type
+                          in ("onebitadam", "onebitlamb", "zerooneadam",
+                              "0/1adam"))
+            blocked = ("no >1 ZeRO axis" if topology.zero_size <= 1 else
+                       "offload_param streaming" if self._param_stream else
+                       "SuperOffload" if (off_opt_pre is not None
+                                          and off_opt_pre.super_offload)
+                       else
+                       "NVMe optimizer store" if (off_opt_pre is not None
+                                                  and off_opt_pre.device
+                                                  == "nvme") else
+                       "1-bit optimizer" if onebit_opt else
+                       "qgZ compressed gradients"
+                       if zc.zero_quantized_gradients
+                       and self.zero_stage <= 1 else "")
+            if blocked:
+                logger.warning(
+                    "step_schedule.weight_update='decomposed': unsupported "
+                    f"with this configuration ({blocked}) — keeping the "
+                    "stage's native update layout")
+            else:
+                self._decomposed_update = True
+                log_dist("step_schedule: decomposed weight update — "
+                         "optimizer state + grad accumulator sharded over "
+                         f"the ZeRO axes (world={topology.zero_size}, "
+                         f"stage={self.zero_stage})")
+
         # -- optimizer --------------------------------------------------
         if optimizer is not None:
             self.optimizer = optimizer
@@ -367,7 +444,8 @@ class DeepSpeedEngine:
                 any(ax is not None for ax in getattr(sh, "spec", P()))
                 for sh in jax.tree.leaves(self.param_shardings))
             sharded = (self.zero_stage >= 1 or any_sharded
-                       or bool(self._param_stream))
+                       or bool(self._param_stream)
+                       or self._decomposed_update)
             if cfg.optimizer is not None:
                 self.optimizer = build_optimizer(cfg.optimizer.type, cfg.optimizer.params,
                                                  sharded_params=sharded)
@@ -376,7 +454,12 @@ class DeepSpeedEngine:
         self.base_lr = (cfg.optimizer.lr if cfg.optimizer else 1e-3)
 
         params_treedef = jax.tree_util.tree_structure(params_shape)
-        opt_param_shardings = self.rules.optimizer_shardings(params_shape)
+        if self._decomposed_update:
+            # always-fsdp specs (what stage >= 1 / >= 2 would use)
+            opt_param_shardings = self.rules.tree_shardings(
+                params_shape, param_style=False)
+        else:
+            opt_param_shardings = self.rules.optimizer_shardings(params_shape)
         if self._param_stream:
             # split the optimizer: the streamed layer partition's state
             # lives host-resident and is stepped one layer-slice at a time
@@ -567,7 +650,11 @@ class DeepSpeedEngine:
             self._swap_pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=2, thread_name_prefix="dstpu-swap")
 
-        self.grad_shardings = self.rules.grad_accum_shardings(params_shape)
+        if self._decomposed_update:
+            self.grad_shardings = self.rules.tree_shardings(
+                params_shape, param_style=False)
+        else:
+            self.grad_shardings = self.rules.grad_accum_shardings(params_shape)
         if self._param_stream:
             self.grad_shardings = {
                 **self.grad_shardings,
@@ -1313,13 +1400,18 @@ class DeepSpeedEngine:
         is never consumed.  Errors are swallowed: the result is discarded
         by construction, and the caller is usually about to overwrite the
         very state the failed read targeted."""
+        global _DISCARDED_PREFETCH_WARNED
         for name in ("_opt_fut", "_param_fut"):
             fut = getattr(self, name, None)
             if fut is not None:
                 try:
                     fut.result()
                 except Exception as e:
-                    logger.warning(f"discarded prefetch failed: {e}")
+                    if not _DISCARDED_PREFETCH_WARNED:
+                        _DISCARDED_PREFETCH_WARNED = True
+                        logger.warning(
+                            f"discarded prefetch failed: {e} (further "
+                            "discarded-prefetch failures are not logged)")
                 setattr(self, name, None)
 
     def destroy(self) -> None:

@@ -80,6 +80,13 @@ class _RingSpec(NamedTuple):
     window: Optional[int]
     placement: str
     use_flash: bool
+    # hop/compute interleave depth (step_schedule.ring_interleave): 1 =
+    # attend then rotate (serial issue order); 2 = issue the next hop's
+    # ppermute BEFORE the current hop's attend, so the K/V transfer is
+    # dataflow-independent of the hop's kernels and the compiler can
+    # overlap the two.  Math identical either way (the attend always
+    # consumes the un-rotated buffers).
+    interleave: int = 1
 
 
 # ----------------------------------------------------------------------
@@ -269,6 +276,14 @@ def _ring_fwd_xla(ql, kl, vl, spec: _RingSpec):
     def hop(carry, t):
         m, l, acc, kc, vc = carry
         src = lax.rem(idx - t + spec.sp, spec.sp)
+        if spec.interleave > 1:
+            # rotate-ahead (interleave 2): the permute consumes only the
+            # incoming buffers, so issuing it before the attend makes
+            # transfer and compute dataflow-independent — the scheduler
+            # is free to run the hop's kernels under the K/V transfer
+            nkc, nvc = _rotate_together(perm, kc, vc)
+            m, l, acc = maybe_attend(m, l, acc, kc, vc, src)
+            return (m, l, acc, nkc, nvc), None
         m, l, acc = maybe_attend(m, l, acc, kc, vc, src)
         kc, vc = _rotate_together(perm, kc, vc)
         return (m, l, acc, kc, vc), None
@@ -333,6 +348,14 @@ def _ring_fwd_flash(ql, kl, vl, spec: _RingSpec):
     def hop(carry, t):
         m, l, acc, kc, vc = carry
         src = lax.rem(idx - t + spec.sp, spec.sp)
+        if spec.interleave > 1:
+            # rotate-ahead (interleave 2): the permute consumes only the
+            # incoming buffers, so issuing it before the attend makes
+            # transfer and compute dataflow-independent — the scheduler
+            # is free to run the hop's kernels under the K/V transfer
+            nkc, nvc = _rotate_together(perm, kc, vc)
+            m, l, acc = maybe_attend(m, l, acc, kc, vc, src)
+            return (m, l, acc, nkc, nvc), None
         m, l, acc = maybe_attend(m, l, acc, kc, vc, src)
         kc, vc = _rotate_together(perm, kc, vc)
         return (m, l, acc, kc, vc), None
@@ -447,6 +470,17 @@ def _ring_bwd_xla(spec: _RingSpec, res, do):
     def hop(carry, t):
         dq, dk_t, dv_t, kc, vc = carry
         src = lax.rem(idx - t + spec.sp, spec.sp)
+        if spec.interleave > 1:
+            # rotate-ahead: K/V depart before the hop's grads are
+            # computed (overlapping the grad einsums); the traveling
+            # grads must wait for their accumulation, so the single
+            # fused 4-buffer permute splits into two 2-buffer permutes —
+            # the interleave trades a second launch for an earlier K/V
+            # transfer
+            nkc, nvc = _rotate_together(perm, kc, vc)
+            dq_c, dk_c, dv_c = maybe_grads(kc, vc, src, zq, zk, zv)
+            dk_t, dv_t = _rotate_together(perm, dk_t + dk_c, dv_t + dv_c)
+            return (dq + dq_c, dk_t, dv_t, nkc, nvc), None
         dq_c, dk_c, dv_c = maybe_grads(kc, vc, src, zq, zk, zv)
         dq = dq + dq_c
         dk_t = dk_t + dk_c
@@ -529,6 +563,13 @@ def _ring_bwd_flash(spec: _RingSpec, res, do):
     def hop(carry, t):
         dq, dk_t, dv_t, kc, vc = carry
         src = lax.rem(idx - t + spec.sp, spec.sp)
+        if spec.interleave > 1:
+            # rotate-ahead: same split as the XLA backward — K/V depart
+            # under the fused grad kernels, traveling grads follow
+            nkc, nvc = _rotate_together(perm, kc, vc)
+            dq, dk_t, dv_t = maybe_grads(dq, dk_t, dv_t, kc, vc, src)
+            dk_t, dv_t = _rotate_together(perm, dk_t, dv_t)
+            return (dq, dk_t, dv_t, nkc, nvc), None
         dq, dk_t, dv_t = maybe_grads(dq, dk_t, dv_t, kc, vc, src)
         # K/V and their accumulated grads rotate together, in one launch
         kc, vc, dk_t, dv_t = _rotate_together(perm, kc, vc, dk_t, dv_t)
@@ -555,7 +596,8 @@ _ring_local.defvjp(_ring_fwd_rule, _ring_bwd_rule)
 def ring_attention(q, k, v, topo=None, causal: bool = True,
                    sm_scale: Optional[float] = None,
                    window: Optional[int] = None,
-                   placement: str = "contiguous"):
+                   placement: str = "contiguous",
+                   interleave: int = 1):
     """q/k/v: [B, S, H, D] GLOBAL arrays with S sharded over "seq".
     Returns [B, S, H, D].  GQA KV heads travel the ring unrepeated.  Must
     be called under jit (shard_map manual over the seq + batch axes; on
@@ -587,6 +629,9 @@ def ring_attention(q, k, v, topo=None, causal: bool = True,
     if placement not in PLACEMENTS:
         raise ValueError(f"placement={placement!r}: expected one of "
                          f"{PLACEMENTS}")
+    if interleave not in (1, 2):
+        raise ValueError(f"interleave={interleave!r}: expected 1 (attend "
+                         "then rotate) or 2 (rotate-ahead)")
     rep = nh // nkv
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     if sp == 1:
@@ -597,7 +642,8 @@ def ring_attention(q, k, v, topo=None, causal: bool = True,
 
     spec = _RingSpec(sp=sp, rep=rep, scale=float(scale), causal=causal,
                      window=window, placement=placement,
-                     use_flash=_kernel_enabled())
+                     use_flash=_kernel_enabled(),
+                     interleave=int(interleave))
 
     def body(ql, kl, vl):
         return _ring_local(ql, kl, vl, spec)

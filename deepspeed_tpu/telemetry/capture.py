@@ -25,18 +25,54 @@ from deepspeed_tpu.utils.logging import logger
 from deepspeed_tpu.utils.trace import TraceProfiler
 
 
+# Engine span names whose per-window totals make up the software-span
+# overlap estimate (the CPU-degraded stand-in for the XPlane number).
+PHASE_SPANS = ("train.data_ingest", "train.dispatch", "train.sync",
+               "train.telemetry")
+
+
+def spans_overlap_estimate(window_totals: Dict[str, Dict]) -> Dict:
+    """Software-span overlap estimate from a capture window's per-span
+    ``{name: {count, total_ms}}`` totals (Tracer.summary shape).
+
+    The ``train.sync`` span is the host blocked on the device with
+    nothing left to overlap — the software-visible analog of exposed
+    communication; the other phase spans are host work the runtime
+    pipelines under the device's execution.  ``overlap_estimate`` =
+    1 − sync/step is therefore a coarse host-side proxy for "how much of
+    the step was the pipeline kept busy" — it lets the overlap
+    scheduler's decision logic run where XPlane has no device planes
+    (the CPU mesh), and the on-chip XPlane fraction supersedes it
+    whenever device planes exist."""
+    phase = {name.rsplit(".", 1)[1] + "_ms":
+             round(float(window_totals.get(name, {}).get("total_ms", 0.0)),
+                   3)
+             for name in PHASE_SPANS}
+    step_ms = round(sum(phase.values()), 3)
+    sync_ms = phase["sync_ms"]
+    est = (max(0.0, min(1.0, 1.0 - sync_ms / step_ms))
+           if step_ms > 0 else 0.0)
+    return {**phase, "step_ms": step_ms, "exposed_ms": sync_ms,
+            "overlap_estimate": round(est, 4)}
+
+
 def build_capture_report(logdir: str, device_substr: str = "TPU",
-                         step_record=None) -> Dict:
+                         step_record=None, span_totals=None) -> Dict:
     """Pure post-processing of one capture directory → report dict.
 
     Degrades explicitly when the capture has no device planes (CPU runs
     carry host events only): overlap_fraction pins to 0.0 with a note,
-    and the top-ops table falls back to host planes."""
+    the top-ops table falls back to host planes, and — when the caller
+    hands per-window span totals — the ``spans`` block carries the
+    software overlap estimate so the report still feeds the overlap
+    scheduler's decision inputs."""
     from deepspeed_tpu.utils import xplane
 
     report: Dict = {"logdir": logdir, "device_substr": device_substr,
                     "overlap_fraction": 0.0, "devices": {},
-                    "top_ops": [], "note": ""}
+                    "top_ops": [], "dominant_collective": None,
+                    "spans": spans_overlap_estimate(span_totals or {}),
+                    "note": ""}
     try:
         files = xplane.find_xplane_files(logdir)
         if not files:
@@ -62,6 +98,8 @@ def build_capture_report(logdir: str, device_substr: str = "TPU",
                     agg["count"] += op["count"]
             report["top_ops"] = sorted(tops.values(),
                                        key=lambda o: -o["total_ms"])[:10]
+            report["dominant_collective"] = xplane.dominant_collective(
+                report["top_ops"])
     except Exception as e:  # a broken trace must not kill training
         report["note"] = f"capture post-processing failed: {e!r}"
     if step_record is not None:
@@ -109,6 +147,7 @@ class AutoCapture:
         self._times: Deque[float] = deque(maxlen=max(8, int(cfg.window)))
         self._profiler: Optional[TraceProfiler] = None
         self._armed_at = 0
+        self._span_base: Optional[Dict] = None  # tracer totals at arming
         self.reports: list = []   # report paths written this process
 
     # -- trigger logic ---------------------------------------------------
@@ -140,9 +179,48 @@ class AutoCapture:
             return
         self._profiler = prof
         self._armed_at = step
+        self._span_base = self._span_totals()
         self.budget_left -= 1
         logger.info(f"telemetry capture: armed at step {step} "
                     f"({reason}; {self.budget_left} capture(s) left)")
+
+    def _span_totals(self) -> Optional[Dict]:
+        """Per-span totals + drop counter from the hub's tracer
+        (``None`` when tracing is off — the spans block then reports
+        zeros).  The summary covers the tracer's BOUNDED event ring, so
+        a base/now diff is only valid while nothing was evicted."""
+        tracer = getattr(self.telemetry, "tracer", None) \
+            if self.telemetry is not None else None
+        if tracer is None or not getattr(tracer, "enabled", False):
+            return None
+        return {"summary": tracer.summary(),
+                "dropped": tracer.dropped_events}
+
+    def _span_window(self) -> Optional[Dict]:
+        """Per-span totals accumulated SINCE the window armed (the
+        report must describe only the captured steps)."""
+        if self._span_base is None:
+            return None
+        now = self._span_totals()
+        if now is None:
+            return None
+        if now["dropped"] != self._span_base["dropped"]:
+            # the tracer's bounded ring wrapped during the window:
+            # events from the base snapshot were evicted, so the diff
+            # would under-count (or go negative) — degrade to no spans
+            # rather than report a wrong overlap estimate
+            logger.warning("telemetry capture: tracer ring wrapped during "
+                           "the window; spans estimate omitted")
+            return None
+        base_sum = self._span_base["summary"]
+        out = {}
+        for name, row in now["summary"].items():
+            base = base_sum.get(name, {"count": 0, "total_ms": 0.0})
+            d_count = max(0, row["count"] - base["count"])
+            d_ms = max(0.0, round(row["total_ms"] - base["total_ms"], 3))
+            if d_count or d_ms:
+                out[name] = {"count": d_count, "total_ms": d_ms}
+        return out
 
     def on_step_end(self, next_step: int,
                     wall_time_s: Optional[float] = None) -> None:
@@ -167,13 +245,16 @@ class AutoCapture:
             rec = None
         report = build_capture_report(logdir,
                                       device_substr=self.device_substr,
-                                      step_record=rec)
+                                      step_record=rec,
+                                      span_totals=self._span_window())
+        self._span_base = None
         if rec is None and self.telemetry is not None:
             report["note"] = (report["note"] + "; no StepRecord inside "
                               "the capture window (interval-thinned "
                               "telemetry) — mfu_cross_check omitted"
                               ).lstrip("; ")
         report["armed_at_step"] = self._armed_at
+        report["step"] = self._armed_at
         report["num_steps"] = self.num_steps
         path = os.path.join(logdir, "report.json")
         try:

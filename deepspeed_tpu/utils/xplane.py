@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import glob
 import os
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 _COLLECTIVE_MARKERS = ("all-reduce", "all-gather", "reduce-scatter",
                        "collective-permute", "all-to-all")
@@ -131,6 +131,32 @@ def top_device_ops(xspace, device_substr: str = "TPU",
     ranked = sorted(totals.items(), key=lambda kv: -kv[1][0])[:k]
     return [{"name": n, "total_ms": round(t, 4), "count": c}
             for n, (t, c) in ranked]
+
+
+def classify_op(name: str) -> str:
+    """``"collective"`` / ``"compute"`` / ``"other"`` for one device-op
+    name — the same marker tables the overlap fraction uses, exposed so
+    report consumers (the overlap scheduler) classify identically."""
+    n = name.lower()
+    if any(m in n for m in _COLLECTIVE_MARKERS):
+        return "collective"
+    if any(m in n for m in _COMPUTE_MARKERS):
+        return "compute"
+    return "other"
+
+
+def dominant_collective(top_ops: Sequence[Dict]) -> Optional[Dict]:
+    """Largest collective by total self time in a ``top_device_ops``-shaped
+    table → ``{"name", "total_ms"}`` (``None`` when no op classifies as a
+    collective — e.g. a CPU capture's host planes)."""
+    best: Optional[Dict] = None
+    for op in top_ops or ():
+        if classify_op(op.get("name", "")) != "collective":
+            continue
+        if best is None or op.get("total_ms", 0.0) > best["total_ms"]:
+            best = {"name": op["name"],
+                    "total_ms": float(op.get("total_ms", 0.0))}
+    return best
 
 
 def analyze_logdir(logdir: str, device_substr: str = "TPU") -> Dict:

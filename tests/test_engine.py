@@ -147,3 +147,36 @@ def test_moe_model_trains():
     batches = _fixed_batches(model.vocab_size, 6, 8)
     losses = _train(engine, batches)
     assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+
+def test_cancel_prefetch_warning_throttled_once():
+    """A failing discarded prefetch warns once per process, not once per
+    checkpoint load (same pattern as the accelerator's unbalanced
+    range_pop throttle) — and the futures are still joined and cleared
+    on the silent repeats."""
+    import types
+    from concurrent.futures import Future
+    from unittest import mock
+
+    from deepspeed_tpu.runtime import engine as engine_mod
+
+    def failing():
+        f = Future()
+        f.set_exception(RuntimeError("nvme read failed"))
+        return f
+
+    obj = types.SimpleNamespace(_opt_fut=None, _param_fut=None)
+    engine_mod._DISCARDED_PREFETCH_WARNED = False
+    try:
+        with mock.patch.object(engine_mod, "logger") as lg:
+            obj._opt_fut = failing()
+            engine_mod.DeepSpeedEngine._cancel_prefetch(obj)
+            assert lg.warning.call_count == 1
+            # second and third failures: joined, cleared, silent
+            obj._opt_fut = failing()
+            obj._param_fut = failing()
+            engine_mod.DeepSpeedEngine._cancel_prefetch(obj)
+            assert lg.warning.call_count == 1
+        assert obj._opt_fut is None and obj._param_fut is None
+    finally:
+        engine_mod._DISCARDED_PREFETCH_WARNED = False

@@ -169,3 +169,86 @@ def test_autotuner_subprocess_isolation_contains_crash():
     cand = tuner._space()[0]
     res = tuner.run_trial(cand)
     assert res.throughput == 0.0 and res.error
+
+
+def test_enumerate_meshes_divisor_enumeration():
+    """Satellite: exhaustive divisor enumeration — every candidate
+    factorizes the device count exactly, no duplicates, every
+    model-admissible tensor divisor appears, and pruned axes never leak
+    a size-1 entry."""
+    from types import SimpleNamespace
+
+    from deepspeed_tpu.autotuning.autotuner import enumerate_meshes
+
+    permissive = SimpleNamespace(num_heads=24, num_kv_heads=24,
+                                 num_layers=24, num_experts=0)
+    meshes = enumerate_meshes(8, permissive)
+    seen = set()
+    for m in meshes:
+        n = 1
+        for v in m.values():
+            n *= v
+        assert n == 8, m
+        # only "data" may carry 1 (it is always present); the sweep never
+        # emits tensor/pipe/seq/expert entries of size 1
+        assert all(v > 1 for k, v in m.items() if k != "data"), m
+        key = tuple(sorted(m.items()))
+        assert key not in seen, f"duplicate mesh {m}"
+        seen.add(key)
+    # a fully-divisible model admits every divisor of n on each axis
+    assert sorted({m.get("tensor", 1) for m in meshes}) == [1, 2, 4, 8]
+    assert sorted({m.get("pipe", 1) for m in meshes}) == [1, 2, 4, 8]
+    # non-power-of-two device counts enumerate their true divisors
+    assert sorted({m.get("tensor", 1) for m in
+                   enumerate_meshes(6, permissive)}) == [1, 2, 3, 6]
+    # degenerate world: exactly the pure-data mesh
+    assert enumerate_meshes(1, permissive) == [{"data": 1}]
+
+
+def test_memory_estimate_stage_monotonicity_edges():
+    """Satellite: estimate_memory_per_device is monotone non-increasing
+    in zero_stage at any dp, EQUAL across stages at dp=1 (nothing to
+    shard), and each stage increment shrinks exactly its own term."""
+    from deepspeed_tpu.autotuning.autotuner import (
+        BYTES_PER_PARAM, estimate_memory_per_device)
+
+    mi = ModelInfo(num_params=10**8, hidden_size=1024, num_layers=12,
+                   vocab_size=32000)
+    kw = dict(micro_batch=2, seq_len=256)
+    # dp=1: stages are indistinguishable
+    at_dp1 = [estimate_memory_per_device(mi, s, dp_size=1, **kw)
+              for s in (0, 1, 2, 3)]
+    assert len(set(at_dp1)) == 1
+    # dp=8: strictly decreasing, and each step removes (dp-1)/dp of the
+    # corresponding state term
+    at_dp8 = [estimate_memory_per_device(mi, s, dp_size=8, **kw)
+              for s in (0, 1, 2, 3)]
+    assert at_dp8[0] > at_dp8[1] > at_dp8[2] > at_dp8[3]
+    opt_full = mi.num_params * 12
+    assert at_dp8[0] - at_dp8[1] == opt_full - opt_full // 8
+    grads_full = mi.num_params * BYTES_PER_PARAM["bf16"]
+    assert at_dp8[1] - at_dp8[2] == grads_full - grads_full // 8
+    assert at_dp8[2] - at_dp8[3] == grads_full - grads_full // 8  # params
+
+
+def test_generate_tuning_space_enumeration_rules():
+    """Satellite: candidate micro-batches are the power-of-two ladder up
+    to the cap, pipeline meshes prune stages >= 2, and the seq axis
+    prunes non-divisible sequence lengths."""
+    mi = ModelInfo(num_params=10**6, hidden_size=64, num_layers=4,
+                   vocab_size=1000)
+    space = generate_tuning_space(mi, dp_size=2, seq_len=64,
+                                  hbm_bytes=1 << 40, max_micro_batch=8)
+    mbs = sorted({c["micro_batch"] for c in space})
+    assert mbs == [1, 2, 4, 8]  # the cap itself is included (no
+    #                             off-by-one at the ladder top)
+    assert {c["zero_stage"] for c in space} == {0, 1, 2, 3}
+    # pipeline composes with ZeRO-0/1 only
+    pp_space = generate_tuning_space(
+        mi, dp_size=1, seq_len=64, hbm_bytes=1 << 40, max_micro_batch=2,
+        meshes=[{"data": 1, "pipe": 2}])
+    assert pp_space and {c["zero_stage"] for c in pp_space} == {0, 1}
+    # a seq mesh that does not divide the sequence length yields nothing
+    assert generate_tuning_space(
+        mi, dp_size=1, seq_len=63, hbm_bytes=1 << 40,
+        meshes=[{"data": 1, "seq": 2}]) == []

@@ -79,6 +79,28 @@ RING_BWD_BENCH_KEYS = ["bwd_ms_per_hop_fused", "bwd_ms_per_hop_xla",
                        "transient_bytes_fused", "transient_bytes_xla",
                        "transient_reduction"]
 
+# frozen overlap-scheduler vocabulary (autotuning/overlap_scheduler.py;
+# docs/AUTOTUNING.md): decision names and evidence keys must match the
+# module AND be documented; the step_schedule config keys must be
+# documented; the autosched bench row keys must be emitted by bench.py
+# and documented; and the capture-report keys the scheduler consumes
+# (telemetry/capture.py) must be documented too.
+AUTOTUNING_DOCS = os.path.join(REPO, "docs", "AUTOTUNING.md")
+EXPECTED_SCHEDULE_DECISIONS = ["decomposed_update", "noop",
+                               "ring_interleave", "zero3_prefetch"]
+EXPECTED_EVIDENCE_KEYS = ["dominant_collective", "exposed_comm_ms",
+                          "overlap_fraction", "overlap_source",
+                          "probe_step"]
+EXPECTED_STEP_SCHEDULE_KEYS = [
+    "decisions", "gather_prefetch_depth", "mode", "overlap_threshold",
+    "param_persistence_threshold", "prefetch_bucket_size", "probe_steps",
+    "ring_interleave", "weight_update",
+]
+AUTOSCHED_BENCH_KEYS = ["mfu_static", "mfu_tuned", "exposed_comm_ms",
+                        "schedule_decision"]
+CAPTURE_REPORT_SCHED_KEYS = ["dominant_collective", "exposed_ms",
+                             "overlap_estimate", "spans", "step"]
+
 # frozen multi-replica serving vocabulary (same contract): the
 # serve_load_multi bench row keys must be emitted by bench.py and
 # documented in docs/SERVING.md; every router-tier Prometheus metric
@@ -311,6 +333,72 @@ def check_router_serving() -> List[str]:
     return errors
 
 
+def check_autotuning() -> List[str]:
+    """Overlap-scheduler vocabulary: frozen decision/evidence/config key
+    lists match the modules, every name is documented in
+    docs/AUTOTUNING.md, and the autosched bench row emits the frozen
+    keys."""
+    from dataclasses import fields as dc_fields
+
+    from deepspeed_tpu.autotuning.overlap_scheduler import (EVIDENCE_KEYS,
+                                                            SCHEDULE_DECISIONS)
+    from deepspeed_tpu.runtime.config import StepScheduleConfig
+
+    errors = []
+    if sorted(SCHEDULE_DECISIONS) != sorted(EXPECTED_SCHEDULE_DECISIONS):
+        errors.append(
+            "overlap_scheduler.SCHEDULE_DECISIONS drifted from the frozen "
+            f"list: extra={sorted(set(SCHEDULE_DECISIONS) - set(EXPECTED_SCHEDULE_DECISIONS))}, "
+            f"missing={sorted(set(EXPECTED_SCHEDULE_DECISIONS) - set(SCHEDULE_DECISIONS))}"
+            " — update EXPECTED_SCHEDULE_DECISIONS + docs/AUTOTUNING.md "
+            "together")
+    if sorted(EVIDENCE_KEYS) != sorted(EXPECTED_EVIDENCE_KEYS):
+        errors.append(
+            "overlap_scheduler.EVIDENCE_KEYS drifted from the frozen list: "
+            f"extra={sorted(set(EVIDENCE_KEYS) - set(EXPECTED_EVIDENCE_KEYS))}, "
+            f"missing={sorted(set(EXPECTED_EVIDENCE_KEYS) - set(EVIDENCE_KEYS))}")
+    ss_keys = sorted(f.name for f in dc_fields(StepScheduleConfig))
+    if ss_keys != EXPECTED_STEP_SCHEDULE_KEYS:
+        errors.append(
+            "StepScheduleConfig key set drifted from the frozen list: "
+            f"extra={sorted(set(ss_keys) - set(EXPECTED_STEP_SCHEDULE_KEYS))}, "
+            f"missing={sorted(set(EXPECTED_STEP_SCHEDULE_KEYS) - set(ss_keys))}"
+            " — update EXPECTED_STEP_SCHEDULE_KEYS + the docs config table")
+    try:
+        with open(AUTOTUNING_DOCS, "r", encoding="utf-8") as f:
+            adocs = f.read()
+    except OSError as e:
+        return errors + [f"cannot read {AUTOTUNING_DOCS}: {e}"]
+    for name in (list(SCHEDULE_DECISIONS) + list(EVIDENCE_KEYS) + ss_keys
+                 + CAPTURE_REPORT_SCHED_KEYS):
+        if f"`{name}`" not in adocs:
+            errors.append(f"autotuning name {name!r} not documented in "
+                          f"{os.path.basename(AUTOTUNING_DOCS)}")
+    try:
+        with open(os.path.join(REPO, "bench.py"), "r",
+                  encoding="utf-8") as f:
+            bench_src = f.read()
+    except OSError as e:
+        return errors + [f"cannot read bench.py: {e}"]
+    for key in AUTOSCHED_BENCH_KEYS:
+        if f'"{key}"' not in bench_src:
+            errors.append(f"autosched bench key {key!r} not emitted by "
+                          "bench.py (frozen AUTOSCHED_BENCH_KEYS drifted)")
+        if f"`{key}`" not in adocs:
+            errors.append(f"autosched bench key {key!r} not documented in "
+                          f"{os.path.basename(AUTOTUNING_DOCS)}")
+    # the observability capture-report section must point readers at the
+    # scheduler that consumes it (cross-link contract, like QUANT)
+    try:
+        with open(DOCS, "r", encoding="utf-8") as f:
+            if "AUTOTUNING.md" not in f.read():
+                errors.append("docs/OBSERVABILITY.md does not cross-link "
+                              "AUTOTUNING.md from its capture section")
+    except OSError as e:
+        errors.append(f"cannot read {DOCS}: {e}")
+    return errors
+
+
 def validate_chrome_trace(obj: Any) -> List[str]:
     """Structural validation of a Chrome trace-event JSON object (pass a
     path or the loaded dict).  Perfetto/chrome://tracing both accept the
@@ -378,7 +466,8 @@ def check_trace_export() -> List[str]:
 def run_all() -> List[str]:
     return (check_tags_documented() + check_schema() + check_span_names()
             + check_quant_comm() + check_ring_bench()
-            + check_router_serving() + check_trace_export())
+            + check_router_serving() + check_autotuning()
+            + check_trace_export())
 
 
 def main() -> int:
