@@ -1,0 +1,50 @@
+"""Static graph auditing: jaxpr/HLO-level sharding, donation, and
+collective lint (docs/STATIC_ANALYSIS.md).
+
+``audit()`` lowers a jitted step function and — without executing it —
+emits a typed frozen-schema :class:`GraphAuditReport`: a collective
+census diffed against declared intent, a donation audit against the
+aliases XLA actually assigned, and hot-path hygiene findings.  Shipped
+three ways: the ``tools/graft_lint.py`` CLI, a tier-1 pytest hook over
+every bench-row step config (``analysis/targets.py``), and the
+``analysis.audit()``/``collective_census_engine()`` API the overlap
+scheduler consumes for pinned-schedule evidence.
+
+Importing this package stays jax-free (``report``/``vocab``/``seam``
+are plain data + stdlib); the auditor itself loads lazily on first use,
+mirroring how ``serving/`` avoids a jax taint.
+"""
+
+from deepspeed_tpu.analysis.report import (AUDIT_REPORT_KEYS,  # noqa: F401
+                                           AUDIT_SCHEMA_VERSION,
+                                           CENSUS_KEYS, DONATION_KEYS,
+                                           FINDING_KEYS, FINDING_KINDS,
+                                           SEVERITIES, CollectiveStat,
+                                           Finding, GraphAuditReport,
+                                           load_baseline)
+
+_LAZY = {
+    "AuditIntent": "auditor", "audit": "auditor",
+    "audit_engine": "auditor", "audit_v2_engine": "auditor",
+    "collective_census_engine": "auditor", "intent_for_engine": "auditor",
+    "lint_repo": "seam", "lint_source": "seam",
+    "VocabSpec": "vocab", "check_all": "vocab",
+    "BENCH_AUDIT_TARGETS": "targets", "run_audit_target": "targets",
+}
+
+
+def __getattr__(name):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(f"{__name__}.{mod}"), name)
+
+
+__all__ = sorted([
+    "AUDIT_REPORT_KEYS", "AUDIT_SCHEMA_VERSION", "CENSUS_KEYS",
+    "DONATION_KEYS", "FINDING_KEYS", "FINDING_KINDS", "SEVERITIES",
+    "CollectiveStat", "Finding", "GraphAuditReport", "load_baseline",
+] + list(_LAZY))

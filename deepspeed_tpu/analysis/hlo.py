@@ -1,0 +1,203 @@
+"""Post-SPMD HLO text parsing for the static graph auditor.
+
+Works on the text of an *optimized* (post-partitioner) HLO module —
+``jitted.lower(*args).compile().as_text()`` — because that is the first
+artifact where GSPMD's implicitly inserted collectives exist: the
+StableHLO from ``lower()`` still carries sharding as annotations, and a
+resharding nobody asked for only becomes an ``all-to-all`` once the SPMD
+partitioner has run.  Pure text processing, no jax import: the parser is
+exercisable on checked-in HLO fixtures.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.analysis.report import CollectiveStat
+
+# Async collectives lower as a `-start`/`-done` pair; each pair is
+# counted ONCE, via the `-done` op, whose result type is exactly the
+# collective's result — the `-start` op's tuple type also contains the
+# operand buffer(s), which would inflate payload/wire bytes.
+COLLECTIVE_KINDS = ("all-gather", "all-reduce", "all-to-all",
+                    "collective-permute", "reduce-scatter")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "s32": 4, "u32": 4,
+    "s64": 8, "u64": 8, "f16": 2, "bf16": 2, "f32": 4, "f64": 8,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1, "c64": 8, "c128": 16,
+}
+
+# `f32[8,16]{1,0}` / `bf16[2]` / `s8[]` — one typed buffer in an HLO
+# shape string.  Layout braces and dims are optional (scalars).
+_SHAPE_RE = re.compile(r"\b([a-z]u?\d*[a-z0-9]*)\[([\d,]*)\]")
+
+_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.+?)\s+"
+    r"(" + "|".join(COLLECTIVE_KINDS) + r")(-start|-done)?\(",
+)
+
+# `replica_groups=[4,2]<=[8]` (iota form: 4 groups of 2) or the explicit
+# `replica_groups={{0,1},{2,3}}` form.
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+_GROUPS_EXPL_RE = re.compile(r"replica_groups=\{\{([\d,]+)\}")
+
+_ALIAS_RE = re.compile(
+    r"input_output_alias=\{(.*?)\}(?:,\s*\w+=|\s*$)",
+    re.DOTALL | re.MULTILINE)
+_ALIAS_PAIR_RE = re.compile(r"\{([\d,\s]*)\}:\s*\((\d+)")
+
+_CUSTOM_CALL_RE = re.compile(r'custom_call_target="([^"]+)"')
+
+
+def shape_bytes(type_str: str) -> int:
+    """Total byte footprint of every typed buffer in an HLO type string
+    (handles tuples: ``(f32[4,4], bf16[2,2])``)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(type_str):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def _group_size(line: str, num_partitions: int) -> int:
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        return max(1, int(m.group(2)))
+    m = _GROUPS_EXPL_RE.search(line)
+    if m:
+        return max(1, len([x for x in m.group(1).split(",") if x]))
+    return max(1, num_partitions)
+
+
+def wire_bytes(kind: str, payload: int, n: int) -> int:
+    """Ring-algorithm wire-byte model per device for one collective,
+    priced off the op's RESULT bytes (``payload``).
+
+    all-gather / all-to-all move (n−1)/n of the (already full-sized)
+    result; reduce-scatter's result is the 1/n shard, so its ring cost
+    is (n−1)× the result; all-reduce is reduce-scatter + all-gather
+    over an equal-sized result (2×(n−1)/n); a collective-permute ships
+    its whole buffer one hop.
+    """
+    if n <= 1:
+        return 0
+    if kind == "collective-permute":
+        return payload
+    if kind == "reduce-scatter":
+        return int(payload * (n - 1))
+    frac = (n - 1) / n
+    if kind == "all-reduce":
+        return int(2 * payload * frac)
+    return int(payload * frac)
+
+
+def parse_collectives(hlo_text: str,
+                      num_partitions: int = 1) -> List[Dict[str, Any]]:
+    """Every collective op in the module text → one record with kind,
+    wire dtype(s), payload/wire bytes, group size, and the op_name
+    metadata XLA carried from the jaxpr (attribution)."""
+    lines = hlo_text.splitlines()
+    # async pairs split their information: the `-start` line carries
+    # replica_groups + metadata, the `-done` line carries the true
+    # result type — collect the starts first, then price each `-done`
+    # with its own type but its start's attributes
+    start_lines: Dict[str, str] = {}
+    for line in lines:
+        m = _OP_RE.match(line)
+        if m is not None and m.group(4) == "-start":
+            start_lines[m.group(1)] = line
+    ops: List[Dict[str, Any]] = []
+    for line in lines:
+        m = _OP_RE.match(line)
+        if m is None or m.group(4) == "-start":
+            continue
+        name, out_type, kind = m.group(1), m.group(2), m.group(3)
+        attr_line = line
+        if m.group(4) == "-done":
+            operand = re.search(r"%([\w.\-]+)\s*\)", line)
+            if operand and operand.group(1) in start_lines:
+                attr_line = start_lines[operand.group(1)]
+        dtypes = sorted({d for d, _ in _SHAPE_RE.findall(out_type)
+                         if d in _DTYPE_BYTES})
+        payload = shape_bytes(out_type)
+        n = _group_size(attr_line, num_partitions)
+        meta = (re.search(r'op_name="([^"]+)"', line)
+                or re.search(r'op_name="([^"]+)"', attr_line))
+        ops.append({
+            "name": name, "kind": kind,
+            "dtype": "+".join(dtypes) or "unknown",
+            "payload_bytes": payload,
+            "wire_bytes": wire_bytes(kind, payload, n),
+            "group_size": n,
+            "op_name": meta.group(1) if meta else "",
+        })
+    return ops
+
+
+def aggregate_census(ops: List[Dict[str, Any]]) -> List[CollectiveStat]:
+    """Collapse per-op records into per-(kind, dtype) census rows."""
+    rows: Dict[tuple, CollectiveStat] = {}
+    for op in ops:
+        key = (op["kind"], op["dtype"])
+        row = rows.setdefault(key, CollectiveStat(
+            kind=op["kind"], dtype=op["dtype"],
+            group_size=op["group_size"]))
+        row.count += 1
+        row.payload_bytes += op["payload_bytes"]
+        row.wire_bytes += op["wire_bytes"]
+        row.group_size = max(row.group_size, op["group_size"])
+    return sorted(rows.values(), key=lambda c: (c.kind, c.dtype))
+
+
+def parse_input_output_alias(hlo_text: str) -> Dict[int, str]:
+    """The module header's donation outcome: ``{param_index:
+    output_index_path}`` for every input buffer XLA actually aliased."""
+    m = _ALIAS_RE.search(hlo_text)
+    if m is None:
+        return {}
+    out: Dict[int, str] = {}
+    for out_idx, param in _ALIAS_PAIR_RE.findall(m.group(1)):
+        out[int(param)] = out_idx.replace(" ", "")
+    return out
+
+
+def entry_parameters(hlo_text: str) -> List[Dict[str, Any]]:
+    """``[{index, type}]`` for the ENTRY computation's parameters (the
+    flat argument buffers, in jax's flattened-args order)."""
+    entry: Optional[str] = None
+    depth = 0
+    lines: List[str] = []
+    for line in hlo_text.splitlines():
+        if entry is None:
+            if line.lstrip().startswith("ENTRY"):
+                entry = line
+                depth = line.count("{") - line.count("}")
+                lines.append(line)
+            continue
+        lines.append(line)
+        depth += line.count("{") - line.count("}")
+        if depth <= 0:
+            break
+    params = []
+    for line in lines:
+        m = re.match(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.+?)\s+"
+                     r"parameter\((\d+)\)", line)
+        if m:
+            params.append({"index": int(m.group(2)), "type": m.group(1)})
+    return sorted(params, key=lambda p: p["index"])
+
+
+def custom_call_targets(hlo_text: str) -> List[str]:
+    return sorted(set(_CUSTOM_CALL_RE.findall(hlo_text)))
+
+
+def has_infeed(hlo_text: str) -> bool:
+    return bool(re.search(r"=\s*\([^)]*\)\s*infeed\(|\s+infeed\(",
+                          hlo_text))

@@ -1,0 +1,179 @@
+"""Typed frozen-schema report for the static graph auditor.
+
+The auditor (``analysis/auditor.py``) lowers a jitted step function and
+emits ONE :class:`GraphAuditReport` per audited graph: a collective
+census, a donation audit, and a list of typed :class:`Finding` records.
+Like the telemetry StepRecord, the report schema is FROZEN — the key
+sets below are linted against ``docs/STATIC_ANALYSIS.md`` by
+``tools/telemetry_check.py`` (via the shared ``analysis/vocab`` checker),
+so a drive-by key rename fails the tier-1 suite, not a downstream
+consumer.  This module imports no jax: reports are plain data and safe
+to load anywhere (the serving layer included).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional
+
+AUDIT_SCHEMA_VERSION = 1
+
+# Frozen finding vocabulary — one entry per defect class the auditor can
+# name.  Update EXPECTED_FINDING_KINDS in tools/telemetry_check.py and
+# the docs/STATIC_ANALYSIS.md catalogue in the same commit as any change.
+FINDING_KINDS = (
+    "collective_mismatch",   # a declared collective is absent from the graph
+    "donation_miss",         # donated buffer XLA did not alias to an output
+    "dtype_promotion",       # bf16/fp16 tensor promoted to fp32 in the step
+    "host_callback",         # host callback / infeed inside the hot path
+    "implicit_resharding",   # GSPMD-inserted collective nobody declared
+    "recompile_hazard",      # weak-type / python-scalar step argument
+    "seam_violation",        # version-gated jax symbol outside jax_compat
+    "wire_dtype_mismatch",   # fp32 wire on a path declared quantized
+)
+
+SEVERITIES = ("info", "warning", "high")
+
+# Frozen top-level report keys (sorted, like the StepRecord schema).
+AUDIT_REPORT_KEYS = [
+    "backend", "census", "donation", "findings", "label",
+    "num_partitions", "schema",
+]
+
+# Frozen per-census-row keys: one row per (collective kind, wire dtype).
+CENSUS_KEYS = ["count", "dtype", "group_size", "kind", "payload_bytes",
+               "wire_bytes"]
+
+# Frozen per-finding keys.
+FINDING_KEYS = ["detail", "fingerprint", "kind", "message", "severity",
+                "where"]
+
+# Frozen donation-block keys.
+DONATION_KEYS = ["aliased", "declared", "missed", "missed_bytes"]
+
+
+@dataclass
+class Finding:
+    """One named defect.
+
+    ``where`` locates the finding (an op name, ``file:line``, or the
+    audit label); ``detail`` carries kind-specific structured data and
+    MUST include a ``key`` entry — a stable, count-free identifier (e.g.
+    ``"all-to-all:f32"`` or ``"(64, 32):float32"``) so the fingerprint
+    survives byte-count drift between runs and a ``--baseline`` file
+    keeps suppressing the same defect.
+    """
+    kind: str
+    severity: str
+    message: str
+    where: str = ""
+    detail: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.kind not in FINDING_KINDS:
+            raise ValueError(f"unknown finding kind {self.kind!r} "
+                             f"(known: {list(FINDING_KINDS)})")
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"unknown severity {self.severity!r} "
+                             f"(known: {list(SEVERITIES)})")
+
+    def fingerprint(self) -> str:
+        """Stable 12-hex id for baseline suppression: hashes the finding
+        class and its stable ``detail['key']`` — never the message, whose
+        byte counts and op ids drift run to run."""
+        key = str(self.detail.get("key", ""))
+        raw = f"{self.kind}|{self.where}|{key}"
+        return hashlib.sha1(raw.encode("utf-8")).hexdigest()[:12]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"detail": dict(self.detail),
+                "fingerprint": self.fingerprint(), "kind": self.kind,
+                "message": self.message, "severity": self.severity,
+                "where": self.where}
+
+
+@dataclass
+class CollectiveStat:
+    """Census row: every lowered collective of one (kind, dtype) pair.
+
+    ``payload_bytes`` is the summed result-shape footprint;
+    ``wire_bytes`` applies the standard ring-algorithm cost model per
+    kind (see ``analysis/hlo.py``) — the number to diff against the
+    ``comm_quantization`` byte-reduction claims.
+    """
+    kind: str
+    dtype: str
+    count: int = 0
+    payload_bytes: int = 0
+    wire_bytes: int = 0
+    group_size: int = 1
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"count": self.count, "dtype": self.dtype,
+                "group_size": self.group_size, "kind": self.kind,
+                "payload_bytes": self.payload_bytes,
+                "wire_bytes": self.wire_bytes}
+
+
+@dataclass
+class GraphAuditReport:
+    """One audited graph: census + donation audit + findings."""
+    label: str
+    backend: str = "cpu"
+    num_partitions: int = 1
+    census: List[CollectiveStat] = field(default_factory=list)
+    donation: Dict[str, Any] = field(default_factory=lambda: {
+        "aliased": 0, "declared": 0, "missed": [], "missed_bytes": 0})
+    findings: List[Finding] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "backend": self.backend,
+            "census": [c.to_dict() for c in sorted(
+                self.census, key=lambda c: (c.kind, c.dtype))],
+            "donation": dict(self.donation),
+            "findings": [f.to_dict() for f in self.findings],
+            "label": self.label,
+            "num_partitions": self.num_partitions,
+            "schema": AUDIT_SCHEMA_VERSION,
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    # ------------------------------------------------------------------
+    def high_findings(self, baseline: Optional[Iterable[str]] = None
+                      ) -> List[Finding]:
+        """High-severity findings not suppressed by ``baseline``
+        (an iterable of fingerprints)."""
+        sup = frozenset(baseline or ())
+        return [f for f in self.findings
+                if f.severity == "high" and f.fingerprint() not in sup]
+
+    def census_summary(self) -> Dict[str, Dict[str, Any]]:
+        """Compact per-kind rollup — the shape that rides the overlap
+        scheduler's pinned ``step_schedule`` evidence (``static_census``):
+        ``{kind: {count, wire_bytes, dtypes}}``."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for c in self.census:
+            row = out.setdefault(c.kind, {"count": 0, "wire_bytes": 0,
+                                          "dtypes": []})
+            row["count"] += c.count
+            row["wire_bytes"] += c.wire_bytes
+            if c.dtype not in row["dtypes"]:
+                row["dtypes"] = sorted(row["dtypes"] + [c.dtype])
+        return out
+
+
+def load_baseline(path: str) -> frozenset:
+    """Read a ``--baseline`` suppression file: ``{"suppress": [fp, ...]}``
+    (each entry a :meth:`Finding.fingerprint` value).  A missing file is
+    an empty baseline — absence must not un-gate the lint."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return frozenset()
+    return frozenset(str(s) for s in data.get("suppress", []))

@@ -1,0 +1,179 @@
+"""Bench-row audit targets: every step configuration ``bench.py`` times
+gets a statically auditable twin here, scaled to the virtual 8-device
+CPU mesh so the tier-1 suite and ``tools/graft_lint.py --rows`` can
+lower + audit each one WITHOUT running a step.
+
+The mapping (see bench.py's row table):
+
+=====================  ==============================================
+target                 bench row(s) whose step it audits
+=====================  ==============================================
+``train_zero1``        gpt2_350m (primary ZeRO-1 train step)
+``train_zero3``        llama8b_class_zero3 / peak_params base rungs
+``train_commquant``    gpt2_350m_commquant (int8 quantized DP reduce)
+``train_autosched``    gpt2_350m_autosched (pinned zero3_prefetch)
+``ring_attention``     longseq_ring (ring fwd+bwd on the 2×4 mesh)
+``v2_decode``          v2_decode / serve_load* (16-token decode step)
+``v2_prefill``         v2_decode / serve_load* (full-budget prefill)
+=====================  ==============================================
+
+Each target builds its engine, audits, and tears the global topology
+down — callers get one :class:`GraphAuditReport` per name.  Geometry is
+tiny (gpt2-tiny class) because the lint checks graph *structure*; byte
+volumes scale with the real config but kind/dtype/alias findings do
+not.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from deepspeed_tpu.analysis.report import GraphAuditReport
+
+
+def _reset_topology():
+    from deepspeed_tpu.parallel import topology
+
+    topology._GLOBAL_TOPOLOGY = None
+
+
+def _train_config(n: int, **over):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 1},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10_000,
+        "mesh": {"data": n},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _audit_train(label: str, **over) -> GraphAuditReport:
+    import jax
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.analysis.auditor import audit_engine
+    from deepspeed_tpu.models import get_model_config
+
+    model = get_model_config("gpt2-tiny", max_seq_len=64)
+    engine, _, _, _ = ds.initialize(
+        model=model, config=_train_config(jax.device_count(), **over))
+    try:
+        return audit_engine(engine, label=label)
+    finally:
+        engine.destroy()
+        _reset_topology()
+
+
+def target_train_zero1() -> GraphAuditReport:
+    return _audit_train("train_zero1", bf16={"enabled": True})
+
+
+def target_train_zero3() -> GraphAuditReport:
+    return _audit_train("train_zero3", bf16={"enabled": True},
+                        zero_optimization={"stage": 3})
+
+
+def target_train_commquant() -> GraphAuditReport:
+    return _audit_train(
+        "train_commquant",
+        comm_quantization={"enabled": True, "grad_reduce": "int8"})
+
+
+def target_train_autosched() -> GraphAuditReport:
+    # the pinned shape the autosched row converges to on a ZeRO-3 probe
+    return _audit_train(
+        "train_autosched", bf16={"enabled": True},
+        zero_optimization={"stage": 3},
+        step_schedule={"mode": "pinned", "gather_prefetch_depth": 2,
+                       "param_persistence_threshold": 100_000})
+
+
+def target_ring_attention() -> GraphAuditReport:
+    """longseq_ring twin: jitted ring fwd+bwd on the 2(data)×4(seq)
+    mesh — the census must carry the ring's collective-permute hops and
+    nothing unexplained."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.analysis.auditor import AuditIntent, audit
+    from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
+    from deepspeed_tpu.sequence.ring import ring_attention
+
+    topo = MeshTopology({"seq": 4, "data": 2})
+    set_topology(topo)
+    try:
+        b, s, nh, d = 2, 64, 4, 16
+        rng = np.random.default_rng(0)
+        q = jnp.asarray(rng.standard_normal((b, s, nh, d)), jnp.float32)
+
+        def fwd_bwd(q, k, v):
+            def loss(q, k, v):
+                return ring_attention(q, k, v, topo).astype(
+                    jnp.float32).sum()
+            l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+            return l, grads
+
+        intent = AuditIntent(
+            expected=frozenset({"collective-permute", "all-reduce",
+                                "all-gather", "reduce-scatter"}),
+            required={"collective-permute": ()})
+        return audit(jax.jit(fwd_bwd), q, q, q, label="ring_attention",
+                     intent=intent)
+    finally:
+        set_topology(None)
+        _reset_topology()
+
+
+def _audit_v2(phase: str) -> GraphAuditReport:
+    from deepspeed_tpu.analysis.auditor import audit_v2_engine
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.models import get_model_config
+
+    model = get_model_config("gpt2-tiny", max_seq_len=128)
+    eng = InferenceEngineV2(model, {
+        "state_manager": {"max_tracked_sequences": 4,
+                          "max_ragged_batch_size": 64},
+        "memory_config": {"num_blocks": 16, "block_size": 16},
+        "max_context": 128})
+    # the point of the target is the CONFIGURED tiny geometry — a config
+    # nesting drift that silently fell back to defaults would audit a
+    # 512-block step instead of the bench row's twin
+    assert eng.cfg.num_blocks == 16 and eng.state_manager.max_seqs == 4, \
+        (eng.cfg.num_blocks, eng.state_manager.max_seqs)
+    try:
+        return audit_v2_engine(eng, phase=phase)
+    finally:
+        _reset_topology()
+
+
+def target_v2_decode() -> GraphAuditReport:
+    return _audit_v2("decode")
+
+
+def target_v2_prefill() -> GraphAuditReport:
+    return _audit_v2("prefill")
+
+
+BENCH_AUDIT_TARGETS: Dict[str, Callable[[], GraphAuditReport]] = {
+    "train_zero1": target_train_zero1,
+    "train_zero3": target_train_zero3,
+    "train_commquant": target_train_commquant,
+    "train_autosched": target_train_autosched,
+    "ring_attention": target_ring_attention,
+    "v2_decode": target_v2_decode,
+    "v2_prefill": target_v2_prefill,
+}
+
+
+def run_audit_target(name: str) -> GraphAuditReport:
+    try:
+        fn = BENCH_AUDIT_TARGETS[name]
+    except KeyError:
+        raise KeyError(f"unknown audit target {name!r} "
+                       f"(known: {sorted(BENCH_AUDIT_TARGETS)})") from None
+    return fn()

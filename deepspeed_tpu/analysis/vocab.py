@@ -1,0 +1,104 @@
+"""Shared frozen-vocabulary checker.
+
+Every telemetry/bench/audit vocabulary in this repo follows the same
+contract: a module-level tuple is FROZEN, a lint compares it against an
+expected list checked into the lint tool, every name must appear
+(backticked) in the owning doc, and any bench keys must literally be
+emitted by their bench source.  ``tools/telemetry_check.py`` grew four
+copy-pasted implementations of that contract; this module is the single
+engine both it and ``tools/graft_lint.py`` drive — adding a vocabulary
+is ONE :class:`VocabSpec` registration, not another bespoke check
+function.
+
+Pure stdlib, no jax: importable from any tool or test.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Sequence, Tuple
+
+
+@dataclass
+class VocabSpec:
+    """One frozen vocabulary and everywhere it must agree.
+
+    ``name``           — label used in error messages.
+    ``expected``       — the frozen list the lint tool pins.
+    ``actual``         — optional thunk returning the module's live list
+                         (import deferred to check time); drift in either
+                         direction is an error.
+    ``docs_path``      — file every documented name must appear in.
+    ``doc_names``      — names to look for in the docs (defaults to
+                         ``expected``); matched as `` `name` `` unless a
+                         ``doc_normalize`` maps a concrete name onto its
+                         documented wildcard row first.
+    ``doc_normalize``  — e.g. ``router_routed_r3_total →
+                         router_routed_r*_total``.
+    ``source_keys``    — ``[(path, keys)]``: each key must appear as a
+                         ``"key"`` string literal in that source file
+                         (the bench-row emission contract).
+    """
+    name: str
+    expected: Sequence[str] = ()
+    actual: Optional[Callable[[], Sequence[str]]] = None
+    docs_path: Optional[str] = None
+    doc_names: Optional[Sequence[str]] = None
+    doc_normalize: Optional[Callable[[str], str]] = None
+    source_keys: Sequence[Tuple[str, Sequence[str]]] = field(
+        default_factory=list)
+
+    def check(self) -> List[str]:
+        errors: List[str] = []
+        live = list(self.expected)
+        if self.actual is not None:
+            try:
+                live = list(self.actual())
+            except Exception as e:   # import failure is a lint failure
+                return [f"{self.name}: cannot load live vocabulary: {e}"]
+            if sorted(live) != sorted(self.expected):
+                errors.append(
+                    f"{self.name} drifted from the frozen list: "
+                    f"extra={sorted(set(live) - set(self.expected))}, "
+                    f"missing={sorted(set(self.expected) - set(live))} — "
+                    "update the frozen list and the docs together")
+        if self.docs_path is not None:
+            try:
+                with open(self.docs_path, "r", encoding="utf-8") as f:
+                    docs = f.read()
+            except OSError as e:
+                errors.append(f"{self.name}: cannot read "
+                              f"{self.docs_path}: {e}")
+                docs = None
+            if docs is not None:
+                import os
+                base = os.path.basename(self.docs_path)
+                for nm in (self.doc_names if self.doc_names is not None
+                           else live):
+                    doc_nm = (self.doc_normalize(nm) if self.doc_normalize
+                              else nm)
+                    if f"`{nm}`" not in docs and f"`{doc_nm}`" not in docs:
+                        errors.append(f"{self.name}: {nm!r} not "
+                                      f"documented in {base}")
+        for path, keys in self.source_keys:
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    src = f.read()
+            except OSError as e:
+                errors.append(f"{self.name}: cannot read {path}: {e}")
+                continue
+            import os
+            base = os.path.basename(path)
+            for key in keys:
+                if f'"{key}"' not in src and f"'{key}'" not in src:
+                    errors.append(
+                        f"{self.name}: key {key!r} not emitted by {base} "
+                        "(frozen key list drifted)")
+        return errors
+
+
+def check_all(specs: Sequence[VocabSpec]) -> List[str]:
+    errors: List[str] = []
+    for spec in specs:
+        errors.extend(spec.check())
+    return errors

@@ -60,8 +60,14 @@ SCHEDULE_DECISIONS = ("decomposed_update", "noop", "ring_interleave",
                       "zero3_prefetch")
 
 # Frozen evidence key set: every ScheduleDecision carries exactly these.
+# `static_census` is the graph auditor's per-kind collective rollup
+# (analysis/auditor.collective_census_engine — docs/STATIC_ANALYSIS.md):
+# pinned evidence records WHAT the step's comm statically is alongside
+# how well the runtime overlapped it; None when the audit was
+# unavailable during the probe.
 EVIDENCE_KEYS = ("dominant_collective", "exposed_comm_ms",
-                 "overlap_fraction", "overlap_source", "probe_step")
+                 "overlap_fraction", "overlap_source", "probe_step",
+                 "static_census")
 
 # param_persistence_threshold rungs (same ladder as the DeepCompile
 # SelectiveUnshardPass — compile/backend.py): each step trades spare HBM
@@ -99,8 +105,14 @@ class ScheduleDecision:
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "ScheduleDecision":
+        ev = dict(d.get("evidence", {}))
+        if ev:
+            # configs pinned before the census field existed must keep
+            # loading (pinned-mode reproducibility contract): an absent
+            # census is None, the same value a failed audit records
+            ev.setdefault("static_census", None)
         return cls(decision=d["decision"], knobs=dict(d.get("knobs", {})),
-                   evidence=dict(d.get("evidence", {})))
+                   evidence=ev)
 
 
 def extract_evidence(report: Dict[str, Any],
@@ -157,6 +169,7 @@ def extract_evidence(report: Dict[str, Any],
         "overlap_source": source,
         "probe_step": int(report.get("step",
                                      report.get("armed_at_step", 0))),
+        "static_census": report.get("static_census"),
     }
 
 
@@ -315,10 +328,23 @@ class OverlapScheduler:
 
         engine, _, _, _ = ds.initialize(model=self.model,
                                         config=self._probe_config())
+        census = None
         try:
             self.last_context = self._context_from_engine(engine)
             for _ in range(self.probe_steps + 1):
                 engine.train_batch(batch)
+            try:
+                # static collective census for the pinned evidence (one
+                # AOT lower+compile — a one-time probe cost, same class
+                # as profile_compiled's); a failed audit must not cost
+                # the probe its runtime report
+                from deepspeed_tpu.analysis.auditor import \
+                    collective_census_engine
+
+                census = collective_census_engine(engine)
+            except Exception as e:
+                logger.warning(f"overlap_scheduler: static census "
+                               f"unavailable ({e})")
         finally:
             # a failed probe step must still release the engine — a
             # leaked armed TraceProfiler would make a RETRIED probe fail
@@ -338,6 +364,7 @@ class OverlapScheduler:
                 f"(output_dir={self.output_dir})")
         with open(paths[-1], "r", encoding="utf-8") as f:
             self.last_report = json.load(f)
+        self.last_report["static_census"] = census
         return self.last_report
 
     def pin(self, updates: Dict[str, Any],

@@ -46,7 +46,7 @@ def run_bench(sizes_mb: Optional[List[float]] = None, trials: int = 5,
     sizes_mb = sizes_mb or [1.0, 16.0, 64.0]
     results = []
 
-    from jax.experimental.shard_map import shard_map
+    from deepspeed_tpu.utils.jax_compat import shard_map
 
     for op in ("all_reduce", "all_gather", "reduce_scatter", "all_to_all"):
         for mb in sizes_mb:
@@ -72,7 +72,8 @@ def run_bench(sizes_mb: Optional[List[float]] = None, trials: int = 5,
                 in_spec, out_spec = P(axis), P(axis)
 
             jitted = jax.jit(shard_map(fn, mesh=mesh, in_specs=(in_spec,),
-                                       out_specs=out_spec, check_rep=False))
+                                       out_specs=out_spec,
+                                       check_vma=False))
             out = jitted(x)  # compile + warm
             np.asarray(jax.device_get(out)).ravel()[:1]
             t0 = time.perf_counter()
@@ -110,7 +111,7 @@ def run_bench(sizes_mb: Optional[List[float]] = None, trials: int = 5,
             return shard
 
         jitted = jax.jit(shard_map(qfn, mesh=mesh, in_specs=(P(),),
-                                   out_specs=P(axis), check_rep=False))
+                                   out_specs=P(axis), check_vma=False))
         out = jitted(x)
         np.asarray(jax.device_get(out)).ravel()[:1]
         t0 = time.perf_counter()

@@ -271,6 +271,27 @@ class InferenceEngineV2:
             top_p=sample.get("top_p"))
         return rb, toks
 
+    def audit_step_args(self, phase: str = "decode"):
+        """``(jitted ragged step, example args)`` for the static graph
+        auditor (``analysis/auditor.py``): the decode-shaped (16-token
+        bucket) or prefill-shaped (full token budget) step, buildable
+        without admitting any sequence.  Zero-filled index arrays are
+        fine — the auditor lowers and compiles, never executes, so the
+        donated KV caches are not consumed."""
+        if phase not in ("decode", "prefill"):
+            raise ValueError(f"audit_step_args: unknown phase {phase!r} "
+                             "(decode|prefill)")
+        sm = self.state_manager
+        t = (min(16, self.scheduler.token_budget) if phase == "decode"
+             else self.scheduler.token_budget)
+        ids = jnp.zeros((t,), jnp.int32)
+        rows = jnp.zeros((sm.max_seqs + 1,), jnp.int32)
+        tables = jnp.zeros((sm.max_seqs + 1, sm.max_blocks_per_seq),
+                           jnp.int32)
+        args = (self.params, self.cache_k, self.cache_v,
+                ids, ids, ids, ids, tables, rows, rows)
+        return self._step, args
+
     def put(self, batch_uids: Sequence[int],
             batch_tokens: Sequence[Sequence[int]]) -> Dict[int, np.ndarray]:
         """Admit prompts and run ONE ragged step (ref engine_v2.py:30 put).

@@ -1353,13 +1353,66 @@ class DeepSpeedEngine:
                     params, opt_state, grads, lr, ls_state)
             metrics = {"grad_norm": grad_norm, "loss_scale": ls_state["scale"],
                        "skipped": jnp.logical_not(finite)}
+            if self._param_stream:
+                return new_params, new_opt, new_ls, metrics
+            # Return the DONATED grad buffer zeroed in place: without a
+            # same-shaped output the donation could never be honored
+            # (params/opt/ls already claim the other aliases — graph
+            # auditor finding `donation_miss`), so the full fp32 gradient
+            # tree stayed live across the update AND the next
+            # accumulation round re-materialized a fresh zeros tree,
+            # unsharded on one device, before resharding it.  Now the
+            # alias is real (a memset, no allocation) and step()/forward()
+            # recycle the buffer instead.
+            zero_grads = jax.tree.map(jnp.zeros_like, grads)
+            return new_params, new_opt, new_ls, zero_grads, metrics
+
+        metrics3_sh = jax.tree.map(
+            lambda _: self._replicated,
+            {"grad_norm": 0, "loss_scale": 0, "skipped": 0})
+        if self._param_stream:
+            apply_out = (self.param_shardings, self.opt_shardings,
+                         self._replicated, metrics3_sh)
+            # no grad-shaped output exists to alias (the streamed grads
+            # are consumed layer-wise), so donating grads could never be
+            # honored — same pigeonhole as apply_step_store
+            apply_donate = (0, 1, 2)
+            self._zero_grads_jit = None
+        else:
+            apply_out = (self.param_shardings, self.opt_shardings,
+                         self._replicated, self.grad_shardings, metrics3_sh)
+            apply_donate = (0, 1, 2, 3)
+            gshapes = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, jnp.float32),
+                self.params)
+            # cold-start grad buffer born IN the accumulator sharding —
+            # the eager zeros + device_put it replaces held the whole
+            # unsharded fp32 tree on one device first
+            self._zero_grads_jit = jax.jit(
+                lambda: jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), gshapes),
+                out_shardings=self.grad_shardings)
+        self._apply_step_jit = jax.jit(
+            apply_step, donate_argnums=apply_donate,
+            out_shardings=apply_out)
+
+        def apply_step_store(params, opt_state, ls_state, grads, lr):
+            """Overlapped opt-store variant: grads arrive fresh from
+            `_grads_batch_store_jit` each step and are never recycled,
+            so skip apply_step's zero-grads output (a full-tree memset)
+            — and don't donate a buffer no output can alias (4 input
+            trees, 3 outputs: the pigeonhole leaves grads over)."""
+            new_params, new_opt, new_ls, grad_norm, finite = apply_update(
+                params, opt_state, grads, lr, ls_state)
+            metrics = {"grad_norm": grad_norm,
+                       "loss_scale": ls_state["scale"],
+                       "skipped": jnp.logical_not(finite)}
             return new_params, new_opt, new_ls, metrics
 
-        self._apply_step_jit = jax.jit(
-            apply_step, donate_argnums=(0, 1, 2, 3),
-            out_shardings=(self.param_shardings, self.opt_shardings, self._replicated,
-                           jax.tree.map(lambda _: self._replicated,
-                                        {"grad_norm": 0, "loss_scale": 0, "skipped": 0})))
+        self._apply_step_store_jit = jax.jit(
+            apply_step_store, donate_argnums=(0, 1, 2),
+            out_shardings=(self.param_shardings, self.opt_shardings,
+                           self._replicated, metrics3_sh))
 
         def eval_step(params, batch):
             return loss_fn(params, batch)
@@ -1419,6 +1472,10 @@ class DeepSpeedEngine:
         prefetches).  Call when done training — the last step always
         leaves one speculative store read in flight (whose NVMe buffer
         stays pinned until consumed).  Ref DeepSpeedEngine.destroy."""
+        # the recycled (trio-path) grad accumulator persists between
+        # steps by design — that is what lets apply_step alias it in
+        # place — but must not outlive training
+        self._grad_buffer = None
         self._cancel_prefetch()
         if self._watchdog is not None:
             self._watchdog.stop()
@@ -1812,6 +1869,37 @@ class DeepSpeedEngine:
         return (self.params, opt_state, self.loss_scale_state, batch_stack,
                 lr)
 
+    def audit_step_args(self, data=None):
+        """``(jitted step, example args)`` for the static graph auditor
+        (``analysis/auditor.py``) — everything needed to lower and
+        compile the train step WITHOUT running it.  ``data`` defaults to
+        a zero-filled batch of the configured geometry (the auditor only
+        reads shapes).  Donated example buffers are never consumed: AOT
+        ``lower()``/``compile()`` does not execute."""
+        if self._super_opt is not None or self._opt_store is not None:
+            raise ValueError(
+                "audit_step_args: the host/NVMe-resident optimizer paths "
+                "split the step across several programs — audit the "
+                "fused-step variant of this config instead")
+        if data is None:
+            mc = self.model_config
+            if mc is None:
+                raise ValueError("audit_step_args: no model_config to "
+                                 "synthesize a batch from — pass data")
+            rows = (self.micro_batch_size
+                    * self.gradient_accumulation_steps_value
+                    * self.topology.dp_size)
+            seq = int(getattr(mc, "max_seq_len", 128)) or 128
+            ids = np.zeros((rows, seq), np.int32)
+            data = {"input_ids": ids, "labels": ids}
+        batch_stack = self._stack_micro_batches(data)
+        batch_stack = self._maybe_add_pld(batch_stack)
+        batch_stack = self._maybe_add_dropout_key(batch_stack)
+        batch_stack = self._put_batch(batch_stack, stacked=True)
+        lr = jnp.float32(self.lr_scheduler(self.global_steps))
+        return (self._train_step_jit,
+                self._train_step_args(self.opt_state, batch_stack, lr))
+
     def _train_batch_traced_body(self, data) -> jnp.ndarray:
         if self._onebit is not None:
             return self._train_batch_onebit(data)
@@ -1845,9 +1933,10 @@ class DeepSpeedEngine:
                 loss, grads = self._grads_batch_store_jit(
                     self.params, batch_stack, self.loss_scale_state["scale"])
                 opt_state = self._swap_in_opt_state()
-                self.params, opt_state, self.loss_scale_state, metrics = \
-                    self._apply_step_jit(self.params, opt_state,
-                                         self.loss_scale_state, grads, lr)
+                (self.params, opt_state, self.loss_scale_state,
+                 metrics) = self._apply_step_store_jit(
+                    self.params, opt_state, self.loss_scale_state, grads,
+                    lr)
             metrics = {**metrics, "loss": loss}
         else:
             opt_state = self._swap_in_opt_state()
@@ -2015,8 +2104,14 @@ class DeepSpeedEngine:
         self.timers(FORWARD_GLOBAL_TIMER).start()
         self._swap_in_params()
         if self._grad_buffer is None:
-            zeros = jax.tree.map(lambda s: jnp.zeros(s.shape, jnp.float32), self.params)
-            self._grad_buffer = jax.device_put(zeros, self.grad_shardings)
+            if self._zero_grads_jit is not None:
+                # sharded from birth; also aliased-recycled from the
+                # previous step() so this only runs on the cold start
+                self._grad_buffer = self._zero_grads_jit()
+            else:
+                zeros = jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, jnp.float32), self.params)
+                self._grad_buffer = jax.device_put(zeros, self.grad_shardings)
         mc = self.model_config
         if mc is not None and (getattr(mc, "dropout", 0.0) > 0.0
                                or getattr(mc, "moe_noisy_gate_policy", None)):
@@ -2055,12 +2150,22 @@ class DeepSpeedEngine:
         lr = jnp.float32(self.lr_scheduler(self.global_steps))
         opt_state = self._swap_in_opt_state()
         self._swap_in_params()
-        self.params, opt_state, self.loss_scale_state, metrics = self._apply_step_jit(
-            self.params, opt_state, self.loss_scale_state, self._grad_buffer, lr)
+        if self._param_stream:
+            (self.params, opt_state, self.loss_scale_state,
+             metrics) = self._apply_step_jit(
+                self.params, opt_state, self.loss_scale_state,
+                self._grad_buffer, lr)
+            self._grad_buffer = None
+        else:
+            # the donated grad buffer comes back zeroed (aliased in
+            # place) and seeds the next accumulation round
+            (self.params, opt_state, self.loss_scale_state,
+             self._grad_buffer, metrics) = self._apply_step_jit(
+                self.params, opt_state, self.loss_scale_state,
+                self._grad_buffer, lr)
         self._swap_out_opt_state(opt_state)
         self._swap_out_params()
         self._prefetch_stores()
-        self._grad_buffer = None
         self._micro_in_step = 0
         self.global_steps += 1
         self.lr_scheduler.step()

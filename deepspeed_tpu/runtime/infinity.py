@@ -36,15 +36,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-try:  # jax >= 0.5 spells memory spaces as an enum
-    HOST = jax.memory.Space.Host
-    DEVICE = jax.memory.Space.Device
-except AttributeError:  # jax 0.4.x: device_put targets inside jit take
-    # TransferToMemoryKind (same placement semantics, string-keyed)
-    from jax._src.sharding_impls import TransferToMemoryKind
+from deepspeed_tpu.utils.jax_compat import memory_spaces
 
-    HOST = TransferToMemoryKind("pinned_host")
-    DEVICE = TransferToMemoryKind("device")
+HOST, DEVICE = memory_spaces()
 
 _MEMORY_KINDS: dict = {}
 

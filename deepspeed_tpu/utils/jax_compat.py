@@ -15,7 +15,21 @@ from __future__ import annotations
 import jax
 
 __all__ = ["shard_map", "get_abstract_mesh", "tpu_compiler_params",
-           "axis_size", "axis_bound_manually"]
+           "axis_size", "axis_bound_manually", "memory_spaces"]
+
+
+def memory_spaces():
+    """``(HOST, DEVICE)`` placement targets for ``device_put`` inside
+    jit: the ``jax.memory.Space`` enum where it exists (jax >= 0.5);
+    on 0.4.x the string-keyed ``TransferToMemoryKind`` carries the same
+    placement semantics (``pinned_host`` / ``device``)."""
+    try:
+        return jax.memory.Space.Host, jax.memory.Space.Device
+    except AttributeError:
+        from jax._src.sharding_impls import TransferToMemoryKind
+
+        return (TransferToMemoryKind("pinned_host"),
+                TransferToMemoryKind("device"))
 
 
 def axis_bound_manually(axis_name: str) -> bool:

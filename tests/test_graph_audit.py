@@ -1,0 +1,410 @@
+"""Static graph auditor (deepspeed_tpu/analysis; docs/STATIC_ANALYSIS.md).
+
+Covers the frozen report schema, each planted defect class (implicit
+resharding, donation miss, host callback, fp32-wire-on-quantized-path,
+recompile hazard, seam violation), golden-census stability, the engine
+donation-fix regression, and the tier-1 gate: every bench-row step
+config audits with zero unbaselined high-severity findings on the
+virtual 8-device CPU mesh.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.analysis import (AUDIT_REPORT_KEYS, Finding,
+                                    GraphAuditReport, load_baseline)
+from deepspeed_tpu.analysis.auditor import AuditIntent, audit
+from deepspeed_tpu.analysis.seam import lint_repo, lint_source
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mesh(shape=(8,), names=("data",)):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+# ----------------------------------------------------------------------
+# report schema / baseline machinery
+# ----------------------------------------------------------------------
+def test_report_schema_frozen_and_sorted():
+    rep = GraphAuditReport(label="x")
+    d = rep.to_dict()
+    assert sorted(d.keys()) == sorted(AUDIT_REPORT_KEYS)
+    line = rep.to_json()
+    assert list(json.loads(line).keys()) == sorted(d.keys())
+    assert d["schema"] == 1
+
+
+def test_finding_vocab_rejected():
+    with pytest.raises(ValueError, match="unknown finding kind"):
+        Finding(kind="nonsense", severity="high", message="m")
+    with pytest.raises(ValueError, match="unknown severity"):
+        Finding(kind="donation_miss", severity="fatal", message="m")
+
+
+def test_fingerprint_stable_and_baseline_suppression(tmp_path):
+    f1 = Finding(kind="donation_miss", severity="high", message="run A: "
+                 "12345 bytes", where="step", detail={"key": "(4,4):f32"})
+    f2 = Finding(kind="donation_miss", severity="high", message="run B: "
+                 "99999 bytes", where="step", detail={"key": "(4,4):f32"})
+    # messages differ (byte counts drift), fingerprints must not
+    assert f1.fingerprint() == f2.fingerprint()
+    rep = GraphAuditReport(label="x", findings=[f1])
+    assert [f.kind for f in rep.high_findings()] == ["donation_miss"]
+    assert rep.high_findings(baseline={f1.fingerprint()}) == []
+    # missing baseline file = empty baseline, never an error
+    assert load_baseline(str(tmp_path / "nope.json")) == frozenset()
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"suppress": [f1.fingerprint()]}))
+    assert rep.high_findings(load_baseline(str(p))) == []
+
+
+# ----------------------------------------------------------------------
+# census
+# ----------------------------------------------------------------------
+def test_census_detects_collectives_with_bytes():
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    mesh = _mesh()
+    fn = jax.jit(shard_map(lambda x: jax.lax.psum(x, "data"), mesh=mesh,
+                           in_specs=(P("data"),), out_specs=P("data")))
+    rep = audit(fn, jnp.zeros((8, 4096), jnp.float32), label="psum",
+                intent=AuditIntent(expected=frozenset({"all-reduce"})))
+    kinds = {c.kind: c for c in rep.census}
+    assert "all-reduce" in kinds
+    ar = kinds["all-reduce"]
+    assert ar.count >= 1 and ar.payload_bytes > 0 and ar.wire_bytes > 0
+    assert ar.group_size == 8 and "f32" in ar.dtype
+    assert rep.high_findings() == []
+
+
+def test_census_stable_across_jit_of_same_config():
+    mesh = _mesh()
+    sh = NamedSharding(mesh, P("data", None))
+
+    def step(x):
+        return (x @ x.T).sum()
+
+    reps = [audit(jax.jit(step, in_shardings=(sh,)),
+                  jnp.zeros((64, 64)), label="golden") for _ in range(2)]
+    assert [c.to_dict() for c in reps[0].census] \
+        == [c.to_dict() for c in reps[1].census]
+    assert reps[0].census_summary() == reps[1].census_summary()
+
+
+def test_planted_implicit_resharding_detected():
+    mesh = _mesh((4, 2), ("data", "tensor"))
+
+    def step(x):
+        y = x * 2
+        # nobody "declared" this layout flip: GSPMD must insert a
+        # resharding collective to satisfy it
+        y = jax.lax.with_sharding_constraint(
+            y, NamedSharding(mesh, P(None, "data")))
+        return y.sum()
+
+    fn = jax.jit(step, in_shardings=(
+        NamedSharding(mesh, P("data", None)),))
+    x = jnp.zeros((1024, 1024))
+    rep = audit(fn, x, label="planted", intent=AuditIntent())
+    highs = rep.high_findings()
+    assert any(f.kind == "implicit_resharding" for f in highs), \
+        [f.to_dict() for f in rep.findings]
+    # the same graph under an intent that EXPECTS the transition is clean
+    ok = audit(fn, x, label="declared", intent=AuditIntent(
+        expected=frozenset({"all-to-all", "all-reduce",
+                            "collective-permute", "all-gather",
+                            "reduce-scatter"})))
+    assert ok.high_findings() == []
+
+
+def test_wire_dtype_mismatch_on_quantized_intent():
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    mesh = _mesh()
+    fn = jax.jit(shard_map(lambda g: jax.lax.psum(g, "data"), mesh=mesh,
+                           in_specs=(P(),), out_specs=P()))
+    g = jnp.zeros((256, 256), jnp.float32)   # 256 KB fp32 "grad reduce"
+    intent = AuditIntent(expected=frozenset({"all-reduce"}),
+                         banned={"all-reduce": ("f32",)})
+    rep = audit(fn, g, label="quantized_path", intent=intent)
+    assert any(f.kind == "wire_dtype_mismatch" and f.severity == "high"
+               for f in rep.findings), [f.to_dict() for f in rep.findings]
+
+
+def test_required_collective_absent_is_mismatch():
+    rep = audit(jax.jit(lambda x: x + 1), jnp.zeros((4,)), label="local",
+                intent=AuditIntent(required={"collective-permute": ()}))
+    assert any(f.kind == "collective_mismatch" for f in rep.findings)
+    assert rep.high_findings() == []   # warning, not high
+
+
+# ----------------------------------------------------------------------
+# donation
+# ----------------------------------------------------------------------
+def test_planted_donation_miss_detected():
+    def step(a, b):
+        return a + 1.0, (a * b).astype(jnp.bfloat16)  # b can never alias
+
+    fn = jax.jit(step, donate_argnums=(0, 1))
+    rep = audit(fn, jnp.zeros((256, 256)), jnp.zeros((256, 256)),
+                label="planted_donation")
+    assert rep.donation["declared"] == 2
+    assert rep.donation["aliased"] == 1
+    assert rep.donation["missed_bytes"] == 256 * 256 * 4
+    misses = [f for f in rep.findings if f.kind == "donation_miss"]
+    assert misses and misses[0].severity == "high"
+    # the honorable version is clean
+    ok = audit(jax.jit(lambda a: a * 2, donate_argnums=(0,)),
+               jnp.zeros((256, 256)), label="ok_donation")
+    assert ok.donation["declared"] == 1 == ok.donation["aliased"]
+    assert not [f for f in ok.findings if f.kind == "donation_miss"]
+
+
+def test_engine_apply_step_donation_fully_aliased():
+    """Regression for the donation fix: apply_step now returns the
+    donated grad buffer zeroed in place, so EVERY declared donation
+    aliases — the full fp32 gradient tree no longer rides the update as
+    a dead buffer, and step() recycles it into the next round."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import get_model_config
+
+    model = get_model_config("gpt2-tiny", max_seq_len=64)
+    engine, _, _, _ = ds.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2}, "steps_per_print": 10_000,
+        "mesh": {"data": jax.device_count()}})
+    try:
+        grads = engine._zero_grads_jit()
+        rep = audit(engine._apply_step_jit, engine.params,
+                    engine.opt_state, engine.loss_scale_state, grads,
+                    jnp.float32(1e-3), label="apply_step")
+        assert rep.donation["declared"] == rep.donation["aliased"] > 0, \
+            rep.donation
+        assert not [f for f in rep.findings if f.kind == "donation_miss"]
+        # trio path: the buffer comes back zeroed and seeds round 2
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, model.vocab_size,
+                           size=(jax.device_count(), 65), dtype=np.int32)
+        mb = {"input_ids": ids[:, :64],
+              "labels": ids[:, 1:].astype(np.int32)}
+        for _ in range(2):
+            for _ in range(engine.gradient_accumulation_steps_value):
+                loss = engine.forward(mb)
+                engine.backward()
+            engine.step()
+        assert np.isfinite(float(np.asarray(loss)))
+        assert engine._grad_buffer is not None
+        total = sum(float(np.asarray(jnp.abs(leaf).sum()))
+                    for leaf in jax.tree_util.tree_leaves(
+                        engine._grad_buffer))
+        assert total == 0.0
+    finally:
+        engine.destroy()
+
+
+# ----------------------------------------------------------------------
+# hot-path hygiene
+# ----------------------------------------------------------------------
+def test_planted_host_callback_detected():
+    def step(x):
+        jax.pure_callback(lambda v: v, jax.ShapeDtypeStruct((), x.dtype),
+                          x.sum())
+        return x * 2
+
+    rep = audit(jax.jit(step), jnp.zeros((8,)), label="cb")
+    cbs = [f for f in rep.findings if f.kind == "host_callback"]
+    assert cbs and cbs[0].severity == "high"
+
+    def dbg(x):
+        jax.debug.print("x={x}", x=x.sum())
+        return x * 2
+
+    rep2 = audit(jax.jit(dbg), jnp.zeros((8,)), label="dbg")
+    cbs2 = [f for f in rep2.findings if f.kind == "host_callback"]
+    assert cbs2 and cbs2[0].severity == "warning"   # async, degraded
+
+
+def test_recompile_hazard_python_scalar():
+    rep = audit(jax.jit(lambda x, s: x * s), jnp.zeros((4,)), 2.0,
+                label="scalar")
+    hz = [f for f in rep.findings if f.kind == "recompile_hazard"]
+    assert hz and "float" in hz[0].detail["what"]
+    clean = audit(jax.jit(lambda x, s: x * s), jnp.zeros((4,)),
+                  jnp.float32(2.0), label="array_scalar")
+    assert not [f for f in clean.findings
+                if f.kind == "recompile_hazard"]
+
+
+def test_dtype_promotion_reported_in_bf16_step():
+    def step(x):
+        return (x.astype(jnp.float32) @ x.astype(jnp.float32).T).sum()
+
+    rep = audit(jax.jit(step), jnp.zeros((128, 128), jnp.bfloat16),
+                label="promo", intent=AuditIntent(compute_dtype="bf16"))
+    promos = [f for f in rep.findings if f.kind == "dtype_promotion"]
+    assert promos and promos[0].detail["bytes"] > 0
+    # fp32 compute never reports promotions
+    rep2 = audit(jax.jit(step), jnp.zeros((128, 128), jnp.bfloat16),
+                 label="promo_fp32", intent=AuditIntent())
+    assert not [f for f in rep2.findings if f.kind == "dtype_promotion"]
+
+
+# ----------------------------------------------------------------------
+# HLO parser units (no jax needed beyond text fixtures)
+# ----------------------------------------------------------------------
+def test_hlo_parsers_on_synthetic_text():
+    from deepspeed_tpu.analysis.hlo import (parse_collectives,
+                                            parse_input_output_alias,
+                                            wire_bytes)
+
+    hlo = """HloModule jit_step, is_scheduled=true, input_output_alias={ {0}: (0, {}, may-alias), {1}: (2, {}, may-alias) }, entry_computation_layout={(f32[8]{0})->f32[8]{0}}
+
+ENTRY %main (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  %all-reduce.1 = f32[8]{0} all-reduce(f32[8]{0} %p0), channel_id=1, replica_groups=[1,8]<=[8], to_apply=%add
+  %ags = (f32[128,8]{1,0}, f32[1024,8]{1,0}) all-gather-start(f32[128,8]{1,0} %x), replica_groups=[4,2]<=[8], dimensions={0}
+  %agd = f32[1024,8]{1,0} all-gather-done((f32[128,8]{1,0}, f32[1024,8]{1,0}) %ags)
+  ROOT %cp = bf16[16,4]{1,0} collective-permute(bf16[16,4]{1,0} %x), source_target_pairs={{0,1}}
+}
+"""
+    ops = parse_collectives(hlo, num_partitions=8)
+    # the async pair counts ONCE, priced off the -done op's RESULT type
+    # (the -start tuple also contains the operand — would inflate bytes)
+    # but with the -start line's replica_groups (subgroup of 2, not the
+    # 8-partition fallback)
+    assert [(o["kind"], o["dtype"]) for o in ops] == \
+        [("all-reduce", "f32"), ("all-gather", "f32"),
+         ("collective-permute", "bf16")]
+    assert ops[1]["payload_bytes"] == 1024 * 8 * 4
+    assert ops[1]["group_size"] == 2
+    assert ops[0]["payload_bytes"] == 32
+    assert ops[0]["wire_bytes"] == wire_bytes("all-reduce", 32, 8)
+    assert ops[2]["payload_bytes"] == 128 and ops[2]["wire_bytes"] == 128
+    assert parse_input_output_alias(hlo) == {0: "0", 2: "1"}
+    assert wire_bytes("all-gather", 800, 8) == 700
+    assert wire_bytes("all-reduce", 800, 1) == 0
+
+
+# ----------------------------------------------------------------------
+# seam lint
+# ----------------------------------------------------------------------
+def test_seam_lint_repo_is_clean():
+    findings = lint_repo(REPO)
+    assert findings == [], [f.to_dict() for f in findings]
+
+
+def test_seam_lint_detects_planted_violations():
+    planted = (
+        "from jax.experimental.shard_map import shard_map\n"
+        "import jax\n"
+        "def f():\n"
+        "    sp = jax.memory.Space.Host\n"
+        "    from jax._src import core\n"
+        "    return jax.shard_map, getattr(None, 'TPUCompilerParams')\n")
+    found = lint_source(planted, "deepspeed_tpu/planted.py")
+    keys = {f.detail["key"] for f in found}
+    assert {"jax.experimental.shard_map.shard_map", "jax.memory",
+            "jax._src.core", "jax.shard_map",
+            "TPUCompilerParams"} <= keys
+    assert all(f.severity == "high" for f in found)
+    # the allowlist suppresses exactly the named symbol, nothing else
+    allowed = lint_source(planted, "deepspeed_tpu/planted.py",
+                          allow={"deepspeed_tpu/planted.py::jax.memory"})
+    assert "jax.memory" not in {f.detail["key"] for f in allowed}
+    assert len(allowed) == len(found) - 1
+    # jax_compat itself is exempt — it IS the seam
+    assert lint_source(planted, "deepspeed_tpu/utils/jax_compat.py") == []
+
+
+# ----------------------------------------------------------------------
+# scheduler evidence integration
+# ----------------------------------------------------------------------
+def test_pre_census_pinned_records_still_load():
+    """Back-compat: a step_schedule pinned BEFORE static_census joined
+    the frozen evidence keys must keep loading (pinned-mode
+    reproducibility) — the absent census defaults to None, exactly what
+    a failed audit records.  Empty evidence is still rejected."""
+    from deepspeed_tpu.autotuning.overlap_scheduler import ScheduleDecision
+
+    old = {"decision": "zero3_prefetch",
+           "knobs": {"gather_prefetch_depth": 2},
+           "evidence": {"dominant_collective": "all-gather",
+                        "exposed_comm_ms": 1.2, "overlap_fraction": 0.3,
+                        "overlap_source": "spans", "probe_step": 4}}
+    d = ScheduleDecision.from_dict(old)
+    assert d.evidence["static_census"] is None
+    with pytest.raises(ValueError, match="missing"):
+        ScheduleDecision.from_dict({"decision": "noop", "evidence": {}})
+
+
+def test_scheduler_evidence_carries_static_census():
+    from deepspeed_tpu.autotuning.overlap_scheduler import (EVIDENCE_KEYS,
+                                                            extract_evidence)
+
+    assert "static_census" in EVIDENCE_KEYS
+    census = {"all-gather": {"count": 3, "wire_bytes": 123,
+                             "dtypes": ["f32"]}}
+    rep = {"devices": {"d0": {"collective_ms": 1.0}},
+           "overlap_fraction": 0.4, "step": 4, "static_census": census}
+    ev = extract_evidence(rep, {"zero_stage": 3})
+    assert sorted(ev) == sorted(EVIDENCE_KEYS)
+    assert ev["static_census"] == census
+    # absent census degrades to None, never a KeyError
+    rep.pop("static_census")
+    assert extract_evidence(rep, {})["static_census"] is None
+
+
+# ----------------------------------------------------------------------
+# the tier-1 gate: every bench-row step config audits clean
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("name", sorted(
+    __import__("deepspeed_tpu.analysis.targets",
+               fromlist=["BENCH_AUDIT_TARGETS"]).BENCH_AUDIT_TARGETS))
+def test_bench_row_static_audit_clean(name):
+    from deepspeed_tpu.analysis.targets import run_audit_target
+
+    baseline = load_baseline(
+        os.path.join(REPO, "tools", "graft_lint_baseline.json"))
+    rep = run_audit_target(name)
+    assert rep.to_dict()["schema"] == 1
+    highs = rep.high_findings(baseline)
+    assert highs == [], [f.to_dict() for f in highs]
+    # donation contract: whatever a step declares, XLA aliased
+    assert rep.donation["declared"] == rep.donation["aliased"], \
+        rep.donation
+    if name.startswith("train_"):
+        assert rep.census, "a dp=8 train step with no collectives?"
+    if name == "ring_attention":
+        assert any(c.kind == "collective-permute" for c in rep.census)
+    if name == "train_commquant":
+        a2a = [c for c in rep.census if c.kind == "all-to-all"
+               and "s8" in c.dtype]
+        assert a2a, "int8 wire missing from the quantized reduce"
+
+
+def test_graft_lint_cli_seam_only(tmp_path):
+    """CLI plumbing: --seam runs AST-only (no backend churn), exits 0 on
+    the clean tree, and --json writes a well-formed dump."""
+    import importlib.util
+
+    path = os.path.join(REPO, "tools", "graft_lint.py")
+    spec = importlib.util.spec_from_file_location("graft_lint_mod", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    out = str(tmp_path / "lint.json")
+    rc = mod.main(["--seam", "--json", out])
+    assert rc == 0
+    with open(out, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    assert data["unbaselined_high"] == []
+    assert isinstance(data["findings"], list)

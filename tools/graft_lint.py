@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Static graph auditor + seam lint CLI (docs/STATIC_ANALYSIS.md).
+
+Runs the ``deepspeed_tpu/analysis`` auditor over the bench-row step
+configs on a virtual 8-device CPU mesh (``--rows``) and/or the AST-level
+jax-version-seam lint over the production tree (``--seam``); with
+neither flag, both run.  Exit status 1 when any HIGH-severity finding is
+not suppressed by the baseline file.
+
+Usage::
+
+    python tools/graft_lint.py                   # everything
+    python tools/graft_lint.py --rows train_zero3 v2_decode
+    python tools/graft_lint.py --seam            # AST lint only
+    python tools/graft_lint.py --list            # show row targets
+    python tools/graft_lint.py --json out.json   # machine-readable dump
+    python tools/graft_lint.py --write-baseline  # accept current highs
+
+The baseline (default ``tools/graft_lint_baseline.json``) holds finding
+fingerprints — stable hashes of (kind, where, stable-key), never of
+byte counts — so a deliberately accepted finding stays suppressed while
+anything NEW still fails the lint.  ``--write-baseline`` records every
+currently-unsuppressed high finding; review the diff like code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "graft_lint_baseline.json")
+
+
+def _setup_mesh_backend() -> None:
+    """Pin the virtual 8-device CPU mesh BEFORE any backend touch (same
+    discipline as ``bench.py --smoke``: a down TPU tunnel must not hang
+    the lint, and audits check graph *structure*, which the CPU mesh
+    lowers identically)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    for flag in ("--xla_force_host_platform_device_count=8",
+                 "--xla_backend_optimization_level=0"):
+        if flag.split("=")[0] not in flags:
+            flags = f"{flags} {flag}".strip()
+    os.environ["XLA_FLAGS"] = flags
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="graft_lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--rows", nargs="*", default=None, metavar="ROW",
+                   help="audit bench-row step configs (all when no names "
+                        "are given)")
+    p.add_argument("--seam", action="store_true",
+                   help="run the AST jax-version-seam lint")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="finding-fingerprint suppression file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="append every currently-unsuppressed high "
+                        "finding to the baseline")
+    p.add_argument("--json", dest="json_path", default=None,
+                   help="write full reports + findings as JSON")
+    p.add_argument("--list", action="store_true",
+                   help="list bench-row audit targets and exit")
+    args = p.parse_args(argv)
+
+    sys.path.insert(0, REPO)
+    from deepspeed_tpu.analysis.report import load_baseline
+
+    run_rows = args.rows is not None or not args.seam
+    run_seam = args.seam or args.rows is None
+
+    if args.list:
+        from deepspeed_tpu.analysis.targets import BENCH_AUDIT_TARGETS
+        for name in sorted(BENCH_AUDIT_TARGETS):
+            print(name)
+        return 0
+
+    findings = []
+    reports = []
+    if run_rows:
+        _setup_mesh_backend()
+        from deepspeed_tpu.analysis.targets import (BENCH_AUDIT_TARGETS,
+                                                    run_audit_target)
+        names = args.rows or sorted(BENCH_AUDIT_TARGETS)
+        for name in names:
+            rep = run_audit_target(name)
+            reports.append(rep)
+            findings.extend(rep.findings)
+            census = ", ".join(f"{k}×{v['count']}"
+                               for k, v in rep.census_summary().items())
+            print(f"row {name}: {len(rep.findings)} finding(s); "
+                  f"donation {rep.donation['aliased']}/"
+                  f"{rep.donation['declared']} aliased; "
+                  f"census [{census or 'no collectives'}]")
+    if run_seam:
+        from deepspeed_tpu.analysis.seam import lint_repo
+        seam = lint_repo(REPO)
+        findings.extend(seam)
+        print(f"seam: {len(seam)} violation(s)")
+
+    baseline = load_baseline(args.baseline)
+    highs: List = [f for f in findings if f.severity == "high"]
+    new_highs = [f for f in highs if f.fingerprint() not in baseline]
+    suppressed = len(highs) - len(new_highs)
+
+    for f in findings:
+        mark = ("BASELINED" if f.severity == "high"
+                and f.fingerprint() in baseline else f.severity.upper())
+        print(f"[{mark}] {f.kind} @ {f.where} ({f.fingerprint()})\n"
+              f"    {f.message}")
+
+    if args.write_baseline and new_highs:
+        data = {"comment": "graft_lint accepted findings — every entry "
+                           "is a Finding.fingerprint(); review changes "
+                           "to this file like code",
+                "suppress": sorted(baseline.union(
+                    f.fingerprint() for f in new_highs))}
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            json.dump(data, fh, indent=2)
+            fh.write("\n")
+        print(f"baseline: wrote {len(new_highs)} new fingerprint(s) to "
+              f"{args.baseline}")
+        new_highs = []
+
+    if args.json_path:
+        with open(args.json_path, "w", encoding="utf-8") as fh:
+            json.dump({"reports": [r.to_dict() for r in reports],
+                       "findings": [f.to_dict() for f in findings],
+                       "unbaselined_high": [f.to_dict()
+                                            for f in new_highs]},
+                      fh, indent=2, sort_keys=True)
+
+    print(f"graft_lint: {len(findings)} finding(s), {len(new_highs)} "
+          f"unbaselined high ({suppressed} baselined)")
+    return 1 if new_highs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

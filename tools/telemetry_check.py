@@ -29,6 +29,16 @@ import tempfile
 from typing import Any, List
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# the shared frozen-vocabulary engine (deepspeed_tpu/analysis/vocab.py):
+# every "frozen list == module list, names documented, bench keys
+# emitted" contract below is ONE VocabSpec registration, shared with
+# tools/graft_lint.py
+from deepspeed_tpu.analysis.vocab import VocabSpec  # noqa: E402
+from deepspeed_tpu.analysis.vocab import check_all as _vocab_check  # noqa: E402
+
 DOCS = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 
 # frozen with schema version 1 — tools/telemetry_check.py is the tripwire
@@ -90,7 +100,7 @@ EXPECTED_SCHEDULE_DECISIONS = ["decomposed_update", "noop",
                                "ring_interleave", "zero3_prefetch"]
 EXPECTED_EVIDENCE_KEYS = ["dominant_collective", "exposed_comm_ms",
                           "overlap_fraction", "overlap_source",
-                          "probe_step"]
+                          "probe_step", "static_census"]
 EXPECTED_STEP_SCHEDULE_KEYS = [
     "decisions", "gather_prefetch_depth", "mode", "overlap_threshold",
     "param_persistence_threshold", "prefetch_bucket_size", "probe_steps",
@@ -110,6 +120,26 @@ CAPTURE_REPORT_SCHED_KEYS = ["dominant_collective", "exposed_ms",
 SERVING_DOCS = os.path.join(REPO, "docs", "SERVING.md")
 SERVE_MULTI_BENCH_KEYS = ["agg_tokens_per_sec", "ttft_p95_ms",
                           "prefix_hit_rate", "prefill_tokens_saved"]
+
+# frozen static-graph-audit vocabulary (deepspeed_tpu/analysis/report.py;
+# docs/STATIC_ANALYSIS.md): finding kinds, severities, and the audit
+# report's frozen key sets — same tripwire contract as the StepRecord
+# schema, linted through the shared VocabSpec engine.
+STATIC_DOCS = os.path.join(REPO, "docs", "STATIC_ANALYSIS.md")
+EXPECTED_FINDING_KINDS = [
+    "collective_mismatch", "donation_miss", "dtype_promotion",
+    "host_callback", "implicit_resharding", "recompile_hazard",
+    "seam_violation", "wire_dtype_mismatch",
+]
+EXPECTED_AUDIT_SEVERITIES = ["info", "warning", "high"]
+EXPECTED_AUDIT_REPORT_KEYS = ["backend", "census", "donation", "findings",
+                              "label", "num_partitions", "schema"]
+EXPECTED_AUDIT_CENSUS_KEYS = ["count", "dtype", "group_size", "kind",
+                              "payload_bytes", "wire_bytes"]
+EXPECTED_AUDIT_FINDING_KEYS = ["detail", "fingerprint", "kind", "message",
+                               "severity", "where"]
+EXPECTED_AUDIT_DONATION_KEYS = ["aliased", "declared", "missed",
+                                "missed_bytes"]
 
 
 def _exported_monitor_tags() -> List[str]:
@@ -216,84 +246,54 @@ def check_span_names() -> List[str]:
     return errors
 
 
+def _cross_link(docs_path: str, needle: str, what: str) -> List[str]:
+    """A docs file must reference another doc (cross-link contract)."""
+    try:
+        with open(docs_path, "r", encoding="utf-8") as f:
+            if needle not in f.read():
+                return [f"{os.path.basename(docs_path)} does not "
+                        f"cross-link {needle} from its {what} section"]
+    except OSError as e:
+        return [f"cannot read {docs_path}: {e}"]
+    return []
+
+
+_BENCH = os.path.join(REPO, "bench.py")
+
+
 def check_quant_comm() -> List[str]:
     """Quantized-collective telemetry: frozen comm-op vocabulary matches
     the module, every op and bench key is documented, and the bench row
     actually emits the documented keys."""
-    from deepspeed_tpu.comm.quantized import QUANT_COMM_OPS
+    def _ops():
+        from deepspeed_tpu.comm.quantized import QUANT_COMM_OPS
 
-    errors = []
-    if sorted(QUANT_COMM_OPS) != sorted(EXPECTED_QUANT_COMM_OPS):
-        errors.append(
-            "quantized.QUANT_COMM_OPS drifted from the frozen list: "
-            f"extra={sorted(set(QUANT_COMM_OPS) - set(EXPECTED_QUANT_COMM_OPS))}, "
-            f"missing={sorted(set(EXPECTED_QUANT_COMM_OPS) - set(QUANT_COMM_OPS))}"
-            " — update EXPECTED_QUANT_COMM_OPS + docs/QUANTIZED_COMM.md "
-            "together")
-    try:
-        with open(QUANT_DOCS, "r", encoding="utf-8") as f:
-            qdocs = f.read()
-    except OSError as e:
-        return errors + [f"cannot read {QUANT_DOCS}: {e}"]
-    for op in QUANT_COMM_OPS:
-        if f"`{op}`" not in qdocs:
-            errors.append(f"quant comm op {op!r} not documented in "
-                          f"{os.path.basename(QUANT_DOCS)}")
-    try:
-        with open(os.path.join(REPO, "bench.py"), "r",
-                  encoding="utf-8") as f:
-            bench_src = f.read()
-    except OSError as e:
-        return errors + [f"cannot read bench.py: {e}"]
-    for key in QUANT_BENCH_KEYS:
-        if f"`{key}`" not in qdocs:
-            errors.append(f"comm-quant bench key {key!r} not documented in "
-                          f"{os.path.basename(QUANT_DOCS)}")
-        if f'"{key}"' not in bench_src:
-            errors.append(f"comm-quant bench key {key!r} not emitted by "
-                          "bench.py (frozen QUANT_BENCH_KEYS drifted)")
-    # the observability comm-volume section must point readers at the
-    # quantized-collective docs (cross-link contract)
-    try:
-        with open(DOCS, "r", encoding="utf-8") as f:
-            if "QUANTIZED_COMM.md" not in f.read():
-                errors.append("docs/OBSERVABILITY.md does not cross-link "
-                              "QUANTIZED_COMM.md from its comm section")
-    except OSError as e:
-        errors.append(f"cannot read {DOCS}: {e}")
-    return errors
+        return QUANT_COMM_OPS
+
+    return _vocab_check([
+        VocabSpec(name="quantized.QUANT_COMM_OPS",
+                  expected=EXPECTED_QUANT_COMM_OPS, actual=_ops,
+                  docs_path=QUANT_DOCS),
+        VocabSpec(name="QUANT_BENCH_KEYS", expected=QUANT_BENCH_KEYS,
+                  docs_path=QUANT_DOCS,
+                  source_keys=[(_BENCH, QUANT_BENCH_KEYS)]),
+    ]) + _cross_link(DOCS, "QUANTIZED_COMM.md", "comm")
 
 
 def check_ring_bench() -> List[str]:
     """Ring bench-row vocabulary: every frozen longseq_ring / --bwd key
     is emitted by its bench source and documented in the
     docs/RING_ATTENTION.md bench-key table."""
-    errors = []
-    try:
-        with open(RING_DOCS, "r", encoding="utf-8") as f:
-            rdocs = f.read()
-    except OSError as e:
-        return [f"cannot read {RING_DOCS}: {e}"]
-    for path, keys in (
-            (os.path.join(REPO, "bench.py"), RING_BENCH_KEYS),
-            (os.path.join(REPO, "tools", "bench_flash_longseq.py"),
-             RING_BWD_BENCH_KEYS)):
-        try:
-            with open(path, "r", encoding="utf-8") as f:
-                src = f.read()
-        except OSError as e:
-            errors.append(f"cannot read {path}: {e}")
-            continue
-        for key in keys:
-            if f'"{key}"' not in src:
-                errors.append(
-                    f"ring bench key {key!r} not emitted by "
-                    f"{os.path.basename(path)} (frozen RING_BENCH_KEYS/"
-                    "RING_BWD_BENCH_KEYS drifted)")
-            if f"`{key}`" not in rdocs:
-                errors.append(f"ring bench key {key!r} not documented in "
-                              f"{os.path.basename(RING_DOCS)}")
-    return errors
+    return _vocab_check([
+        VocabSpec(name="RING_BENCH_KEYS", expected=RING_BENCH_KEYS,
+                  docs_path=RING_DOCS,
+                  source_keys=[(_BENCH, RING_BENCH_KEYS)]),
+        VocabSpec(name="RING_BWD_BENCH_KEYS",
+                  expected=RING_BWD_BENCH_KEYS, docs_path=RING_DOCS,
+                  source_keys=[(os.path.join(REPO, "tools",
+                                             "bench_flash_longseq.py"),
+                                RING_BWD_BENCH_KEYS)]),
+    ])
 
 
 def check_router_serving() -> List[str]:
@@ -305,32 +305,17 @@ def check_router_serving() -> List[str]:
 
     from deepspeed_tpu.serving.metrics import RouterMetrics
 
-    errors = []
-    try:
-        with open(SERVING_DOCS, "r", encoding="utf-8") as f:
-            sdocs = f.read()
-    except OSError as e:
-        return [f"cannot read {SERVING_DOCS}: {e}"]
-    for m in RouterMetrics(n_replicas=2).registry.collect():
-        wildcard = re.sub(r"_r\d+_", "_r*_", m.name)
-        if f"`{m.name}`" not in sdocs and f"`{wildcard}`" not in sdocs:
-            errors.append(f"router metric {m.name!r} not documented in "
-                          f"{os.path.basename(SERVING_DOCS)}")
-    try:
-        with open(os.path.join(REPO, "bench.py"), "r",
-                  encoding="utf-8") as f:
-            bench_src = f.read()
-    except OSError as e:
-        return errors + [f"cannot read bench.py: {e}"]
-    for key in SERVE_MULTI_BENCH_KEYS:
-        if f'"{key}"' not in bench_src:
-            errors.append(f"serve_load_multi bench key {key!r} not emitted "
-                          "by bench.py (frozen SERVE_MULTI_BENCH_KEYS "
-                          "drifted)")
-        if f"`{key}`" not in sdocs:
-            errors.append(f"serve_load_multi bench key {key!r} not "
-                          f"documented in {os.path.basename(SERVING_DOCS)}")
-    return errors
+    names = [m.name for m in
+             RouterMetrics(n_replicas=2).registry.collect()]
+    return _vocab_check([
+        # registry-derived, so no frozen list — the docs contract only
+        VocabSpec(name="router metrics", doc_names=names,
+                  docs_path=SERVING_DOCS,
+                  doc_normalize=lambda n: re.sub(r"_r\d+_", "_r*_", n)),
+        VocabSpec(name="SERVE_MULTI_BENCH_KEYS",
+                  expected=SERVE_MULTI_BENCH_KEYS, docs_path=SERVING_DOCS,
+                  source_keys=[(_BENCH, SERVE_MULTI_BENCH_KEYS)]),
+    ])
 
 
 def check_autotuning() -> List[str]:
@@ -340,63 +325,71 @@ def check_autotuning() -> List[str]:
     keys."""
     from dataclasses import fields as dc_fields
 
-    from deepspeed_tpu.autotuning.overlap_scheduler import (EVIDENCE_KEYS,
-                                                            SCHEDULE_DECISIONS)
-    from deepspeed_tpu.runtime.config import StepScheduleConfig
+    def _decisions():
+        from deepspeed_tpu.autotuning.overlap_scheduler import \
+            SCHEDULE_DECISIONS
 
-    errors = []
-    if sorted(SCHEDULE_DECISIONS) != sorted(EXPECTED_SCHEDULE_DECISIONS):
-        errors.append(
-            "overlap_scheduler.SCHEDULE_DECISIONS drifted from the frozen "
-            f"list: extra={sorted(set(SCHEDULE_DECISIONS) - set(EXPECTED_SCHEDULE_DECISIONS))}, "
-            f"missing={sorted(set(EXPECTED_SCHEDULE_DECISIONS) - set(SCHEDULE_DECISIONS))}"
-            " — update EXPECTED_SCHEDULE_DECISIONS + docs/AUTOTUNING.md "
-            "together")
-    if sorted(EVIDENCE_KEYS) != sorted(EXPECTED_EVIDENCE_KEYS):
-        errors.append(
-            "overlap_scheduler.EVIDENCE_KEYS drifted from the frozen list: "
-            f"extra={sorted(set(EVIDENCE_KEYS) - set(EXPECTED_EVIDENCE_KEYS))}, "
-            f"missing={sorted(set(EXPECTED_EVIDENCE_KEYS) - set(EVIDENCE_KEYS))}")
-    ss_keys = sorted(f.name for f in dc_fields(StepScheduleConfig))
-    if ss_keys != EXPECTED_STEP_SCHEDULE_KEYS:
-        errors.append(
-            "StepScheduleConfig key set drifted from the frozen list: "
-            f"extra={sorted(set(ss_keys) - set(EXPECTED_STEP_SCHEDULE_KEYS))}, "
-            f"missing={sorted(set(EXPECTED_STEP_SCHEDULE_KEYS) - set(ss_keys))}"
-            " — update EXPECTED_STEP_SCHEDULE_KEYS + the docs config table")
-    try:
-        with open(AUTOTUNING_DOCS, "r", encoding="utf-8") as f:
-            adocs = f.read()
-    except OSError as e:
-        return errors + [f"cannot read {AUTOTUNING_DOCS}: {e}"]
-    for name in (list(SCHEDULE_DECISIONS) + list(EVIDENCE_KEYS) + ss_keys
-                 + CAPTURE_REPORT_SCHED_KEYS):
-        if f"`{name}`" not in adocs:
-            errors.append(f"autotuning name {name!r} not documented in "
-                          f"{os.path.basename(AUTOTUNING_DOCS)}")
-    try:
-        with open(os.path.join(REPO, "bench.py"), "r",
-                  encoding="utf-8") as f:
-            bench_src = f.read()
-    except OSError as e:
-        return errors + [f"cannot read bench.py: {e}"]
-    for key in AUTOSCHED_BENCH_KEYS:
-        if f'"{key}"' not in bench_src:
-            errors.append(f"autosched bench key {key!r} not emitted by "
-                          "bench.py (frozen AUTOSCHED_BENCH_KEYS drifted)")
-        if f"`{key}`" not in adocs:
-            errors.append(f"autosched bench key {key!r} not documented in "
-                          f"{os.path.basename(AUTOTUNING_DOCS)}")
-    # the observability capture-report section must point readers at the
-    # scheduler that consumes it (cross-link contract, like QUANT)
-    try:
-        with open(DOCS, "r", encoding="utf-8") as f:
-            if "AUTOTUNING.md" not in f.read():
-                errors.append("docs/OBSERVABILITY.md does not cross-link "
-                              "AUTOTUNING.md from its capture section")
-    except OSError as e:
-        errors.append(f"cannot read {DOCS}: {e}")
-    return errors
+        return SCHEDULE_DECISIONS
+
+    def _evidence():
+        from deepspeed_tpu.autotuning.overlap_scheduler import EVIDENCE_KEYS
+
+        return EVIDENCE_KEYS
+
+    def _ss_keys():
+        from deepspeed_tpu.runtime.config import StepScheduleConfig
+
+        return sorted(f.name for f in dc_fields(StepScheduleConfig))
+
+    return _vocab_check([
+        VocabSpec(name="overlap_scheduler.SCHEDULE_DECISIONS",
+                  expected=EXPECTED_SCHEDULE_DECISIONS, actual=_decisions,
+                  docs_path=AUTOTUNING_DOCS),
+        VocabSpec(name="overlap_scheduler.EVIDENCE_KEYS",
+                  expected=EXPECTED_EVIDENCE_KEYS, actual=_evidence,
+                  docs_path=AUTOTUNING_DOCS),
+        VocabSpec(name="StepScheduleConfig keys",
+                  expected=EXPECTED_STEP_SCHEDULE_KEYS, actual=_ss_keys,
+                  docs_path=AUTOTUNING_DOCS),
+        VocabSpec(name="AUTOSCHED_BENCH_KEYS",
+                  expected=AUTOSCHED_BENCH_KEYS, docs_path=AUTOTUNING_DOCS,
+                  source_keys=[(_BENCH, AUTOSCHED_BENCH_KEYS)]),
+        VocabSpec(name="capture report scheduler keys",
+                  expected=CAPTURE_REPORT_SCHED_KEYS,
+                  docs_path=AUTOTUNING_DOCS),
+    ]) + _cross_link(DOCS, "AUTOTUNING.md", "capture")
+
+
+def check_graph_audit() -> List[str]:
+    """Static-graph-audit vocabulary: finding kinds / severities / report
+    key sets match deepspeed_tpu/analysis/report.py, every name is
+    documented in docs/STATIC_ANALYSIS.md, and the autotuning docs
+    cross-link the census-in-evidence field."""
+    from deepspeed_tpu.analysis import (AUDIT_REPORT_KEYS, CENSUS_KEYS,
+                                        DONATION_KEYS, FINDING_KEYS,
+                                        FINDING_KINDS, SEVERITIES)
+
+    return _vocab_check([
+        VocabSpec(name="analysis.FINDING_KINDS",
+                  expected=EXPECTED_FINDING_KINDS,
+                  actual=lambda: FINDING_KINDS, docs_path=STATIC_DOCS),
+        VocabSpec(name="analysis.SEVERITIES",
+                  expected=EXPECTED_AUDIT_SEVERITIES,
+                  actual=lambda: SEVERITIES, docs_path=STATIC_DOCS),
+        VocabSpec(name="analysis.AUDIT_REPORT_KEYS",
+                  expected=EXPECTED_AUDIT_REPORT_KEYS,
+                  actual=lambda: AUDIT_REPORT_KEYS, docs_path=STATIC_DOCS),
+        VocabSpec(name="analysis.CENSUS_KEYS",
+                  expected=EXPECTED_AUDIT_CENSUS_KEYS,
+                  actual=lambda: CENSUS_KEYS, docs_path=STATIC_DOCS),
+        VocabSpec(name="analysis.FINDING_KEYS",
+                  expected=EXPECTED_AUDIT_FINDING_KEYS,
+                  actual=lambda: FINDING_KEYS, docs_path=STATIC_DOCS),
+        VocabSpec(name="analysis.DONATION_KEYS",
+                  expected=EXPECTED_AUDIT_DONATION_KEYS,
+                  actual=lambda: DONATION_KEYS, docs_path=STATIC_DOCS),
+    ]) + _cross_link(AUTOTUNING_DOCS, "STATIC_ANALYSIS.md",
+                     "census-in-evidence")
 
 
 def validate_chrome_trace(obj: Any) -> List[str]:
@@ -467,7 +460,7 @@ def run_all() -> List[str]:
     return (check_tags_documented() + check_schema() + check_span_names()
             + check_quant_comm() + check_ring_bench()
             + check_router_serving() + check_autotuning()
-            + check_trace_export())
+            + check_graph_audit() + check_trace_export())
 
 
 def main() -> int:
