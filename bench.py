@@ -292,6 +292,47 @@ def _autosched_run(model, config, batch, steps, seq):
     return tps, losses
 
 
+def _autosched_fused_ab(model, static_cfg, batch, steps, seq):
+    """Fused-vs-scheduled gather A/B → the frozen
+    fused_gather_loss_delta / fused_gather_wire_bytes keys.  Both sides
+    run IDENTICAL data; the fused engine's all-gather wire bytes come
+    from the static census (analysis.collective_census_engine)."""
+    import copy
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.analysis.auditor import collective_census_engine
+
+    def variant(fused):
+        cfg = copy.deepcopy(static_cfg)
+        cfg["zero_optimization"] = {
+            **cfg.get("zero_optimization", {}),
+            "param_persistence_threshold": 0}
+        cfg["step_schedule"] = {"gather_prefetch_depth": 2,
+                                "fused_gather_matmul": fused}
+        return cfg
+
+    engine, _, _, _ = ds.initialize(model=model, config=variant(False))
+    losses_sched = [_sync(engine.train_batch(batch)) for _ in range(steps)]
+    engine.destroy()
+    _reset_topology()
+
+    engine, _, _, _ = ds.initialize(model=model, config=variant(True))
+    assert engine.model_config.fused_gather_matmul, \
+        "fused gather-matmul gate did not engage"
+    losses_fused = [_sync(engine.train_batch(batch)) for _ in range(steps)]
+    census = collective_census_engine(engine)
+    assert census["fused_collective"]["gather_matmul"]["present"]
+    gather_bytes = int(census.get("all-gather", {}).get("wire_bytes", 0))
+    engine.destroy()
+    _reset_topology()
+    return {
+        "fused_gather_loss_delta": round(
+            max(abs(a - b) for a, b in zip(losses_fused, losses_sched)),
+            6),
+        "fused_gather_wire_bytes": gather_bytes,
+    }
+
+
 def _autosched_body():
     """Overlap-driven step scheduling (autotuning/overlap_scheduler.py;
     docs/AUTOTUNING.md): the SAME model/data trained under the static
@@ -349,6 +390,14 @@ def _autosched_body():
     assert tuned_cfg["step_schedule"]["mode"] == "pinned"
     tps_tuned, losses_t = _autosched_run(model, tuned_cfg, batch, steps, seq)
 
+    # fused-vs-scheduled gather A/B (the fused_gather_matmul decision
+    # arm's two sides on identical data; docs/AUTOTUNING.md): scheduled
+    # = prefetch-depth-2 unroll, fused = the gather-matmul MLP region
+    # (ops/pallas/gather_matmul.py).  Persistence is forced off so the
+    # MLP weights actually shard at smoke geometry (the 350m row's MLP
+    # crosses the default threshold on its own).
+    fused_ab = _autosched_fused_ab(model, static_cfg, batch, steps, seq)
+
     fired = sorted({d.decision for d in decisions} - {"noop"})
     ev = decisions[0].evidence
     return {
@@ -366,6 +415,7 @@ def _autosched_body():
         "decisions": [d.to_dict() for d in decisions],
         "loss_final_static": round(losses_s[-1], 5),
         "loss_final_tuned": round(losses_t[-1], 5),
+        **fused_ab,
         "telemetry_jsonl": _telemetry_jsonl(name),
         "trace_json": _trace_json(name),
     }
@@ -540,6 +590,79 @@ def row_longseq_llama():
     return _longseq_row(model, 4, "llama_d128")
 
 
+def _ring_wire_ab():
+    """Per-hop fused-vs-scheduled wire A/B (comm_quantization.
+    ring_rotation; docs/RING_ATTENTION.md): int8 quantized rotation vs
+    the fp32 wire.  Wire bytes are CENSUS-verified via
+    analysis.collective_census_engine on twin engines (the static HLO
+    parse of every collective-permute — the ratio is geometry-
+    independent, so the census twins stay small), and loss parity runs
+    on IDENTICAL data at a long-sequence smoke (per-position V-wire
+    noise enters the loss ~1/S, so the longseq regime is where the row
+    lives anyway) with fp32 compute so the delta is pure wire error."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.analysis.auditor import collective_census_engine
+    from deepspeed_tpu.models import get_model_config
+
+    def build(wire, seq):
+        model = get_model_config("llama-tiny", max_seq_len=seq,
+                                 seq_impl="ring",
+                                 ring_placement="striped",
+                                 attn_impl="xla")
+        cfg = {
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 2},
+            "gradient_clipping": 1.0,
+            "mesh": {"seq": 4},
+            "steps_per_print": 10_000,
+        }
+        if wire != "fp32":
+            cfg["comm_quantization"] = {"enabled": True,
+                                        "ring_rotation": wire}
+        engine, _, _, _ = ds.initialize(model=model, config=cfg)
+        return engine, model
+
+    wire_bytes = {}
+    for wire in ("fp32", "int8"):
+        engine, _ = build(wire, 256)
+        census = collective_census_engine(engine)
+        wire_bytes[wire] = int(census.get("collective-permute",
+                                          {}).get("wire_bytes", 0))
+        if wire == "int8":
+            fused = census["fused_collective"]["ring_rotation"]
+            assert fused["present"] and fused["wire"] == "int8", fused
+        engine.destroy()
+        _reset_topology()
+
+    seq, steps = 2048, 2
+    losses = {}
+    for wire in ("fp32", "int8"):
+        engine, model = build(wire, seq)
+        rows = engine.topology.dp_size
+        rng = np.random.default_rng(6)  # IDENTICAL data across wires
+        ids = rng.integers(0, model.vocab_size, size=(rows, seq + 1),
+                           dtype=np.int32)
+        batch = {"input_ids": ids[:, :-1],
+                 "labels": ids[:, 1:].astype(np.int32)}
+        losses[wire] = [_sync(engine.train_batch(batch))
+                        for _ in range(steps)]
+        engine.destroy()
+        _reset_topology()
+
+    loss_delta = max(abs(a - b) for a, b in zip(losses["int8"],
+                                                losses["fp32"]))
+    return {
+        "ring_wire_bytes_fp32": wire_bytes["fp32"],
+        "ring_wire_bytes_quant": wire_bytes["int8"],
+        "ring_wire_reduction": round(
+            wire_bytes["fp32"] / wire_bytes["int8"], 2)
+        if wire_bytes["int8"] else 0.0,
+        "ring_loss_delta": round(loss_delta, 6),
+    }
+
+
 def _longseq_ring_body():
     """Ring context parallelism measured for real: llama-class geometry
     with the sequence sharded over a "seq" mesh ring — striped block
@@ -604,13 +727,26 @@ def _longseq_ring_body():
     mfu = _mfu(tps_chip, model, seq)
     from deepspeed_tpu.sequence.ring import _kernel_enabled
 
+    ring_bwd = "fused" if _kernel_enabled() else "xla"
+    # quantize-into-ppermute A/B (after the main engine is torn down —
+    # the A/B builds its own twins); the XLA wire codec is gate-
+    # independent, so drop the smoke's interpreter flag first: the
+    # interpreted Pallas kernels at the A/B's 2048-seq loss run would
+    # crawl, and the wire bytes/parity they'd measure are identical
+    if SMOKE:
+        import importlib
+
+        importlib.import_module(
+            "deepspeed_tpu.ops.pallas.flash_mha").INTERPRET = False
+    wire_ab = _ring_wire_ab()
     return {
         "metric": f"longseq_{seq}_ring_sp{sp}_train_tokens_per_sec_per_chip",
         "value": round(tps_chip, 1), "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.55, 3),
         "mfu": round(mfu, 3),
         "placement": "striped",
-        "ring_backward": "fused" if _kernel_enabled() else "xla",
+        "ring_backward": ring_bwd,
+        **wire_ab,
         "telemetry_jsonl": _telemetry_jsonl("longseq_ring"),
         "trace_json": _trace_json("longseq_ring"),
     }

@@ -429,6 +429,16 @@ def intent_for_engine(engine) -> AuditIntent:
         if seq_impl == "ring":
             expected.add("collective-permute")
             required.setdefault("collective-permute", ())
+            ring_wire = getattr(engine.model_config, "ring_wire_dtype",
+                                "fp32")
+            if ring_wire != "fp32":
+                # quantized ring rotation (comm_quantization.ring_rotation):
+                # the K/V payload moves s8 (int8) or u8 (fp8 bitcast) —
+                # a DECLARED narrow wire, not a wire_dtype_mismatch; the
+                # fp32-wire rotation's u32 word-packing must be gone
+                # (the small fp32 scale messages stay legitimate)
+                required["collective-permute"] = ("s8", "u8")
+                banned["collective-permute"] = ("u32",)
         else:   # ulysses/alst head<->seq exchanges
             expected.add("all-to-all")
     if ep > 1:
@@ -473,6 +483,49 @@ def audit_v2_engine(v2, phase: str = "decode",
     return audit(fn, *args, label=label or f"v2_{phase}", intent=intent)
 
 
+def fused_collective_intent(engine) -> Dict[str, Dict[str, Any]]:
+    """Which compute-collective FUSIONS the engine's gates declare —
+    the hops that are no longer scheduled around but folded into their
+    producing/consuming compute (docs/STATIC_ANALYSIS.md):
+
+    * ``ring_rotation`` — quantized ring wire
+      (comm_quantization.ring_rotation; sequence/ring.py): the
+      collective-permute payload narrowed + dequant in the flash
+      epilogue.
+    * ``gather_matmul`` — step_schedule.fused_gather_matmul
+      (ops/pallas/gather_matmul.py): MLP param all-gathers issued from
+      the matmul region.
+    * ``reduce_scatter_epilogue`` — step_schedule.fused_reduce_scatter:
+      explicit per-leaf psum_scatter in the grad-accumulator epilogue.
+    """
+    out: Dict[str, Dict[str, Any]] = {}
+    mc = getattr(engine, "model_config", None)
+    sp = getattr(engine.topology, "sp_size", 1)
+    if (mc is not None and sp > 1
+            and getattr(mc, "seq_impl", "") == "ring"
+            and getattr(mc, "ring_wire_dtype", "fp32") != "fp32"):
+        out["ring_rotation"] = {"kind": "collective-permute",
+                                "wire": mc.ring_wire_dtype}
+    if mc is not None and getattr(mc, "fused_gather_matmul", False):
+        out["gather_matmul"] = {"kind": "all-gather",
+                                "axes": list(mc.fused_gather_axes)}
+    if getattr(engine, "_fused_rs", False):
+        out["reduce_scatter_epilogue"] = {"kind": "reduce-scatter"}
+    return out
+
+
 def collective_census_engine(engine) -> Dict[str, Dict[str, Any]]:
-    """Compact census for the overlap scheduler's pinned evidence."""
-    return audit_engine(engine, label="census_probe").census_summary()
+    """Compact census for the overlap scheduler's pinned evidence.
+
+    On top of the per-kind rollup, a ``fused_collective`` entry records
+    which hops are FUSED (gate-declared) vs merely scheduled, each with
+    ``present`` = whether a matching collective kind materialized in the
+    lowered step — so pinned ``static_census`` evidence distinguishes a
+    fused wire from a scheduled one."""
+    report = audit_engine(engine, label="census_probe")
+    summary = report.census_summary()
+    fused = fused_collective_intent(engine)
+    summary["fused_collective"] = {
+        name: {**info, "present": info["kind"] in summary}
+        for name, info in sorted(fused.items())}
+    return summary

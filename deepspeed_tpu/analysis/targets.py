@@ -12,7 +12,13 @@ target                 bench row(s) whose step it audits
 ``train_zero3``        llama8b_class_zero3 / peak_params base rungs
 ``train_commquant``    gpt2_350m_commquant (int8 quantized DP reduce)
 ``train_autosched``    gpt2_350m_autosched (pinned zero3_prefetch)
+``train_fused_rs``     gpt2_350m_autosched fused A/B (decomposed +
+                       fused reduce-scatter epilogue)
+``train_fused_gather`` gpt2_350m_autosched fused A/B (stage-3 fused
+                       gather-matmul MLP)
 ``ring_attention``     longseq_ring (ring fwd+bwd on the 2×4 mesh)
+``ring_attention_quant``  longseq_ring quantized-wire A/B (int8
+                       ring_rotation)
 ``v2_decode``          v2_decode / serve_load* (16-token decode step)
 ``v2_prefill``         v2_decode / serve_load* (full-budget prefill)
 =====================  ==============================================
@@ -92,15 +98,13 @@ def target_train_autosched() -> GraphAuditReport:
                        "param_persistence_threshold": 100_000})
 
 
-def target_ring_attention() -> GraphAuditReport:
-    """longseq_ring twin: jitted ring fwd+bwd on the 2(data)×4(seq)
-    mesh — the census must carry the ring's collective-permute hops and
-    nothing unexplained."""
+def _audit_ring(label: str, wire_dtype: str,
+                intent) -> GraphAuditReport:
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from deepspeed_tpu.analysis.auditor import AuditIntent, audit
+    from deepspeed_tpu.analysis.auditor import audit
     from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
     from deepspeed_tpu.sequence.ring import ring_attention
 
@@ -113,20 +117,69 @@ def target_ring_attention() -> GraphAuditReport:
 
         def fwd_bwd(q, k, v):
             def loss(q, k, v):
-                return ring_attention(q, k, v, topo).astype(
-                    jnp.float32).sum()
+                return ring_attention(
+                    q, k, v, topo, wire_dtype=wire_dtype).astype(
+                        jnp.float32).sum()
             l, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
             return l, grads
 
-        intent = AuditIntent(
-            expected=frozenset({"collective-permute", "all-reduce",
-                                "all-gather", "reduce-scatter"}),
-            required={"collective-permute": ()})
-        return audit(jax.jit(fwd_bwd), q, q, q, label="ring_attention",
+        return audit(jax.jit(fwd_bwd), q, q, q, label=label,
                      intent=intent)
     finally:
         set_topology(None)
         _reset_topology()
+
+
+def target_ring_attention() -> GraphAuditReport:
+    """longseq_ring twin: jitted ring fwd+bwd on the 2(data)×4(seq)
+    mesh — the census must carry the ring's collective-permute hops and
+    nothing unexplained."""
+    from deepspeed_tpu.analysis.auditor import AuditIntent
+
+    intent = AuditIntent(
+        expected=frozenset({"collective-permute", "all-reduce",
+                            "all-gather", "reduce-scatter"}),
+        required={"collective-permute": ()})
+    return _audit_ring("ring_attention", "fp32", intent)
+
+
+def target_ring_attention_quant() -> GraphAuditReport:
+    """Quantized-wire longseq_ring twin (comm_quantization.ring_rotation
+    = int8): the rotation's collective-permutes must move s8 payloads —
+    the fp32-wire u32 word-packing is BANNED at volume, and an s8
+    permute is required (the fused-wire declaration the auditor's
+    intent_for_engine derives for quantized ring engines)."""
+    from deepspeed_tpu.analysis.auditor import AuditIntent
+
+    intent = AuditIntent(
+        expected=frozenset({"collective-permute", "all-reduce",
+                            "all-gather", "reduce-scatter"}),
+        required={"collective-permute": ("s8",)},
+        banned={"collective-permute": ("u32",)})
+    return _audit_ring("ring_attention_quant", "int8", intent)
+
+
+def target_train_fused_rs() -> GraphAuditReport:
+    """Fused reduce-scatter twin (step_schedule.fused_reduce_scatter +
+    decomposed update at stage 1): the explicit per-leaf psum_scatter in
+    the grad-accumulator epilogue must audit clean — reduce-scatter is
+    declared intent on the decomposed path."""
+    return _audit_train(
+        "train_fused_rs",
+        step_schedule={"weight_update": "decomposed",
+                       "fused_reduce_scatter": True})
+
+
+def target_train_fused_gather() -> GraphAuditReport:
+    """Fused gather-matmul twin (step_schedule.fused_gather_matmul at
+    stage 3, persistence off so the tiny MLP weights actually shard):
+    the explicit in-region all-gathers must audit clean — all-gather is
+    declared stage-3 intent either way; this pins that the fused path
+    introduces nothing unexplained."""
+    return _audit_train(
+        "train_fused_gather", bf16={"enabled": True},
+        zero_optimization={"stage": 3, "param_persistence_threshold": 0},
+        step_schedule={"fused_gather_matmul": True})
 
 
 def _audit_v2(phase: str) -> GraphAuditReport:
@@ -164,7 +217,10 @@ BENCH_AUDIT_TARGETS: Dict[str, Callable[[], GraphAuditReport]] = {
     "train_zero3": target_train_zero3,
     "train_commquant": target_train_commquant,
     "train_autosched": target_train_autosched,
+    "train_fused_rs": target_train_fused_rs,
+    "train_fused_gather": target_train_fused_gather,
     "ring_attention": target_ring_attention,
+    "ring_attention_quant": target_ring_attention_quant,
     "v2_decode": target_v2_decode,
     "v2_prefill": target_v2_prefill,
 }

@@ -56,8 +56,8 @@ from deepspeed_tpu.utils.logging import logger
 
 # Frozen decision vocabulary — linted against docs/AUTOTUNING.md by
 # tools/telemetry_check.py (same contract as the telemetry span names).
-SCHEDULE_DECISIONS = ("decomposed_update", "noop", "ring_interleave",
-                      "zero3_prefetch")
+SCHEDULE_DECISIONS = ("decomposed_update", "fused_gather_matmul", "noop",
+                      "ring_interleave", "zero3_prefetch")
 
 # Frozen evidence key set: every ScheduleDecision carries exactly these.
 # `static_census` is the graph auditor's per-kind collective rollup
@@ -216,6 +216,22 @@ def decide(report: Dict[str, Any], context: Dict[str, Any],
         updates.update(knobs)
         decisions.append(ScheduleDecision("zero3_prefetch", knobs, ev))
 
+    # (a') ZeRO-3 fused gather-matmul: the scheduled arm is exhausted
+    # (prefetch depth already widened by a previous probe) and the
+    # exposed collective is still the param gather → stop scheduling
+    # around it and FUSE it — the layer MLP's explicit shard_map region
+    # issues the following matmul's all-gather itself
+    # (ops/pallas/gather_matmul.py).  Fused vs scheduled is thus one
+    # decision table: first probe deepens prefetch, a still-low second
+    # probe flips to fused.
+    if (low and context.get("zero_stage", 0) >= 3
+            and "gather" in dom
+            and int(base.get("gather_prefetch_depth", 1)) >= 2
+            and not base.get("fused_gather_matmul", False)):
+        knobs = {"fused_gather_matmul": True}
+        updates.update(knobs)
+        decisions.append(ScheduleDecision("fused_gather_matmul", knobs, ev))
+
     # (b) ring hop/compute interleave: an exposed ring rotation → issue
     # the next hop's permute before the current hop's attend.
     if (low and context.get("sp", 1) > 1
@@ -313,6 +329,7 @@ class OverlapScheduler:
                 "prefetch_bucket_size": bucket,
                 "ring_interleave": ss.ring_interleave,
                 "weight_update": ss.weight_update,
+                "fused_gather_matmul": ss.fused_gather_matmul,
             },
         }
 

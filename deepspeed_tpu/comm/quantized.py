@@ -61,6 +61,40 @@ _FP8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
 _FP8_MAX = 448.0  # e4m3fn finite max
 
 
+def wire_encode_rows(x, wire_dtype: str):
+    """Encode a ``[..., d]`` buffer for the wire with ONE fp32 scale per
+    trailing-dim row (the quantization block IS the trailing dim — the
+    layout the ring rotation and the flash dequant epilogue share).
+
+    Returns ``(payload, scale)``: payload has ``x``'s shape (int8, or fp8
+    bitcast to uint8), ``scale`` is fp32 ``x.shape[:-1] + (1,)``; both are
+    ``(x, None)`` for fp32.  Always routes the jnp codec so GSPMD/manual
+    call sites partition it freely (same reasoning as qwz_weight_gather's
+    backend="jnp").
+    """
+    if wire_dtype == "fp32":
+        return x, None
+    d = x.shape[-1]
+    x2 = x.astype(jnp.float32).reshape(-1, d)
+    payload, scale = _wire_encode(x2, wire_dtype, d, backend="jnp")
+    return (payload.reshape(x.shape),
+            scale.reshape(x.shape[:-1] + (1,)))
+
+
+def wire_decode_rows(payload, scale, wire_dtype: str):
+    """Inverse of :func:`wire_encode_rows`; always returns fp32.  The
+    int8 branch is element-for-element the multiply the Pallas flash
+    epilogue performs (``ops/pallas/flash_mha.wire_dequant_rows``), so
+    the kernel and XLA wire codecs are the same arithmetic — pinned by
+    the codec-parity test in tests/test_fused_collectives.py."""
+    if wire_dtype == "fp32":
+        return payload
+    d = payload.shape[-1]
+    out = _wire_decode(payload.reshape(-1, d),
+                       scale.reshape(-1, 1), wire_dtype, backend="jnp")
+    return out.reshape(payload.shape)
+
+
 def fp8_supported() -> bool:
     return _FP8_DTYPE is not None
 

@@ -168,8 +168,21 @@ class TransformerConfig:
     # hop's ppermute issued before the current hop's attend so the
     # transfer overlaps the hop's kernels)
     ring_interleave: int = 1
+    # ring rotation wire dtype (comm_quantization.ring_rotation; set by
+    # the engine): "fp32" | "int8" | "fp8" — quantized payloads + fp32
+    # per-row scales travel every ring hop, dequantized in the consuming
+    # flash kernel's epilogue (sequence/ring.py)
+    ring_wire_dtype: str = "fp32"
     # layer-scan unroll factor (XLA overlaps across unrolled iterations)
     scan_unroll: int = 1
+    # ZeRO-3 fused gather-matmul (step_schedule.fused_gather_matmul;
+    # ops/pallas/gather_matmul.py): the MLP matmuls run inside an
+    # explicit shard_map over `fused_gather_axes` that issues the
+    # following matmul's param all-gather ahead of the current one.  Set
+    # by the engine after it verifies the MLP weights actually carry the
+    # expected fsdp sharding pattern.
+    fused_gather_matmul: bool = False
+    fused_gather_axes: Tuple[str, ...] = ()
     # residual/embedding dropout rate (GPT-2/BERT-class training; llama
     # pretraining leaves it 0).  Applied when the engine threads a
     # per-step PRNG key through the batch ("dropout_key"); inference and
@@ -595,7 +608,8 @@ def _attn_block(x, p, positions, cfg: TransformerConfig,
                              sm_scale=cfg.attn_scale,
                              window=cfg.sliding_window or None,
                              placement=cfg.ring_placement,
-                             interleave=cfg.ring_interleave)
+                             interleave=cfg.ring_interleave,
+                             wire_dtype=cfg.ring_wire_dtype)
         out = out.reshape(b, s, nh * d)
         out = out @ p["wo"].astype(dt)
         if p.get("bo") is not None:
@@ -643,6 +657,21 @@ def _mlp_block(x, p, cfg: TransformerConfig):
     dt0 = x.dtype
     dt = _module_dtype(cfg, "mlp", dt0)
     x = x.astype(dt)
+    if cfg.fused_gather_matmul and cfg.fused_gather_axes:
+        # ZeRO-3 fused gather-matmul (step_schedule.fused_gather_matmul;
+        # ops/pallas/gather_matmul.py): explicit shard_map over the fsdp
+        # axes — the following matmul's param all-gather issues inside
+        # the current matmul's epilogue region instead of wherever GSPMD
+        # scheduled it.  The engine verified the weight sharding pattern
+        # before setting the flag; the tiny output bias stays on the
+        # implicit path (bi rides the fused region — it must add before
+        # the activation).
+        from deepspeed_tpu.ops.pallas.gather_matmul import fused_gather_mlp
+
+        y = fused_gather_mlp(x, p, cfg)
+        if p.get("bo") is not None:
+            y = y + p["bo"].astype(dt)
+        return y.astype(dt0)
     if cfg.activation == "swiglu":
         gate = jax.nn.silu(x @ p["wg"].astype(dt))
         up = x @ p["wi"].astype(dt)

@@ -457,10 +457,27 @@ def _dkv_kernel_blocked(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # the offset arithmetic — under striped placement every hop is ~half
 # dead, which is exactly the ring causal-load-balancing win.
 # ----------------------------------------------------------------------
-def _carry_kernel(info_ref, q_ref, k_ref, v_ref, mi_ref, li_ref, acci_ref,
-                  mo_ref, lo_ref, acco_ref, m_scr, l_scr, acc_scr, *,
+def wire_dequant_rows(payload, scale_col):
+    """The flash kernels' wire-dequant epilogue: int8 payload rows ×
+    their per-row fp32 scale.  Exactly the arithmetic of
+    ``comm/quantized.wire_decode_rows``'s int8 branch (one fp32 multiply
+    per element after an int8→fp32 convert), shared here so the Pallas
+    and XLA wire codecs can never drift — pinned bitwise by the
+    codec-parity test.  ``payload [rows, d]`` int8, ``scale_col
+    [rows, 1]`` fp32 → fp32 ``[rows, d]``."""
+    return payload.astype(jnp.float32) * scale_col
+
+
+def _carry_kernel(info_ref, *refs,
                   sm_scale, causal, window, bq, bk, q_stride, k_stride,
-                  s_real):
+                  s_real, quantized=False):
+    if quantized:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, mi_ref, li_ref, acci_ref,
+         mo_ref, lo_ref, acco_ref, m_scr, l_scr, acc_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, mi_ref, li_ref, acci_ref,
+         mo_ref, lo_ref, acco_ref, m_scr, l_scr, acc_scr) = refs
+        ks_ref = vs_ref = None
     iq = pl.program_id(2)
     ik = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -484,6 +501,14 @@ def _carry_kernel(info_ref, q_ref, k_ref, v_ref, mi_ref, li_ref, acci_ref,
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
+        if ks_ref is not None:
+            # wire-dequant epilogue: the visiting K/V block traveled the
+            # ring as int8 payload + per-row fp32 scales; dequantize in
+            # VMEM and promote the whole tile to fp32 (the XLA fallback
+            # computes from the same decoded fp32 values)
+            k = wire_dequant_rows(k, ks_ref[0, 0][:, 0:1])
+            v = wire_dequant_rows(v, vs_ref[0, 0][:, 0:1])
+            q = q.astype(jnp.float32)
         s = _scores(q, k, sm_scale)
         if masked:
             valid = _ring_tile_mask(
@@ -536,7 +561,7 @@ def ring_carry_pad(s_l: int) -> int:
 
 def flash_carry_block(q, k, v, m, l, acc, q_off, k_off, *, q_stride=1,
                       k_stride=1, s_real=None, sm_scale=None, causal=True,
-                      window=None):
+                      window=None, k_scale=None, v_scale=None):
     """One ring hop: online-softmax update of ``(m, l, acc)`` against the
     visiting K/V block, fused in a single Pallas pass (no materialized
     score matrix in HBM).
@@ -548,6 +573,12 @@ def flash_carry_block(q, k, v, m, l, acc, q_off, k_off, *, q_stride=1,
     offsets of the two blocks; ``q_stride/k_stride``: static position
     strides (1 = contiguous shards, sp = striped placement).  S_pad must
     be ``ring_carry_pad(s_real)``.  Returns updated ``(m, l, acc)``.
+
+    ``k_scale/v_scale`` (both or neither): quantized ring wire — ``k/v``
+    are then the int8 payloads that traveled the ring and the scales are
+    the per-row fp32 block scales, lane-replicated ``[B, Hkv, S_pad,
+    128]``; dequant happens in the kernel epilogue
+    (:func:`wire_dequant_rows`), so no fp32 K/V copy ever exists in HBM.
     """
     b, hq, s_pad, d = q.shape
     hkv = k.shape[1]
@@ -558,6 +589,10 @@ def flash_carry_block(q, k, v, m, l, acc, q_off, k_off, *, q_stride=1,
     if s_pad % bq:
         raise ValueError(f"S_pad={s_pad} not a multiple of the ring block "
                          f"({bq}); pad with ring_carry_pad")
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("flash_carry_block: k_scale and v_scale must be "
+                         "passed together")
     info = jnp.stack([jnp.asarray(q_off, jnp.int32),
                       jnp.asarray(k_off, jnp.int32)])
     grid = (b, hq, s_pad // bq, s_pad // bk)
@@ -565,17 +600,25 @@ def flash_carry_block(q, k, v, m, l, acc, q_off, k_off, *, q_stride=1,
                           lambda ib, ih, iq, ik: (ib, ih, iq, 0))
     kv_spec = pl.BlockSpec((1, 1, bk, d),
                            lambda ib, ih, iq, ik: (ib, ih // group, ik, 0))
+    kv_lane_spec = pl.BlockSpec((1, 1, bk, 128),
+                                lambda ib, ih, iq, ik: (ib, ih // group,
+                                                        ik, 0))
     lane_spec = pl.BlockSpec((1, 1, bq, 128),
                              lambda ib, ih, iq, ik: (ib, ih, iq, 0))
+    scale_args = (k_scale, v_scale) if quantized else ()
+    scale_specs = [kv_lane_spec, kv_lane_spec] if quantized else []
+    carry0 = 4 + len(scale_args)   # (info, q, k, v, *scales, m, l, acc)
     return pl.pallas_call(
         functools.partial(_carry_kernel, sm_scale=sm_scale, causal=causal,
                           window=window, bq=bq, bk=bk, q_stride=q_stride,
-                          k_stride=k_stride, s_real=s_real),
+                          k_stride=k_stride, s_real=s_real,
+                          quantized=quantized),
         grid=grid,
         interpret=INTERPRET,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            q_spec, kv_spec, kv_spec, lane_spec, lane_spec, q_spec,
+            q_spec, kv_spec, kv_spec, *scale_specs,
+            lane_spec, lane_spec, q_spec,
         ],
         out_specs=[lane_spec, lane_spec, q_spec],
         out_shape=[
@@ -590,8 +633,8 @@ def flash_carry_block(q, k, v, m, l, acc, q_off, k_off, *, q_stride=1,
         ],
         # the carry is read once (ik == 0) and rewritten in place — alias
         # it through so the per-hop scan never copies the running state
-        input_output_aliases={4: 0, 5: 1, 6: 2},
-    )(info, q, k, v, m, l, acc)
+        input_output_aliases={carry0: 0, carry0 + 1: 1, carry0 + 2: 2},
+    )(info, q, k, v, *scale_args, m, l, acc)
 
 
 # ----------------------------------------------------------------------
@@ -653,9 +696,16 @@ def _ring_tile_mask(iq, ik, q_off, k_off, *, bq, bk, q_stride, k_stride,
     return valid
 
 
-def _ring_dq_kernel(info_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                    delta_ref, dqi_ref, dqo_ref, dq_scr, *, sm_scale,
-                    causal, window, bq, bk, q_stride, k_stride, s_real):
+def _ring_dq_kernel(info_ref, *refs, sm_scale,
+                    causal, window, bq, bk, q_stride, k_stride, s_real,
+                    quantized=False):
+    if quantized:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, do_ref, lse_ref,
+         delta_ref, dqi_ref, dqo_ref, dq_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref,
+         delta_ref, dqi_ref, dqo_ref, dq_scr) = refs
+        ks_ref = vs_ref = None
     iq = pl.program_id(2)
     ik = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -675,6 +725,13 @@ def _ring_dq_kernel(info_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
+        if ks_ref is not None:
+            # wire-dequant epilogue (see _carry_kernel): int8 payload +
+            # per-row scales in, fp32 tiles out
+            k = wire_dequant_rows(k, ks_ref[0, 0][:, 0:1])
+            v = wire_dequant_rows(v, vs_ref[0, 0][:, 0:1])
+            q = q.astype(jnp.float32)
+            do = do.astype(jnp.float32)
         lse = lse_ref[0, 0][:, 0:1]
         delta = delta_ref[0, 0][:, 0:1]
         s = _scores(q, k, sm_scale)
@@ -705,10 +762,17 @@ def _ring_dq_kernel(info_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dqo_ref[0, 0] = dq_scr[...]
 
 
-def _ring_dkv_kernel(info_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                     delta_ref, dki_ref, dvi_ref, dko_ref, dvo_ref,
-                     dk_scr, dv_scr, *, sm_scale, causal, window, bq, bk,
-                     q_stride, k_stride, s_real, group):
+def _ring_dkv_kernel(info_ref, *refs, sm_scale, causal, window, bq, bk,
+                     q_stride, k_stride, s_real, group, quantized=False):
+    if quantized:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, do_ref, lse_ref,
+         delta_ref, dki_ref, dvi_ref, dko_ref, dvo_ref,
+         dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref,
+         delta_ref, dki_ref, dvi_ref, dko_ref, dvo_ref,
+         dk_scr, dv_scr) = refs
+        ks_ref = vs_ref = None
     ik = pl.program_id(2)
     iq = pl.program_id(3)
     nq = pl.num_programs(3)
@@ -727,6 +791,10 @@ def _ring_dkv_kernel(info_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     def compute(masked):
         k = k_ref[0, 0]                                     # [bk, d]
         v = v_ref[0, 0]
+        if ks_ref is not None:
+            # wire-dequant epilogue (see _carry_kernel)
+            k = wire_dequant_rows(k, ks_ref[0, 0][:, 0:1])
+            v = wire_dequant_rows(v, vs_ref[0, 0][:, 0:1])
         if masked:
             valid = _ring_tile_mask(
                 iq, ik, q_off, k_off, bq=bq, bk=bk, q_stride=q_stride,
@@ -735,6 +803,9 @@ def _ring_dkv_kernel(info_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         for g in range(group):                              # static loop
             q = q_ref[0, g]                                 # [bq, d]
             do = do_ref[0, g]
+            if ks_ref is not None:
+                q = q.astype(jnp.float32)
+                do = do.astype(jnp.float32)
             lse = lse_ref[0, g][:, 0:1]
             delta = delta_ref[0, g][:, 0:1]
             s = _scores(q, k, sm_scale)                     # [bq, bk]
@@ -779,7 +850,8 @@ def _ring_bwd_blocks(s_pad: int, group: int):
 
 def flash_ring_dq_block(q, k, v, do, lse, delta, dq, q_off, k_off, *,
                         q_stride=1, k_stride=1, s_real=None, sm_scale=None,
-                        causal=True, window=None):
+                        causal=True, window=None, k_scale=None,
+                        v_scale=None):
     """One ring backward hop, dq side: accumulate this hop's dq
     contribution against the visiting K/V block into ``dq`` in place.
 
@@ -788,8 +860,11 @@ def flash_ring_dq_block(q, k, v, do, lse, delta, dq, q_off, k_off, *,
     (see :func:`bwd_lane_residuals`); ``dq [B, Hq, S_pad, D]`` fp32
     running accumulator, aliased through.  ``q_off/k_off`` traced int32
     global position offsets, ``q_stride/k_stride`` static strides — the
-    same contract as :func:`flash_carry_block`.  S_pad must be
-    ``ring_carry_pad(s_real)``.  Returns the updated ``dq``."""
+    same contract as :func:`flash_carry_block`, including the
+    ``k_scale/v_scale`` quantized-wire operands (int8 payload K/V +
+    lane-replicated per-row fp32 scales; dequant in the kernel).
+    S_pad must be ``ring_carry_pad(s_real)``.  Returns the updated
+    ``dq``."""
     b, hq, s_pad, d = q.shape
     hkv = k.shape[1]
     group = hq // hkv
@@ -799,6 +874,10 @@ def flash_ring_dq_block(q, k, v, do, lse, delta, dq, q_off, k_off, *,
     if s_pad % bq:
         raise ValueError(f"S_pad={s_pad} not a multiple of the ring block "
                          f"({bq}); pad with ring_carry_pad")
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("flash_ring_dq_block: k_scale and v_scale must "
+                         "be passed together")
     info = jnp.stack([jnp.asarray(q_off, jnp.int32),
                       jnp.asarray(k_off, jnp.int32)])
     grid = (b, hq, s_pad // bq, s_pad // bk)
@@ -806,36 +885,45 @@ def flash_ring_dq_block(q, k, v, do, lse, delta, dq, q_off, k_off, *,
                           lambda ib, ih, iq, ik: (ib, ih, iq, 0))
     kv_spec = pl.BlockSpec((1, 1, bk, d),
                            lambda ib, ih, iq, ik: (ib, ih // group, ik, 0))
+    kv_lane_spec = pl.BlockSpec((1, 1, bk, 128),
+                                lambda ib, ih, iq, ik: (ib, ih // group,
+                                                        ik, 0))
     lane_spec = pl.BlockSpec((1, 1, bq, 128),
                              lambda ib, ih, iq, ik: (ib, ih, iq, 0))
+    scale_args = (k_scale, v_scale) if quantized else ()
+    scale_specs = [kv_lane_spec, kv_lane_spec] if quantized else []
+    dq_idx = 7 + len(scale_args)
     return pl.pallas_call(
         functools.partial(_ring_dq_kernel, sm_scale=sm_scale, causal=causal,
                           window=window, bq=bq, bk=bk, q_stride=q_stride,
-                          k_stride=k_stride, s_real=s_real),
+                          k_stride=k_stride, s_real=s_real,
+                          quantized=quantized),
         grid=grid,
         interpret=INTERPRET,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            q_spec, kv_spec, kv_spec, q_spec, lane_spec, lane_spec, q_spec,
+            q_spec, kv_spec, kv_spec, *scale_specs,
+            q_spec, lane_spec, lane_spec, q_spec,
         ],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((b, hq, s_pad, d), jnp.float32),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         # dq is read once (ik == 0) and rewritten in place — the per-hop
         # scan never copies the accumulator
-        input_output_aliases={7: 0},
-    )(info, q, k, v, do, lse, delta, dq)
+        input_output_aliases={dq_idx: 0},
+    )(info, q, k, v, *scale_args, do, lse, delta, dq)
 
 
 def flash_ring_dkv_block(q, k, v, do, lse, delta, dk, dv, q_off, k_off, *,
                          q_stride=1, k_stride=1, s_real=None, sm_scale=None,
-                         causal=True, window=None):
+                         causal=True, window=None, k_scale=None,
+                         v_scale=None):
     """One ring backward hop, dk/dv side: accumulate this hop's grads for
     the VISITING K/V block into the traveling ``dk/dv`` buffers in place
     (they rotate with their block; sequence/ring.py delivers them home).
-    Same layout/offset contract as :func:`flash_ring_dq_block`;
-    ``dk/dv [B, Hkv, S_pad, D]`` fp32, aliased through.  Returns the
-    updated ``(dk, dv)``."""
+    Same layout/offset/quantized-wire contract as
+    :func:`flash_ring_dq_block`; ``dk/dv [B, Hkv, S_pad, D]`` fp32,
+    aliased through.  Returns the updated ``(dk, dv)``."""
     b, hq, s_pad, d = q.shape
     hkv = k.shape[1]
     group = hq // hkv
@@ -846,6 +934,10 @@ def flash_ring_dkv_block(q, k, v, do, lse, delta, dk, dv, q_off, k_off, *,
         raise ValueError(f"S_pad={s_pad} not a multiple of the ring "
                          f"backward blocks ({bq}, {bk}); pad with "
                          "ring_carry_pad")
+    quantized = k_scale is not None
+    if quantized != (v_scale is not None):
+        raise ValueError("flash_ring_dkv_block: k_scale and v_scale must "
+                         "be passed together")
     info = jnp.stack([jnp.asarray(q_off, jnp.int32),
                       jnp.asarray(k_off, jnp.int32)])
     grid = (b, hkv, s_pad // bk, s_pad // bq)   # iq innermost-sequential
@@ -855,17 +947,22 @@ def flash_ring_dkv_block(q, k, v, do, lse, delta, dk, dv, q_off, k_off, *,
                                  lambda ib, ihkv, ik, iq: (ib, ihkv, iq, 0))
     kv_spec = pl.BlockSpec((1, 1, bk, d),
                            lambda ib, ihkv, ik, iq: (ib, ihkv, ik, 0))
+    kv_lane_spec = pl.BlockSpec((1, 1, bk, 128),
+                                lambda ib, ihkv, ik, iq: (ib, ihkv, ik, 0))
+    scale_args = (k_scale, v_scale) if quantized else ()
+    scale_specs = [kv_lane_spec, kv_lane_spec] if quantized else []
+    dk_idx = 7 + len(scale_args)
     return pl.pallas_call(
         functools.partial(_ring_dkv_kernel, sm_scale=sm_scale,
                           causal=causal, window=window, bq=bq, bk=bk,
                           q_stride=q_stride, k_stride=k_stride,
-                          s_real=s_real, group=group),
+                          s_real=s_real, group=group, quantized=quantized),
         grid=grid,
         interpret=INTERPRET,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            grp_spec, kv_spec, kv_spec, grp_spec, grp_lane_spec,
-            grp_lane_spec, kv_spec, kv_spec,
+            grp_spec, kv_spec, kv_spec, *scale_specs, grp_spec,
+            grp_lane_spec, grp_lane_spec, kv_spec, kv_spec,
         ],
         out_specs=[kv_spec, kv_spec],
         out_shape=[
@@ -874,8 +971,8 @@ def flash_ring_dkv_block(q, k, v, do, lse, delta, dk, dv, q_off, k_off, *,
         ],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        input_output_aliases={7: 0, 8: 1},
-    )(info, q, k, v, do, lse, delta, dk, dv)
+        input_output_aliases={dk_idx: 0, dk_idx + 1: 1},
+    )(info, q, k, v, *scale_args, do, lse, delta, dk, dv)
 
 
 # ----------------------------------------------------------------------

@@ -527,6 +527,19 @@ class StepScheduleConfig:
       ``"decomposed"`` (shard optimizer state + grad accumulator over
       the ZeRO axes even at stage 0/1: reduce-scatter + 1/world update +
       params all-gather, arXiv:2004.13336).
+    * ``fused_gather_matmul`` — ZeRO-3 fused gather-matmul
+      (ops/pallas/gather_matmul.py): the layer MLP runs as an explicit
+      shard_map whose matmul region issues the following matmul's param
+      all-gather ahead of the current one, instead of leaving the
+      gathers to GSPMD scheduling (the T3 fusion, arXiv:2401.16677);
+      composes with ``gather_prefetch_depth``'s unroll window.
+      Warn-fallback to the scheduled path when the config is ineligible.
+    * ``fused_reduce_scatter`` — with ``weight_update="decomposed"``,
+      the train step accumulates gradients LOCALLY inside a shard_map
+      over the DP axes and issues an explicit per-leaf reduce-scatter in
+      the accumulation epilogue, consuming the accumulator in place,
+      instead of relying on GSPMD to insert the scatter at the layout
+      constraint.  Warn-fallback when ineligible.
     """
     mode: str = "static"            # static | probe | pinned
     probe_steps: int = 3            # compiled steps per probe (+1 warmup)
@@ -536,6 +549,8 @@ class StepScheduleConfig:
     prefetch_bucket_size: Optional[int] = None
     ring_interleave: int = 1
     weight_update: str = "fused"    # fused | decomposed
+    fused_gather_matmul: bool = False
+    fused_reduce_scatter: bool = False
     decisions: Optional[List[Dict[str, Any]]] = None
 
     MODES = ("static", "probe", "pinned")
@@ -556,6 +571,8 @@ class StepScheduleConfig:
                 f"step_schedule.ring_interleave must be one of "
                 f"{list(self.RING_INTERLEAVES)}, got {self.ring_interleave}")
         self.ring_interleave = int(self.ring_interleave)
+        self.fused_gather_matmul = bool(self.fused_gather_matmul)
+        self.fused_reduce_scatter = bool(self.fused_reduce_scatter)
         if int(self.probe_steps) < 1:
             raise DeepSpeedConfigError(
                 f"step_schedule.probe_steps must be >= 1, got "
@@ -612,6 +629,13 @@ class CommQuantizationConfig:
     * ``zero3_gather`` — the stage-3 parameter all-gather (the qwZ
       straight-through gather, parallel/zeropp.py); ``int8``/``fp8``
       move quantized payloads on the wire.
+    * ``ring_rotation`` — the ring-attention K/V (and traveling-grad)
+      rotation over the "seq" mesh ring (sequence/ring.py):
+      ``int8``/``fp8`` move block-quantized payloads + per-row fp32
+      scales on every ``ppermute`` hop; dequant runs inside the
+      consuming flash kernel's epilogue on the fused path (int8) or
+      through the shared XLA codec otherwise.  Blocks are the head dim
+      (``group_size`` does not apply to this collective).
 
     ``error_feedback`` carries the grad-reduce quantization residual
     into the next step (LoCo-style; ignored for fp32 wire).  The
@@ -621,11 +645,12 @@ class CommQuantizationConfig:
     enabled: bool = False
     grad_reduce: str = "fp32"      # fp32 | int8 | fp8
     zero3_gather: str = "fp32"     # fp32 | int8 | fp8
+    ring_rotation: str = "fp32"    # fp32 | int8 | fp8
     group_size: int = 256          # block size per fp32 scale
     error_feedback: bool = True
     collectives: Optional[Dict[str, str]] = None
 
-    COLLECTIVES = ("grad_reduce", "zero3_gather")
+    COLLECTIVES = ("grad_reduce", "zero3_gather", "ring_rotation")
     WIRE_DTYPES = ("fp32", "int8", "fp8")
 
     def __post_init__(self):

@@ -78,6 +78,17 @@ def _advance_loss_scale(scale, good, skipped, finite, dynamic: bool,
     return scale, good, skipped
 
 
+def _stacked_batch_specs(batch_stack, axes):
+    """Per-leaf PartitionSpecs of a stacked micro-batch ``[gas, rows,
+    ...]`` for a manual (shard_map) region: row dims shard over the DP
+    ``axes``; PRNG keys and sub-2D leaves replicate.  Shared by every
+    explicit-collective path (comm-quant reduce, fused reduce-scatter,
+    1-bit build) so a new batch leaf's layout is decided once."""
+    return {k: (P() if k == "dropout_key" or np.ndim(v) < 2
+                else P(*([None, axes] + [None] * (np.ndim(v) - 2))))
+            for k, v in batch_stack.items()}
+
+
 def _global_norm(tree) -> jnp.ndarray:
     leaves = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in jax.tree.leaves(tree)]
     return jnp.sqrt(jnp.sum(jnp.stack(leaves)))
@@ -289,6 +300,22 @@ class DeepSpeedEngine:
                 # ring hop schedule (step_schedule): issue the next hop's
                 # ppermute before the current hop's attend
                 mc = mc.replace(ring_interleave=ss.ring_interleave)
+            cq_ring = cfg.comm_quantization
+            if cq_ring.enabled and cq_ring.ring_rotation != "fp32":
+                if mc.seq_impl == "ring" and topology.sp_size > 1:
+                    # quantized ring wire (comm_quantization.ring_rotation;
+                    # sequence/ring.py): the K/V rotation and the traveling
+                    # dk/dv move int8/fp8 payloads + fp32 per-row scales
+                    # per hop, dequantized in the flash kernel epilogue
+                    mc = mc.replace(ring_wire_dtype=cq_ring.ring_rotation)
+                    log_dist("comm_quantization: ring rotation wire = "
+                             f"{cq_ring.ring_rotation} over "
+                             f"sp={topology.sp_size}")
+                else:
+                    logger.warning(
+                        "comm_quantization.ring_rotation: no >1 'seq' "
+                        "mesh axis (or seq_impl != 'ring') — nothing "
+                        "travels a ring; keeping the fp32 wire")
             if cfg.pipeline.num_microbatches:
                 mc = mc.replace(pipeline_microbatches=cfg.pipeline.num_microbatches)
             if self._param_stream:
@@ -331,6 +358,84 @@ class DeepSpeedEngine:
                 raise DeepSpeedConfigError(
                     msg + " — zero_optimization.strict_sharding is set")
             log_dist(msg, level="warning")
+
+        # -- ZeRO-3 fused gather-matmul (step_schedule.fused_gather_matmul;
+        # ops/pallas/gather_matmul.py) ----------------------------------
+        # The layer MLP runs as an explicit shard_map over the fsdp axes
+        # whose matmul region issues the FOLLOWING matmul's param
+        # all-gather ahead of the current one (T3, arXiv:2401.16677) —
+        # decided here, after the sharding rules exist, because the path
+        # is only correct when the MLP weights actually carry the
+        # expected fsdp pattern (wi/wg sharded on the embed dim 0, wo on
+        # the embed dim 1, same axes).
+        if cfg.step_schedule.fused_gather_matmul:
+            mc2 = self.model_config
+            cqg = cfg.comm_quantization
+            qwz_on = ((cqg.enabled and cqg.zero3_gather != "fp32")
+                      or cfg.zero_config.zero_quantized_weights)
+            blocked = (
+                "requires the built-in transformer model" if mc2 is None
+                else "requires ZeRO stage 3" if self.zero_stage < 3 else
+                "TP/PP/SP/EP mesh axes unsupported" if (
+                    topology.tp_size > 1 or topology.pp_size > 1
+                    or topology.sp_size > 1 or topology.ep_size > 1) else
+                "hierarchical (hpz/mics) partitioning unsupported"
+                if self._secondary_mode != "none" else
+                "param streaming unsupported" if self._param_stream else
+                "quantized zero3_gather (qwZ) already owns the gather"
+                if qwz_on else
+                "compression masking unsupported"
+                if self._compression is not None else
+                "MoE layers unsupported" if mc2.is_moe else "")
+            axes = None
+            if not blocked:
+                def _axes_of(entry):
+                    if entry is None:
+                        return ()
+                    return tuple(entry) if isinstance(entry, (tuple, list)) \
+                        else (entry,)
+
+                try:
+                    mlp_sh = self.param_shardings["layers"]["mlp"]
+                    wi_s = tuple(mlp_sh["wi"].spec)
+                    wo_s = tuple(mlp_sh["wo"].spec)
+                except (KeyError, TypeError):
+                    wi_s = wo_s = ()
+                ok = (len(wi_s) == 3 and len(wo_s) == 3
+                      and wi_s[0] is None and wi_s[2] is None
+                      and wo_s[0] is None and wo_s[1] is None
+                      and _axes_of(wi_s[1])
+                      and _axes_of(wi_s[1]) == _axes_of(wo_s[2]))
+                if ok and mc2.activation == "swiglu":
+                    wg_s = tuple(mlp_sh["wg"].spec)
+                    ok = wg_s == wi_s
+                elif ok and "bi" in mlp_sh:
+                    # the pre-activation bias rides the fused region with
+                    # an in_spec over the same axes — an indivisible bias
+                    # dim (replicated spec) must fall back, not crash at
+                    # trace time
+                    bi_s = tuple(mlp_sh["bi"].spec)
+                    ok = (len(bi_s) == 2 and bi_s[0] is None
+                          and _axes_of(bi_s[1]) == _axes_of(wi_s[1]))
+                if ok:
+                    axes = _axes_of(wi_s[1])
+                else:
+                    blocked = ("MLP weights do not carry the expected "
+                               "fsdp sharding pattern (persistence "
+                               "threshold or indivisible dims)")
+            if axes:
+                mc2 = mc2.replace(fused_gather_matmul=True,
+                                  fused_gather_axes=axes)
+                self.model_config = mc2
+                self._init_fn = partial(tf_model.init_params, mc2)
+                self._loss_fn = partial(tf_model.loss_fn, cfg=mc2)
+                log_dist("step_schedule: fused gather-matmul — MLP "
+                         f"all-gathers issued in-region over {axes}")
+            else:
+                logger.warning(
+                    "step_schedule.fused_gather_matmul: unsupported with "
+                    f"this configuration ({blocked}) — keeping the "
+                    "scheduled (GSPMD) gather path")
 
         def _init_sharding_unsafe() -> bool:
             """True when jitting rng init straight into the param
@@ -898,6 +1003,50 @@ class DeepSpeedEngine:
                     "SuperOffload / optimizer store / 1-bit optimizer) — "
                     "falling back to the implicit fp32 reduction")
 
+        # -- fused reduce-scatter epilogue (step_schedule block) --------
+        # With the decomposed update, GSPMD compiles the DP grad reduce
+        # as reduce-scatter wherever its layout pass places it; the
+        # fused variant instead accumulates gradients LOCALLY inside a
+        # shard_map over the DP axes and issues an explicit per-leaf
+        # psum_scatter in the accumulation epilogue — the scatter
+        # consumes the just-written accumulator in place (the last
+        # micro-batch's adds and the wire movement are one fused region)
+        # and early leaves' scatters overlap later leaves' update math.
+        self._fused_rs = False
+        if cfg.step_schedule.fused_reduce_scatter:
+            blocked = (
+                "requires weight_update='decomposed'"
+                if not self._decomposed_update else
+                "requires ZeRO stage <= 1 (stage >= 2 grads are already "
+                "scatter-laid-out by GSPMD)" if self.zero_stage > 1 else
+                "needs a >1 data-parallel mesh without TP/PP/SP"
+                if not _dp_only else
+                # the full-manual region over BATCH_AXES cannot host the
+                # MoE expert-parallel nested shard_map, and expert-
+                # sharded grad leaves would scatter over the wrong axes
+                "MoE / expert-parallel unsupported"
+                if (self.topology.ep_size > 1
+                    or (self.model_config is not None
+                        and self.model_config.is_moe)) else
+                "hierarchical (hpz/mics) partitioning unsupported"
+                if self._secondary_mode != "none" else
+                "comm_quantization grad reduce already owns the wire"
+                if self._comm_quant is not None else
+                "1-bit/qgZ optimizer owns the reduction"
+                if self._onebit is not None else
+                "sparse gradients unsupported"
+                if cfg.sparse_gradients_enabled else "")
+            if blocked:
+                logger.warning(
+                    "step_schedule.fused_reduce_scatter: unsupported with "
+                    f"this configuration ({blocked}) — keeping the GSPMD "
+                    "scatter placement")
+            else:
+                self._fused_rs = True
+                log_dist("step_schedule: fused reduce-scatter — explicit "
+                         "per-leaf psum_scatter in the grad-accumulator "
+                         f"epilogue over dp={self.topology.dp_size}")
+
         self._compile_steps()
 
     # ------------------------------------------------------------------
@@ -1138,11 +1287,7 @@ class DeepSpeedEngine:
                 moves the flat buffer — int8/fp8 payload + fp32 block
                 scales on the wire, fp32 accumulation, optional LoCo-style
                 error-feedback residual carried across steps."""
-                batch_specs = {
-                    k: (P() if k == "dropout_key" or np.ndim(v) < 2
-                        else P(*([None, _Q_AXES]
-                                 + [None] * (np.ndim(v) - 2))))
-                    for k, v in batch_stack.items()}
+                batch_specs = _stacked_batch_specs(batch_stack, _Q_AXES)
                 err_spec = P(_Q_AXES) if cq_ef else P()
 
                 def local(params, batch_stack, scale, res):
@@ -1275,6 +1420,78 @@ class DeepSpeedEngine:
                         _quant_step_core(params, opt_state, ls_state,
                                          batch_stack, lr, None)
                     return new_params, new_opt, new_ls, metrics
+
+        if self._fused_rs:
+            # -- fused reduce-scatter epilogue (step_schedule block;
+            # eligibility decided in __init__) ------------------------
+            from deepspeed_tpu.parallel.topology import BATCH_AXES as _RS_AXES
+            from deepspeed_tpu.utils.jax_compat import \
+                shard_map as _rs_shard_map
+
+            rs_world = topo.dp_size
+            rs_param_specs = jax.tree.map(lambda s: s.spec,
+                                          self.param_shardings)
+            rs_grad_specs = jax.tree.map(lambda s: s.spec,
+                                         self.grad_shardings)
+
+            def accum_grads_fused_rs(params, batch_stack, scale):
+                """Decomposed-update variant of accum_grads: gradients
+                accumulate LOCALLY inside a shard_map over the DP axes
+                (no implicit GSPMD reduction), and the accumulation
+                epilogue issues ONE explicit psum_scatter per leaf into
+                the always-fsdp grad layout — the scatter consumes the
+                local accumulator in place and the 1/world update
+                (apply_update) runs on the shard it returns."""
+                batch_specs = _stacked_batch_specs(batch_stack, _RS_AXES)
+
+                def local(params, batch_stack, scale):
+                    def body(carry, mb):
+                        grad_acc, loss_acc = carry
+                        loss, grads = micro_grads(params, mb, scale)
+                        grad_acc = jax.tree.map(
+                            lambda a, g: a + g.astype(jnp.float32),
+                            grad_acc, grads)
+                        return (grad_acc, loss_acc + loss), None
+
+                    zeros = jax.tree.map(
+                        lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                    (grads, loss_sum), _ = lax.scan(
+                        body, (zeros, jnp.float32(0.0)), batch_stack)
+                    # local loss is a mean over this shard's rows; the
+                    # pmean restores the global-batch mean
+                    loss_sum = lax.pmean(loss_sum, _RS_AXES)
+
+                    def scatter(g, spec):
+                        dims = [i for i, s in enumerate(spec)
+                                if s is not None]
+                        if not dims:
+                            # indivisible leaf: the fsdp layout kept it
+                            # replicated, so the reduce stays a mean
+                            return lax.pmean(g, _RS_AXES)
+                        return lax.psum_scatter(
+                            g, _RS_AXES, scatter_dimension=dims[0],
+                            tiled=True) / rs_world
+
+                    grads = jax.tree.map(scatter, grads, rs_grad_specs)
+                    return grads, loss_sum
+
+                mapped = _rs_shard_map(
+                    local, mesh=topo.mesh,
+                    in_specs=(rs_param_specs, batch_specs, P()),
+                    out_specs=(rs_grad_specs, P()),
+                    check_vma=False)
+                return mapped(params, batch_stack, scale)
+
+            def train_step(params, opt_state, ls_state,  # noqa: F811
+                           batch_stack, lr):
+                grads, loss_sum = accum_grads_fused_rs(
+                    params, batch_stack, ls_state["scale"])
+                new_params, new_opt, new_ls, grad_norm, finite = \
+                    apply_update(params, opt_state, grads, lr, ls_state)
+                metrics = {"loss": loss_sum / gas, "grad_norm": grad_norm,
+                           "loss_scale": ls_state["scale"],
+                           "skipped": jnp.logical_not(finite)}
+                return new_params, new_opt, new_ls, metrics
 
         if self._super_opt is not None:
             # SuperOffload path: device computes grads + norm + finite in
@@ -2080,11 +2297,9 @@ class DeepSpeedEngine:
         batch_stack = self._maybe_add_dropout_key(batch_stack)
         batch_stack = self._put_batch(batch_stack, stacked=True)
         if not self._onebit._built:
-            batch_specs = {
-                k: (P() if k == "dropout_key"  # replicated keys, not rows
-                    else P(*([None, BATCH_AXES] + [None] * (np.ndim(v) - 2))))
-                for k, v in batch_stack.items()}
-            self._onebit.build(self.param_shardings, batch_specs)
+            self._onebit.build(self.param_shardings,
+                               _stacked_batch_specs(batch_stack,
+                                                    BATCH_AXES))
         lr = jnp.float32(self.lr_scheduler(self.global_steps))
         self.params, self._onebit_state, loss = self._onebit(
             self.params, self._onebit_state, batch_stack, lr)

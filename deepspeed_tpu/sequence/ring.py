@@ -87,6 +87,12 @@ class _RingSpec(NamedTuple):
     # overlap the two.  Math identical either way (the attend always
     # consumes the un-rotated buffers).
     interleave: int = 1
+    # ring wire dtype (comm_quantization.ring_rotation): "fp32" keeps
+    # the raw word-packed rotation; "int8"/"fp8" move block-quantized
+    # payloads + fp32 per-row scales on the wire (module comment above
+    # _rotate_quantized).  int8 dequantizes inside the flash kernels'
+    # epilogues on the fused path; fp8 always decodes via the XLA codec.
+    wire: str = "fp32"
 
 
 # ----------------------------------------------------------------------
@@ -182,21 +188,35 @@ def _kernel_enabled() -> bool:
 # Hop rotation: every buffer that travels the ring in one hop moves in
 # ONE collective launch.
 # ----------------------------------------------------------------------
+def _word_count(x) -> int:
+    """Whole 32-bit words needed for ``x``'s bytes (ceil)."""
+    return -(-int(np.prod(x.shape)) * x.dtype.itemsize // 4)
+
+
 def _to_words(x):
-    """Flatten to raw 32-bit words (bit-exact; 2-byte dtypes pack in
-    pairs, so no wire inflation for bf16 K/V next to fp32 grads)."""
+    """Flatten to raw 32-bit words (bit-exact).  Sub-word dtypes pack 2
+    (bf16/fp16) or 4 (int8) elements per word; an element count that
+    does not fill the last word is ZERO-PADDED to the word boundary —
+    ``_from_words`` slices the pad back off, so callers need no shape
+    alignment (regression: odd head_dim / odd-length bf16 buffers used
+    to fall back to per-buffer permutes)."""
+    flat = x.reshape(-1)
     if x.dtype.itemsize == 4:
-        flat = x.reshape(-1)
         return flat if x.dtype == jnp.uint32 \
             else lax.bitcast_convert_type(flat, jnp.uint32)
-    return lax.bitcast_convert_type(x.reshape(-1, 2), jnp.uint32)
+    per = 4 // x.dtype.itemsize
+    if flat.size % per:
+        flat = jnp.pad(flat, (0, per - flat.size % per))
+    return lax.bitcast_convert_type(flat.reshape(-1, per), jnp.uint32)
 
 
 def _from_words(w, shape, dtype):
+    n = int(np.prod(shape))
     if dtype.itemsize == 4:
-        return w if dtype == jnp.uint32 \
+        out = w if dtype == jnp.uint32 \
             else lax.bitcast_convert_type(w, dtype)
-    return lax.bitcast_convert_type(w, dtype).reshape(shape)
+        return out.reshape(shape)
+    return lax.bitcast_convert_type(w, dtype).reshape(-1)[:n].reshape(shape)
 
 
 def _rotate_together(perm, *xs):
@@ -207,21 +227,92 @@ def _rotate_together(perm, *xs):
     was four serialized collective-permute launches per hop for
     (kc, vc, dk_t, dv_t); one fused message keeps the ICI pipe busy with
     a single transfer the compiler can overlap with the hop's kernels.
-    Byte-exact for 4-byte and even-sized 2-byte dtypes; anything else
-    falls back to per-buffer permutes."""
-    if any(x.dtype.itemsize not in (2, 4)
-           or (x.dtype.itemsize == 2 and int(np.prod(x.shape)) % 2)
+    Byte-exact for 1/2/4-byte dtypes; tail elements that do not fill a
+    word are pad-carried and sliced off on arrival (see _to_words)."""
+    if any(x.dtype.itemsize not in (1, 2, 4)
            for x in xs):  # pragma: no cover - no such dtype travels today
         return tuple(lax.ppermute(x, SEQ_AXIS, perm) for x in xs)
     words = lax.ppermute(jnp.concatenate([_to_words(x) for x in xs]),
                          SEQ_AXIS, perm)
     out, i = [], 0
     for x in xs:
-        n = int(np.prod(x.shape)) * x.dtype.itemsize // 4
-        out.append(_from_words(words[i:i + n], x.shape, x.dtype)
-                   .reshape(x.shape))
+        n = _word_count(x)
+        out.append(_from_words(words[i:i + n], x.shape, x.dtype))
         i += n
     return tuple(out)
+
+
+# ----------------------------------------------------------------------
+# Quantized wire (comm_quantization.ring_rotation): the traveling
+# buffers move as int8 (or fp8-as-uint8) payloads + per-row fp32 block
+# scales, the codec shared verbatim with comm/quantized.py
+# (wire_encode_rows / wire_decode_rows — blocks are the trailing head
+# dim).  K/V are encoded ONCE at ring entry and the payload+scales
+# travel all sp-1 hops (a single quantization however long the ring);
+# the traveling dk/dv grad accumulators change every hop, so they
+# re-encode per hop.  Dequant on the consuming side happens inside the
+# flash kernels' epilogues (flash_mha.wire_dequant_rows — new scale
+# operands) on the fused path, or via wire_decode_rows on the XLA
+# fallback, so the two codecs cannot drift.
+# ----------------------------------------------------------------------
+def _rotate_quantized(perm, payloads, scales):
+    """One hop of the quantized wire: every payload flattens into ONE
+    narrow message and every fp32 scale into another; a single
+    ``lax.ppermute`` call moves the pair (one collective per dtype).
+    Unlike :func:`_rotate_together` the payload is NOT word-packed — the
+    wire dtype stays s8/u8 in the lowered HLO, so the static census
+    (analysis/) sees the narrowed collective-permute it declares.
+    Returns ``(payloads', scales')``."""
+    pay = jnp.concatenate([p.reshape(-1) for p in payloads])
+    sc = jnp.concatenate([s.reshape(-1) for s in scales])
+    pay, sc = lax.ppermute((pay, sc), SEQ_AXIS, perm)
+    outp, i = [], 0
+    for p in payloads:
+        n = int(np.prod(p.shape))
+        outp.append(pay[i:i + n].reshape(p.shape))
+        i += n
+    outs, i = [], 0
+    for s in scales:
+        n = int(np.prod(s.shape))
+        outs.append(sc[i:i + n].reshape(s.shape))
+        i += n
+    return tuple(outp), tuple(outs)
+
+
+def _rotate_kv_grads_quant(perm, wire, kp, vp, ks, vs, dk, dv):
+    """Backward-hop rotation on the quantized wire: the K/V payloads and
+    scales pass through encoded, the fp32 traveling grads encode for the
+    wire and decode on arrival — all four payloads in one message, all
+    four scale vectors in another, one ``ppermute`` call."""
+    from deepspeed_tpu.comm.quantized import (wire_decode_rows,
+                                              wire_encode_rows)
+
+    dkp, dks = wire_encode_rows(dk, wire)
+    dvp, dvs = wire_encode_rows(dv, wire)
+    (kp, vp, dkp, dvp), (ks, vs, dks, dvs) = _rotate_quantized(
+        perm, (kp, vp, dkp, dvp), (ks, vs, dks, dvs))
+    return (kp, vp, ks, vs, wire_decode_rows(dkp, dks, wire),
+            wire_decode_rows(dvp, dvs, wire))
+
+
+def _lane128(s):
+    """Lane-replicate a compact per-row scale ``[..., 1]`` to the
+    128-lane layout the flash kernels read (the lse/delta convention)."""
+    return jnp.broadcast_to(s, s.shape[:-1] + (128,))
+
+
+def _rotate_grads_quant(perm, wire, dk, dv):
+    """Grads-only quantized rotation (the interleave-2 late half and the
+    final delivery hop)."""
+    from deepspeed_tpu.comm.quantized import (wire_decode_rows,
+                                              wire_encode_rows)
+
+    dkp, dks = wire_encode_rows(dk, wire)
+    dvp, dvs = wire_encode_rows(dv, wire)
+    (dkp, dvp), (dks, dvs) = _rotate_quantized(perm, (dkp, dvp),
+                                               (dks, dvs))
+    return (wire_decode_rows(dkp, dks, wire),
+            wire_decode_rows(dvp, dvs, wire))
 
 
 # ----------------------------------------------------------------------
@@ -273,31 +364,87 @@ def _ring_fwd_xla(ql, kl, vl, spec: _RingSpec):
                         lambda: (m, l, acc),
                         lambda: attend(m, l, acc, kc, vc, src))
 
-    def hop(carry, t):
-        m, l, acc, kc, vc = carry
-        src = lax.rem(idx - t + spec.sp, spec.sp)
-        if spec.interleave > 1:
-            # rotate-ahead (interleave 2): the permute consumes only the
-            # incoming buffers, so issuing it before the attend makes
-            # transfer and compute dataflow-independent — the scheduler
-            # is free to run the hop's kernels under the K/V transfer
-            nkc, nvc = _rotate_together(perm, kc, vc)
-            m, l, acc = maybe_attend(m, l, acc, kc, vc, src)
-            return (m, l, acc, nkc, nvc), None
-        m, l, acc = maybe_attend(m, l, acc, kc, vc, src)
-        kc, vc = _rotate_together(perm, kc, vc)
-        return (m, l, acc, kc, vc), None
-
     m0 = jnp.full((b, nkv, rep, s_l, 1), _NEG, jnp.float32)
     l0 = jnp.zeros((b, nkv, rep, s_l, 1), jnp.float32)
     a0 = jnp.zeros((b, nkv, rep, s_l, d), jnp.float32)
-    # sp-1 hops permute after attending; the LAST block attends without
-    # the dead ring rotation (a collective inside scan that XLA cannot
-    # eliminate)
-    (m, l, acc, kc, vc), _ = lax.scan(
-        hop, (m0, l0, a0, kl, vl), jnp.arange(spec.sp - 1))
-    src_last = lax.rem(idx + 1, spec.sp)
-    m, l, acc = maybe_attend(m, l, acc, kc, vc, src_last)
+
+    quant = spec.wire != "fp32"
+    if quant:
+        from deepspeed_tpu.comm.quantized import (wire_decode_rows,
+                                                  wire_encode_rows)
+
+        # hop 0 is the shard's OWN block: it never touches the wire, so
+        # it attends EXACTLY (never causally dead either — the diagonal
+        # is always live); only the traveling copy quantizes.  Encoding
+        # happens ONCE here: payload + per-row scales travel all sp-1
+        # hops, one quantization however long the ring.
+        m, l, acc = attend(m0, l0, a0, kl, vl, idx)
+        kp, ks = wire_encode_rows(kl, spec.wire)
+        vp, vs = wire_encode_rows(vl, spec.wire)
+
+        def maybe_attend_q(m, l, acc, kp, ks, vp, vs, src):
+            def live():
+                kf = wire_decode_rows(kp, ks, spec.wire)
+                vf = wire_decode_rows(vp, vs, spec.wire)
+                return attend(m, l, acc, kf, vf, src)
+
+            if not masked:
+                return live()
+            return lax.cond(_hop_dead(idx, src, s_l, spec),
+                            lambda: (m, l, acc), live)
+
+        # first rotation peeled out of the scan (the scan body attends
+        # then rotates, same shape as the fp32-wire loop)
+        (kp, vp), (ks, vs) = _rotate_quantized(perm, (kp, vp), (ks, vs))
+
+        def hop(carry, t):
+            m, l, acc, kp, vp, ks, vs = carry
+            src = lax.rem(idx - t - 1 + spec.sp, spec.sp)
+            if spec.interleave > 1:
+                (nkp, nvp), (nks, nvs) = _rotate_quantized(
+                    perm, (kp, vp), (ks, vs))
+                m, l, acc = maybe_attend_q(m, l, acc, kp, ks, vp, vs, src)
+                return (m, l, acc, nkp, nvp, nks, nvs), None
+            m, l, acc = maybe_attend_q(m, l, acc, kp, ks, vp, vs, src)
+            (kp, vp), (ks, vs) = _rotate_quantized(perm, (kp, vp),
+                                                   (ks, vs))
+            return (m, l, acc, kp, vp, ks, vs), None
+
+        (m, l, acc, kp, vp, ks, vs), _ = lax.scan(
+            hop, (m, l, acc, kp, vp, ks, vs), jnp.arange(spec.sp - 2))
+        src_last = lax.rem(idx + 1, spec.sp)
+        m, l, acc = maybe_attend_q(m, l, acc, kp, ks, vp, vs, src_last)
+    else:
+        # hop 0 = the shard's own block: attended first (it is never
+        # causally dead), with the first rotation peeled out of the scan
+        # — the same skeleton as the quantized branch, so the static
+        # collective census counts both wires with identical op
+        # multiplicity (analysis/; the scan body still holds sp-2
+        # attend-then-rotate hops and the LAST block attends without the
+        # dead ring rotation XLA cannot eliminate)
+        m, l, acc = attend(m0, l0, a0, kl, vl, idx)
+        kc, vc = _rotate_together(perm, kl, vl)
+
+        def hop(carry, t):
+            m, l, acc, kc, vc = carry
+            src = lax.rem(idx - t - 1 + spec.sp, spec.sp)
+            if spec.interleave > 1:
+                # rotate-ahead (interleave 2): the permute consumes only
+                # the incoming buffers, so issuing it before the attend
+                # makes transfer and compute dataflow-independent — the
+                # scheduler is free to run the hop's kernels under the
+                # K/V transfer
+                nkc, nvc = _rotate_together(perm, kc, vc)
+                m, l, acc = maybe_attend(m, l, acc, kc, vc, src)
+                return (m, l, acc, nkc, nvc), None
+            m, l, acc = maybe_attend(m, l, acc, kc, vc, src)
+            kc, vc = _rotate_together(perm, kc, vc)
+            return (m, l, acc, kc, vc), None
+
+        (m, l, acc, kc, vc), _ = lax.scan(
+            hop, (m, l, acc, kc, vc), jnp.arange(spec.sp - 2))
+        src_last = lax.rem(idx + 1, spec.sp)
+        m, l, acc = maybe_attend(m, l, acc, kc, vc, src_last)
     out = acc / jnp.maximum(l, 1e-20)            # [b, nkv, rep, q, d]
     out = out.transpose(0, 3, 1, 2, 4).reshape(b, s_l, nh, d)
     lse = (m + jnp.log(jnp.maximum(l, 1e-20)))[..., 0]  # [b, nkv, rep, q]
@@ -330,13 +477,19 @@ def _ring_fwd_flash(ql, kl, vl, spec: _RingSpec):
              else idx * s_l).astype(jnp.int32)
     perm = [(i, (i + 1) % spec.sp) for i in range(spec.sp)]
 
-    def attend(m, l, acc, kc, vc, src):
+    def attend(m, l, acc, kc, vc, src, ks=None, vs=None):
         k_off = (src if spec.placement == "striped"
                  else src * s_l).astype(jnp.int32)
-        return flash_carry_block(
-            qk, kc, vc, m, l, acc, q_off, k_off, q_stride=stride,
-            k_stride=stride, s_real=s_l, sm_scale=spec.scale,
-            causal=spec.causal, window=spec.window)
+        kw = dict(q_stride=stride, k_stride=stride, s_real=s_l,
+                  sm_scale=spec.scale, causal=spec.causal,
+                  window=spec.window)
+        if ks is not None:
+            # quantized wire, fused dequant: the int8 payload feeds the
+            # kernel directly with its per-row scales lane-replicated —
+            # no fp32 K/V copy ever exists in HBM
+            kw.update(k_scale=_lane128(ks), v_scale=_lane128(vs))
+        return flash_carry_block(qk, kc, vc, m, l, acc, q_off, k_off,
+                                 **kw)
 
     def maybe_attend(m, l, acc, kc, vc, src):
         if not masked:
@@ -345,28 +498,86 @@ def _ring_fwd_flash(ql, kl, vl, spec: _RingSpec):
                         lambda: (m, l, acc),
                         lambda: attend(m, l, acc, kc, vc, src))
 
-    def hop(carry, t):
-        m, l, acc, kc, vc = carry
-        src = lax.rem(idx - t + spec.sp, spec.sp)
-        if spec.interleave > 1:
-            # rotate-ahead (interleave 2): the permute consumes only the
-            # incoming buffers, so issuing it before the attend makes
-            # transfer and compute dataflow-independent — the scheduler
-            # is free to run the hop's kernels under the K/V transfer
-            nkc, nvc = _rotate_together(perm, kc, vc)
-            m, l, acc = maybe_attend(m, l, acc, kc, vc, src)
-            return (m, l, acc, nkc, nvc), None
-        m, l, acc = maybe_attend(m, l, acc, kc, vc, src)
-        kc, vc = _rotate_together(perm, kc, vc)
-        return (m, l, acc, kc, vc), None
-
     m0 = jnp.full((b, nh, s_pad, 128), _NEG, jnp.float32)
     l0 = jnp.zeros((b, nh, s_pad, 128), jnp.float32)
     a0 = jnp.zeros((b, nh, s_pad, d), jnp.float32)
-    (m, l, acc, kc, vc), _ = lax.scan(
-        hop, (m0, l0, a0, kk, vk), jnp.arange(spec.sp - 1))
-    src_last = lax.rem(idx + 1, spec.sp)
-    m, l, acc = maybe_attend(m, l, acc, kc, vc, src_last)
+
+    quant = spec.wire != "fp32"
+    if quant:
+        from deepspeed_tpu.comm.quantized import (wire_decode_rows,
+                                                  wire_encode_rows)
+
+        # hop 0 = the shard's own block: exact attend (it never touches
+        # the wire, and the diagonal is never dead); encode once for the
+        # traveling copy (pad rows quantize to exact zeros)
+        m, l, acc = attend(m0, l0, a0, kk, vk, idx)
+        kp, ks = wire_encode_rows(kk, spec.wire)
+        vp, vs = wire_encode_rows(vk, spec.wire)
+        kernel_dequant = spec.wire == "int8"
+
+        def maybe_attend_q(m, l, acc, kp, ks, vp, vs, src):
+            def live():
+                if kernel_dequant:
+                    return attend(m, l, acc, kp, vp, src, ks=ks, vs=vs)
+                # fp8 wire: the kernel has no fp8 lane — decode via the
+                # XLA codec and run the plain kernel on the values
+                kf = wire_decode_rows(kp, ks, spec.wire).astype(qk.dtype)
+                vf = wire_decode_rows(vp, vs, spec.wire).astype(qk.dtype)
+                return attend(m, l, acc, kf, vf, src)
+
+            if not masked:
+                return live()
+            return lax.cond(_hop_dead(idx, src, s_l, spec),
+                            lambda: (m, l, acc), live)
+
+        # first rotation peeled out of the scan (the scan body attends
+        # then rotates, same shape as the fp32-wire loop)
+        (kp, vp), (ks, vs) = _rotate_quantized(perm, (kp, vp), (ks, vs))
+
+        def hop(carry, t):
+            m, l, acc, kp, vp, ks, vs = carry
+            src = lax.rem(idx - t - 1 + spec.sp, spec.sp)
+            if spec.interleave > 1:
+                (nkp, nvp), (nks, nvs) = _rotate_quantized(
+                    perm, (kp, vp), (ks, vs))
+                m, l, acc = maybe_attend_q(m, l, acc, kp, ks, vp, vs, src)
+                return (m, l, acc, nkp, nvp, nks, nvs), None
+            m, l, acc = maybe_attend_q(m, l, acc, kp, ks, vp, vs, src)
+            (kp, vp), (ks, vs) = _rotate_quantized(perm, (kp, vp),
+                                                   (ks, vs))
+            return (m, l, acc, kp, vp, ks, vs), None
+
+        (m, l, acc, kp, vp, ks, vs), _ = lax.scan(
+            hop, (m, l, acc, kp, vp, ks, vs), jnp.arange(spec.sp - 2))
+        src_last = lax.rem(idx + 1, spec.sp)
+        m, l, acc = maybe_attend_q(m, l, acc, kp, ks, vp, vs, src_last)
+    else:
+        # hop 0 = own block, first rotation peeled — same skeleton as
+        # the quantized branch (census op-multiplicity symmetry; see
+        # _ring_fwd_xla)
+        m, l, acc = attend(m0, l0, a0, kk, vk, idx)
+        kc, vc = _rotate_together(perm, kk, vk)
+
+        def hop(carry, t):
+            m, l, acc, kc, vc = carry
+            src = lax.rem(idx - t - 1 + spec.sp, spec.sp)
+            if spec.interleave > 1:
+                # rotate-ahead (interleave 2): the permute consumes only
+                # the incoming buffers, so issuing it before the attend
+                # makes transfer and compute dataflow-independent — the
+                # scheduler is free to run the hop's kernels under the
+                # K/V transfer
+                nkc, nvc = _rotate_together(perm, kc, vc)
+                m, l, acc = maybe_attend(m, l, acc, kc, vc, src)
+                return (m, l, acc, nkc, nvc), None
+            m, l, acc = maybe_attend(m, l, acc, kc, vc, src)
+            kc, vc = _rotate_together(perm, kc, vc)
+            return (m, l, acc, kc, vc), None
+
+        (m, l, acc, kc, vc), _ = lax.scan(
+            hop, (m, l, acc, kc, vc), jnp.arange(spec.sp - 2))
+        src_last = lax.rem(idx + 1, spec.sp)
+        m, l, acc = maybe_attend(m, l, acc, kc, vc, src_last)
 
     m1 = m[:, :, :s_l, 0]                                # [b, nh, s_l]
     l1 = l[:, :, :s_l, 0]
@@ -467,9 +678,74 @@ def _ring_bwd_xla(spec: _RingSpec, res, do):
     # layouts must be free to diverge without silently wrong grads
     zv = jnp.zeros((b, s_l, nkv, d), jnp.float32)
 
+    quant = spec.wire != "fp32"
+    if quant:
+        from deepspeed_tpu.comm.quantized import (wire_decode_rows,
+                                                  wire_encode_rows)
+
+        def maybe_grads_q(kp, ks, vp, vs, src):
+            def live():
+                return hop_grads(wire_decode_rows(kp, ks, spec.wire),
+                                 wire_decode_rows(vp, vs, spec.wire), src)
+
+            if not masked:
+                return live()
+            return lax.cond(_hop_dead(idx, src, s_l, spec),
+                            lambda: (zq, zk, zv), live)
+
+        # own-block grads are exact (hop 0 never touches the wire and
+        # the diagonal is never dead); encode once for the traveling copy
+        dq, dk_t, dv_t = hop_grads(kl, vl, idx)
+        kp, ks = wire_encode_rows(kl, spec.wire)
+        vp, vs = wire_encode_rows(vl, spec.wire)
+        # first rotation peeled out of the scan; K/V payloads and the
+        # freshly-accumulated traveling grads move together
+        kp, vp, ks, vs, dk_t, dv_t = _rotate_kv_grads_quant(
+            perm, spec.wire, kp, vp, ks, vs, dk_t, dv_t)
+
+        def hop(carry, t):
+            dq, dk_t, dv_t, kp, vp, ks, vs = carry
+            src = lax.rem(idx - t - 1 + spec.sp, spec.sp)
+            if spec.interleave > 1:
+                (nkp, nvp), (nks, nvs) = _rotate_quantized(
+                    perm, (kp, vp), (ks, vs))
+                dq_c, dk_c, dv_c = maybe_grads_q(kp, ks, vp, vs, src)
+                dk_t, dv_t = _rotate_grads_quant(perm, spec.wire,
+                                                 dk_t + dk_c, dv_t + dv_c)
+                return (dq + dq_c, dk_t, dv_t, nkp, nvp, nks, nvs), None
+            dq_c, dk_c, dv_c = maybe_grads_q(kp, ks, vp, vs, src)
+            kp, vp, ks, vs, dk_t, dv_t = _rotate_kv_grads_quant(
+                perm, spec.wire, kp, vp, ks, vs, dk_t + dk_c, dv_t + dv_c)
+            return (dq + dq_c, dk_t, dv_t, kp, vp, ks, vs), None
+
+        (dq, dk_t, dv_t, kp, vp, ks, vs), _ = lax.scan(
+            hop, (dq, dk_t, dv_t, kp, vp, ks, vs),
+            jnp.arange(spec.sp - 2))
+        src_last = lax.rem(idx + 1, spec.sp)
+        dq_c, dk_c, dv_c = maybe_grads_q(kp, ks, vp, vs, src_last)
+        dq = dq + dq_c
+        # delivery hop: the traveling grads quantize one last time
+        dk_t, dv_t = _rotate_grads_quant(perm, spec.wire,
+                                         dk_t + dk_c, dv_t + dv_c)
+        return (dq.reshape(b, s_l, nh, d).astype(ql.dtype),
+                dk_t.astype(kl.dtype), dv_t.astype(vl.dtype))
+
+    # hop 0 = own block, first rotation peeled — same skeleton as the
+    # quantized branch (census op-multiplicity symmetry)
+    if spec.interleave > 1:
+        # rotate-ahead: K/V depart before even the own-block grads
+        nkc, nvc = _rotate_together(perm, kl, vl)
+        dq, dk_t, dv_t = hop_grads(kl, vl, idx)
+        dk_t, dv_t = _rotate_together(perm, dk_t, dv_t)
+        kc, vc = nkc, nvc
+    else:
+        dq, dk_t, dv_t = hop_grads(kl, vl, idx)
+        # K/V and their accumulated grads rotate together, in one launch
+        kc, vc, dk_t, dv_t = _rotate_together(perm, kl, vl, dk_t, dv_t)
+
     def hop(carry, t):
         dq, dk_t, dv_t, kc, vc = carry
-        src = lax.rem(idx - t + spec.sp, spec.sp)
+        src = lax.rem(idx - t - 1 + spec.sp, spec.sp)
         if spec.interleave > 1:
             # rotate-ahead: K/V depart before the hop's grads are
             # computed (overlapping the grad einsums); the traveling
@@ -490,7 +766,7 @@ def _ring_bwd_xla(spec: _RingSpec, res, do):
         return (dq, dk_t, dv_t, kc, vc), None
 
     (dq, dk_t, dv_t, kc, vc), _ = lax.scan(
-        hop, (zq, zk, zv, kl, vl), jnp.arange(spec.sp - 1))
+        hop, (dq, dk_t, dv_t, kc, vc), jnp.arange(spec.sp - 2))
     src_last = lax.rem(idx + 1, spec.sp)
     dq_c, dk_c, dv_c = maybe_grads(kc, vc, src_last, zq, zk, zv)
     dq = dq + dq_c
@@ -537,12 +813,15 @@ def _ring_bwd_flash(spec: _RingSpec, res, do):
              else idx * s_l).astype(jnp.int32)
     perm = [(i, (i + 1) % spec.sp) for i in range(spec.sp)]
 
-    def hop_grads(dq, dk_t, dv_t, kc, vc, src):
+    def hop_grads(dq, dk_t, dv_t, kc, vc, src, ks=None, vs=None):
         k_off = (src if spec.placement == "striped"
                  else src * s_l).astype(jnp.int32)
         kw = dict(q_stride=stride, k_stride=stride, s_real=s_l,
                   sm_scale=spec.scale, causal=spec.causal,
                   window=spec.window)
+        if ks is not None:
+            # quantized wire, fused dequant (see _ring_fwd_flash.attend)
+            kw.update(k_scale=_lane128(ks), v_scale=_lane128(vs))
         dq = flash_ring_dq_block(qk, kc, vc, dok, lsep, deltap, dq,
                                  q_off, k_off, **kw)
         dk_t, dv_t = flash_ring_dkv_block(qk, kc, vc, dok, lsep, deltap,
@@ -560,9 +839,81 @@ def _ring_bwd_flash(spec: _RingSpec, res, do):
     zk = jnp.zeros((b, nkv, s_pad, d), jnp.float32)
     zv = jnp.zeros((b, nkv, s_pad, d), jnp.float32)
 
+    quant = spec.wire != "fp32"
+    if quant:
+        from deepspeed_tpu.comm.quantized import (wire_decode_rows,
+                                                  wire_encode_rows)
+
+        kernel_dequant = spec.wire == "int8"
+
+        def maybe_grads_q(dq, dk_t, dv_t, kp, ks, vp, vs, src):
+            def live():
+                if kernel_dequant:
+                    return hop_grads(dq, dk_t, dv_t, kp, vp, src,
+                                     ks=ks, vs=vs)
+                kf = wire_decode_rows(kp, ks, spec.wire).astype(qk.dtype)
+                vf = wire_decode_rows(vp, vs, spec.wire).astype(qk.dtype)
+                return hop_grads(dq, dk_t, dv_t, kf, vf, src)
+
+            if not masked:
+                return live()
+            return lax.cond(_hop_dead(idx, src, s_l, spec),
+                            lambda: (dq, dk_t, dv_t), live)
+
+        # own-block grads are exact (hop 0 never touches the wire and
+        # the diagonal is never dead); encode once for the traveling copy
+        dq, dk_t, dv_t = hop_grads(dq0, zk, zv, kk, vk, idx)
+        kp, ks = wire_encode_rows(kk, spec.wire)
+        vp, vs = wire_encode_rows(vk, spec.wire)
+        # first rotation peeled out of the scan
+        kp, vp, ks, vs, dk_t, dv_t = _rotate_kv_grads_quant(
+            perm, spec.wire, kp, vp, ks, vs, dk_t, dv_t)
+
+        def hop(carry, t):
+            dq, dk_t, dv_t, kp, vp, ks, vs = carry
+            src = lax.rem(idx - t - 1 + spec.sp, spec.sp)
+            if spec.interleave > 1:
+                (nkp, nvp), (nks, nvs) = _rotate_quantized(
+                    perm, (kp, vp), (ks, vs))
+                dq, dk_t, dv_t = maybe_grads_q(dq, dk_t, dv_t, kp, ks,
+                                               vp, vs, src)
+                dk_t, dv_t = _rotate_grads_quant(perm, spec.wire,
+                                                 dk_t, dv_t)
+                return (dq, dk_t, dv_t, nkp, nvp, nks, nvs), None
+            dq, dk_t, dv_t = maybe_grads_q(dq, dk_t, dv_t, kp, ks,
+                                           vp, vs, src)
+            kp, vp, ks, vs, dk_t, dv_t = _rotate_kv_grads_quant(
+                perm, spec.wire, kp, vp, ks, vs, dk_t, dv_t)
+            return (dq, dk_t, dv_t, kp, vp, ks, vs), None
+
+        (dq, dk_t, dv_t, kp, vp, ks, vs), _ = lax.scan(
+            hop, (dq, dk_t, dv_t, kp, vp, ks, vs),
+            jnp.arange(spec.sp - 2))
+        src_last = lax.rem(idx + 1, spec.sp)
+        dq, dk_t, dv_t = maybe_grads_q(dq, dk_t, dv_t, kp, ks, vp, vs,
+                                       src_last)
+        # delivery hop: the traveling grads quantize one last time
+        dk_t, dv_t = _rotate_grads_quant(perm, spec.wire, dk_t, dv_t)
+        dq = dq[:, :, :s_l].swapaxes(1, 2).astype(ql.dtype)
+        dk = dk_t[:, :, :s_l].swapaxes(1, 2).astype(kl.dtype)
+        dv = dv_t[:, :, :s_l].swapaxes(1, 2).astype(vl.dtype)
+        return dq, dk, dv
+
+    # hop 0 = own block, first rotation peeled — same skeleton as the
+    # quantized branch (census op-multiplicity symmetry)
+    if spec.interleave > 1:
+        # rotate-ahead: K/V depart before even the own-block grads
+        kc, vc = _rotate_together(perm, kk, vk)
+        dq, dk_t, dv_t = hop_grads(dq0, zk, zv, kk, vk, idx)
+        dk_t, dv_t = _rotate_together(perm, dk_t, dv_t)
+    else:
+        dq, dk_t, dv_t = hop_grads(dq0, zk, zv, kk, vk, idx)
+        # K/V and their accumulated grads rotate together, in one launch
+        kc, vc, dk_t, dv_t = _rotate_together(perm, kk, vk, dk_t, dv_t)
+
     def hop(carry, t):
         dq, dk_t, dv_t, kc, vc = carry
-        src = lax.rem(idx - t + spec.sp, spec.sp)
+        src = lax.rem(idx - t - 1 + spec.sp, spec.sp)
         if spec.interleave > 1:
             # rotate-ahead: same split as the XLA backward — K/V depart
             # under the fused grad kernels, traveling grads follow
@@ -576,7 +927,7 @@ def _ring_bwd_flash(spec: _RingSpec, res, do):
         return (dq, dk_t, dv_t, kc, vc), None
 
     (dq, dk_t, dv_t, kc, vc), _ = lax.scan(
-        hop, (dq0, zk, zv, kk, vk), jnp.arange(spec.sp - 1))
+        hop, (dq, dk_t, dv_t, kc, vc), jnp.arange(spec.sp - 2))
     src_last = lax.rem(idx + 1, spec.sp)
     dq, dk_t, dv_t = maybe_grads(dq, dk_t, dv_t, kc, vc, src_last)
     # the traveling grads sit one rank behind their owner — deliver home
@@ -597,7 +948,8 @@ def ring_attention(q, k, v, topo=None, causal: bool = True,
                    sm_scale: Optional[float] = None,
                    window: Optional[int] = None,
                    placement: str = "contiguous",
-                   interleave: int = 1):
+                   interleave: int = 1,
+                   wire_dtype: str = "fp32"):
     """q/k/v: [B, S, H, D] GLOBAL arrays with S sharded over "seq".
     Returns [B, S, H, D].  GQA KV heads travel the ring unrepeated.  Must
     be called under jit (shard_map manual over the seq + batch axes; on
@@ -609,7 +961,15 @@ def ring_attention(q, k, v, topo=None, causal: bool = True,
     (shard r owns rows [r·S_l, (r+1)·S_l)) or "striped" (shard r owns
     rows r, r+sp, …; the causal-load-balanced layout — see module
     docstring; the caller must feed striped data, cf.
-    :func:`stripe_sequence`)."""
+    :func:`stripe_sequence`).
+
+    ``wire_dtype`` (comm_quantization.ring_rotation): "fp32" = the raw
+    word-packed rotation; "int8"/"fp8" = block-quantized payloads +
+    per-row fp32 scales on the wire — K/V encoded once at ring entry,
+    traveling dk/dv re-encoded per hop, dequant in the flash kernels'
+    epilogues (int8 + the ``_kernel_enabled()`` gate) or via the shared
+    XLA codec otherwise (docs/RING_ATTENTION.md, docs/QUANTIZED_COMM.md).
+    Ignored at sp == 1 (no ring, nothing travels)."""
     topo = topo or get_topology()
     sp = topo.sp_size if topo is not None else 1
     nh, nkv = q.shape[2], k.shape[2]
@@ -632,6 +992,10 @@ def ring_attention(q, k, v, topo=None, causal: bool = True,
     if interleave not in (1, 2):
         raise ValueError(f"interleave={interleave!r}: expected 1 (attend "
                          "then rotate) or 2 (rotate-ahead)")
+    if wire_dtype != "fp32":
+        from deepspeed_tpu.comm.quantized import validate_wire_dtype
+
+        validate_wire_dtype(wire_dtype)
     rep = nh // nkv
     scale = sm_scale if sm_scale is not None else q.shape[-1] ** -0.5
     if sp == 1:
@@ -643,7 +1007,8 @@ def ring_attention(q, k, v, topo=None, causal: bool = True,
     spec = _RingSpec(sp=sp, rep=rep, scale=float(scale), causal=causal,
                      window=window, placement=placement,
                      use_flash=_kernel_enabled(),
-                     interleave=int(interleave))
+                     interleave=int(interleave),
+                     wire=str(wire_dtype))
 
     def body(ql, kl, vl):
         return _ring_local(ql, kl, vl, spec)

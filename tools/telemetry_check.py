@@ -84,7 +84,9 @@ QUANT_BENCH_KEYS = ["grad_reduce_bytes_fp32", "grad_reduce_bytes_quant",
 # bench source AND documented in the docs/RING_ATTENTION.md key table —
 # the lint trips when either side drifts.
 RING_DOCS = os.path.join(REPO, "docs", "RING_ATTENTION.md")
-RING_BENCH_KEYS = ["mfu", "placement", "ring_backward", "vs_baseline"]
+RING_BENCH_KEYS = ["mfu", "placement", "ring_backward", "vs_baseline",
+                   "ring_wire_bytes_fp32", "ring_wire_bytes_quant",
+                   "ring_wire_reduction", "ring_loss_delta"]
 RING_BWD_BENCH_KEYS = ["bwd_ms_per_hop_fused", "bwd_ms_per_hop_xla",
                        "transient_bytes_fused", "transient_bytes_xla",
                        "transient_reduction"]
@@ -96,18 +98,20 @@ RING_BWD_BENCH_KEYS = ["bwd_ms_per_hop_fused", "bwd_ms_per_hop_xla",
 # and documented; and the capture-report keys the scheduler consumes
 # (telemetry/capture.py) must be documented too.
 AUTOTUNING_DOCS = os.path.join(REPO, "docs", "AUTOTUNING.md")
-EXPECTED_SCHEDULE_DECISIONS = ["decomposed_update", "noop",
-                               "ring_interleave", "zero3_prefetch"]
+EXPECTED_SCHEDULE_DECISIONS = ["decomposed_update", "fused_gather_matmul",
+                               "noop", "ring_interleave", "zero3_prefetch"]
 EXPECTED_EVIDENCE_KEYS = ["dominant_collective", "exposed_comm_ms",
                           "overlap_fraction", "overlap_source",
                           "probe_step", "static_census"]
 EXPECTED_STEP_SCHEDULE_KEYS = [
-    "decisions", "gather_prefetch_depth", "mode", "overlap_threshold",
+    "decisions", "fused_gather_matmul", "fused_reduce_scatter",
+    "gather_prefetch_depth", "mode", "overlap_threshold",
     "param_persistence_threshold", "prefetch_bucket_size", "probe_steps",
     "ring_interleave", "weight_update",
 ]
 AUTOSCHED_BENCH_KEYS = ["mfu_static", "mfu_tuned", "exposed_comm_ms",
-                        "schedule_decision"]
+                        "schedule_decision", "fused_gather_loss_delta",
+                        "fused_gather_wire_bytes"]
 CAPTURE_REPORT_SCHED_KEYS = ["dominant_collective", "exposed_ms",
                              "overlap_estimate", "spans", "step"]
 
