@@ -1227,6 +1227,207 @@ def row_serve_load_multi():
     return _serve_load_multi_body()
 
 
+def _chaos_train_half(base: str, tel) -> dict:
+    """Train-side chaos (resilience/supervisor.py): an unkilled reference
+    run, then the same workload with a worker killed mid-train AND its
+    host removed from the survivors census — the supervisor must dump a
+    flight bundle, stop the group (SIGTERM→SIGKILL budget), re-plan a
+    SMALLER mesh, restart, and resume from the latest committed
+    universal checkpoint with the loss curve landing back on the
+    reference."""
+    from deepspeed_tpu.resilience.supervisor import (RecoverySupervisor,
+                                                     loss_curve)
+
+    if SMOKE:
+        total_steps, die_at, deadline_s = 6, 3, 240.0
+        n_hosts, dev_per_host = 2, 2
+        worker_env = {"DSTPU_SEQ": "16", "DSTPU_BATCH": "8"}
+    else:
+        total_steps, die_at, deadline_s = 20, 10, 600.0
+        n_hosts, dev_per_host = 2, 4
+        worker_env = {"DSTPU_SEQ": "128", "DSTPU_BATCH": "8"}
+
+    ref_dir = os.path.join(base, "ref")
+    sup_ref = RecoverySupervisor(
+        ref_dir, hosts_fn=lambda: [f"h{i}" for i in range(n_hosts)],
+        devices_per_host=dev_per_host, total_steps=total_steps,
+        deadline_s=60.0, poll_s=0.2, worker_env=dict(worker_env),
+        force_cpu=SMOKE)
+    ref = sup_ref.run()
+    ref_losses = loss_curve(ref.progress_path)
+
+    chaos_dir = os.path.join(base, "chaos")
+    os.makedirs(chaos_dir, exist_ok=True)
+    sentinel = os.path.join(chaos_dir, ".chaos_fired")
+
+    def hosts():
+        # the dying worker arms the chaos sentinel just before exiting —
+        # from then on host h1 is gone and the re-plan must shrink
+        alive = n_hosts - (1 if os.path.exists(sentinel) else 0)
+        return [f"h{i}" for i in range(alive)]
+
+    sup = RecoverySupervisor(
+        chaos_dir, hosts_fn=hosts, devices_per_host=dev_per_host,
+        total_steps=total_steps, deadline_s=60.0, poll_s=0.2,
+        stop_timeout_s=15.0, resume_deadline_s=deadline_s, telemetry=tel,
+        worker_env={**worker_env,
+                    "DSTPU_CHAOS": json.dumps({"die_at": die_at})},
+        force_cpu=SMOKE)
+    res = sup.run()
+    curve = loss_curve(res.progress_path)
+
+    gap = max(abs(curve[s] - ref_losses[s])
+              for s in ref_losses if s >= die_at)
+    recovery_s = res.outages[0]["outage_s"] if res.outages else -1.0
+    assert res.returncode == 0 and res.recoveries >= 1, \
+        (res.returncode, res.recoveries)
+    assert res.outages and res.outages[0]["resized"], \
+        "host loss did not shrink the planned mesh"
+    assert recovery_s < deadline_s, (recovery_s, deadline_s)
+    # one outage = one skipped record next to total_steps applied ones
+    goodput_after = total_steps / (total_steps + len(res.outages))
+    return {"recovery_s": round(recovery_s, 1),
+            "loss_gap": round(gap, 6),
+            "goodput_after": round(goodput_after, 4),
+            "recovered_mesh": res.outages[0]["mesh"],
+            "flight_bundle": bool(res.outages[0]["bundle"])}
+
+
+def _chaos_serve_half() -> dict:
+    """Serving-side chaos: open-loop load against a 2-replica Router;
+    replica r0 is hard-killed mid-load (fail-over must keep p99 TTFT
+    bounded and every request completing) and then respawned LIVE on its
+    own slice (ReplicaSet.respawn) — the re-grown replica must serve
+    again."""
+    import threading
+
+    from deepspeed_tpu.models import get_model_config
+    from deepspeed_tpu.serving import ReplicaSet, Router, SamplingParams
+
+    model = get_model_config("llama-tiny")
+    if SMOKE:
+        n_req, new, rate = 12, 8, 50.0
+        eng_cfg = {"dtype": "float32",
+                   "memory_config": {"num_blocks": 64, "block_size": 4},
+                   "max_context": 64}
+    else:
+        n_req, new, rate = 64, 32, 32.0
+        eng_cfg = {"memory_config": {"num_blocks": 512}}
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(1, model.vocab_size, size=12).tolist()
+               for _ in range(n_req)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
+    kill_at, respawn_at = n_req // 3, 2 * n_req // 3
+
+    rs = ReplicaSet.build(model, 2, eng_cfg, {}, seed=0)
+    router = Router(rs).start()
+    router.generate(prompts[:2], max_new_tokens=new)  # compile both
+    first_at = [0.0] * n_req
+    threads = []
+
+    def consume(i, stream):
+        for _tok in stream:
+            if first_at[i] == 0.0:
+                first_at[i] = time.perf_counter()
+
+    t0 = time.perf_counter()
+    for i in range(n_req):
+        lag = arrivals[i] - (time.perf_counter() - t0)
+        if lag > 0:
+            time.sleep(lag)
+        if i == kill_at:
+            rs[0].kill()          # hard stop: in-flight requests fail over
+        if i == respawn_at:
+            rs.respawn(0)         # live re-grow on the freed slice
+        s = router.submit(prompts[i], SamplingParams(max_new_tokens=new))
+        th = threading.Thread(target=consume, args=(i, s))
+        th.start()
+        threads.append(th)
+    submit_at = [t0 + a for a in arrivals]
+    for th in threads:
+        th.join(timeout=600)
+    ttft_ms = sorted((f - s) * 1e3
+                     for f, s in zip(first_at, submit_at) if f > 0)
+    p99 = (ttft_ms[min(len(ttft_ms) - 1, int(0.99 * (len(ttft_ms) - 1)))]
+           if ttft_ms else -1.0)
+    snap = router.snapshot()
+    # the respawned replica must actually serve: a direct request to it
+    out = rs[0].server.generate([prompts[0]], max_new_tokens=4)
+    regrown = int(rs[0].alive and len(out[0]) == 4)
+    router.stop()
+    _reset_topology()
+    assert len(ttft_ms) == n_req, (len(ttft_ms), n_req)
+    assert snap["failovers"] >= 1, snap
+    assert regrown == 1
+    return {"serve_ttft_p99_ms": round(p99, 1),
+            "failovers": int(snap["failovers"]),
+            "regrown": regrown}
+
+
+def _chaos_recovery_body():
+    """Chaos row (docs/ELASTICITY.md): kill a worker mid-train → assert
+    recovery within the deadline + loss continuity on a SHRUNK mesh;
+    kill a serving replica under open-loop load → assert p99 TTFT stays
+    bounded through fail-over and the ReplicaSet re-grows live.  Frozen
+    keys linted by tools/telemetry_check.py against docs/ELASTICITY.md."""
+    import tempfile
+
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    from deepspeed_tpu.telemetry import Telemetry
+
+    base = tempfile.mkdtemp(prefix="dstpu_chaos_")
+    tel = Telemetry(TelemetryConfig(
+        enabled=True, jsonl_path=_telemetry_jsonl("chaos_recovery"),
+        tracing={"enabled": True,
+                 "trace_path": _trace_json("chaos_recovery")},
+        flight={"enabled": True,
+                "output_dir": os.path.join(base, "flight")}))
+    train = _chaos_train_half(base, tel)
+    serve = _chaos_serve_half()
+    tel.close()
+    return {
+        "metric": "chaos_recovery_s",
+        "telemetry_jsonl": _telemetry_jsonl("chaos_recovery"),
+        "trace_json": _trace_json("chaos_recovery"),
+        "value": train["recovery_s"], "unit": "s",
+        **train, **serve,
+    }
+
+
+def row_chaos_recovery():
+    """Self-healing chaos row.  The recovery supervisor spawns worker
+    subprocesses that force their own virtual CPU meshes, but the
+    serving half needs >1 device in-process; smoke mode pins the outer
+    process to ONE cpu device, so the smoke variant re-execs itself on a
+    virtual 8-device CPU mesh (same pattern as serve_load_multi)."""
+    if SMOKE and "--chaos-inner" not in sys.argv:
+        import os
+        import subprocess
+
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        env["JAX_PLATFORMS"] = "cpu"
+        cmd = [sys.executable, __file__, "--row", "chaos_recovery",
+               "--smoke", "--chaos-inner"]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=900, env=env)
+        except subprocess.TimeoutExpired:
+            return {"metric": "chaos_recovery",
+                    "error": "smoke timed out"}
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return {"metric": "chaos_recovery",
+                "error": ("no result line; " + " | ".join(tail[-3:]))[:300]}
+    return _chaos_recovery_body()
+
+
 def _device_probe_error(timeout_s: float = 120.0):
     """A hung bench run records nothing at all (worse than an error row) —
     probe the backend with a deadline before touching it."""
@@ -1246,6 +1447,7 @@ _ROWS = {
     "v2_decode": row_v2_decode,
     "serve_load": row_serve_load,
     "serve_load_multi": row_serve_load_multi,
+    "chaos_recovery": row_chaos_recovery,
     "gpt2_350m": row_gpt2_350m,
 }
 
@@ -1314,7 +1516,8 @@ def main() -> None:
     for name in ("llama8b_class_zero3", "longseq_flash", "longseq_llama",
                  "longseq_ring", "gpt2_350m_commquant",
                  "gpt2_350m_autosched", "peak_params",
-                 "v2_decode", "serve_load", "serve_load_multi"):
+                 "v2_decode", "serve_load", "serve_load_multi",
+                 "chaos_recovery"):
         if SMOKE:
             try:
                 r = _ROWS[name]()

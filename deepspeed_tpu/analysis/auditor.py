@@ -420,6 +420,16 @@ def intent_for_engine(engine) -> AuditIntent:
                              "all-to-all"))
     if tp > 1:
         expected.update(("all-reduce", "all-gather", "reduce-scatter"))
+        if dp > 1 and stage >= 1:
+            # 2-D dp×tp mesh with a sharded optimizer: the layout
+            # transition between batch-parallel gradients and the
+            # (data, tensor)-factored ZeRO state legitimately lowers as
+            # collective-permutes (GSPMD routes the cross-axis reshard
+            # point-to-point; observed on the train_resumed target's
+            # data×tensor resume mesh — identical on a from-scratch
+            # engine with the same mesh, so it is the config's own
+            # intent, not a resume artifact)
+            expected.add("collective-permute")
     if pp > 1:
         expected.update(("collective-permute", "all-reduce", "all-gather"))
     if sp > 1:

@@ -182,6 +182,49 @@ def target_train_fused_gather() -> GraphAuditReport:
         step_schedule={"fused_gather_matmul": True})
 
 
+def target_train_resumed() -> GraphAuditReport:
+    """Self-healing resume twin (chaos_recovery row): state saved under
+    a pure-data mesh is universally reloaded onto a data×tensor
+    factorization through the PartitionOracle, and the RESUMED engine's
+    train step is audited.  Zero unbaselined highs means the
+    oracle-derived shardings census-match the declared intent — the
+    resharding resume introduced no implicit reshard, no dropped
+    donation, no unexplained collective — which is the static half of
+    the chaos e2e's loss-continuity assertion."""
+    import tempfile
+
+    import jax
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.analysis.auditor import audit_engine
+    from deepspeed_tpu.checkpoint.universal import (ds_to_universal,
+                                                    load_universal)
+    from deepspeed_tpu.models import get_model_config
+
+    model = get_model_config("gpt2-tiny", max_seq_len=64)
+    n = jax.device_count()
+    with tempfile.TemporaryDirectory() as ckdir:
+        engine, _, _, _ = ds.initialize(
+            model=model,
+            config=_train_config(n, zero_optimization={"stage": 2}))
+        try:
+            engine.save_checkpoint(ckdir, tag="seed")
+            udir = ds_to_universal(ckdir, tag="seed")
+        finally:
+            engine.destroy()
+            _reset_topology()
+        cfg = _train_config(n, zero_optimization={"stage": 2})
+        cfg["mesh"] = ({"data": n // 2, "tensor": 2} if n >= 2
+                       else {"data": 1})
+        engine2, _, _, _ = ds.initialize(model=model, config=cfg)
+        try:
+            load_universal(engine2, udir)
+            return audit_engine(engine2, label="train_resumed")
+        finally:
+            engine2.destroy()
+            _reset_topology()
+
+
 def _audit_v2(phase: str) -> GraphAuditReport:
     from deepspeed_tpu.analysis.auditor import audit_v2_engine
     from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
@@ -219,6 +262,7 @@ BENCH_AUDIT_TARGETS: Dict[str, Callable[[], GraphAuditReport]] = {
     "train_autosched": target_train_autosched,
     "train_fused_rs": target_train_fused_rs,
     "train_fused_gather": target_train_fused_gather,
+    "train_resumed": target_train_resumed,
     "ring_attention": target_ring_attention,
     "ring_attention_quant": target_ring_attention_quant,
     "v2_decode": target_v2_decode,

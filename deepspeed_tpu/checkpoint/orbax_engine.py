@@ -65,22 +65,37 @@ class OrbaxCheckpointEngine:
         self.wait()  # one in-flight save at a time (double buffering)
         self._ckptr.save(path, tree, force=True)
         if self.async_save:
-            self._pending = path
+            # crash-atomic commit: meta.json + the `latest` pointer are
+            # the COMPLETION markers — deferring them to wait() means a
+            # process killed while tensorstore is still streaming shards
+            # leaves `latest` pointing at the previous good tag, and a
+            # recovery resume never reads a torn save
+            self._pending = (path, save_dir, str(tag), meta)
+            log_dist(f"orbax checkpoint queued: {path}")
+            return
+        self._commit(save_dir, str(tag), meta)
+        log_dist(f"orbax checkpoint saved: {path}")
+
+    @staticmethod
+    def _commit(save_dir: str, tag: str, meta) -> None:
         import json
 
         if jax.process_index() == 0:
-            os.makedirs(os.path.join(save_dir, str(tag)), exist_ok=True)
-            with open(os.path.join(save_dir, str(tag), "meta.json"), "w") as f:
+            os.makedirs(os.path.join(save_dir, tag), exist_ok=True)
+            with open(os.path.join(save_dir, tag, "meta.json"), "w") as f:
                 json.dump(meta, f)
             with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(str(tag))
-        log_dist(f"orbax checkpoint {'queued' if self.async_save else 'saved'}: {path}")
+                f.write(tag)
 
     def wait(self) -> None:
-        """Block until the in-flight async save commits."""
+        """Block until the in-flight async save commits, then publish its
+        meta.json + `latest` pointer (the commit point)."""
         if self._pending is not None:
+            path, save_dir, tag, meta = self._pending
             self._ckptr.wait_until_finished()
+            self._commit(save_dir, tag, meta)
             self._pending = None
+            log_dist(f"orbax checkpoint committed: {path}")
 
     def load(self, engine, load_dir: str, tag: Optional[str] = None,
              load_optimizer_states: bool = True,
@@ -88,6 +103,7 @@ class OrbaxCheckpointEngine:
         import json
 
         self._reject_superoffload(engine)
+        self.wait()  # an uncommitted in-flight save is invisible until it lands
         if tag is None:
             with open(os.path.join(load_dir, "latest")) as f:
                 tag = f.read().strip()

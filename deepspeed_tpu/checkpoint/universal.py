@@ -5,21 +5,38 @@ Re-design of the reference's UCP (``deepspeed/checkpoint/ds_to_universal.py``
 ``utils/zero_to_fp32.py``): the reference must merge per-rank ZeRO shards and
 TP slices into atomic per-param files; here global arrays are already
 logical wholes (single-controller JAX), so the converter writes one ``.npy``
-per parameter path and reload simply re-shards onto whatever mesh the new
-engine has — world-size elasticity falls out of the sharding system.
+per parameter path and reload re-shards onto whatever mesh the new engine
+has — the target engine's :class:`~deepspeed_tpu.resilience.oracle.
+PartitionOracle` supplies every leaf's spec, so world-size elasticity
+(different dp/fsdp/tp factorizations, shrunk worlds) falls out of the
+name-based derivation rather than any saved placement.
+
+Crash atomicity (docs/ELASTICITY.md): the converter writes into a
+``universal.tmp-<pid>`` staging directory, stamps a completion marker
+(:data:`COMMIT_MARKER`) as its LAST file, and ``os.replace``s the staged
+dir into place — the final path either does not exist or is complete.  A
+recovery supervisor resuming from "the latest checkpoint" therefore
+never reads a torn save: :func:`resolve_universal_dir` requires the
+marker and falls back to the newest committed tag when the ``latest``
+pointer names an uncommitted one (the exact state a worker killed
+mid-save leaves behind).
 
 Layout:
     <dir>/universal/
         meta.json                 # step counters, config, param manifest
+        .committed                # completion marker (written last)
         params/<path>.npy         # fp32 master weights
         optimizer/<path>.npy      # flattened optimizer state leaves
 """
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import pickle
+import shutil
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -27,11 +44,71 @@ import numpy as np
 
 from deepspeed_tpu.utils.logging import log_dist, logger
 
+COMMIT_MARKER = ".committed"
+
+
+class _SizesOnlyTopology:
+    """Duck-typed stand-in for MeshTopology when only axis SIZES matter:
+    ``PartitionOracle.flat_specs`` never touches ``.mesh``, so the
+    converter can record the source run's specs without owning that many
+    devices (it may run on a one-chip head node)."""
+
+    def __init__(self, sizes: Dict[str, int]):
+        from deepspeed_tpu.parallel.topology import MESH_AXES
+
+        self.sizes = {ax: int(sizes.get(ax, 1)) for ax in MESH_AXES}
+
+    def axis_size(self, axis: str) -> int:
+        return self.sizes[axis]
+
+    @property
+    def tp_size(self) -> int:
+        return self.sizes["tensor"]
+
+    @property
+    def pp_size(self) -> int:
+        return self.sizes["pipe"]
+
+    @property
+    def ep_size(self) -> int:
+        return self.sizes["expert"]
+
+    @property
+    def sp_size(self) -> int:
+        return self.sizes["seq"]
+
+
+def _source_specs(mesh_sizes: Dict[str, int], ds_config: Dict[str, Any],
+                  manifest: Dict[str, Tuple[int, ...]]) -> Dict[str, str]:
+    """The source run's oracle-derived param specs, recorded for
+    forensics: a resumed engine (or ``graft_lint --rows``) can diff its
+    own oracle's answers against what the saving run intended."""
+    from deepspeed_tpu.resilience.oracle import PartitionOracle
+
+    topo = _SizesOnlyTopology(mesh_sizes or {})
+    try:
+        # the engine's own construction recipe — hpZ/MiCS secondary mode
+        # and the pinned step_schedule persistence override included —
+        # so the recorded specs are what the saving run ACTUALLY used
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+        oracle = PartitionOracle.from_config(topo, DeepSpeedConfig(ds_config))
+    except Exception as e:
+        # a partial/legacy ds_config must not make the checkpoint
+        # unconvertible — degrade to the static zero block
+        logger.warning(f"source_specs: ds_config no longer parses, "
+                       f"falling back to the static zero block ({e})")
+        zc = (ds_config or {}).get("zero_optimization", {}) or {}
+        oracle = PartitionOracle(
+            topo, zero_stage=int(zc.get("stage", 0)),
+            persist_threshold=int(zc.get("param_persistence_threshold", 0) or 0))
+    return {k: str(v) for k, v in oracle.flat_specs(manifest).items()}
+
 
 def _flatten_with_paths(tree) -> Dict[str, np.ndarray]:
     flat = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        from deepspeed_tpu.parallel.sharding import path_str
+        from deepspeed_tpu.resilience.oracle import path_str
 
         if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
             # ds_to_universal runs on process 0 only, so a cross-process
@@ -59,10 +136,22 @@ def _load_flat(root: str) -> Dict[str, np.ndarray]:
     return out
 
 
+def is_committed(universal_dir: str) -> bool:
+    """A universal dir is readable iff its completion marker exists —
+    the staging-dir rename makes this redundant for the FINAL path, but
+    a crashed ``os.replace``-capable filesystem is not guaranteed
+    everywhere the bundle may be rsynced to."""
+    return (os.path.exists(os.path.join(universal_dir, "meta.json"))
+            and os.path.exists(os.path.join(universal_dir, COMMIT_MARKER)))
+
+
 def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
                     output_dir: Optional[str] = None) -> str:
     """Convert a saved checkpoint to the universal per-param format.
-    Ref: ds_to_universal.py main flow (extract shards → merge → per-param)."""
+    Ref: ds_to_universal.py main flow (extract shards → merge → per-param).
+
+    Crash-atomic: everything lands in a staging dir that is renamed into
+    place only after the completion marker is written."""
     from deepspeed_tpu.checkpoint.engine import LATEST_FILE, _ckpt_path
 
     if tag is None:
@@ -81,19 +170,27 @@ def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
             raise RuntimeError("universal conversion failed on process 0")
         return out
 
+    staging = f"{out}.tmp-{os.getpid()}"
     ok = False
     try:
         with open(_ckpt_path(ckpt_dir, tag), "rb") as f:
             state = pickle.load(f)
 
-        os.makedirs(os.path.join(out, "params"), exist_ok=True)
-        os.makedirs(os.path.join(out, "optimizer"), exist_ok=True)
+        # sweep debris from earlier killed conversions (any pid): torn
+        # staging dirs and aside dirs a swap never finished deleting
+        for stale in glob.glob(f"{out}.tmp-*") + glob.glob(f"{out}.old-*"):
+            shutil.rmtree(stale, ignore_errors=True)
+        if os.path.exists(staging):
+            shutil.rmtree(staging)
+        os.makedirs(os.path.join(staging, "params"))
+        os.makedirs(os.path.join(staging, "optimizer"))
 
         params_flat = _flatten_with_paths(state["module"])
-        _save_flat(params_flat, os.path.join(out, "params"))
+        _save_flat(params_flat, os.path.join(staging, "params"))
         opt_flat = _flatten_with_paths(state["optimizer"])
-        _save_flat(opt_flat, os.path.join(out, "optimizer"))
+        _save_flat(opt_flat, os.path.join(staging, "optimizer"))
 
+        manifest = {k: tuple(v.shape) for k, v in params_flat.items()}
         meta = {
             "global_steps": state.get("global_steps", 0),
             "micro_steps": state.get("micro_steps", 0),
@@ -101,16 +198,42 @@ def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
             "loss_scale_state": {k: float(np.asarray(v))
                                  for k, v in state.get("loss_scale_state",
                                                        {}).items()},
-            "param_manifest": {k: list(v.shape)
-                               for k, v in params_flat.items()},
+            "param_manifest": {k: list(v) for k, v in manifest.items()},
+            "param_dtypes": {k: str(v.dtype) for k, v in params_flat.items()},
             "opt_treedef_leaves": len(opt_flat),
             "ds_config": state.get("ds_config", {}),
             "source_mesh": state.get("mesh_sizes", {}),
+            "source_specs": _source_specs(state.get("mesh_sizes", {}),
+                                          state.get("ds_config", {}),
+                                          manifest),
         }
-        with open(os.path.join(out, "meta.json"), "w") as f:
+        with open(os.path.join(staging, "meta.json"), "w") as f:
             json.dump(meta, f, indent=2)
+        # marker LAST, then the atomic publish: the final path either
+        # doesn't exist or is complete (mid-save kill leaves only a
+        # .tmp-* dir, which resolve_universal_dir never reads)
+        with open(os.path.join(staging, COMMIT_MARKER), "w") as f:
+            json.dump({"time_unix": time.time(), "pid": os.getpid()}, f)
+        old = None
+        if os.path.exists(out):
+            # swap the previously committed conversion ASIDE (atomic
+            # rename) instead of rmtree'ing it first: a kill during a
+            # tree delete would destroy the only committed copy of this
+            # tag while the replacement sits unpublished in staging —
+            # two renames shrink that window to microseconds and keep
+            # the old bytes recoverable at .old-* until the new dir is
+            # live
+            old = f"{out}.old-{os.getpid()}"
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            os.replace(out, old)
+        os.replace(staging, out)
+        if old is not None:
+            shutil.rmtree(old, ignore_errors=True)
         ok = True
     finally:
+        if not ok and os.path.isdir(staging):
+            shutil.rmtree(staging, ignore_errors=True)
         if jax.process_count() > 1:
             # ALWAYS release the non-writer processes — a writer exception
             # must raise on process 0, not hang processes 1..N — and tell
@@ -123,11 +246,50 @@ def ds_to_universal(ckpt_dir: str, tag: Optional[str] = None,
     return out
 
 
+def _scan_committed(load_dir: str) -> Optional[str]:
+    """Newest committed ``<load_dir>/<tag>/universal`` by step count
+    (mtime breaks ties) — the fall-back when the ``latest`` pointer
+    names a tag whose conversion never committed."""
+    best = None
+    best_key = None
+    try:
+        tags = sorted(os.listdir(load_dir))
+    except OSError:
+        return None
+    for t in tags:
+        cand = os.path.join(load_dir, t, "universal")
+        if not is_committed(cand):
+            continue
+        try:
+            with open(os.path.join(cand, "meta.json")) as f:
+                steps = int(json.load(f).get("global_steps", 0))
+        except (OSError, ValueError):
+            continue
+        key = (steps, os.path.getmtime(os.path.join(cand, COMMIT_MARKER)))
+        if best_key is None or key > best_key:
+            best, best_key = cand, key
+    return best
+
+
 def resolve_universal_dir(load_dir: str, tag: Optional[str] = None) -> str:
     """Accept either the universal dir itself, a checkpoint root (+tag), or a
-    checkpoint root with a ``latest`` file."""
+    checkpoint root with a ``latest`` file.  Uncommitted dirs (no
+    completion marker — a save died mid-write) are SKIPPED: when the
+    ``latest`` pointer names a torn tag, the newest committed tag under
+    the root wins, so a supervisor restart after a mid-save kill resumes
+    from the last good checkpoint instead of crashing on a torn one.  An
+    explicitly requested ``tag`` never falls back — a missing requested
+    tag raises."""
     if os.path.exists(os.path.join(load_dir, "meta.json")):
+        if not is_committed(load_dir):
+            raise FileNotFoundError(
+                f"universal checkpoint {load_dir} is uncommitted "
+                f"(missing {COMMIT_MARKER}) — either the save died "
+                f"mid-write, or the dir predates the crash-atomic commit "
+                f"protocol; re-run ds_to_universal on the source "
+                f"checkpoint to regenerate it")
         return load_dir
+    explicit_tag = tag is not None
     if tag is None:
         latest = os.path.join(load_dir, "latest")
         if os.path.exists(latest):
@@ -135,27 +297,58 @@ def resolve_universal_dir(load_dir: str, tag: Optional[str] = None) -> str:
                 tag = f.read().strip()
     if tag is not None:
         cand = os.path.join(load_dir, str(tag), "universal")
-        if os.path.exists(os.path.join(cand, "meta.json")):
+        if is_committed(cand):
             return cand
-    raise FileNotFoundError(f"no universal checkpoint under {load_dir} (tag={tag})")
+        if explicit_tag:
+            # a caller-requested tag is a contract: silently resuming
+            # from some OTHER (older) committed tag would load the wrong
+            # checkpoint — the fallback is only for the tag the `latest`
+            # pointer named (the mid-save-kill recovery case)
+            raise FileNotFoundError(
+                f"universal checkpoint for requested tag {tag!r} is "
+                f"missing or uncommitted under {load_dir}")
+        fallback = _scan_committed(load_dir)
+        if fallback is not None:
+            logger.warning(
+                f"universal checkpoint for tag {tag!r} is missing or "
+                f"uncommitted; resuming from {fallback} instead")
+            return fallback
+    else:
+        fallback = _scan_committed(load_dir)
+        if fallback is not None:
+            return fallback
+    raise FileNotFoundError(f"no committed universal checkpoint under "
+                            f"{load_dir} (tag={tag})")
 
 
 def load_universal(engine, universal_dir: str) -> None:
     """Load a universal checkpoint into an engine with ANY mesh topology
-    (ref load_hp_checkpoint_state, universal_checkpoint.py:22).  Arrays are
-    device_put with the engine's current shardings, so dp/tp/pp/sp changes
-    between save and load "just work"."""
+    (ref load_hp_checkpoint_state, universal_checkpoint.py:22).
+
+    Resharding is the oracle's job: ``engine.param_shardings`` /
+    ``engine.opt_shardings`` are the target engine's
+    :class:`~deepspeed_tpu.resilience.oracle.PartitionOracle` output
+    (plus any engine-side memory-kind placement), so ``device_put``
+    lands every leaf on the new mesh regardless of the dp/fsdp/tp
+    factorization — or world size — the checkpoint was saved under.
+    Every leaf is shape- and dtype-validated against the engine's
+    template before any state is mutated."""
+    universal_dir = resolve_universal_dir(universal_dir)
     with open(os.path.join(universal_dir, "meta.json")) as f:
         meta = json.load(f)
 
     params_flat = _load_flat(os.path.join(universal_dir, "params"))
-    params = _unflatten_like(engine.params, params_flat)
-    engine.params = jax.device_put(params, engine.param_shardings)
+    params = _unflatten_like(engine.params, params_flat, what="params")
 
     opt_flat = _load_flat(os.path.join(universal_dir, "optimizer"))
     template = engine._opt_state_template()
+    opt_state = None
     if opt_flat and template is not None:
-        opt_state = _unflatten_like(template, opt_flat)
+        opt_state = _unflatten_like(template, opt_flat, what="optimizer")
+
+    # both trees validated — only now mutate the engine
+    engine.params = jax.device_put(params, engine.param_shardings)
+    if opt_state is not None:
         # store mode: device placement is transient (engine pushes to the
         # store right after); stream mode: resident (possibly host) shardings
         target = (engine._opt_device_shardings if engine._opt_store is not None
@@ -179,22 +372,30 @@ def load_universal(engine, universal_dir: str) -> None:
              f"(source mesh {meta.get('source_mesh')} → {engine.topology.sizes})")
 
 
-def _unflatten_like(template, flat: Dict[str, np.ndarray]):
-    """Rebuild a pytree with ``template``'s structure from path→array dict."""
-    from deepspeed_tpu.parallel.sharding import path_str
+def _unflatten_like(template, flat: Dict[str, np.ndarray],
+                    what: str = "checkpoint"):
+    """Rebuild a pytree with ``template``'s structure from path→array dict,
+    validating every leaf's shape and dtype compatibility first."""
+    from deepspeed_tpu.resilience.oracle import path_str
 
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(template)
     new_leaves = []
     for path, leaf in leaves_with_paths:
         key = path_str(path)
         if key not in flat:
-            raise KeyError(f"universal checkpoint missing entry '{key}'")
+            raise KeyError(f"universal {what} missing entry '{key}'")
         arr = flat[key]
         if tuple(arr.shape) != tuple(np.shape(leaf)):
             raise ValueError(f"shape mismatch for '{key}': "
                              f"checkpoint {arr.shape} vs model {np.shape(leaf)}")
-        new_leaves.append(arr.astype(np.asarray(leaf).dtype
-                                     if hasattr(leaf, "dtype") else arr.dtype))
+        target_dt = np.dtype(getattr(leaf, "dtype", arr.dtype))
+        if target_dt != arr.dtype and not np.can_cast(
+                arr.dtype, target_dt, casting="same_kind"):
+            raise ValueError(
+                f"dtype mismatch for '{key}': checkpoint {arr.dtype} is "
+                f"not same-kind castable to model {target_dt} — the "
+                "checkpoint belongs to a differently-typed model")
+        new_leaves.append(arr.astype(target_dt))
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 

@@ -3,4 +3,5 @@ from deepspeed_tpu.elasticity.elasticity import (
     ElasticityIncompatibleWorldSize, compute_elastic_config,
     elasticity_enabled, ensure_immutable_elastic_config,
     get_compatible_gpus_v01, get_valid_gpus)
-from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent, WorkerSpec
+from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent, WorkerSpec,
+                                                    start_group, stop_group)

@@ -20,7 +20,7 @@ from jax import lax
 
 from deepspeed_tpu.models import transformer as tf_model
 from deepspeed_tpu.models.transformer import TransformerConfig
-from deepspeed_tpu.parallel.sharding import ShardingRules
+from deepspeed_tpu.resilience.oracle import PartitionOracle
 from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -51,7 +51,8 @@ class InferenceEngine:
         mesh_sizes = {"tensor": self.cfg.tp_size} if self.cfg.tp_size > 1 else None
         self.topology = MeshTopology(mesh_sizes)
         set_topology(self.topology)
-        self.rules = ShardingRules(self.topology, zero_stage=0)
+        self.oracle = PartitionOracle(self.topology, zero_stage=0)
+        self.rules = self.oracle
         if model_params is None:
             shapes = jax.eval_shape(partial(tf_model.init_params, self.model_config),
                                     jax.random.PRNGKey(seed))

@@ -33,7 +33,7 @@ from deepspeed_tpu.inference.v2.ragged import (DSStateManager,
 from deepspeed_tpu.inference.v2.scheduler import SplitFuseScheduler
 from deepspeed_tpu.models import transformer as tf_model
 from deepspeed_tpu.models.transformer import TransformerConfig
-from deepspeed_tpu.parallel.sharding import ShardingRules
+from deepspeed_tpu.resilience.oracle import PartitionOracle
 from deepspeed_tpu.parallel.topology import MeshTopology, set_topology
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -129,7 +129,8 @@ class InferenceEngineV2:
         # host's devices.  None keeps the whole-world default.
         self.topology = MeshTopology(mesh_sizes or None, devices=devices)
         set_topology(self.topology)
-        self.rules = ShardingRules(self.topology, zero_stage=0)
+        self.oracle = PartitionOracle(self.topology, zero_stage=0)
+        self.rules = self.oracle
 
         if model_params is None:
             shapes = jax.eval_shape(partial(tf_model.init_params, self.model_config),

@@ -1,269 +1,18 @@
-"""Parameter sharding rules: ZeRO stages + tensor parallelism as PartitionSpecs.
+"""Parameter sharding rules — compatibility shim.
 
-This module is the TPU-native core of what the reference spreads across
-``runtime/zero/partition_parameters.py`` (ZeRO-3 param partitioning),
-``runtime/zero/stage_1_and_2.py`` (optimizer/grad partitioning) and
-``module_inject/auto_tp.py`` (AutoTP tensor-parallel sharding):
-
-* Every parameter path maps to a tuple of **logical dims** (table below).
-* Logical dims map to mesh axes depending on the active config:
-    - ``tensor``-class dims (attention heads out, ffn, vocab) → "tensor" axis
-      (Megatron column/row parallel layout, ref module_inject/layers.py).
-    - the designated **fsdp dim** → the ZeRO axes ("data","expert","seq")
-      when stage == 3 (param partitioning, ref partition_parameters.py:1644);
-      unsharded otherwise.
-    - "expert" dim (stacked expert weights) → "expert" axis
-      (ref groups._create_expert_and_data_parallel, groups.py:240).
-* Optimizer state reuses the stage-3 spec whenever stage >= 1 — partitioned
-  optimizer states are exactly ZeRO-1 (ref stage_1_and_2.py:125).
-* The gradient-accumulation buffer uses the stage-3 spec when stage >= 2 —
-  partitioned gradients are ZeRO-2.
-
-XLA then inserts the all-gather / reduce-scatter collectives that the
-reference issues eagerly, and its latency-hiding scheduler replaces the
-prefetch coordinator (ref partitioned_param_coordinator.py).
+The implementation moved to :mod:`deepspeed_tpu.resilience.oracle`: the
+name-based spec derivation is now the :class:`PartitionOracle`, the ONE
+source of partition specs shared by engine init, checkpoint save/load
+and the serving replicas (docs/ELASTICITY.md).  ``ShardingRules`` is the
+same class under its historical name; importing from here keeps every
+existing call site working without a second derivation existing
+anywhere.
 """
 
 from __future__ import annotations
 
-import re
-from typing import Any, Dict, List, Optional, Tuple
+from deepspeed_tpu.resilience.oracle import (DEFAULT_RULES,  # noqa: F401
+                                             PartitionOracle, ShardingRules,
+                                             path_str)
 
-import jax
-import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
-
-from deepspeed_tpu.parallel.topology import (DATA_AXIS, EXPERT_AXIS, PIPE_AXIS, SEQ_AXIS,
-                                             SUBDATA_AXIS, TENSOR_AXIS, MeshTopology)
-from deepspeed_tpu.utils.logging import logger
-
-# path-pattern → logical dims, one entry per array dim.
-# Logical dim vocabulary:
-#   layer   — stacked-layer scan axis (never sharded)
-#   expert  — stacked-expert axis → "expert" mesh axis
-#   embed   — hidden/residual dim  → fsdp candidate
-#   mlp     — ffn intermediate dim → "tensor" (column-parallel)
-#   heads   — attention projection out dim → "tensor" (column-parallel)
-#   vocab   — vocabulary dim → "tensor"
-#   norm    — layernorm vector → fsdp candidate (1-D, ZeRO-3 shards these too)
-#   pos     — position-embedding rows
-DEFAULT_RULES: List[Tuple[str, Tuple[Optional[str], ...]]] = [
-    (r"embed/tokens$", ("vocab", "embed")),
-    (r"embed/positions$", ("pos", "embed")),
-    (r"embed/token_types$", ("pos", "embed")),
-    (r"embed/norm/(scale|bias)$", ("norm",)),
-    # BERT MLM head (transform dense + LN + vocab bias)
-    (r"mlm_head/w$", ("embed", None)),
-    (r"mlm_head/b$", ("embed",)),
-    (r"mlm_head/ln/(scale|bias)$", ("norm",)),
-    (r"mlm_head/bias$", ("vocab",)),
-    (r"attn/w[qkv]$", ("layer", "embed", "heads")),
-    (r"attn/b[qkv]$", ("layer", "heads")),
-    (r"attn/wo$", ("layer", "heads", "embed")),
-    (r"attn/bo$", ("layer", "embed")),
-    (r"mlp/w[ig]$", ("layer", "embed", "mlp")),
-    (r"mlp/bi$", ("layer", "mlp")),
-    (r"mlp/wo$", ("layer", "mlp", "embed")),
-    (r"mlp/bo$", ("layer", "embed")),
-    (r"moe/router$", ("layer", "embed", None)),
-    (r"moe/w[ig]$", ("layer", "expert", "embed", "mlp")),
-    (r"moe/wo$", ("layer", "expert", "mlp", "embed")),
-    # Qwen2-MoE shared expert: dense FFN shapes (no expert dim)
-    (r"moe/shared/w[ig]$", ("layer", "embed", "mlp")),
-    (r"moe/shared/wo$", ("layer", "mlp", "embed")),
-    (r"moe/shared_gate$", ("layer", "embed", None)),
-    # PR-MoE residual branch (ref moe/layer.py:83): dense FFN + Linear(h,2)
-    (r"moe/residual/w[ig]$", ("layer", "embed", "mlp")),
-    (r"moe/residual/wo$", ("layer", "mlp", "embed")),
-    (r"moe/coef_w$", ("layer", "embed", None)),
-    (r"moe/coef_b$", ("layer", None)),
-    (r"ln\d/(scale|bias)$", ("layer", "norm")),
-    (r"final_norm/(scale|bias)$", ("norm",)),
-    (r"lm_head$", ("embed", "vocab")),
-]
-
-
-def path_str(path) -> str:
-    parts = []
-    for k in path:
-        if hasattr(k, "key"):
-            parts.append(str(k.key))
-        elif hasattr(k, "idx"):
-            parts.append(str(k.idx))
-        else:
-            parts.append(str(k))
-    return "/".join(parts)
-
-
-class ShardingRules:
-    """Resolves param paths to NamedShardings for a given topology + config."""
-
-    def __init__(self, topology: MeshTopology, zero_stage: int = 0,
-                 rules: Optional[List[Tuple[str, Tuple[Optional[str], ...]]]] = None,
-                 shard_norms: bool = True, secondary_mode: str = "none",
-                 persist_threshold: int = 0):
-        """``secondary_mode``: hierarchical partitioning over the factored
-        (data=outer, subdata=inner) DP world —
-          "hpz"  — ZeRO++ secondary partition: PARAMS shard only over the
-                   inner axes (within-node gather rides ICI), optimizer/grad
-                   state still shards over the full ZeRO world
-                   (ref zero_hpz_partition_size, runtime/zero/config.py:300);
-          "mics" — MiCS: params AND optimizer/grad state shard only within
-                   the sub-group; the outer data axis is pure replication
-                   with (XLA-inserted) hierarchical gradient allreduce
-                   (ref MiCS_Init/MiCS_Optimizer, runtime/zero/mics.py).
-        """
-        self.topo = topology
-        self.zero_stage = zero_stage
-        self.rules = [(re.compile(pat), dims) for pat, dims in (rules or DEFAULT_RULES)]
-        self.shard_norms = shard_norms
-        if secondary_mode not in ("none", "hpz", "mics"):
-            raise ValueError(f"secondary_mode {secondary_mode!r}")
-        self.secondary_mode = secondary_mode
-        # params with fewer elements than this stay gathered under ZeRO-3
-        # (ref param_persistence_threshold, runtime/zero/config.py)
-        self.persist_threshold = int(persist_threshold)
-
-    # ------------------------------------------------------------------
-    def _fsdp_axes(self, is_expert_param: bool,
-                   param_style: bool) -> Tuple[str, ...]:
-        if self.secondary_mode == "mics" or (self.secondary_mode == "hpz"
-                                             and param_style):
-            candidates = (SUBDATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
-        else:
-            candidates = (DATA_AXIS, SUBDATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
-        axes = []
-        for ax in candidates:
-            if is_expert_param and ax == EXPERT_AXIS:
-                continue  # expert dim already consumes the expert axis
-            if self.topo.axis_size(ax) > 1:
-                axes.append(ax)
-        return tuple(axes)
-
-    def _logical_dims(self, path: str, ndim: int) -> Optional[Tuple[Optional[str], ...]]:
-        for pat, dims in self.rules:
-            if pat.search(path):
-                if len(dims) != ndim:
-                    logger.warning(f"sharding rule for '{path}' has {len(dims)} dims, "
-                                   f"array has {ndim}; replicating")
-                    return None
-                return dims
-        return None
-
-    def spec_for(self, path: str, shape: Tuple[int, ...],
-                 param_style: bool = True) -> P:
-        """PartitionSpec for a parameter array.
-
-        ``param_style=True`` applies stage-3 fsdp sharding only when
-        zero_stage == 3; pass False to get the always-fsdp spec used for
-        optimizer state (stage>=1) and grad accumulators (stage>=2).
-        """
-        ndim = len(shape)
-        dims = self._logical_dims(path, ndim)
-        if dims is None:
-            return P()
-        is_expert = "expert" in dims
-        fsdp_axes = self._fsdp_axes(is_expert, param_style)
-        apply_fsdp = bool(fsdp_axes) and (not param_style or self.zero_stage >= 3)
-        if apply_fsdp and param_style and self.persist_threshold:
-            # persistent small params (ref param_persistence_threshold,
-            # runtime/zero/parameter_offload.py persistent-param set):
-            # keeping norms/biases gathered avoids a per-use all-gather
-            # whose latency dwarfs its bytes; optimizer state
-            # (param_style=False) stays partitioned like the reference.
-            # The threshold is PER PARAMETER — divide out the stacked
-            # layer dim, or every norm crosses it via L alone.
-            elems = int(np.prod(shape)) if shape else 1
-            if dims[0] == "layer" and shape:
-                elems //= max(1, shape[0])
-            if elems < self.persist_threshold:
-                apply_fsdp = False
-        tp = self.topo.tp_size > 1
-
-        spec: List[Any] = [None] * ndim
-        for i, d in enumerate(dims):
-            if d == "layer" and self.topo.pp_size > 1:
-                # stacked-layer axis → pipeline stages (ref PipelineModule
-                # uniform partitioning, runtime/pipe/module.py:393)
-                if shape[i] % self.topo.pp_size == 0:
-                    spec[i] = PIPE_AXIS
-            elif d == "expert" and self.topo.ep_size > 1:
-                if shape[i] % self.topo.ep_size == 0:
-                    spec[i] = EXPERT_AXIS
-            elif d in ("mlp", "heads", "vocab") and tp:
-                if shape[i] % self.topo.tp_size == 0:
-                    spec[i] = TENSOR_AXIS
-
-        if apply_fsdp:
-            n_shard = int(np.prod([self.topo.axis_size(a) for a in fsdp_axes]))
-            # Prefer the designated fsdp dim ("embed" / "norm" / "pos"),
-            # falling back to any unsharded divisible dim.
-            candidates = [i for i, d in enumerate(dims)
-                          if d in ("embed", "norm", "pos") and spec[i] is None]
-            if not self.shard_norms:
-                candidates = [i for i in candidates if dims[i] != "norm"]
-            candidates += [i for i, d in enumerate(dims)
-                           if d in ("mlp", "heads", "vocab") and spec[i] is None]
-            for i in candidates:
-                if shape[i] % n_shard == 0:
-                    spec[i] = fsdp_axes if len(fsdp_axes) > 1 else fsdp_axes[0]
-                    break
-        return P(*spec)
-
-    # ------------------------------------------------------------------
-    def audit_replicated(self, params, min_bytes: int = 1 << 20):
-        """Large parameters that fall through ``spec_for``'s divisibility
-        fallback and end up fully replicated despite a >1 shardable world.
-
-        A big replicated tensor silently degrades ZeRO-3 to ZeRO-1 for
-        that param (and AutoTP to no-op) — callers must surface this
-        loudly rather than discover it as OOM at scale.  Returns
-        ``[(path, shape, nbytes)]``; empty when every axis is size 1
-        (nothing could shard) or all large params got a sharded dim.
-        """
-        fsdp_axes = self._fsdp_axes(False, param_style=True)
-        fsdp_world = int(np.prod([self.topo.axis_size(a)
-                                  for a in fsdp_axes])) if fsdp_axes else 1
-        # pp deliberately excluded: pipeline shards only the stacked-layer
-        # dim; embeds/head replicating across stages is by design
-        shard_world = max(fsdp_world if self.zero_stage >= 3 else 1,
-                          self.topo.tp_size)
-        if shard_world <= 1:
-            return []
-        offenders = []
-
-        def visit(path, leaf):
-            shape = tuple(np.shape(leaf))
-            dt = np.dtype(getattr(leaf, "dtype", np.float32))
-            nbytes = int(np.prod(shape)) * dt.itemsize if shape else 0
-            if nbytes < min_bytes:
-                return
-            spec = self.spec_for(path_str(path), shape, param_style=True)
-            if all(s is None for s in spec):
-                offenders.append((path_str(path), shape, nbytes))
-
-        jax.tree_util.tree_map_with_path(visit, params)
-        return offenders
-
-    def tree_specs(self, params, param_style: bool = True):
-        """Pytree of PartitionSpecs matching ``params``."""
-        def leaf_spec(path, leaf):
-            return self.spec_for(path_str(path), np.shape(leaf), param_style=param_style)
-
-        return jax.tree_util.tree_map_with_path(leaf_spec, params)
-
-    def tree_shardings(self, params, param_style: bool = True):
-        specs = self.tree_specs(params, param_style=param_style)
-        return jax.tree.map(lambda s: NamedSharding(self.topo.mesh, s), specs,
-                            is_leaf=lambda x: isinstance(x, P))
-
-    def param_shardings(self, params):
-        return self.tree_shardings(params, param_style=True)
-
-    def optimizer_shardings(self, params):
-        """Optimizer-state sharding: partitioned when stage >= 1 (ZeRO-1)."""
-        return self.tree_shardings(params, param_style=self.zero_stage < 1)
-
-    def grad_accum_shardings(self, params):
-        """Grad-accumulator sharding: partitioned when stage >= 2 (ZeRO-2)."""
-        return self.tree_shardings(params, param_style=self.zero_stage < 2)
+__all__ = ["ShardingRules", "PartitionOracle", "DEFAULT_RULES", "path_str"]

@@ -1,0 +1,22 @@
+"""Self-healing elastic training: sharding oracle + recovery supervisor.
+
+Two halves (docs/ELASTICITY.md):
+
+* :mod:`~deepspeed_tpu.resilience.oracle` — :class:`PartitionOracle`,
+  the ONE name-based partition-spec source shared by engine init,
+  checkpoint save/load and the serving replicas, which is what lets a
+  universal checkpoint saved on one mesh land on any other
+  (dp/fsdp/tp refactorizations, shrunk worlds).
+* :mod:`~deepspeed_tpu.resilience.supervisor` — the watchdog → elastic
+  agent → universal-resume recovery loop that turns a mid-run worker
+  death or hang into a measured goodput gap instead of a dead job.
+
+``oracle`` imports jax; ``supervisor``/``worker`` drive subprocesses and
+stay importable without an accelerator stack, so the import here is
+split the same way as :mod:`deepspeed_tpu.serving`.
+"""
+
+from deepspeed_tpu.resilience.oracle import (DEFAULT_RULES, PartitionOracle,
+                                             path_str, plan_mesh)
+
+__all__ = ["PartitionOracle", "DEFAULT_RULES", "path_str", "plan_mesh"]

@@ -1,0 +1,372 @@
+"""PartitionOracle: the single name-based partition-spec source.
+
+This is the systematic-placement half of arXiv:2601.02311 applied to
+recovery: every parameter **path** maps — by regex pattern + shape
+heuristics (the SNIPPETS.md [3] idiom) — to a tuple of logical dims, and
+logical dims map to mesh axes for whatever topology the oracle is built
+over.  Because the mapping is a pure function of ``(path, shape,
+topology, config)`` and never of the array's current placement, the SAME
+oracle answers three different callers identically:
+
+* **engine init** (``runtime/engine.py``) — parameter / optimizer /
+  grad-accumulator shardings for the training mesh;
+* **checkpoint save/load** (``checkpoint/universal.py``) — a flat
+  ``{path: array}`` checkpoint re-lands on an ARBITRARY target mesh
+  (different dp/fsdp/tp factorization, shrunk world) by asking the
+  target engine's oracle for each path's spec;
+* **serving replicas** (``inference/v2/engine_v2.py`` via
+  ``serving/replica.py``) — the same weights shard onto each replica's
+  mesh slice, which is what lets a :class:`ReplicaSet` grow/shrink live.
+
+Any per-site spec derivation is a resharding bug waiting to happen —
+two derivations that drift make a checkpoint saved by one unloadable by
+the other.  ``parallel/sharding.py`` re-exports this class under its
+historical name ``ShardingRules`` so existing callers keep working; the
+implementation lives HERE only.
+
+The logical-dim table and ZeRO/TP/hpZ/MiCS semantics are the TPU-native
+core of what the reference spreads across
+``runtime/zero/partition_parameters.py`` (ZeRO-3 param partitioning),
+``runtime/zero/stage_1_and_2.py`` (optimizer/grad partitioning) and
+``module_inject/auto_tp.py`` (AutoTP tensor-parallel sharding).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.parallel.topology import (DATA_AXIS, EXPERT_AXIS, MESH_AXES,
+                                             PIPE_AXIS, SEQ_AXIS, SUBDATA_AXIS,
+                                             TENSOR_AXIS, MeshTopology)
+from deepspeed_tpu.utils.logging import logger
+
+# path-pattern → logical dims, one entry per array dim.
+# Logical dim vocabulary:
+#   layer   — stacked-layer scan axis (never sharded)
+#   expert  — stacked-expert axis → "expert" mesh axis
+#   embed   — hidden/residual dim  → fsdp candidate
+#   mlp     — ffn intermediate dim → "tensor" (column-parallel)
+#   heads   — attention projection out dim → "tensor" (column-parallel)
+#   vocab   — vocabulary dim → "tensor"
+#   norm    — layernorm vector → fsdp candidate (1-D, ZeRO-3 shards these too)
+#   pos     — position-embedding rows
+DEFAULT_RULES: List[Tuple[str, Tuple[Optional[str], ...]]] = [
+    (r"embed/tokens$", ("vocab", "embed")),
+    (r"embed/positions$", ("pos", "embed")),
+    (r"embed/token_types$", ("pos", "embed")),
+    (r"embed/norm/(scale|bias)$", ("norm",)),
+    # BERT MLM head (transform dense + LN + vocab bias)
+    (r"mlm_head/w$", ("embed", None)),
+    (r"mlm_head/b$", ("embed",)),
+    (r"mlm_head/ln/(scale|bias)$", ("norm",)),
+    (r"mlm_head/bias$", ("vocab",)),
+    (r"attn/w[qkv]$", ("layer", "embed", "heads")),
+    (r"attn/b[qkv]$", ("layer", "heads")),
+    (r"attn/wo$", ("layer", "heads", "embed")),
+    (r"attn/bo$", ("layer", "embed")),
+    (r"mlp/w[ig]$", ("layer", "embed", "mlp")),
+    (r"mlp/bi$", ("layer", "mlp")),
+    (r"mlp/wo$", ("layer", "mlp", "embed")),
+    (r"mlp/bo$", ("layer", "embed")),
+    (r"moe/router$", ("layer", "embed", None)),
+    (r"moe/w[ig]$", ("layer", "expert", "embed", "mlp")),
+    (r"moe/wo$", ("layer", "expert", "mlp", "embed")),
+    # Qwen2-MoE shared expert: dense FFN shapes (no expert dim)
+    (r"moe/shared/w[ig]$", ("layer", "embed", "mlp")),
+    (r"moe/shared/wo$", ("layer", "mlp", "embed")),
+    (r"moe/shared_gate$", ("layer", "embed", None)),
+    # PR-MoE residual branch (ref moe/layer.py:83): dense FFN + Linear(h,2)
+    (r"moe/residual/w[ig]$", ("layer", "embed", "mlp")),
+    (r"moe/residual/wo$", ("layer", "mlp", "embed")),
+    (r"moe/coef_w$", ("layer", "embed", None)),
+    (r"moe/coef_b$", ("layer", None)),
+    (r"ln\d/(scale|bias)$", ("layer", "norm")),
+    (r"final_norm/(scale|bias)$", ("norm",)),
+    (r"lm_head$", ("embed", "vocab")),
+]
+
+
+def path_str(path) -> str:
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def plan_mesh(n_devices: int,
+              template: Optional[Mapping[str, int]] = None) -> Dict[str, int]:
+    """Re-plan mesh axis sizes for a (possibly shrunk) device count.
+
+    The recovery supervisor calls this when a host is gone and the
+    surviving world must re-mesh before the universal-checkpoint resume:
+    model-parallel axes from the previous run (``template``) are KEPT
+    while they still divide the new world — their layouts are what the
+    checkpoint's tensors expect to find useful — and the data axis
+    absorbs whatever remains.  Axes that no longer fit are shed
+    outermost-first (pipe, subdata, expert, seq, tensor): the innermost
+    axes carry the highest-bandwidth collectives and the most intrusive
+    layouts, so they are the last to fold into data parallelism.
+    """
+    if n_devices < 1:
+        raise ValueError(f"plan_mesh: n_devices={n_devices}")
+    template = dict(template or {})
+    sizes = {ax: max(1, int(template.get(ax, 1)))
+             for ax in MESH_AXES if ax != DATA_AXIS}
+    shed_order = (PIPE_AXIS, SUBDATA_AXIS, EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS)
+    prod = int(np.prod(list(sizes.values())))
+    while prod > 1 and (n_devices % prod != 0 or prod > n_devices):
+        for ax in shed_order:
+            if sizes[ax] > 1:
+                sizes[ax] = 1
+                break
+        prod = int(np.prod(list(sizes.values())))
+    plan = dict(sizes)
+    plan[DATA_AXIS] = n_devices // prod
+    return {ax: int(plan.get(ax, 1)) for ax in MESH_AXES}
+
+
+def secondary_mode_from_config(zero_config: Any) -> str:
+    """hpZ / MiCS hierarchical-partitioning mode from a zero config block
+    — shared by the engine (which factors the data axis BEFORE the mesh
+    exists) and :meth:`PartitionOracle.from_config`."""
+    if getattr(zero_config, "zero_hpz_partition_size", 1) > 1:
+        return "hpz"
+    if getattr(zero_config, "mics_shard_size", 0) > 0:
+        return "mics"
+    return "none"
+
+
+class PartitionOracle:
+    """Resolves param paths to PartitionSpecs/NamedShardings for a given
+    topology + config.  See the module docstring for the single-source
+    contract."""
+
+    def __init__(self, topology: MeshTopology, zero_stage: int = 0,
+                 rules: Optional[List[Tuple[str, Tuple[Optional[str], ...]]]] = None,
+                 shard_norms: bool = True, secondary_mode: str = "none",
+                 persist_threshold: int = 0):
+        """``secondary_mode``: hierarchical partitioning over the factored
+        (data=outer, subdata=inner) DP world —
+          "hpz"  — ZeRO++ secondary partition: PARAMS shard only over the
+                   inner axes (within-node gather rides ICI), optimizer/grad
+                   state still shards over the full ZeRO world
+                   (ref zero_hpz_partition_size, runtime/zero/config.py:300);
+          "mics" — MiCS: params AND optimizer/grad state shard only within
+                   the sub-group; the outer data axis is pure replication
+                   with (XLA-inserted) hierarchical gradient allreduce
+                   (ref MiCS_Init/MiCS_Optimizer, runtime/zero/mics.py).
+        """
+        self.topo = topology
+        self.zero_stage = zero_stage
+        self.rules = [(re.compile(pat), dims) for pat, dims in (rules or DEFAULT_RULES)]
+        self.shard_norms = shard_norms
+        if secondary_mode not in ("none", "hpz", "mics"):
+            raise ValueError(f"secondary_mode {secondary_mode!r}")
+        self.secondary_mode = secondary_mode
+        # params with fewer elements than this stay gathered under ZeRO-3
+        # (ref param_persistence_threshold, runtime/zero/config.py)
+        self.persist_threshold = int(persist_threshold)
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_config(cls, topology: MeshTopology, config: Any,
+                    **over) -> "PartitionOracle":
+        """The engine-side construction recipe, in ONE place: zero stage,
+        hpZ/MiCS secondary mode, and the persistence threshold (with the
+        pinned ``step_schedule`` override winning over the static
+        ``zero_optimization`` value) all come from a
+        :class:`~deepspeed_tpu.runtime.config.DeepSpeedConfig`.  The
+        recovery supervisor and the resumed engine both build their
+        oracle through here, so a resume can never derive different
+        specs than the run it resumes."""
+        zc = config.zero_config
+        persist = zc.param_persistence_threshold
+        ss = getattr(config, "step_schedule", None)
+        if ss is not None and ss.param_persistence_threshold is not None:
+            persist = ss.param_persistence_threshold
+        kw = dict(zero_stage=zc.stage,
+                  secondary_mode=secondary_mode_from_config(zc),
+                  persist_threshold=persist)
+        kw.update(over)
+        return cls(topology, **kw)
+
+    # ------------------------------------------------------------------
+    def _fsdp_axes(self, is_expert_param: bool,
+                   param_style: bool) -> Tuple[str, ...]:
+        if self.secondary_mode == "mics" or (self.secondary_mode == "hpz"
+                                             and param_style):
+            candidates = (SUBDATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
+        else:
+            candidates = (DATA_AXIS, SUBDATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
+        axes = []
+        for ax in candidates:
+            if is_expert_param and ax == EXPERT_AXIS:
+                continue  # expert dim already consumes the expert axis
+            if self.topo.axis_size(ax) > 1:
+                axes.append(ax)
+        return tuple(axes)
+
+    def _logical_dims(self, path: str, ndim: int) -> Optional[Tuple[Optional[str], ...]]:
+        for pat, dims in self.rules:
+            if pat.search(path):
+                if len(dims) != ndim:
+                    logger.warning(f"sharding rule for '{path}' has {len(dims)} dims, "
+                                   f"array has {ndim}; replicating")
+                    return None
+                return dims
+        return None
+
+    def spec_for(self, path: str, shape: Tuple[int, ...],
+                 param_style: bool = True) -> P:
+        """PartitionSpec for a parameter array.
+
+        ``param_style=True`` applies stage-3 fsdp sharding only when
+        zero_stage == 3; pass False to get the always-fsdp spec used for
+        optimizer state (stage>=1) and grad accumulators (stage>=2).
+        """
+        ndim = len(shape)
+        dims = self._logical_dims(path, ndim)
+        if dims is None:
+            return P()
+        is_expert = "expert" in dims
+        fsdp_axes = self._fsdp_axes(is_expert, param_style)
+        apply_fsdp = bool(fsdp_axes) and (not param_style or self.zero_stage >= 3)
+        if apply_fsdp and param_style and self.persist_threshold:
+            # persistent small params (ref param_persistence_threshold,
+            # runtime/zero/parameter_offload.py persistent-param set):
+            # keeping norms/biases gathered avoids a per-use all-gather
+            # whose latency dwarfs its bytes; optimizer state
+            # (param_style=False) stays partitioned like the reference.
+            # The threshold is PER PARAMETER — divide out the stacked
+            # layer dim, or every norm crosses it via L alone.
+            elems = int(np.prod(shape)) if shape else 1
+            if dims[0] == "layer" and shape:
+                elems //= max(1, shape[0])
+            if elems < self.persist_threshold:
+                apply_fsdp = False
+        tp = self.topo.tp_size > 1
+
+        spec: List[Any] = [None] * ndim
+        for i, d in enumerate(dims):
+            if d == "layer" and self.topo.pp_size > 1:
+                # stacked-layer axis → pipeline stages (ref PipelineModule
+                # uniform partitioning, runtime/pipe/module.py:393)
+                if shape[i] % self.topo.pp_size == 0:
+                    spec[i] = PIPE_AXIS
+            elif d == "expert" and self.topo.ep_size > 1:
+                if shape[i] % self.topo.ep_size == 0:
+                    spec[i] = EXPERT_AXIS
+            elif d in ("mlp", "heads", "vocab") and tp:
+                if shape[i] % self.topo.tp_size == 0:
+                    spec[i] = TENSOR_AXIS
+
+        if apply_fsdp:
+            n_shard = int(np.prod([self.topo.axis_size(a) for a in fsdp_axes]))
+            # Shape heuristic: prefer the designated fsdp dim
+            # ("embed" / "norm" / "pos"), falling back to any unsharded
+            # divisible dim.
+            candidates = [i for i, d in enumerate(dims)
+                          if d in ("embed", "norm", "pos") and spec[i] is None]
+            if not self.shard_norms:
+                candidates = [i for i in candidates if dims[i] != "norm"]
+            candidates += [i for i, d in enumerate(dims)
+                           if d in ("mlp", "heads", "vocab") and spec[i] is None]
+            for i in candidates:
+                if shape[i] % n_shard == 0:
+                    spec[i] = fsdp_axes if len(fsdp_axes) > 1 else fsdp_axes[0]
+                    break
+        return P(*spec)
+
+    # ------------------------------------------------------------------
+    def audit_replicated(self, params, min_bytes: int = 1 << 20):
+        """Large parameters that fall through ``spec_for``'s divisibility
+        fallback and end up fully replicated despite a >1 shardable world.
+
+        A big replicated tensor silently degrades ZeRO-3 to ZeRO-1 for
+        that param (and AutoTP to no-op) — callers must surface this
+        loudly rather than discover it as OOM at scale.  Returns
+        ``[(path, shape, nbytes)]``; empty when every axis is size 1
+        (nothing could shard) or all large params got a sharded dim.
+        """
+        fsdp_axes = self._fsdp_axes(False, param_style=True)
+        fsdp_world = int(np.prod([self.topo.axis_size(a)
+                                  for a in fsdp_axes])) if fsdp_axes else 1
+        # pp deliberately excluded: pipeline shards only the stacked-layer
+        # dim; embeds/head replicating across stages is by design
+        shard_world = max(fsdp_world if self.zero_stage >= 3 else 1,
+                          self.topo.tp_size)
+        if shard_world <= 1:
+            return []
+        offenders = []
+
+        def visit(path, leaf):
+            shape = tuple(np.shape(leaf))
+            dt = np.dtype(getattr(leaf, "dtype", np.float32))
+            nbytes = int(np.prod(shape)) * dt.itemsize if shape else 0
+            if nbytes < min_bytes:
+                return
+            spec = self.spec_for(path_str(path), shape, param_style=True)
+            if all(s is None for s in spec):
+                offenders.append((path_str(path), shape, nbytes))
+
+        jax.tree_util.tree_map_with_path(visit, params)
+        return offenders
+
+    def tree_specs(self, params, param_style: bool = True):
+        """Pytree of PartitionSpecs matching ``params``."""
+        def leaf_spec(path, leaf):
+            return self.spec_for(path_str(path), np.shape(leaf), param_style=param_style)
+
+        return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+    def tree_shardings(self, params, param_style: bool = True):
+        specs = self.tree_specs(params, param_style=param_style)
+        return jax.tree.map(lambda s: NamedSharding(self.topo.mesh, s), specs,
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def param_shardings(self, params):
+        return self.tree_shardings(params, param_style=True)
+
+    def optimizer_shardings(self, params):
+        """Optimizer-state sharding: partitioned when stage >= 1 (ZeRO-1)."""
+        return self.tree_shardings(params, param_style=self.zero_stage < 1)
+
+    def grad_accum_shardings(self, params):
+        """Grad-accumulator sharding: partitioned when stage >= 2 (ZeRO-2)."""
+        return self.tree_shardings(params, param_style=self.zero_stage < 2)
+
+    # -- flat (checkpoint) interface -----------------------------------
+    def flat_specs(self, manifest: Mapping[str, Any],
+                   param_style: bool = True) -> Dict[str, P]:
+        """Specs for a FLAT ``{path: shape-or-array}`` manifest — the
+        universal-checkpoint resharding entry: a saved flat checkpoint
+        carries no pytree, only paths and shapes, and this is everything
+        the oracle needs."""
+        out: Dict[str, P] = {}
+        for path, shp in manifest.items():
+            shape = tuple(np.shape(shp)) if not isinstance(shp, (tuple, list)) \
+                else tuple(int(s) for s in shp)
+            out[path] = self.spec_for(path, shape, param_style=param_style)
+        return out
+
+    def flat_shardings(self, manifest: Mapping[str, Any],
+                       param_style: bool = True) -> Dict[str, NamedSharding]:
+        return {k: NamedSharding(self.topo.mesh, s)
+                for k, s in self.flat_specs(manifest,
+                                            param_style=param_style).items()}
+
+
+# Historical name: the class predates the resilience subsystem.  It is
+# the SAME object — parallel/sharding.py re-exports it — so there is
+# exactly one spec derivation in the tree.
+ShardingRules = PartitionOracle

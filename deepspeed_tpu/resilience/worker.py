@@ -1,0 +1,176 @@
+"""Resumable training worker — the process the recovery supervisor runs.
+
+One incarnation of one rank: build an engine on the mesh the supervisor
+planned (``DSTPU_MESH``), optionally resume from the latest COMMITTED
+universal checkpoint, train, heartbeat every step, and persist a
+crash-atomic universal checkpoint so the next incarnation — possibly on
+a smaller mesh — can pick up where this one died.  Used directly by the
+chaos bench row and the tier-1 chaos e2e test; any real training script
+that honors the same env contract (docs/ELASTICITY.md "worker
+contract") plugs into the supervisor identically.
+
+Env contract (all optional unless marked):
+    DSTPU_MESH           json mesh sizes, e.g. '{"data": 4}'  [required]
+    DSTPU_CKPT_DIR       checkpoint root                       [required]
+    DSTPU_PROGRESS       rank-0 heartbeat/progress JSONL path  [required]
+    DSTPU_TOTAL_STEPS    train until global_steps reaches this (default 8)
+    DSTPU_RESUME         "1": resume from the latest committed universal
+    DSTPU_MODEL          model-zoo name (default gpt2-tiny)
+    DSTPU_SEQ            sequence length (default 16)
+    DSTPU_BATCH          GLOBAL batch size (default 8) — held fixed across
+                         resizes so the loss curve stays comparable
+    DSTPU_ZERO_STAGE     zero_optimization.stage (default 2)
+    DSTPU_SAVE_EVERY     checkpoint cadence in steps (default 1)
+    DSTPU_FORCE_CPU      "1": force the cpu platform with
+                         product(mesh) virtual host devices (the smoke /
+                         tier-1 harness; on-chip runs leave it unset)
+    DSTPU_CHAOS          json fault injection, honored ONCE per ckpt dir
+                         (a sentinel file arms exactly one incarnation):
+                         {"die_at": N}          — exit(13) after step N,
+                                                  BEFORE saving it
+                         {"hang_at": N}         — stop heartbeating after
+                                                  step N (simulated wedge)
+                         {"ignore_term": true}  — also swallow SIGTERM, so
+                                                  only SIGKILL escalation
+                                                  can clear the worker
+                         {"rank": r}            — which rank acts (default 0)
+
+Per-step progress lines ``{"step", "loss", "rank", "incarnation",
+"time_unix"}`` are the supervisor's heartbeat AND the loss-continuity
+evidence: batches are a pure function of the step index, so a resumed
+curve must land on the unkilled run's curve.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+
+# env read + platform forcing BEFORE any jax device use (backends are
+# lazy, so this is early enough even though the package __init__ already
+# imported jax)
+_MESH = {k: int(v) for k, v in
+         json.loads(os.environ.get("DSTPU_MESH") or "{}").items()}
+_NDEV = 1
+for _v in _MESH.values():
+    _NDEV *= max(1, _v)
+if os.environ.get("DSTPU_FORCE_CPU", "0") == "1":
+    _flag = f"--xla_force_host_platform_device_count={_NDEV}"
+    if _flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " " + _flag)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _chaos_cfg() -> dict:
+    return json.loads(os.environ.get("DSTPU_CHAOS") or "{}")
+
+
+def _chaos_armed(ckpt_dir: str) -> bool:
+    """Fault injection fires in exactly one incarnation: the sentinel is
+    written BEFORE the fatal action, so the restarted worker sees it and
+    trains through."""
+    return not os.path.exists(os.path.join(ckpt_dir, ".chaos_fired"))
+
+
+def _arm_sentinel(ckpt_dir: str) -> None:
+    with open(os.path.join(ckpt_dir, ".chaos_fired"), "w") as f:
+        f.write(str(os.getpid()))
+
+
+def main() -> int:
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.checkpoint.universal import (ds_to_universal,
+                                                    load_universal,
+                                                    resolve_universal_dir)
+    from deepspeed_tpu.models import get_model_config
+
+    rank = int(os.environ.get("DSTPU_PROC_ID", "0"))
+    ckpt_dir = os.environ["DSTPU_CKPT_DIR"]
+    progress = os.environ["DSTPU_PROGRESS"]
+    if rank != 0:
+        progress = f"{progress}.r{rank}"
+    total_steps = int(os.environ.get("DSTPU_TOTAL_STEPS", "8"))
+    seq = int(os.environ.get("DSTPU_SEQ", "16"))
+    batch_size = int(os.environ.get("DSTPU_BATCH", "8"))
+    save_every = int(os.environ.get("DSTPU_SAVE_EVERY", "1"))
+    resume = os.environ.get("DSTPU_RESUME", "0") == "1"
+    incarnation = int(os.environ.get("DSTPU_INCARNATION", "0"))
+
+    chaos = _chaos_cfg()
+    chaos_mine = (int(chaos.get("rank", 0)) == rank and chaos
+                  and _chaos_armed(ckpt_dir))
+    if chaos_mine and chaos.get("ignore_term"):
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+
+    model = get_model_config(os.environ.get("DSTPU_MODEL", "gpt2-tiny"),
+                             max_seq_len=max(seq, 16))
+    dp = (_MESH.get("data", 1) * _MESH.get("subdata", 1)
+          * _MESH.get("expert", 1))
+    cfg = {
+        "train_batch_size": batch_size,
+        "train_micro_batch_size_per_gpu": max(1, batch_size // dp),
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {
+            "stage": int(os.environ.get("DSTPU_ZERO_STAGE", "2"))},
+        "steps_per_print": 100000,
+        "mesh": _MESH,
+    }
+    engine, _, _, _ = ds.initialize(model=model, config=cfg, seed=7)
+    if resume:
+        try:
+            load_universal(engine, resolve_universal_dir(ckpt_dir))
+        except FileNotFoundError:
+            # crashed before the FIRST committed save: nothing to resume,
+            # start over — a missing checkpoint must not wedge recovery
+            print("worker: no committed universal checkpoint yet; "
+                  "starting from step 0", flush=True)
+
+    def batch_for(step: int):
+        # pure function of the step index: every incarnation (any mesh)
+        # consumes the identical global batch, so curves are comparable
+        rng = np.random.default_rng(1000 + step)
+        ids = rng.integers(0, model.vocab_size, size=(batch_size, seq + 1),
+                           dtype=np.int32)
+        return {"input_ids": ids[:, :-1], "labels": ids[:, 1:]}
+
+    while engine.global_steps < total_steps:
+        step = engine.global_steps  # 0-based index of the step we run
+        loss = float(np.asarray(engine.train_batch(batch_for(step))))
+        with open(progress, "a") as f:
+            f.write(json.dumps({"step": engine.global_steps, "loss": loss,
+                                "rank": rank, "incarnation": incarnation,
+                                "time_unix": time.time()}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+        done = engine.global_steps
+        if chaos_mine and chaos.get("die_at") is not None \
+                and done >= int(chaos["die_at"]):
+            # BEFORE the save: the step we just ran is lost and the
+            # resumed incarnation must recompute it from the previous
+            # committed checkpoint — the real mid-train crash shape
+            _arm_sentinel(ckpt_dir)
+            os._exit(13)
+        if chaos_mine and chaos.get("hang_at") is not None \
+                and done >= int(chaos["hang_at"]):
+            _arm_sentinel(ckpt_dir)
+            while True:  # simulated wedge: alive, silent, not progressing
+                time.sleep(3600)
+
+        if rank == 0 and done % save_every == 0:
+            tag = f"step{done}"
+            engine.save_checkpoint(ckpt_dir, tag=tag)
+            ds_to_universal(ckpt_dir, tag=tag)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,7 +36,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from deepspeed_tpu.models import transformer as tf_model
 from deepspeed_tpu.models.transformer import TransformerConfig
-from deepspeed_tpu.parallel.sharding import ShardingRules
+from deepspeed_tpu.resilience.oracle import (PartitionOracle,
+                                             secondary_mode_from_config)
 from deepspeed_tpu.parallel.topology import (BATCH_AXES, SEQ_AXIS, MeshTopology, get_topology,
                                              set_topology)
 from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
@@ -179,8 +180,7 @@ class DeepSpeedEngine:
 
         # -- topology: mesh block merged with tensor_parallel/pipeline/etc.
         zc = self.config.zero_config
-        self._secondary_mode = ("hpz" if zc.zero_hpz_partition_size > 1 else
-                                "mics" if zc.mics_shard_size > 0 else "none")
+        self._secondary_mode = secondary_mode_from_config(zc)
         if topology is None:
             mesh_sizes = self.config.mesh.resolved(len(jax.devices()))
             if self._secondary_mode != "none":
@@ -327,17 +327,15 @@ class DeepSpeedEngine:
             self._init_fn = model.init
             self._loss_fn = model.loss
 
-        # -- sharding rules --------------------------------------------
-        # persistence threshold: a pinned step_schedule overrides the
-        # static zero_optimization value (overlap_scheduler raises it
-        # when the capture shows exposed small-param gathers)
-        persist = cfg.zero_config.param_persistence_threshold
-        if cfg.step_schedule.param_persistence_threshold is not None:
-            persist = cfg.step_schedule.param_persistence_threshold
-        self.rules = ShardingRules(
-            topology, zero_stage=self.zero_stage,
-            secondary_mode=self._secondary_mode,
-            persist_threshold=persist)
+        # -- sharding oracle -------------------------------------------
+        # THE partition-spec source for this engine: init, checkpoint
+        # save/load (universal resharding included) and any serving
+        # engine sharing these weights all read specs from here — the
+        # construction recipe (zero stage, hpZ/MiCS mode, persistence
+        # threshold incl. the pinned step_schedule override) lives in
+        # PartitionOracle.from_config, not at this call site.
+        self.oracle = PartitionOracle.from_config(topology, cfg)
+        self.rules = self.oracle
         rng = jax.random.PRNGKey(self.seed)
 
         params_shape = jax.eval_shape(self._init_fn, rng)
@@ -1694,6 +1692,17 @@ class DeepSpeedEngine:
         # place — but must not outlive training
         self._grad_buffer = None
         self._cancel_prefetch()
+        ce = self._checkpoint_engine
+        if ce is not None and hasattr(ce, "wait"):
+            # an async writer (orbax/decoupled) publishes meta.json + the
+            # `latest` pointer only at wait() — without this, the run's
+            # FINAL save would stream all its shards and still be
+            # unloadable because its commit point never ran
+            try:
+                ce.wait()
+            except Exception as e:
+                logger.warning(f"checkpoint writer wait() failed during "
+                               f"destroy: {e}")
         if self._watchdog is not None:
             self._watchdog.stop()
         if self.telemetry is not None and sys.exc_info()[0] is not None:

@@ -220,8 +220,8 @@ class RouterMetrics:
         self._requests = reg.counter("router_requests_total")
         self._rejected = reg.counter("router_rejected_total")
         self._failovers = reg.counter("router_failovers_total")
-        self._routed = [reg.counter(f"router_routed_r{i}_total")
-                        for i in range(n_replicas)]
+        self._routed = {i: reg.counter(f"router_routed_r{i}_total")
+                        for i in range(n_replicas)}
         self._g_alive = reg.gauge("router_replicas_alive")
 
     requests = property(lambda self: int(self._requests.value))
@@ -229,7 +229,17 @@ class RouterMetrics:
     failovers = property(lambda self: int(self._failovers.value))
 
     def routed(self, i: int) -> int:
-        return int(self._routed[i].value)
+        c = self._routed.get(i)
+        return int(c.value) if c is not None else 0
+
+    def ensure_replica(self, i: int) -> None:
+        """Counter for a replica added AFTER construction (live grow /
+        respawn) — the registry get-or-creates, so an index that comes
+        back keeps its lifetime count."""
+        if i not in self._routed:
+            self._routed[i] = self.registry.counter(
+                f"router_routed_r{i}_total")
+            self.n_replicas = max(self.n_replicas, i + 1)
 
     def record_submit(self) -> None:
         self._requests.inc()
@@ -238,6 +248,7 @@ class RouterMetrics:
         self._rejected.inc()
 
     def record_route(self, replica: int) -> None:
+        self.ensure_replica(replica)
         self._routed[replica].inc()
 
     def record_failover(self) -> None:
@@ -253,5 +264,5 @@ class RouterMetrics:
             "failovers": self.failovers,
             "replicas_alive": int(self._g_alive.value),
             "routed": {f"r{i}": self.routed(i)
-                       for i in range(self.n_replicas)},
+                       for i in sorted(self._routed)},
         }

@@ -75,12 +75,21 @@ class ServingReplica:
 
 
 class ReplicaSet:
-    """Owns N replicas; start/stop fan out, build slices the devices."""
+    """Owns N replicas; start/stop fan out, build slices the devices.
+
+    A set built through :meth:`build` can also GROW/SHRINK live
+    (:meth:`grow`, :meth:`shrink`, :meth:`respawn`): every replica's
+    engine derives its weight shardings from the same
+    :class:`~deepspeed_tpu.resilience.oracle.PartitionOracle` rules the
+    training engine uses, so a replica built mid-flight on a fresh slice
+    is bit-identical to the originals and the router's fail-over
+    machinery covers requests through the transition."""
 
     def __init__(self, replicas: Sequence[ServingReplica]):
         if not replicas:
             raise ValueError("ReplicaSet needs at least one replica")
         self.replicas: List[ServingReplica] = list(replicas)
+        self._ctx: Optional[Dict[str, Any]] = None  # set by build()
 
     def __len__(self) -> int:
         return len(self.replicas)
@@ -99,7 +108,8 @@ class ReplicaSet:
     def build(cls, model: Any, n_replicas: int,
               engine_config: Optional[dict] = None,
               server_config: Optional[dict] = None, seed: int = 0,
-              devices: Optional[Sequence[Any]] = None) -> "ReplicaSet":
+              devices: Optional[Sequence[Any]] = None,
+              devices_per_replica: Optional[int] = None) -> "ReplicaSet":
         """Build N engines on disjoint device slices + one server each.
 
         Every replica gets the SAME model/config/seed, so weights are
@@ -130,23 +140,95 @@ class ReplicaSet:
                 "is not supported: the MoE dispatch reads the global mesh "
                 "topology, which replicas on disjoint slices would "
                 "clobber (run one replica, or ep_size=1)")
-        per = len(devices) // n_replicas
-        if per < 1:
+        # devices_per_replica < len//n leaves headroom slices for grow():
+        # the default carves the whole device list into n equal slices
+        per = int(devices_per_replica or len(devices) // n_replicas)
+        if per < 1 or per * n_replicas > len(devices):
             raise ValueError(
                 f"{len(devices)} device(s) cannot host {n_replicas} "
-                "replicas on disjoint slices")
-        replicas = []
-        for i in range(n_replicas):
-            slice_i = devices[i * per:(i + 1) * per]
-            engine = InferenceEngineV2(model, dict(engine_config or {}),
-                                       seed=seed, devices=slice_i)
-            scfg = dict(server_config or {})
-            scfg.setdefault("metrics_label", f"r{i}")
-            server = InferenceServer(engine, scfg)
-            replicas.append(ServingReplica(i, engine, server))
-            log_dist(f"replica r{i}: {per} device(s) "
-                     f"[{i * per}..{(i + 1) * per - 1}]", level="info")
-        return cls(replicas)
+                f"replicas on disjoint {per}-device slices")
+        ctx = {"model": model, "engine_config": dict(engine_config or {}),
+               "server_config": dict(server_config or {}), "seed": seed,
+               "devices": devices, "per": per}
+        replicas = [cls._build_one(ctx, i) for i in range(n_replicas)]
+        rs = cls(replicas)
+        rs._ctx = ctx
+        return rs
+
+    @staticmethod
+    def _build_one(ctx: Dict[str, Any], index: int) -> ServingReplica:
+        """One replica on slice ``index`` of the build context — same
+        model/config/seed as every sibling (the bit-identity contract),
+        used by build(), grow() and respawn() alike."""
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+
+        per = ctx["per"]
+        slice_i = ctx["devices"][index * per:(index + 1) * per]
+        if len(slice_i) < per:
+            raise ValueError(
+                f"no free device slice for replica r{index} "
+                f"({len(ctx['devices'])} device(s), {per} per replica)")
+        engine = InferenceEngineV2(ctx["model"], dict(ctx["engine_config"]),
+                                   seed=ctx["seed"], devices=slice_i)
+        scfg = dict(ctx["server_config"])
+        scfg.setdefault("metrics_label", f"r{index}")
+        server = InferenceServer(engine, scfg)
+        log_dist(f"replica r{index}: {per} device(s) "
+                 f"[{index * per}..{(index + 1) * per - 1}]", level="info")
+        return ServingReplica(index, engine, server)
+
+    # -- live resizing ---------------------------------------------------
+    def _require_ctx(self) -> Dict[str, Any]:
+        if self._ctx is None:
+            raise RuntimeError("live grow/shrink requires a ReplicaSet "
+                               "constructed through ReplicaSet.build")
+        return self._ctx
+
+    def respawn(self, index: int) -> ServingReplica:
+        """Rebuild a DEAD replica on its own device slice and start it —
+        the serving half of self-healing: after fail-over drains a crash,
+        capacity grows back without a restart.  The fresh engine re-inits
+        from the shared seed through the same oracle-derived shardings,
+        so it is bit-identical to the replica it replaces."""
+        ctx = self._require_ctx()
+        pos = next((p for p, r in enumerate(self.replicas)
+                    if r.index == index), None)
+        if pos is None:
+            raise ValueError(f"no replica with index {index}")
+        old = self.replicas[pos]
+        if old.alive:
+            raise RuntimeError(f"replica r{index} is alive; kill/shrink it "
+                               "before respawning")
+        fresh = self._build_one(ctx, index)
+        fresh.server.start()
+        self.replicas[pos] = fresh
+        log_dist(f"replica r{index}: respawned on its slice", level="info")
+        return fresh
+
+    def grow(self) -> ServingReplica:
+        """Add one replica on the lowest unused device slice (started) —
+        a slice freed by shrink() is reused before a fresh one is cut."""
+        ctx = self._require_ctx()
+        used = {r.index for r in self.replicas}
+        index = next(i for i in range(len(used) + 1) if i not in used)
+        fresh = self._build_one(ctx, index)
+        fresh.server.start()
+        self.replicas.append(fresh)
+        return fresh
+
+    def shrink(self, index: int) -> ServingReplica:
+        """Remove a replica: hard-stop it and drop it from the set.  Its
+        in-flight requests fail over through the router (same path a
+        crash takes); its device slice becomes free for a later grow()."""
+        pos = next((p for p, r in enumerate(self.replicas)
+                    if r.index == index), None)
+        if pos is None:
+            raise ValueError(f"no replica with index {index}")
+        if len(self.replicas) == 1:
+            raise ValueError("cannot shrink the last replica")
+        victim = self.replicas.pop(pos)
+        victim.kill()
+        return victim
 
     def start(self) -> "ReplicaSet":
         for r in self.replicas:

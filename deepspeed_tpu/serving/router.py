@@ -188,7 +188,9 @@ class Router:
     # -- dispatch policy -------------------------------------------------
     def _score(self, rep: ServingReplica) -> float:
         with self._lock:
-            inflight = self._inflight[rep.index]
+            # .get, not []: the replica may have been grown/respawned
+            # into the set after this router was constructed
+            inflight = self._inflight.get(rep.index, 0)
         return rep.kv_headroom - self.cfg.queue_weight * (rep.queue_load
                                                           + inflight)
 
@@ -249,7 +251,8 @@ class Router:
             rr.inner = inner
             rr.stream._attach(inner)
             with self._lock:
-                self._inflight[rep.index] += 1
+                self._inflight[rep.index] = \
+                    self._inflight.get(rep.index, 0) + 1
             self.metrics.record_route(rep.index)
             if self.tracer.enabled:
                 self.tracer.instant("router.dispatch", rr.trace_id,

@@ -309,6 +309,30 @@ class Telemetry:
         self._export(rec)
         return rec
 
+    def record_recovery(self, step: int, outage_s: float) -> StepRecord:
+        """Goodput-gap record: one recovery outage counts as a SKIPPED
+        step whose wall time is the whole detection→resumed gap, so the
+        cumulative ``goodput`` curve (1 − skipped/total) prices outages
+        next to overflow-skipped steps and the JSONL shows the gap as a
+        first-class row (``kind: "recovery"``) rather than a hole in the
+        step sequence.  Emitted by the recovery supervisor
+        (resilience/supervisor.py) when post-restart progress resumes."""
+        self._steps += 1
+        self._skipped += 1
+        goodput = 1.0 - self._skipped / max(1, self._steps)
+        rec = StepRecord(
+            step=step, kind="recovery", wall_time_s=float(outage_s),
+            peak_flops_per_sec=self.peak_flops_per_sec,
+            goodput=goodput, skipped=True, comm={})
+        self.g_goodput.set(goodput)
+        # both counters, like _update_registry: anyone deriving goodput
+        # from the exported steps/skipped totals must agree with the gauge
+        self.c_steps.inc()
+        self.c_skipped.inc()
+        self.last_record = rec
+        self._export(rec)
+        return rec
+
     def record_serving_step(self, step: int,
                             snapshot: Dict[str, Any]) -> StepRecord:
         """Serving-side record: queue/preemption/KV stats ride the

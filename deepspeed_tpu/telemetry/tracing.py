@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional
 
 # Duration spans (Chrome "X" complete events).
 SPAN_NAMES = (
+    "recovery.outage",         # detection -> resumed progress (supervisor)
     "router.leg",              # one replica attempt of a routed request
     "router.request",          # whole routed-request lifetime (root span)
     "serve.admission_block",   # submit blocked on a full queue ('block' policy)
@@ -61,6 +62,10 @@ SPAN_NAMES = (
 
 # Instant events (Chrome "i" events).
 EVENT_NAMES = (
+    "recovery.detected",       # worker crash / hang noticed by supervisor
+    "recovery.replan",         # surviving hosts -> new mesh plan
+    "recovery.restart",        # group relaunched (possibly resized)
+    "recovery.resumed",        # first post-restart training progress
     "router.dispatch",         # routed request bound to a replica
     "router.failover",         # replica died; request re-dispatched
     "serve.emit",              # one token handed to a response stream

@@ -19,7 +19,7 @@ from typing import Any, Callable, Optional
 import jax
 import numpy as np
 
-from deepspeed_tpu.parallel.sharding import ShardingRules
+from deepspeed_tpu.resilience.oracle import PartitionOracle
 from deepspeed_tpu.parallel.topology import MeshTopology, get_topology
 
 
@@ -43,7 +43,7 @@ class Init:
         self.zero_stage = zero_stage
         self.topology = topology
         self.dtype = dtype
-        self._rules: Optional[ShardingRules] = None
+        self._rules: Optional[PartitionOracle] = None
 
     def __enter__(self) -> "Init":
         topo = self.topology or get_topology()
@@ -52,7 +52,7 @@ class Init:
 
             topo = init_distributed()
         self.topology = topo
-        self._rules = ShardingRules(topo, zero_stage=self.zero_stage)
+        self._rules = PartitionOracle(topo, zero_stage=self.zero_stage)
         return self
 
     def __exit__(self, *exc) -> None:

@@ -1,0 +1,582 @@
+"""Self-healing elastic training (deepspeed_tpu/resilience/): the
+partition oracle as THE spec source, universal-checkpoint resharding
+across mesh shapes, crash-atomic commits, escalating group stop, the
+watchdog→agent→resume supervisor loop, and live serving grow/shrink.
+See docs/ELASTICITY.md; ISSUE 13 acceptance tests live here."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.checkpoint.universal import (COMMIT_MARKER, ds_to_universal,
+                                                load_universal,
+                                                resolve_universal_dir)
+from deepspeed_tpu.models import get_model_config
+from deepspeed_tpu.resilience.oracle import PartitionOracle, plan_mesh
+from tests.conftest import make_lm_batch
+
+
+def _cfg(mesh, stage=2, **over):
+    dp = mesh.get("data", 1) * mesh.get("subdata", 1) * mesh.get("expert", 1)
+    cfg = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": max(1, 8 // dp),
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+        "steps_per_print": 1000,
+        "mesh": mesh,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _mk_engine(model, cfg, seed=3, topology=None):
+    from deepspeed_tpu.parallel import topology as topo_mod
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+    topo_mod._GLOBAL_TOPOLOGY = None
+    if topology is not None:
+        return DeepSpeedEngine(model=model, config=cfg, topology=topology,
+                               seed=seed)
+    engine, _, _, _ = ds.initialize(model=model, config=cfg, seed=seed)
+    return engine
+
+
+def _train(engine, batches):
+    return [float(np.asarray(engine.train_batch(b))) for b in batches]
+
+
+# ---------------------------------------------------------------------------
+# PartitionOracle: the ONE spec source
+# ---------------------------------------------------------------------------
+
+def test_oracle_is_the_single_source():
+    """Engine init, the serving engine, and the historical ShardingRules
+    name all resolve to the SAME class/instance — no per-site spec
+    derivation survives."""
+    from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+    from deepspeed_tpu.parallel.sharding import ShardingRules
+
+    assert ShardingRules is PartitionOracle  # alias, not a second impl
+
+    model = get_model_config("gpt2-tiny")
+    engine = _mk_engine(model, _cfg({"data": 8}, stage=3))
+    assert isinstance(engine.oracle, PartitionOracle)
+    assert engine.rules is engine.oracle
+    # from_config derives identically to what the engine uses
+    twin = PartitionOracle.from_config(engine.topology, engine.config)
+    shape = (model.num_layers, model.hidden_size,
+             model.num_heads * (model.hidden_size // model.num_heads))
+    assert engine.oracle.spec_for("layers/attn/wq", shape) \
+        == twin.spec_for("layers/attn/wq", shape)
+
+    from deepspeed_tpu.parallel import topology as topo_mod
+
+    topo_mod._GLOBAL_TOPOLOGY = None
+    eng2 = InferenceEngineV2(model, {"memory_config": {"num_blocks": 8,
+                                                       "block_size": 4},
+                                     "max_context": 64})
+    assert isinstance(eng2.oracle, PartitionOracle)
+    assert eng2.rules is eng2.oracle
+
+
+def test_oracle_flat_specs_match_tree_specs():
+    """flat_specs on a {path: shape} manifest (the checkpoint view) must
+    agree exactly with tree_specs on the pytree (the engine view) — the
+    property that makes a flat checkpoint land wherever the engine would
+    have put the leaf."""
+    import jax
+
+    from deepspeed_tpu.models import transformer as tf_model
+    from deepspeed_tpu.parallel.topology import MeshTopology
+    from deepspeed_tpu.resilience.oracle import path_str
+
+    model = get_model_config("gpt2-tiny")
+    topo = MeshTopology({"data": 4, "tensor": 2})
+    oracle = PartitionOracle(topo, zero_stage=3)
+    shapes = jax.eval_shape(lambda r: tf_model.init_params(model, r),
+                            jax.random.PRNGKey(0))
+    tree = oracle.tree_specs(shapes)
+    flat_tree = {path_str(p): s for p, s in
+                 jax.tree_util.tree_flatten_with_path(
+                     tree, is_leaf=lambda x: not isinstance(x, dict))[0]}
+    manifest = {path_str(p): tuple(l.shape) for p, l in
+                jax.tree_util.tree_flatten_with_path(shapes)[0]}
+    flat = oracle.flat_specs(manifest)
+    assert set(flat) == set(flat_tree)
+    for k in flat:
+        assert flat[k] == flat_tree[k], k
+    # at least one leaf actually shards over each axis class
+    assert any("tensor" in str(s) for s in flat.values())
+    assert any("data" in str(s) for s in flat.values())
+
+
+def test_plan_mesh_keeps_divisible_axes_and_sheds_outermost_first():
+    assert plan_mesh(8, {"tensor": 2})["tensor"] == 2
+    assert plan_mesh(8, {"tensor": 2})["data"] == 4
+    # tensor no longer divides 6 → folded into data
+    p6 = plan_mesh(6, {"tensor": 4})
+    assert p6["tensor"] == 1 and p6["data"] == 6
+    # pipe shed before tensor (outermost-first)
+    p = plan_mesh(6, {"pipe": 4, "tensor": 2})
+    assert p["pipe"] == 1 and p["tensor"] == 2 and p["data"] == 3
+    # pure shrink
+    assert plan_mesh(3, {"data": 8})["data"] == 3
+    with pytest.raises(ValueError):
+        plan_mesh(0)
+
+
+# ---------------------------------------------------------------------------
+# Universal-checkpoint resharding matrix (save 2×4 → load 4×2 / 8×1 / 6)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def saved_2x4(tmp_path_factory):
+    ckdir = str(tmp_path_factory.mktemp("u24"))
+    model = get_model_config("gpt2-tiny")
+    engine = _mk_engine(model, _cfg({"data": 2, "tensor": 4}))
+    rng = np.random.default_rng(0)
+    batch = make_lm_batch(rng, 8, 16, model.vocab_size)
+    losses = _train(engine, [batch] * 3)
+    engine.save_checkpoint(ckdir, tag="ck")
+    udir = ds_to_universal(ckdir, tag="ck")
+    flat = {}
+    import jax
+
+    from deepspeed_tpu.resilience.oracle import path_str
+
+    for p, leaf in jax.tree_util.tree_flatten_with_path(engine.params)[0]:
+        flat[path_str(p)] = np.asarray(leaf)
+    cont = _train(engine, [batch] * 2)  # unkilled continuation reference
+    return model, ckdir, udir, batch, flat, losses, cont
+
+
+@pytest.mark.parametrize("mesh", [{"data": 4, "tensor": 2}, {"data": 8}])
+def test_universal_reshard_matrix(saved_2x4, mesh):
+    """Save on data2×tensor4, load on a different factorization: every
+    param leaf BITWISE equal to the source, and the N-step loss curve
+    continues exactly like the unkilled engine's."""
+    import jax
+
+    from deepspeed_tpu.resilience.oracle import path_str
+
+    model, ckdir, udir, batch, flat, _, cont = saved_2x4
+    engine2 = _mk_engine(model, _cfg(mesh), seed=99)
+    load_universal(engine2, udir)
+    assert engine2.global_steps == 3
+    for p, leaf in jax.tree_util.tree_flatten_with_path(engine2.params)[0]:
+        np.testing.assert_array_equal(np.asarray(leaf), flat[path_str(p)],
+                                      err_msg=path_str(p))
+    cont_b = _train(engine2, [batch] * 2)
+    np.testing.assert_allclose(cont, cont_b, rtol=2e-4, atol=2e-4)
+    engine2.destroy()
+
+
+def test_universal_reshard_shrunk_world(saved_2x4):
+    """The elastic-resume case proper: the 8-device world shrank to 6
+    (a host died); the oracle reshards the same checkpoint onto the
+    survivors' mesh."""
+    import jax
+
+    from deepspeed_tpu.parallel.topology import MeshTopology
+    from deepspeed_tpu.resilience.oracle import path_str
+
+    model, ckdir, udir, batch, flat, _, cont = saved_2x4
+    n_dev = len(jax.devices())
+    shrunk = n_dev - 2
+    mesh = plan_mesh(shrunk, {"tensor": 4})  # tensor4 no longer fits → data
+    assert mesh["data"] == shrunk and mesh["tensor"] == 1
+    topo = MeshTopology({"data": shrunk}, devices=jax.devices()[:shrunk])
+    cfg = _cfg({"data": shrunk})
+    cfg["train_batch_size"] = 8 * shrunk          # divisible by dp=6
+    cfg["train_micro_batch_size_per_gpu"] = 8
+    engine2 = _mk_engine(model, cfg, seed=17, topology=topo)
+    load_universal(engine2, udir)
+    for p, leaf in jax.tree_util.tree_flatten_with_path(engine2.params)[0]:
+        np.testing.assert_array_equal(np.asarray(leaf), flat[path_str(p)],
+                                      err_msg=path_str(p))
+    assert engine2.global_steps == 3
+    engine2.destroy()
+
+
+def test_universal_dtype_validation_raises(saved_2x4, tmp_path):
+    """A float leaf cannot silently land in an int template: same-kind
+    cast validation trips BEFORE any engine state mutates."""
+    model, ckdir, udir, *_ = saved_2x4
+    from deepspeed_tpu.checkpoint.universal import _unflatten_like
+
+    with pytest.raises(ValueError, match="dtype mismatch"):
+        _unflatten_like({"x": np.zeros((2,), np.int32)},
+                        {"x": np.ones((2,), np.float32)})
+    # same-kind (f64→f32) casts fine
+    out = _unflatten_like({"x": np.zeros((2,), np.float32)},
+                          {"x": np.ones((2,), np.float64)})
+    assert out["x"].dtype == np.float32
+
+
+# ---------------------------------------------------------------------------
+# Crash-atomic commit
+# ---------------------------------------------------------------------------
+
+def _fake_committed(root, tag, steps):
+    udir = os.path.join(root, tag, "universal")
+    os.makedirs(os.path.join(udir, "params"), exist_ok=True)
+    os.makedirs(os.path.join(udir, "optimizer"), exist_ok=True)
+    with open(os.path.join(udir, "meta.json"), "w") as f:
+        json.dump({"global_steps": steps}, f)
+    with open(os.path.join(udir, COMMIT_MARKER), "w") as f:
+        f.write("{}")
+    return udir
+
+
+def test_resolve_skips_uncommitted_tags(tmp_path):
+    """The exact state a worker killed mid-save leaves behind: `latest`
+    points at a tag whose conversion never committed — resolve must fall
+    back to the newest COMMITTED tag, not crash on the torn one."""
+    root = str(tmp_path)
+    good = _fake_committed(root, "step2", steps=2)
+    _fake_committed(root, "step1", steps=1)
+    # step3: save died mid-write — staging dir only, no final universal
+    staging = os.path.join(root, "step3", "universal.tmp-12345")
+    os.makedirs(os.path.join(staging, "params"))
+    with open(os.path.join(staging, "meta.json"), "w") as f:
+        json.dump({"global_steps": 3}, f)
+    with open(os.path.join(root, "latest"), "w") as f:
+        f.write("step3")
+
+    assert resolve_universal_dir(root) == good  # newest committed wins
+
+    # a torn final dir (marker missing — e.g. rsync'd partial) is skipped
+    torn = os.path.join(root, "step4", "universal")
+    os.makedirs(torn)
+    with open(os.path.join(torn, "meta.json"), "w") as f:
+        json.dump({"global_steps": 4}, f)
+    with open(os.path.join(root, "latest"), "w") as f:
+        f.write("step4")
+    assert resolve_universal_dir(root) == good
+    with pytest.raises(FileNotFoundError, match="uncommitted"):
+        resolve_universal_dir(torn)
+
+
+def test_mid_save_kill_leaves_previous_tag_resumable(tmp_path):
+    """True mid-save kill: a subprocess converts a real checkpoint and is
+    SIGKILLed inside the write; the final universal dir must not exist
+    (staging protocol) and resolve must land on the earlier committed
+    tag."""
+    root = str(tmp_path)
+    code = f"""
+import os, sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+os.environ["JAX_PLATFORMS"] = "cpu"
+import numpy as np
+import deepspeed_tpu as ds
+from deepspeed_tpu.checkpoint.universal import ds_to_universal
+from deepspeed_tpu.models import get_model_config
+model = get_model_config("gpt2-tiny")
+engine, _, _, _ = ds.initialize(model=model, config={{
+    "train_micro_batch_size_per_gpu": 4,
+    "optimizer": {{"type": "AdamW", "params": {{"lr": 1e-3}}}},
+    "zero_optimization": {{"stage": 2}}, "steps_per_print": 1000}})
+engine.save_checkpoint({root!r}, tag="a")
+ds_to_universal({root!r}, tag="a")           # commits cleanly
+engine.save_checkpoint({root!r}, tag="b")    # latest -> b
+import deepspeed_tpu.checkpoint.universal as u
+orig = u._save_flat
+def dying(flat, out_root):
+    orig(flat, out_root)
+    os.kill(os.getpid(), 9)                  # die mid-conversion
+u._save_flat = dying
+ds_to_universal({root!r}, tag="b")
+"""
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == -9, proc.stderr[-2000:]
+    assert not os.path.exists(os.path.join(root, "b", "universal")), \
+        "killed save must not publish a final universal dir"
+    with open(os.path.join(root, "latest")) as f:
+        assert f.read().strip() == "b"       # pointer names the torn tag
+    resolved = resolve_universal_dir(root)   # ...and resolve skips it
+    assert resolved == os.path.join(root, "a", "universal")
+
+
+def test_orbax_latest_deferred_until_async_commit(tmp_path):
+    """The orbax writer's crash-atomicity: an async save publishes
+    meta.json + `latest` only at wait() — a process killed mid-stream
+    leaves the previous pointer intact."""
+    model = get_model_config("gpt2-tiny")
+    cfg = _cfg({"data": 8}, checkpoint={"writer": {"type": "orbax"},
+                                        "async_save": True})
+    engine = _mk_engine(model, cfg)
+    rng = np.random.default_rng(0)
+    _train(engine, [make_lm_batch(rng, 8, 16, model.vocab_size)] * 1)
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    assert not os.path.exists(os.path.join(str(tmp_path), "latest")), \
+        "latest must not exist before the async save commits"
+    engine.checkpoint_engine.wait()
+    with open(os.path.join(str(tmp_path), "latest")) as f:
+        assert f.read().strip() == "t1"
+    engine.destroy()
+
+
+# ---------------------------------------------------------------------------
+# Escalating group stop
+# ---------------------------------------------------------------------------
+
+def test_stop_group_escalates_sigterm_to_sigkill():
+    """A wedged worker swallowing SIGTERM used to block restart forever
+    (per-process serial 30 s waits, kill never awaited); now the group
+    shares ONE deadline and stragglers are SIGKILLed."""
+    from deepspeed_tpu.elasticity import stop_group
+
+    code = ("import signal, time\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            "print('armed', flush=True)\n"
+            "time.sleep(600)\n")
+    procs = [subprocess.Popen([sys.executable, "-c", code],
+                              stdout=subprocess.PIPE, text=True)
+             for _ in range(2)]
+    for p in procs:
+        assert p.stdout.readline().strip() == "armed"  # handler installed
+    t0 = time.monotonic()
+    stop_group(procs, stop_timeout_s=1.0)
+    elapsed = time.monotonic() - t0
+    assert all(p.poll() is not None for p in procs)
+    assert any(p.returncode == -signal.SIGKILL for p in procs)
+    assert elapsed < 15.0, elapsed
+
+
+def test_stop_group_graceful_workers_not_killed():
+    procs = [subprocess.Popen([sys.executable, "-c",
+                               "import time; time.sleep(600)"])]
+    from deepspeed_tpu.elasticity import stop_group
+
+    stop_group(procs, stop_timeout_s=10.0)
+    assert procs[0].returncode == -signal.SIGTERM  # TERM sufficed
+
+
+# ---------------------------------------------------------------------------
+# Supervisor: watchdog → agent → resume (the chaos e2e)
+# ---------------------------------------------------------------------------
+
+def _mk_telemetry(tmpdir):
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    from deepspeed_tpu.telemetry import Telemetry
+
+    return Telemetry(TelemetryConfig(
+        enabled=True,
+        jsonl_path=os.path.join(tmpdir, "steps.jsonl"),
+        tracing={"enabled": True,
+                 "trace_path": os.path.join(tmpdir, "t.trace.json")},
+        flight={"enabled": True, "output_dir": os.path.join(tmpdir,
+                                                            "flight")}))
+
+
+def test_supervisor_chaos_crash_resize_resume(tmp_path):
+    """THE acceptance e2e: a worker killed mid-run → flight bundle →
+    group stopped → mesh re-planned SMALLER (its host is gone) →
+    restarted → universal resume through the oracle → loss curve lands
+    on the unkilled reference — with the outage measured as recovery.*
+    spans and a goodput-gap StepRecord."""
+    from deepspeed_tpu.resilience.supervisor import (RecoverySupervisor,
+                                                     loss_curve)
+
+    total, die_at = 5, 2
+    wenv = {"DSTPU_SEQ": "16", "DSTPU_BATCH": "8"}
+
+    ref_dir = str(tmp_path / "ref")
+    ref = RecoverySupervisor(
+        ref_dir, hosts_fn=lambda: ["h0", "h1"], devices_per_host=2,
+        total_steps=total, deadline_s=60.0, poll_s=0.2,
+        worker_env=dict(wenv)).run()
+    assert ref.returncode == 0 and ref.recoveries == 0
+    ref_losses = loss_curve(ref.progress_path)
+    assert sorted(ref_losses) == list(range(1, total + 1))
+
+    chaos_dir = str(tmp_path / "chaos")
+    os.makedirs(chaos_dir)
+    sentinel = os.path.join(chaos_dir, ".chaos_fired")
+    tel = _mk_telemetry(chaos_dir)
+    sup = RecoverySupervisor(
+        chaos_dir,
+        # the dying worker arms the sentinel first: host h1 dies with it
+        hosts_fn=lambda: ["h0"] if os.path.exists(sentinel)
+        else ["h0", "h1"],
+        devices_per_host=2, total_steps=total, deadline_s=60.0,
+        poll_s=0.2, stop_timeout_s=10.0, resume_deadline_s=240.0,
+        telemetry=tel,
+        worker_env={**wenv, "DSTPU_CHAOS": json.dumps({"die_at": die_at})})
+    res = sup.run()
+
+    # recovered, once, onto a SHRUNK mesh
+    assert res.returncode == 0 and res.recoveries == 1
+    assert res.outages[0]["resized"] and res.mesh == {"data": 2}
+    states = [e.state for e in res.events]
+    for s in ("detected", "dumped", "stopped", "replanned", "restarted",
+              "resumed"):
+        assert s in states, (s, states)
+    assert states.index("detected") < states.index("stopped") \
+        < states.index("restarted") < states.index("resumed")
+
+    # flight bundle on disk with the frozen `recovery` reason
+    bundle = res.outages[0]["bundle"]
+    with open(os.path.join(bundle, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["reason"] == "recovery"
+    assert os.path.exists(os.path.join(bundle, "stacks.txt"))
+
+    # loss continuity: every step of the resumed curve matches the
+    # unkilled run (the recomputed crash step included)
+    curve = loss_curve(res.progress_path)
+    assert sorted(curve) == list(range(1, total + 1))
+    for s in range(1, total + 1):
+        assert abs(curve[s] - ref_losses[s]) < 2e-3, (s, curve[s],
+                                                      ref_losses[s])
+
+    # goodput-gap StepRecord: kind=recovery, skipped, outage priced in
+    rec = tel.last_record
+    assert rec is not None and rec.kind == "recovery"
+    assert rec.skipped and rec.wall_time_s > 0
+    assert rec.wall_time_s == pytest.approx(res.outages[0]["outage_s"],
+                                            rel=0.2)
+
+    # recovery.* spans/instants in the trace
+    events = {e["name"] for e in tel.tracer.snapshot()}
+    assert {"recovery.outage", "recovery.detected", "recovery.replan",
+            "recovery.restart", "recovery.resumed"} <= events
+    tel.close()
+
+
+def test_supervisor_hang_watchdog_recovery(tmp_path):
+    """Detection channel 2: the worker stops heartbeating (wedged, TERM
+    ignored) — the supervisor's Watchdog fires, escalation clears the
+    worker, and the run still completes."""
+    from deepspeed_tpu.resilience.supervisor import RecoverySupervisor
+
+    d = str(tmp_path / "hang")
+    sup = RecoverySupervisor(
+        d, hosts_fn=lambda: ["h0"], devices_per_host=1, total_steps=3,
+        deadline_s=6.0, poll_s=0.2, stop_timeout_s=2.0,
+        resume_deadline_s=240.0,
+        worker_env={"DSTPU_SEQ": "16", "DSTPU_BATCH": "4",
+                    "DSTPU_CHAOS": json.dumps({"hang_at": 1,
+                                               "ignore_term": True})})
+    res = sup.run()
+    assert res.returncode == 0 and res.recoveries >= 1
+    assert res.outages[0]["reason"] == "hang"
+
+
+def test_supervisor_max_recoveries_budget(tmp_path):
+    """A worker that dies instantly every time must exhaust the budget
+    and fail LOUDLY, not loop forever."""
+    from deepspeed_tpu.resilience.supervisor import (RecoveryFailed,
+                                                     RecoverySupervisor)
+
+    sup = RecoverySupervisor(
+        str(tmp_path / "doom"), hosts_fn=lambda: ["h0"],
+        devices_per_host=1, total_steps=3, deadline_s=30.0, poll_s=0.1,
+        stop_timeout_s=2.0, resume_deadline_s=30.0, max_recoveries=1,
+        worker_cmd=[sys.executable, "-c", "import sys; sys.exit(3)"])
+    with pytest.raises(RecoveryFailed, match="budget"):
+        sup.run()
+    assert [e.state for e in sup.events].count("restarted") == 1
+    assert sup.events[-1].state == "failed"
+
+
+def test_record_recovery_goodput_gap():
+    """Telemetry.record_recovery: one outage = one skipped step in the
+    cumulative goodput, schema-stable JSONL."""
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    from deepspeed_tpu.telemetry import Telemetry
+
+    tel = Telemetry(TelemetryConfig(enabled=True))
+    for s in range(1, 4):
+        tel.record_train_step(step=s, wall_time_s=0.1, tokens=128)
+    rec = tel.record_recovery(step=3, outage_s=42.5)
+    assert rec.kind == "recovery" and rec.skipped
+    assert rec.wall_time_s == 42.5
+    assert rec.goodput == pytest.approx(3 / 4)
+    d = json.loads(rec.to_json())
+    assert list(d) == sorted(d) and d["schema"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Serving: live grow / shrink / respawn through the same oracle
+# ---------------------------------------------------------------------------
+
+def test_replicaset_grow_shrink_respawn_live():
+    from deepspeed_tpu.serving import ReplicaSet, Router, SamplingParams
+
+    model = get_model_config("llama-tiny")
+    eng_cfg = {"dtype": "float32",
+               "memory_config": {"num_blocks": 32, "block_size": 4},
+               "max_context": 64}
+    # per-replica slices of 2 over 8 devices: room to grow to 4
+    rs = ReplicaSet.build(model, 2, eng_cfg, {}, seed=0,
+                          devices_per_replica=2)
+    router = Router(rs).start()
+    try:
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(1, model.vocab_size, size=8).tolist()
+                   for _ in range(6)]
+        expected = router.generate(prompts, max_new_tokens=8)
+
+        # GROW: new replica on the next free slice, serving immediately
+        r2 = rs.grow()
+        assert len(rs) == 3 and r2.index == 2 and r2.alive
+        out = r2.server.generate([prompts[0]], max_new_tokens=8)
+        assert out[0] == expected[0]        # bit-identical weights
+        # router dispatches to it and the per-replica counter appears
+        for i, p in enumerate(prompts):
+            router.submit(p, SamplingParams(max_new_tokens=4),
+                          session=f"s{i}")
+        time.sleep(0.1)
+        snap = router.snapshot()
+        assert "r2" in snap["routed"]
+
+        # SHRINK: victim's slice frees; survivors keep serving
+        rs.shrink(2)
+        assert len(rs) == 2
+        assert router.generate([prompts[1]],
+                               max_new_tokens=8)[0] == expected[1]
+
+        # RESPAWN: kill r0 mid-stream → fail-over covers the request,
+        # then the replica re-grows on its own slice and serves again
+        s = router.submit(prompts[2], SamplingParams(max_new_tokens=24))
+        it = iter(s)
+        got = [next(it)]                    # demonstrably mid-stream
+        rs[0].kill()
+        for tok in it:
+            got.append(tok)
+        full = router.generate([prompts[2]], max_new_tokens=24)[0]
+        assert got == full                  # bit-identical across the kill
+        fresh = rs.respawn(0)
+        assert fresh.alive and rs[0] is fresh
+        out = fresh.server.generate([prompts[3]], max_new_tokens=8)
+        assert out[0] == expected[3]
+    finally:
+        router.stop(timeout=60.0)
+
+
+def test_replicaset_respawn_requires_dead_replica():
+    from deepspeed_tpu.serving import ReplicaSet
+
+    model = get_model_config("llama-tiny")
+    eng_cfg = {"dtype": "float32",
+               "memory_config": {"num_blocks": 16, "block_size": 4},
+               "max_context": 32}
+    rs = ReplicaSet.build(model, 2, eng_cfg, {}, seed=0).start()
+    try:
+        with pytest.raises(RuntimeError, match="alive"):
+            rs.respawn(0)
+        with pytest.raises(ValueError, match="last replica"):
+            rs.shrink(0)
+            rs.shrink(1)
+    finally:
+        rs.stop(drain=False, timeout=30.0)

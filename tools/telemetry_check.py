@@ -54,18 +54,20 @@ EXPECTED_RECORD_KEYS = [
 # and telemetry/flight.py FLIGHT_REASONS must match, and every name must
 # appear in the docs span table — same contract as the record keys)
 EXPECTED_SPAN_NAMES = [
-    "router.leg", "router.request", "serve.admission_block", "serve.decode",
-    "serve.prefill", "serve.queue_wait", "serve.request", "serve.step",
+    "recovery.outage", "router.leg", "router.request",
+    "serve.admission_block", "serve.decode", "serve.prefill",
+    "serve.queue_wait", "serve.request", "serve.step",
     "train.data_ingest", "train.dispatch", "train.step", "train.sync",
     "train.telemetry", "v2.ragged_step",
 ]
 EXPECTED_EVENT_NAMES = [
-    "router.dispatch", "router.failover", "serve.emit", "serve.enqueue",
-    "serve.finish", "serve.first_token", "serve.preempt",
+    "recovery.detected", "recovery.replan", "recovery.restart",
+    "recovery.resumed", "router.dispatch", "router.failover", "serve.emit",
+    "serve.enqueue", "serve.finish", "serve.first_token", "serve.preempt",
     "serve.prefix_hit", "watchdog.fire",
 ]
 EXPECTED_FLIGHT_REASONS = ["watchdog", "serve_crash", "engine_crash",
-                           "manual"]
+                           "manual", "recovery"]
 
 # frozen quantized-collective comm-op vocabulary (comm/quantized.py
 # QUANT_COMM_OPS): every wire movement of the quantized ZeRO collectives
@@ -144,6 +146,17 @@ EXPECTED_AUDIT_FINDING_KEYS = ["detail", "fingerprint", "kind", "message",
                                "severity", "where"]
 EXPECTED_AUDIT_DONATION_KEYS = ["aliased", "declared", "missed",
                                 "missed_bytes"]
+
+# frozen recovery vocabulary (resilience/supervisor.py RECOVERY_STATES;
+# docs/ELASTICITY.md): the supervisor's state machine and the chaos
+# bench row keys follow the same contract as every other vocabulary —
+# frozen list matches the module, every name documented, bench keys
+# literally emitted by bench.py.
+ELASTICITY_DOCS = os.path.join(REPO, "docs", "ELASTICITY.md")
+EXPECTED_RECOVERY_STATES = ["running", "detected", "dumped", "stopped",
+                            "replanned", "restarted", "resumed", "failed"]
+CHAOS_BENCH_KEYS = ["recovery_s", "loss_gap", "goodput_after",
+                    "serve_ttft_p99_ms", "failovers", "regrown"]
 
 
 def _exported_monitor_tags() -> List[str]:
@@ -396,6 +409,26 @@ def check_graph_audit() -> List[str]:
                      "census-in-evidence")
 
 
+def check_recovery() -> List[str]:
+    """Recovery vocabulary: the supervisor's frozen state machine matches
+    the module and docs/ELASTICITY.md, the chaos bench row emits the
+    frozen keys, and the observability doc cross-links the elasticity
+    doc from its recovery rows."""
+    def _states():
+        from deepspeed_tpu.resilience.supervisor import RECOVERY_STATES
+
+        return RECOVERY_STATES
+
+    return _vocab_check([
+        VocabSpec(name="supervisor.RECOVERY_STATES",
+                  expected=EXPECTED_RECOVERY_STATES, actual=_states,
+                  docs_path=ELASTICITY_DOCS),
+        VocabSpec(name="CHAOS_BENCH_KEYS", expected=CHAOS_BENCH_KEYS,
+                  docs_path=ELASTICITY_DOCS,
+                  source_keys=[(_BENCH, CHAOS_BENCH_KEYS)]),
+    ]) + _cross_link(DOCS, "ELASTICITY.md", "recovery")
+
+
 def validate_chrome_trace(obj: Any) -> List[str]:
     """Structural validation of a Chrome trace-event JSON object (pass a
     path or the loaded dict).  Perfetto/chrome://tracing both accept the
@@ -464,7 +497,8 @@ def run_all() -> List[str]:
     return (check_tags_documented() + check_schema() + check_span_names()
             + check_quant_comm() + check_ring_bench()
             + check_router_serving() + check_autotuning()
-            + check_graph_audit() + check_trace_export())
+            + check_graph_audit() + check_recovery()
+            + check_trace_export())
 
 
 def main() -> int:
