@@ -834,6 +834,88 @@ _PEAK_LADDER = [
 ]
 
 
+def _host_ram_bytes() -> int:
+    """Host RAM — the budget cpu-offloaded classes must fit (the
+    offload rungs die in HOST RESOURCE_EXHAUSTED — r04)."""
+    try:
+        with open("/proc/meminfo", "r", encoding="utf-8") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 16 << 30
+
+
+def _memory_budget_bytes() -> int:
+    """The budget the ladder's DEVICE-resident state must fit: device
+    HBM when the accelerator reports a limit, else host RAM (the CPU
+    backend's "device" memory IS host RAM)."""
+    try:
+        from deepspeed_tpu.accelerator import get_accelerator
+
+        limit = int(get_accelerator().memory_stats(0).get(
+            "bytes_limit", 0))
+        if limit > (1 << 30):
+            return limit
+    except Exception:
+        pass
+    return _host_ram_bytes()
+
+
+def _peak_rungs():
+    """(name, base, overrides, zero, seq) per ladder rung (the smoke
+    ladder is the single tiny rung the smoke actually runs)."""
+    if SMOKE:
+        return [("gpt2-tiny", "gpt2-tiny", {}, {"stage": 0}, 64)]
+    return [(name, base, over, zero, 512)
+            for name, base, over, zero, _ in _PEAK_LADDER]
+
+
+def _ladder_predictions() -> list:
+    """OOM-before-you-run gate (docs/STATIC_ANALYSIS.md): the calibrated
+    analytic predictor prices every rung BEFORE anything runs, so a
+    too-big rung reports why it cannot fit (dominant class + shortfall)
+    instead of dying in RESOURCE_EXHAUSTED mid-ladder."""
+    import jax
+
+    from deepspeed_tpu.autotuning import (ModelInfo,
+                                          load_memory_calibration,
+                                          predict_fit)
+    from deepspeed_tpu.models import get_model_config
+    from deepspeed_tpu.profiling import get_model_profile
+
+    budget = _memory_budget_bytes()
+    cal = load_memory_calibration(backend=jax.default_backend())
+    preds = []
+    for name, base, over, zero, seq in _peak_rungs():
+        model = get_model_config(base, **over)
+        prof = get_model_profile(model, 1, seq)
+        # offloaded classes must not be priced against the device
+        # budget (they are the POINT of the offload rungs) — cpu-homed
+        # state is priced against host RAM instead, nvme is unbounded
+        off_p = (zero.get("offload_param") or {}).get("device")
+        off_o = (zero.get("offload_optimizer") or {}).get("device")
+        pred = predict_fit(
+            ModelInfo(num_params=prof["params"],
+                      hidden_size=model.hidden_size,
+                      num_layers=model.num_layers,
+                      vocab_size=model.vocab_size),
+            int(zero.get("stage", 0)), dp_size=1, micro_batch=1,
+            seq_len=seq, hbm_bytes=budget, calibration=cal,
+            offload_param=off_p, offload_optimizer=off_o,
+            host_bytes=_host_ram_bytes()
+            if "cpu" in (off_p, off_o) else None)
+        preds.append({
+            "rung": name,
+            "predicted_peak_bytes": pred["predicted_peak_bytes"],
+            "predicted_fit": pred["predicted_fit"],
+            "dominant_class": pred["dominant_class"],
+            "shortfall_bytes": pred["shortfall_bytes"],
+        })
+    return preds
+
+
 def _peak_entry(idx: int) -> dict:
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import get_model_config
@@ -873,28 +955,46 @@ def _peak_entry(idx: int) -> dict:
 
 def row_peak_params():
     """Largest model trained end-to-end (fwd+bwd+adam step) on one chip —
-    the 'train bigger than you think' metric.  Each ladder entry runs in
-    its own subprocess (an OOM-killed entry must not leak HBM into the
-    next); largest that completes a finite step wins."""
+    the 'train bigger than you think' metric.  The ladder consults the
+    static memory predictor FIRST (per-rung `predicted_peak_bytes` /
+    `predicted_fit` — a rung predicted not to fit is skipped with its
+    dominant class + shortfall recorded instead of dying in
+    RESOURCE_EXHAUSTED; DSTPU_PEAK_RUN_ALL=1 overrides).  Each attempted
+    entry runs in its own subprocess (an OOM-killed entry must not leak
+    HBM into the next); largest that completes a finite step wins."""
+    preds = _ladder_predictions()
+    run_all = os.environ.get("DSTPU_PEAK_RUN_ALL") == "1"
     best = None
+    best_idx = None
     if SMOKE:
         best = _peak_entry(0)
+        best_idx = 0
+        preds[0]["ran"] = True
+        preds[0]["fit"] = True
     else:
         import subprocess
 
         for i in range(len(_PEAK_LADDER)):
+            preds[i]["ran"] = False
+            preds[i]["fit"] = None
+            if not preds[i]["predicted_fit"] and not run_all:
+                continue   # the predictor already explains why
+            preds[i]["ran"] = True
             try:
                 proc = subprocess.run(
                     [sys.executable, __file__, "--peak-entry", str(i)],
                     capture_output=True, text=True,
                     timeout=_PEAK_LADDER[i][4])
             except subprocess.TimeoutExpired:
+                preds[i]["fit"] = False
                 continue
             for line in reversed(proc.stdout.strip().splitlines()):
                 if line.startswith("{") and "params_m" in line:
                     best = json.loads(line)
                     break
+            preds[i]["fit"] = best is not None
             if best:
+                best_idx = i
                 break
     if best is None:
         raise RuntimeError("no ladder entry fit")
@@ -907,6 +1007,9 @@ def row_peak_params():
         "value": best["params_m"], "unit": "Mparams",
         "vs_baseline": round(best["params_m"] / 6500.0, 3),
         "model": best["name"],
+        "predicted_peak_bytes": preds[best_idx]["predicted_peak_bytes"],
+        "predicted_fit": preds[best_idx]["predicted_fit"],
+        "ladder": preds,
         "telemetry_jsonl": _telemetry_jsonl("peak_params"),
         "trace_json": _trace_json("peak_params"),
     }

@@ -17,19 +17,31 @@ mirroring how ``serving/`` avoids a jax taint.
 
 from deepspeed_tpu.analysis.report import (AUDIT_REPORT_KEYS,  # noqa: F401
                                            AUDIT_SCHEMA_VERSION,
-                                           CENSUS_KEYS, DONATION_KEYS,
-                                           FINDING_KEYS, FINDING_KINDS,
-                                           SEVERITIES, CollectiveStat,
-                                           Finding, GraphAuditReport,
-                                           load_baseline)
+                                           BUDGET_KEYS, BUFFER_KEYS,
+                                           CALIBRATION_KEYS, CENSUS_KEYS,
+                                           DONATION_KEYS, FINDING_KEYS,
+                                           FINDING_KINDS, MEMORY_CLASSES,
+                                           MEMORY_REPORT_KEYS,
+                                           MEMORY_TOTALS_KEYS, SEVERITIES,
+                                           CollectiveStat, Finding,
+                                           GraphAuditReport,
+                                           MemoryAuditReport, bucket_bytes,
+                                           load_baseline,
+                                           load_memory_baseline)
 
 _LAZY = {
     "AuditIntent": "auditor", "audit": "auditor",
+    "audit_artifacts": "auditor", "lower_step": "auditor",
+    "LoweredStep": "auditor",
     "audit_engine": "auditor", "audit_v2_engine": "auditor",
-    "collective_census_engine": "auditor", "intent_for_engine": "auditor",
+    "collective_census_engine": "auditor",
+    "census_and_memory_engine": "auditor", "intent_for_engine": "auditor",
+    "MemoryIntent": "memory", "audit_memory": "memory",
+    "memory_intent_for_engine": "memory", "memory_intent_for_v2": "memory",
     "lint_repo": "seam", "lint_source": "seam",
     "VocabSpec": "vocab", "check_all": "vocab",
     "BENCH_AUDIT_TARGETS": "targets", "run_audit_target": "targets",
+    "run_target_audits": "targets",
 }
 
 
@@ -44,7 +56,10 @@ def __getattr__(name):
 
 
 __all__ = sorted([
-    "AUDIT_REPORT_KEYS", "AUDIT_SCHEMA_VERSION", "CENSUS_KEYS",
-    "DONATION_KEYS", "FINDING_KEYS", "FINDING_KINDS", "SEVERITIES",
-    "CollectiveStat", "Finding", "GraphAuditReport", "load_baseline",
+    "AUDIT_REPORT_KEYS", "AUDIT_SCHEMA_VERSION", "BUDGET_KEYS",
+    "BUFFER_KEYS", "CALIBRATION_KEYS", "CENSUS_KEYS", "DONATION_KEYS",
+    "FINDING_KEYS", "FINDING_KINDS", "MEMORY_CLASSES",
+    "MEMORY_REPORT_KEYS", "MEMORY_TOTALS_KEYS", "SEVERITIES",
+    "CollectiveStat", "Finding", "GraphAuditReport", "MemoryAuditReport",
+    "bucket_bytes", "load_baseline", "load_memory_baseline",
 ] + list(_LAZY))

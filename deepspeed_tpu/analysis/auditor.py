@@ -300,21 +300,39 @@ def _donation_audit(flat_args_info, hlo_text: str, label: str,
 # ----------------------------------------------------------------------
 # the auditor
 # ----------------------------------------------------------------------
-def audit(fn, *args, label: str = "step", intent: Optional[AuditIntent] = None,
-          static_kwargs: Optional[Dict[str, Any]] = None
-          ) -> GraphAuditReport:
-    """Audit one jitted function against example ``args`` (shapes only —
-    the function is lowered and compiled, NEVER executed, so zero-filled
-    arrays are fine and donated example buffers are not consumed)."""
+@dataclass
+class LoweredStep:
+    """One AOT lowering's reusable artifacts.
+
+    Every audit family (collective census, donation, memory plan) reads
+    off the same trio — jaxpr, lowered, compiled — so a caller auditing
+    one target several ways pays the ~2s trace+lower+compile ONCE
+    (``analysis/targets.py`` / ``graft_lint --rows --memory``) instead of
+    once per audit.  The artifacts stay valid after the owning engine is
+    destroyed: they are standalone AOT objects, and the audits only read
+    text/metadata off them."""
+    label: str
+    jaxpr: Any
+    lowered: Any
+    compiled: Any
+    hlo: str
+    args: Tuple[Any, ...]
+    backend: str
+    num_partitions: int
+
+
+def lower_step(fn, *args, label: str = "step",
+               static_kwargs: Optional[Dict[str, Any]] = None
+               ) -> LoweredStep:
+    """Trace + lower + AOT-compile one jitted function (shapes only —
+    NEVER executed, so zero-filled arrays are fine and donated example
+    buffers are not consumed) into a reusable :class:`LoweredStep`."""
     import jax
 
-    intent = intent or AuditIntent()
     kw = static_kwargs or {}
     if not hasattr(fn, "lower"):
-        raise TypeError(f"audit() needs a jax.jit-wrapped callable, got "
+        raise TypeError(f"audit needs a jax.jit-wrapped callable, got "
                         f"{type(fn).__name__} (wrap it in jax.jit first)")
-    findings: List[Finding] = []
-
     with warnings.catch_warnings():
         # jax's donated-buffers-not-usable warning (raised at lowering)
         # is OUR report — do not also print it
@@ -327,19 +345,47 @@ def audit(fn, *args, label: str = "step", intent: Optional[AuditIntent] = None,
             jaxpr = jax.make_jaxpr(fn)(*args, **kw).jaxpr
             lowered = fn.lower(*args, **kw)
         compiled = lowered.compile()
-    if not intent.allow_callbacks:
-        findings.extend(_callback_findings(jaxpr, label))
-    findings.extend(_promotion_findings(jaxpr, label,
-                                        intent.compute_dtype))
-    findings.extend(_signature_findings(args, label))
     hlo = compiled.as_text()
-
     # SPMD modules always carry num_partitions= in the header; absence
     # means a single-partition program, so the fallback is 1 (never the
     # host's device count — a single-device jit on an 8-device host
     # must not have its wire model scaled by 8)
     m = re.search(r"num_partitions=(\d+)", hlo)
-    num_partitions = int(m.group(1)) if m else 1
+    return LoweredStep(label=label, jaxpr=jaxpr, lowered=lowered,
+                       compiled=compiled, hlo=hlo, args=tuple(args),
+                       backend=jax.default_backend(),
+                       num_partitions=int(m.group(1)) if m else 1)
+
+
+def audit(fn, *args, label: str = "step", intent: Optional[AuditIntent] = None,
+          static_kwargs: Optional[Dict[str, Any]] = None
+          ) -> GraphAuditReport:
+    """Audit one jitted function against example ``args`` (lower + audit
+    in one call; use :func:`lower_step` + :func:`audit_artifacts` to
+    share the lowering with the memory auditor)."""
+    return audit_artifacts(lower_step(fn, *args, label=label,
+                                      static_kwargs=static_kwargs),
+                           intent=intent)
+
+
+def audit_artifacts(art: LoweredStep,
+                    intent: Optional[AuditIntent] = None
+                    ) -> GraphAuditReport:
+    """The graph audit proper, off pre-lowered artifacts."""
+    import jax
+
+    intent = intent or AuditIntent()
+    label = art.label
+    jaxpr, lowered, hlo = art.jaxpr, art.lowered, art.hlo
+    num_partitions = art.num_partitions
+    args = art.args
+    findings: List[Finding] = []
+    if not intent.allow_callbacks:
+        findings.extend(_callback_findings(jaxpr, label))
+    findings.extend(_promotion_findings(jaxpr, label,
+                                        intent.compute_dtype))
+    findings.extend(_signature_findings(args, label))
+
     ops = parse_collectives(hlo, num_partitions=num_partitions)
     census = aggregate_census(ops)
     findings.extend(_census_findings(census, intent, label))
@@ -383,7 +429,7 @@ def audit(fn, *args, label: str = "step", intent: Optional[AuditIntent] = None,
     findings.sort(key=lambda f: (order[f.severity], f.kind,
                                  str(f.detail.get("key", ""))))
     return GraphAuditReport(
-        label=label, backend=jax.default_backend(),
+        label=label, backend=art.backend,
         num_partitions=max(1, num_partitions), census=census,
         donation=donation, findings=findings)
 
@@ -478,19 +524,25 @@ def audit_engine(engine, data=None, label: str = "train_step"
     return audit(fn, *args, label=label, intent=intent_for_engine(engine))
 
 
-def audit_v2_engine(v2, phase: str = "decode",
-                    label: Optional[str] = None) -> GraphAuditReport:
-    """Audit the serving engine's ragged prefill/decode step."""
-    fn, args = v2.audit_step_args(phase)
+def intent_for_v2(v2) -> AuditIntent:
+    """The serving engine's declared collective/dtype intent — shared
+    by :func:`audit_v2_engine` and the bench-row target preparer so the
+    CLI/tier-1 audits can never drift from the API audit."""
     expected = set()
     if getattr(v2.topology, "tp_size", 1) > 1:
         expected.update(("all-reduce", "all-gather", "reduce-scatter"))
     if getattr(v2.topology, "ep_size", 1) > 1:
         expected.add("all-to-all")
     compute = "bf16" if "bf" in str(v2.cfg.dtype) else "fp32"
-    intent = AuditIntent(expected=frozenset(expected),
-                         compute_dtype=compute)
-    return audit(fn, *args, label=label or f"v2_{phase}", intent=intent)
+    return AuditIntent(expected=frozenset(expected), compute_dtype=compute)
+
+
+def audit_v2_engine(v2, phase: str = "decode",
+                    label: Optional[str] = None) -> GraphAuditReport:
+    """Audit the serving engine's ragged prefill/decode step."""
+    fn, args = v2.audit_step_args(phase)
+    return audit(fn, *args, label=label or f"v2_{phase}",
+                 intent=intent_for_v2(v2))
 
 
 def fused_collective_intent(engine) -> Dict[str, Dict[str, Any]]:
@@ -532,10 +584,31 @@ def collective_census_engine(engine) -> Dict[str, Dict[str, Any]]:
     ``present`` = whether a matching collective kind materialized in the
     lowered step — so pinned ``static_census`` evidence distinguishes a
     fused wire from a scheduled one."""
-    report = audit_engine(engine, label="census_probe")
+    return census_and_memory_engine(engine)[0]
+
+
+def census_and_memory_engine(engine) -> Tuple[Dict[str, Any],
+                                              Optional[Dict[str, Any]]]:
+    """Both pinned-evidence blocks off ONE lowering: the collective
+    census rollup (``static_census``) and the memory-plan rollup
+    (``static_memory``) — the probe pays the AOT trace+lower+compile
+    once.  The memory half degrades to None (with a warning) rather than
+    costing the probe its census."""
+    fn, args = engine.audit_step_args()
+    art = lower_step(fn, *args, label="census_probe")
+    report = audit_artifacts(art, intent=intent_for_engine(engine))
     summary = report.census_summary()
     fused = fused_collective_intent(engine)
     summary["fused_collective"] = {
         name: {**info, "present": info["kind"] in summary}
         for name, info in sorted(fused.items())}
-    return summary
+    static_memory = None
+    try:
+        from deepspeed_tpu.analysis.memory import (audit_memory,
+                                                   memory_intent_for_engine)
+
+        static_memory = audit_memory(
+            art, intent=memory_intent_for_engine(engine)).summary()
+    except Exception as e:  # census evidence must survive a memory miss
+        warnings.warn(f"static memory audit unavailable: {e}")
+    return summary, static_memory

@@ -12,6 +12,7 @@ exercisable on checked-in HLO fixtures.
 from __future__ import annotations
 
 import re
+from math import prod
 from typing import Any, Dict, List, Optional
 
 from deepspeed_tpu.analysis.report import CollectiveStat
@@ -168,9 +169,11 @@ def parse_input_output_alias(hlo_text: str) -> Dict[int, str]:
     return out
 
 
-def entry_parameters(hlo_text: str) -> List[Dict[str, Any]]:
-    """``[{index, type}]`` for the ENTRY computation's parameters (the
-    flat argument buffers, in jax's flattened-args order)."""
+def entry_lines(hlo_text: str) -> List[str]:
+    """The ENTRY computation's lines (brace-balanced extraction) — the
+    computation whose op results are the module's actually-allocated
+    buffers (fusion bodies are virtual; their internals never allocate
+    separately)."""
     entry: Optional[str] = None
     depth = 0
     lines: List[str] = []
@@ -185,13 +188,67 @@ def entry_parameters(hlo_text: str) -> List[Dict[str, Any]]:
         depth += line.count("{") - line.count("}")
         if depth <= 0:
             break
+    return lines
+
+
+def entry_parameters(hlo_text: str) -> List[Dict[str, Any]]:
+    """``[{index, type}]`` for the ENTRY computation's parameters (the
+    flat argument buffers, in jax's flattened-args order)."""
     params = []
-    for line in lines:
+    for line in entry_lines(hlo_text):
         m = re.match(r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.+?)\s+"
                      r"parameter\((\d+)\)", line)
         if m:
             params.append({"index": int(m.group(2)), "type": m.group(1)})
     return sorted(params, key=lambda p: p["index"])
+
+
+# ops whose "result" re-labels an existing allocation rather than
+# creating one — excluded from the buffer census
+_NO_ALLOC_OPCODES = ("bitcast", "get-tuple-element", "parameter", "tuple")
+
+_BUF_OP_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*"
+    r"(\([^=]*?\)|[a-z][\w\[\],]*(?:\{[^}]*\})?)\s+([\w\-]+)\(")
+
+
+def parse_buffers(hlo_text: str) -> List[Dict[str, Any]]:
+    """Large-allocation census of the ENTRY computation: one record per
+    op result — ``{name, opcode, bytes, dtype, shape, op_name,
+    param_index}`` — the static stand-in for XLA's buffer-assignment
+    dump (the text module does not carry the assignment itself, but
+    every separately-allocated live buffer is some entry op's result).
+    ``shape`` is the dims of the op's largest typed buffer; tuple results
+    sum all member buffers into ``bytes``.  No-alloc ops (parameter /
+    tuple / get-tuple-element / bitcast) are skipped — parameters are
+    reported separately with their ``param_index`` so the caller can
+    classify them via the argument manifests."""
+    out: List[Dict[str, Any]] = []
+    for line in entry_lines(hlo_text):
+        m = _BUF_OP_RE.match(line)
+        if m is None:
+            continue
+        name, out_type, opcode = m.group(1), m.group(2), m.group(3)
+        shapes = [(d, tuple(int(x) for x in dims.split(",") if x))
+                  for d, dims in _SHAPE_RE.findall(out_type)
+                  if d in _DTYPE_BYTES]
+        if not shapes:
+            continue
+        param_index = None
+        if opcode == "parameter":
+            pm = re.search(r"parameter\((\d+)\)", line)
+            param_index = int(pm.group(1)) if pm else None
+        elif opcode in _NO_ALLOC_OPCODES:
+            continue
+        total = shape_bytes(out_type)
+        big_dtype, big_shape = max(
+            shapes, key=lambda s: _DTYPE_BYTES[s[0]] * prod(s[1]))
+        meta = re.search(r'op_name="([^"]+)"', line)
+        out.append({"name": name, "opcode": opcode, "bytes": total,
+                    "dtype": big_dtype, "shape": list(big_shape),
+                    "op_name": meta.group(1) if meta else "",
+                    "param_index": param_index})
+    return out
 
 
 def custom_call_targets(hlo_text: str) -> List[str]:

@@ -1,0 +1,404 @@
+"""Static memory-plan auditor: budget the step's HBM before it runs.
+
+The compiled program's memory plan is fully inspectable before a single
+step executes — the same placement-semantics reasoning the collective
+census applies to wires applies to buffers.  Off one AOT
+``lower().compile()`` (shared with the graph audit via
+:class:`~deepspeed_tpu.analysis.auditor.LoweredStep`) this module emits a
+typed frozen-schema :class:`~deepspeed_tpu.analysis.report.MemoryAuditReport`:
+
+* **totals** — ``compiled.memory_analysis()`` per device (the SPMD
+  module IS the per-device program): temp / argument / output / alias /
+  generated-code bytes, plus the derived static ``peak_bytes``.
+* **buffer census** — top-K ENTRY-computation buffers off the optimized
+  HLO (``analysis/hlo.parse_buffers``) with shape, dtype, bytes and
+  defining op, classified into params / grads / opt-state / activations
+  / transients via the engines' argument manifests
+  (``audit_arg_categories``, the same tree-path naming the
+  PartitionOracle's flat manifests use).
+* **findings** — PR-11-style typed findings with fingerprint baselines:
+  ``unsharded_transient`` (a buffer carrying the GLOBAL shape of an
+  argument the partitioner sharded — replication across a >1 mesh axis
+  where a sharded layout exists; the pre-PR-11 zero-grads pattern),
+  ``remat_miss`` (a score-shaped S²-per-head fp32 transient alive under
+  a config that declared flash/ring attention), ``peak_regression``
+  (static peak grew >10% past the frozen per-target budget committed in
+  ``tools/memory_baseline.json``), and ``model_drift`` (the autotuner's
+  analytic ``estimate_memory_per_device`` vs the XLA-measured totals
+  diverging >25% — emitted as the calibration record the autotuner
+  attaches to its tuning-space pruning).
+
+Zero step executions: the audit runs on the virtual 8-device CPU mesh in
+CI against every bench-row target (``analysis/targets.py``), gates
+``tools/graft_lint.py --memory``, and its rollup rides the overlap
+scheduler's pinned ``static_memory`` evidence.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from math import prod
+
+from deepspeed_tpu.analysis.hlo import (entry_parameters, parse_buffers,
+                                        shape_bytes)
+from deepspeed_tpu.analysis.report import (MEMORY_CLASSES, Finding,
+                                           MemoryAuditReport, bucket_bytes,
+                                           memory_totals_from_analysis)
+
+# peak grew past budget × (1 + PEAK_REGRESSION_TOLERANCE) ⇒ high finding
+PEAK_REGRESSION_TOLERANCE = 0.10
+# analytic-vs-measured divergence past this ratio ⇒ model_drift record
+MODEL_DRIFT_TOLERANCE = 0.25
+
+
+@dataclass
+class MemoryIntent:
+    """What the config declares about the step's memory layout.
+
+    ``arg_categories`` classifies the example-args tuple ELEMENT-wise
+    (one :data:`MEMORY_CLASSES` entry per top-level argument — the
+    engines' ``audit_arg_categories()``); flat parameter buffers inherit
+    their subtree's class.  ``seq_len`` is the PER-SHARD sequence length
+    and ``flash`` whether the config declared a flash/ring attention
+    kernel (score matrices then must never reach HBM).
+    ``analytic_bytes`` is the autotuner's per-device estimate for the
+    same geometry — the ``model_drift`` cross-check input.
+    """
+    arg_categories: Tuple[str, ...] = ()
+    analytic_bytes: Optional[int] = None
+    seq_len: int = 0
+    flash: bool = False
+    min_buffer_bytes: int = 1 << 16
+    # classes whose GLOBAL shapes may legitimately appear replicated:
+    # ZeRO materializes full params transiently by design (stage-3
+    # per-use gathers, the stage-1/2 updated-param re-gather), so engine
+    # intents exempt params/opt-state/grads shapes — replication of a
+    # sharded BATCH or activation layout stays a finding, and planted
+    # tests use the strict empty default
+    replicated_ok: Tuple[str, ...] = ()
+
+    def __post_init__(self):
+        bad = [c for c in (tuple(self.arg_categories)
+                           + tuple(self.replicated_ok))
+               if c not in MEMORY_CLASSES]
+        if bad:
+            raise ValueError(f"unknown memory classes {bad!r} "
+                             f"(known: {list(MEMORY_CLASSES)})")
+
+
+# ----------------------------------------------------------------------
+# classification
+# ----------------------------------------------------------------------
+def flat_arg_classes(args: Tuple[Any, ...],
+                     categories: Tuple[str, ...]) -> Dict[int, str]:
+    """Flat-parameter-index → class, from the per-top-level-argument
+    category tuple (jax flattens the args tuple left to right, so the
+    flat index ranges are the cumulative subtree leaf counts)."""
+    import jax
+
+    if len(categories) != len(args):
+        raise ValueError(
+            f"arg_categories has {len(categories)} entries for "
+            f"{len(args)} top-level arguments")
+    classes: Dict[int, str] = {}
+    i = 0
+    for cat, a in zip(categories, args):
+        for _ in jax.tree_util.tree_leaves(a):
+            classes[i] = cat
+            i += 1
+    return classes
+
+
+def _classify_buffer(buf: Dict[str, Any],
+                     arg_classes: Dict[int, str]) -> str:
+    """Census-row class: parameters through the argument manifest;
+    program-defined buffers split into loop-carried state (the layer
+    scan's stacked activations) vs everything else (transients — fusion
+    outputs, cotangents, resharding scratch)."""
+    if buf["param_index"] is not None:
+        return arg_classes.get(buf["param_index"], "other")
+    if buf["opcode"] == "while" or "scan" in buf["op_name"]:
+        return "activations"
+    return "transients"
+
+
+# ----------------------------------------------------------------------
+# findings
+# ----------------------------------------------------------------------
+def _sharded_global_shapes(art, intent) -> Dict[Tuple[int, ...], int]:
+    """Global dims → shard ratio, for every argument the partitioner
+    SHARDED (per-device entry-parameter footprint strictly below the
+    global aval's), minus shapes belonging to ``intent.replicated_ok``
+    classes (layouts the config legitimately re-materializes in full).
+    Only computable when the executable kept every argument (same
+    reliability caveat as the donation audit)."""
+    import jax
+    import numpy as np
+
+    flat_info, _ = jax.tree_util.tree_flatten(art.lowered.args_info)
+    entry = entry_parameters(art.hlo)
+    if len(entry) != len(flat_info):
+        return {}
+    classes = (flat_arg_classes(art.args, intent.arg_categories)
+               if intent.arg_categories else {})
+    exempt_shapes = set()
+    out: Dict[Tuple[int, ...], int] = {}
+    for i, (info, param) in enumerate(zip(flat_info, entry)):
+        shape = tuple(int(d) for d in getattr(info, "shape", ()))
+        try:
+            global_bytes = int(prod(shape)) * np.dtype(
+                getattr(info, "dtype", "f4")).itemsize
+        except Exception:
+            continue
+        local_bytes = shape_bytes(param["type"])
+        if local_bytes and global_bytes > local_bytes:
+            if classes.get(i) in intent.replicated_ok:
+                exempt_shapes.add(shape)
+                continue
+            ratio = max(2, round(global_bytes / local_bytes))
+            out[shape] = max(out.get(shape, 0), ratio)
+    # a shape both exempted and flagged (an activation arg sharing dims
+    # with a param arg) resolves to exempt — never a phantom finding
+    for shape in exempt_shapes:
+        out.pop(shape, None)
+    return out
+
+
+def _unsharded_transient_findings(buffers, art, intent,
+                                  label) -> List[Finding]:
+    sharded = _sharded_global_shapes(art, intent)
+    if not sharded:
+        return []
+    findings = []
+    seen = set()
+    for buf in buffers:
+        if buf["param_index"] is not None:
+            continue
+        shape = tuple(buf["shape"])
+        # one finding per (shape, dtype): several ops carrying the same
+        # replicated buffer (the gather + its consumer fusion) share a
+        # fingerprint anyway — report the first, largest-first callers
+        # sort by bytes upstream
+        if (shape, buf["dtype"]) in seen:
+            continue
+        if shape in sharded and buf["bytes"] >= intent.min_buffer_bytes:
+            seen.add((shape, buf["dtype"]))
+            ratio = sharded[shape]
+            findings.append(Finding(
+                kind="unsharded_transient", severity="high",
+                message=f"{buf['opcode']} buffer {buf['dtype']}"
+                        f"{list(shape)} ({buf['bytes']} bytes/device) "
+                        f"carries the GLOBAL shape of an argument the "
+                        f"partitioner sharded {ratio}× — a replicated "
+                        "transient where a sharded layout exists (the "
+                        "pre-PR-11 zero-grads pattern)",
+                where=label,
+                detail={"key": f"{list(shape)}:{buf['dtype']}",
+                        "bytes": buf["bytes"], "shard_ratio": ratio,
+                        "op": buf["opcode"]}))
+    return findings
+
+
+def _remat_miss_findings(buffers, intent, label) -> List[Finding]:
+    if not intent.flash or intent.seq_len < 8:
+        return []
+    s = intent.seq_len
+    findings = []
+    for buf in buffers:
+        if buf["param_index"] is not None:
+            continue
+        dims = list(buf["shape"])
+        if (dims.count(s) >= 2 and buf["dtype"] in ("f32", "f64")
+                and buf["bytes"] >= intent.min_buffer_bytes):
+            findings.append(Finding(
+                kind="remat_miss", severity="high",
+                message=f"score-shaped {buf['dtype']}{dims} transient "
+                        f"({buf['bytes']} bytes/device) is live in a step "
+                        "whose config declares flash/ring attention — the "
+                        "S²·heads matrix was supposed to stay in VMEM "
+                        "tiles, not reach HBM",
+                where=label,
+                detail={"key": f"{dims}:{buf['dtype']}",
+                        "bytes": buf["bytes"], "seq_len": s}))
+    return findings
+
+
+def _budget_findings(peak: int, budget: Optional[int],
+                     label: str) -> List[Finding]:
+    if budget is None:
+        return [Finding(
+            kind="peak_regression", severity="warning",
+            message=f"no frozen peak budget for this target/backend — "
+                    f"current static peak is {peak} bytes/device; run "
+                    "graft_lint --memory --write-baseline to freeze it",
+            where=label, detail={"key": f"nobudget:{label}",
+                                 "peak_bytes": peak})]
+    limit = int(budget * (1.0 + PEAK_REGRESSION_TOLERANCE))
+    if peak > limit:
+        return [Finding(
+            kind="peak_regression", severity="high",
+            message=f"statically-predicted peak {peak} bytes/device grew "
+                    f">{PEAK_REGRESSION_TOLERANCE:.0%} past the frozen "
+                    f"budget {budget} — an OOM waiting to happen; fix the "
+                    "regression or deliberately re-freeze the budget",
+            where=label, detail={"key": f"budget:{label}",
+                                 "peak_bytes": peak,
+                                 "budget_bytes": budget})]
+    return []
+
+
+def _drift_finding(measured: int, analytic: Optional[int],
+                   label: str) -> Tuple[Dict[str, Any], List[Finding]]:
+    record: Dict[str, Any] = {"analytic_bytes": analytic,
+                              "measured_bytes": int(measured),
+                              "ratio": None}
+    if not analytic or analytic <= 0 or measured <= 0:
+        return record, []
+    ratio = measured / analytic
+    record["ratio"] = round(ratio, 4)
+    if abs(ratio - 1.0) <= MODEL_DRIFT_TOLERANCE:
+        return record, []
+    return record, [Finding(
+        kind="model_drift", severity="info",
+        message=f"analytic estimate_memory_per_device ({analytic} "
+                f"bytes/device) vs XLA-measured static peak ({measured}) "
+                f"diverge {abs(ratio - 1.0):.0%} — calibration record for "
+                "the autotuner's tuning-space pruning "
+                "(autotuning.load_memory_calibration)",
+        where=label, detail={"key": f"drift:{label}",
+                             "ratio": record["ratio"]})]
+
+
+# ----------------------------------------------------------------------
+# the auditor
+# ----------------------------------------------------------------------
+def audit_memory(art_or_fn, *args, intent: Optional[MemoryIntent] = None,
+                 label: Optional[str] = None,
+                 budget: Optional[int] = None,
+                 top_k: int = 12) -> MemoryAuditReport:
+    """Audit one lowered step's static memory plan — pass either a
+    :class:`~deepspeed_tpu.analysis.auditor.LoweredStep` (shared with
+    the graph audit) or a jitted fn + example args."""
+    from deepspeed_tpu.analysis.auditor import LoweredStep, lower_step
+
+    if isinstance(art_or_fn, LoweredStep):
+        art = art_or_fn
+    else:
+        art = lower_step(art_or_fn, *args, label=label or "step")
+    label = label or art.label
+    intent = intent or MemoryIntent()
+
+    try:
+        ma = art.compiled.memory_analysis()
+    except Exception:
+        ma = None
+    totals = memory_totals_from_analysis(ma)
+
+    raw = parse_buffers(art.hlo)
+    arg_classes = (flat_arg_classes(art.args, intent.arg_categories)
+                   if intent.arg_categories else {})
+    if arg_classes and len(entry_parameters(art.hlo)) != len(arg_classes):
+        # the executable dropped unused arguments, renumbering the HLO
+        # parameter(i) indices past the flat-arg manifest (same caveat
+        # as the donation audit) — a silently WRONG class is worse than
+        # none, so degrade every parameter buffer to uncategorized
+        arg_classes = {}
+    class_bytes = {c: 0 for c in MEMORY_CLASSES}
+    rows: List[Dict[str, Any]] = []
+    for buf in raw:
+        cat = _classify_buffer(buf, arg_classes)
+        class_bytes[cat] += buf["bytes"]
+        rows.append({"bytes": buf["bytes"], "category": cat,
+                     "dtype": buf["dtype"], "op": buf["opcode"],
+                     "shape": list(buf["shape"])})
+    rows.sort(key=lambda r: (-r["bytes"], r["op"], str(r["shape"])))
+
+    findings: List[Finding] = []
+    findings.extend(_unsharded_transient_findings(raw, art, intent, label))
+    findings.extend(_remat_miss_findings(raw, intent, label))
+    findings.extend(_budget_findings(totals["peak_bytes"], budget, label))
+    calibration, drift = _drift_finding(totals["peak_bytes"],
+                                        intent.analytic_bytes, label)
+    findings.extend(drift)
+    order = {"high": 0, "warning": 1, "info": 2}
+    findings.sort(key=lambda f: (order[f.severity], f.kind,
+                                 str(f.detail.get("key", ""))))
+    return MemoryAuditReport(
+        label=label, backend=art.backend,
+        num_partitions=max(1, art.num_partitions), totals=totals,
+        buffers=rows[:top_k], class_bytes=class_bytes,
+        budget={"bucketed_peak_bytes": bucket_bytes(totals["peak_bytes"]),
+                "budget_bytes": budget,
+                "peak_bytes": totals["peak_bytes"]},
+        calibration=calibration, findings=findings)
+
+
+# ----------------------------------------------------------------------
+# engine adapters
+# ----------------------------------------------------------------------
+def memory_intent_for_engine(engine) -> MemoryIntent:
+    """Derive the memory intent from a built train engine: argument
+    classes from the engine's own step-signature manifest, the per-shard
+    sequence length + flash declaration from the model config, and the
+    autotuner's analytic per-device estimate for the same geometry."""
+    mc = engine.model_config
+    topo = engine.topology
+    sp = getattr(topo, "sp_size", 1)
+    seq = int(getattr(mc, "max_seq_len", 0) or 0) // max(1, sp)
+    flash = False
+    if mc is not None:
+        flash = (getattr(mc, "attn_impl", "") == "pallas_flash"
+                 or (getattr(mc, "seq_impl", "") == "ring" and sp > 1
+                     and getattr(mc, "attn_impl", "") != "xla"))
+    return MemoryIntent(
+        arg_categories=tuple(engine.audit_arg_categories()),
+        analytic_bytes=_analytic_bytes_for_engine(engine),
+        seq_len=seq, flash=bool(flash),
+        # ZeRO re-materializes full params/grads transiently by design
+        # (per-use stage-3 gathers, the updated-param re-gather at
+        # stage 1/2) — those layouts are the config's own intent; a
+        # replicated BATCH/activation layout is still a finding
+        replicated_ok=("params", "opt_state", "grads"))
+
+
+def _analytic_bytes_for_engine(engine) -> Optional[int]:
+    try:
+        import jax
+
+        from deepspeed_tpu.autotuning.autotuner import (
+            ModelInfo, estimate_memory_per_device)
+
+        mc = engine.model_config
+        if mc is None:
+            return None
+        n_params = sum(int(prod(x.shape)) for x in
+                       jax.tree_util.tree_leaves(engine.params))
+        topo = engine.topology
+        cfg = engine.config
+        dtype = ("bf16" if getattr(cfg, "bf16_enabled", False) else
+                 "fp16" if getattr(cfg, "fp16_enabled", False) else "fp32")
+        return estimate_memory_per_device(
+            ModelInfo(num_params=n_params,
+                      hidden_size=getattr(mc, "hidden_size", 0),
+                      num_layers=getattr(mc, "num_layers", 0),
+                      vocab_size=getattr(mc, "vocab_size", 0)),
+            engine.zero_stage, max(1, getattr(topo, "dp_size", 1)),
+            engine.micro_batch_size, getattr(mc, "max_seq_len", 0),
+            dtype=dtype, tp_size=getattr(topo, "tp_size", 1),
+            pp_size=getattr(topo, "pp_size", 1),
+            sp_size=getattr(topo, "sp_size", 1))
+    except Exception:
+        return None
+
+
+def memory_intent_for_v2(v2) -> MemoryIntent:
+    """Memory intent for the serving engine's ragged step: no analytic
+    train-memory model applies (no grads/opt state) — classification and
+    transient findings only."""
+    mc = getattr(v2, "model_config", None)
+    return MemoryIntent(
+        arg_categories=tuple(v2.audit_arg_categories()),
+        seq_len=int(getattr(mc, "max_seq_len", 0) or 0) if mc else 0,
+        flash=bool(mc and getattr(mc, "attn_impl", "") == "pallas_flash"))

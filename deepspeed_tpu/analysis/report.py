@@ -29,8 +29,12 @@ FINDING_KINDS = (
     "dtype_promotion",       # bf16/fp16 tensor promoted to fp32 in the step
     "host_callback",         # host callback / infeed inside the hot path
     "implicit_resharding",   # GSPMD-inserted collective nobody declared
+    "model_drift",           # analytic memory model diverged from XLA's plan
+    "peak_regression",       # static peak grew past the frozen target budget
     "recompile_hazard",      # weak-type / python-scalar step argument
+    "remat_miss",            # score-shaped transient under a flash config
     "seam_violation",        # version-gated jax symbol outside jax_compat
+    "unsharded_transient",   # replicated buffer where a sharded layout exists
     "wire_dtype_mismatch",   # fp32 wire on a path declared quantized
 )
 
@@ -41,6 +45,62 @@ AUDIT_REPORT_KEYS = [
     "backend", "census", "donation", "findings", "label",
     "num_partitions", "schema",
 ]
+
+# ----------------------------------------------------------------------
+# memory-plan audit schema (analysis/memory.py) — frozen like the rest
+# ----------------------------------------------------------------------
+# Frozen top-level MemoryAuditReport keys.
+MEMORY_REPORT_KEYS = [
+    "backend", "budget", "buffers", "calibration", "class_bytes",
+    "findings", "label", "num_partitions", "schema", "totals",
+]
+
+# Frozen per-device totals from ``compiled.memory_analysis()`` plus the
+# derived static peak (argument + output + temp + generated_code − alias).
+MEMORY_TOTALS_KEYS = ["alias_bytes", "argument_bytes",
+                      "generated_code_bytes", "output_bytes", "peak_bytes",
+                      "temp_bytes"]
+
+def memory_totals_from_analysis(ma) -> Dict[str, int]:
+    """:data:`MEMORY_TOTALS_KEYS` dict from a
+    ``compiled.memory_analysis()`` result (None-safe) — the ONE place
+    the static-peak derivation lives, shared by ``analysis/memory.py``
+    and the engine's ``profile_compiled`` static-memory handshake so the
+    two can never disagree about what "peak" means."""
+    totals = {k: 0 for k in MEMORY_TOTALS_KEYS}
+    if ma is not None:
+        totals["temp_bytes"] = int(getattr(ma, "temp_size_in_bytes", 0))
+        totals["argument_bytes"] = int(
+            getattr(ma, "argument_size_in_bytes", 0))
+        totals["output_bytes"] = int(getattr(ma, "output_size_in_bytes", 0))
+        totals["alias_bytes"] = int(getattr(ma, "alias_size_in_bytes", 0))
+        totals["generated_code_bytes"] = int(
+            getattr(ma, "generated_code_size_in_bytes", 0))
+    # static peak: everything resident across the step, aliased
+    # (donated) outputs counted once
+    totals["peak_bytes"] = max(0, totals["argument_bytes"]
+                               + totals["output_bytes"]
+                               + totals["temp_bytes"]
+                               + totals["generated_code_bytes"]
+                               - totals["alias_bytes"])
+    return totals
+
+
+# Frozen per-buffer census row keys (top-K ENTRY-computation buffers).
+BUFFER_KEYS = ["bytes", "category", "dtype", "op", "shape"]
+
+# Frozen buffer classification vocabulary (the oracle-manifest classes).
+MEMORY_CLASSES = ("activations", "grads", "opt_state", "other", "params",
+                  "transients")
+
+# Frozen budget-block keys: the frozen per-target budget this audit was
+# gated against (``budget_bytes`` is None when no budget is recorded for
+# this target+backend — a warning, never a silent pass).
+BUDGET_KEYS = ["bucketed_peak_bytes", "budget_bytes", "peak_bytes"]
+
+# Frozen calibration-record keys (the ``model_drift`` cross-check the
+# autotuner attaches to its tuning-space pruning).
+CALIBRATION_KEYS = ["analytic_bytes", "measured_bytes", "ratio"]
 
 # Frozen per-census-row keys: one row per (collective kind, wire dtype).
 CENSUS_KEYS = ["count", "dtype", "group_size", "kind", "payload_bytes",
@@ -177,3 +237,91 @@ def load_baseline(path: str) -> frozenset:
     except FileNotFoundError:
         return frozenset()
     return frozenset(str(s) for s in data.get("suppress", []))
+
+
+# ----------------------------------------------------------------------
+# memory-plan audit report (analysis/memory.py)
+# ----------------------------------------------------------------------
+@dataclass
+class MemoryAuditReport:
+    """One audited graph's static memory plan: per-device totals from
+    ``compiled.memory_analysis()``, a top-K buffer census off the
+    optimized HLO classified into :data:`MEMORY_CLASSES`, the frozen
+    per-target budget check, the analytic-model calibration record, and
+    typed findings (same :class:`Finding` machinery as the graph audit).
+    Plain data, no jax."""
+    label: str
+    backend: str = "cpu"
+    num_partitions: int = 1
+    totals: Dict[str, int] = field(default_factory=lambda: {
+        k: 0 for k in MEMORY_TOTALS_KEYS})
+    buffers: List[Dict[str, Any]] = field(default_factory=list)
+    class_bytes: Dict[str, int] = field(default_factory=dict)
+    budget: Dict[str, Any] = field(default_factory=lambda: {
+        "bucketed_peak_bytes": 0, "budget_bytes": None, "peak_bytes": 0})
+    calibration: Dict[str, Any] = field(default_factory=lambda: {
+        "analytic_bytes": None, "measured_bytes": 0, "ratio": None})
+    findings: List[Finding] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "backend": self.backend,
+            "budget": dict(self.budget),
+            "buffers": [dict(b) for b in self.buffers],
+            "calibration": dict(self.calibration),
+            "class_bytes": dict(self.class_bytes),
+            "findings": [f.to_dict() for f in self.findings],
+            "label": self.label,
+            "num_partitions": self.num_partitions,
+            "schema": AUDIT_SCHEMA_VERSION,
+            "totals": {k: int(self.totals.get(k, 0))
+                       for k in MEMORY_TOTALS_KEYS},
+        }
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+    def high_findings(self, baseline: Optional[Iterable[str]] = None
+                      ) -> List[Finding]:
+        """High-severity findings not suppressed by ``baseline``."""
+        sup = frozenset(baseline or ())
+        return [f for f in self.findings
+                if f.severity == "high" and f.fingerprint() not in sup]
+
+    def summary(self) -> Dict[str, Any]:
+        """Compact rollup for the overlap scheduler's pinned
+        ``static_memory`` evidence: the per-device totals plus the
+        per-class byte rollup — small enough to freeze into a pinned
+        ``step_schedule`` next to ``static_census``."""
+        return {**{k: int(self.totals.get(k, 0))
+                   for k in MEMORY_TOTALS_KEYS},
+                "class_bytes": dict(self.class_bytes)}
+
+
+def bucket_bytes(n: int) -> int:
+    """Round ``n`` UP to a coarse bucket (granularity = 2^(L−5) for an
+    L-bit value, floored at 4 KiB — ≤ ~6.25% quantization).  Frozen
+    per-target budgets are stored bucketed so layout/padding jitter
+    between compiler versions and CPU-vs-TPU backends does not churn the
+    committed baseline, while a real >10% peak regression still lands in
+    a higher bucket."""
+    n = int(n)
+    if n <= 0:
+        return 0
+    gran = max(1 << 12, 1 << max(0, n.bit_length() - 5))
+    return ((n + gran - 1) // gran) * gran
+
+
+def load_memory_baseline(path: str) -> Dict[str, Any]:
+    """Read ``tools/memory_baseline.json``: ``{"budgets": {target:
+    {backend: bucketed_bytes}}, "calibration": {backend: ratio}}``.
+    A missing file is an empty baseline — every target then carries a
+    ``peak_regression`` *warning* (no frozen budget), never a silent
+    pass."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return {"budgets": {}, "calibration": {}}
+    return {"budgets": dict(data.get("budgets", {})),
+            "calibration": dict(data.get("calibration", {}))}

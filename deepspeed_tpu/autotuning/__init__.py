@@ -2,8 +2,11 @@
 
 from deepspeed_tpu.autotuning.autotuner import (Autotuner, ModelInfo,
                                                 TrialResult,
+                                                estimate_memory_breakdown,
                                                 estimate_memory_per_device,
-                                                generate_tuning_space)
+                                                generate_tuning_space,
+                                                load_memory_calibration,
+                                                predict_fit)
 from deepspeed_tpu.autotuning.overlap_scheduler import (SCHEDULE_DECISIONS,
                                                         OverlapScheduler,
                                                         ScheduleDecision,
@@ -12,6 +15,8 @@ from deepspeed_tpu.autotuning.overlap_scheduler import (SCHEDULE_DECISIONS,
                                                         extract_evidence)
 
 __all__ = ["Autotuner", "ModelInfo", "TrialResult",
-           "estimate_memory_per_device", "generate_tuning_space",
+           "estimate_memory_breakdown", "estimate_memory_per_device",
+           "generate_tuning_space", "load_memory_calibration",
+           "predict_fit",
            "OverlapScheduler", "ScheduleDecision", "SCHEDULE_DECISIONS",
            "decide", "ensure_schedule", "extract_evidence"]

@@ -44,13 +44,15 @@ class ModelInfo:
     vocab_size: int = 0
 
 
-def estimate_memory_per_device(model_info: ModelInfo, zero_stage: int,
-                               dp_size: int, micro_batch: int, seq_len: int,
-                               dtype: str = "bf16",
-                               optimizer_factor: int = 12,
-                               tp_size: int = 1, pp_size: int = 1,
-                               sp_size: int = 1) -> int:
-    """Bytes per device for params+grads+optimizer+activations.
+def estimate_memory_breakdown(model_info: ModelInfo, zero_stage: int,
+                              dp_size: int, micro_batch: int, seq_len: int,
+                              dtype: str = "bf16",
+                              optimizer_factor: int = 12,
+                              tp_size: int = 1, pp_size: int = 1,
+                              sp_size: int = 1) -> Dict[str, int]:
+    """Per-class bytes per device for params/grads/optimizer/activations/
+    logits (+ ``total``) — the ladder predictor reports WHICH class blew
+    the budget, not just that it did.
 
     Ref get_instantiation_memory_required_per_gpu (autotuner.py:278):
     optimizer_factor=12 ≈ fp32 master + two Adam moments + fp16 param/grad
@@ -84,7 +86,110 @@ def estimate_memory_per_device(model_info: ModelInfo, zero_stage: int,
     # buffer, but the tuner prices the default untiled path.
     logits = (micro_batch * seq_len * max(1, model_info.vocab_size) * 4 * 2
               // max(1, sp_size * tp_size))
-    return int(params_mem + grads_mem + opt_mem + act + logits)
+    out = {"params": int(params_mem), "grads": int(grads_mem),
+           "optimizer": int(opt_mem), "activations": int(act),
+           "logits": int(logits)}
+    out["total"] = sum(out.values())
+    return out
+
+
+def estimate_memory_per_device(model_info: ModelInfo, zero_stage: int,
+                               dp_size: int, micro_batch: int, seq_len: int,
+                               dtype: str = "bf16",
+                               optimizer_factor: int = 12,
+                               tp_size: int = 1, pp_size: int = 1,
+                               sp_size: int = 1) -> int:
+    """Total bytes per device (see :func:`estimate_memory_breakdown`)."""
+    return estimate_memory_breakdown(
+        model_info, zero_stage, dp_size, micro_batch, seq_len, dtype,
+        optimizer_factor, tp_size, pp_size, sp_size)["total"]
+
+
+def load_memory_calibration(path: Optional[str] = None,
+                            backend: str = "cpu") -> float:
+    """The ``model_drift`` calibration ratio (XLA-measured static peak /
+    analytic estimate) the memory auditor froze into
+    ``tools/memory_baseline.json`` for ``backend`` — 1.0 when the file
+    or the backend entry is absent.  Multiplying the analytic estimate
+    by this ratio turns the never-validated model into one anchored to
+    what XLA actually allocates on this backend."""
+    import json
+
+    if path is None:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))),
+            "tools", "memory_baseline.json")
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return 1.0
+    try:
+        return float(data.get("calibration", {}).get(backend, 1.0)) or 1.0
+    except (TypeError, ValueError):
+        return 1.0
+
+
+def predict_fit(model_info: ModelInfo, zero_stage: int, dp_size: int,
+                micro_batch: int, seq_len: int, hbm_bytes: int,
+                dtype: str = "bf16", calibration: float = 1.0,
+                tp_size: int = 1, pp_size: int = 1, sp_size: int = 1,
+                offload_param: Optional[str] = None,
+                offload_optimizer: Optional[str] = None,
+                host_bytes: Optional[int] = None) -> Dict[str, Any]:
+    """The OOM-before-you-run gate: calibrated per-device peak estimate
+    vs the device budget, with the dominant class and shortfall when it
+    does NOT fit — so a too-big ladder rung reports *why* instead of
+    dying in RESOURCE_EXHAUSTED.
+
+    ZeRO-Offload re-homes whole classes off the device
+    (``offload_param`` / ``offload_optimizer`` take the config's device
+    string, e.g. ``"cpu"`` / ``"nvme"``): the optimizer's fp32 masters +
+    moments (and the grads that feed them) follow ``offload_optimizer``,
+    the param shards follow ``offload_param`` — those classes stop
+    counting against ``hbm_bytes``.  Classes homed on ``"cpu"`` are
+    instead priced against ``host_bytes`` when the caller provides it
+    (the r04 ladder died in HOST resource exhaustion, not HBM); NVMe
+    classes are treated as unbounded."""
+    bd = estimate_memory_breakdown(model_info, zero_stage, dp_size,
+                                   micro_batch, seq_len, dtype,
+                                   tp_size=tp_size, pp_size=pp_size,
+                                   sp_size=sp_size)
+    cal = float(calibration) if calibration else 1.0
+    home = {k: "device" for k in bd if k != "total"}
+    if offload_optimizer:
+        home["optimizer"] = offload_optimizer
+        home["grads"] = offload_optimizer
+    if offload_param:
+        home["params"] = offload_param
+    device_classes = [k for k, h in home.items() if h == "device"]
+    host_classes = [k for k, h in home.items() if h == "cpu"]
+    predicted = int(sum(bd[k] for k in device_classes) * cal)
+    host_need = int(sum(bd[k] for k in host_classes) * cal)
+    fit_device = predicted <= int(hbm_bytes)
+    fit_host = host_bytes is None or host_need <= int(host_bytes)
+    if not fit_device:
+        dominant = max(device_classes, key=lambda k: bd[k])
+        shortfall = predicted - int(hbm_bytes)
+    elif not fit_host:
+        dominant = max(host_classes, key=lambda k: bd[k])
+        shortfall = host_need - int(host_bytes)
+    else:
+        dominant = max((k for k in bd if k != "total"),
+                       key=lambda k: bd[k])
+        shortfall = 0
+    return {
+        "predicted_peak_bytes": predicted,
+        "predicted_fit": fit_device and fit_host,
+        "hbm_bytes": int(hbm_bytes),
+        "host_bytes": None if host_bytes is None else int(host_bytes),
+        "host_resident_bytes": host_need,
+        "calibration": round(cal, 4),
+        "breakdown": bd,
+        "dominant_class": dominant,
+        "shortfall_bytes": max(0, shortfall),
+    }
 
 
 def enumerate_meshes(n_devices: int, model_cfg) -> "List[Dict[str, int]]":
@@ -144,10 +249,14 @@ def generate_tuning_space(model_info: ModelInfo, dp_size: int, seq_len: int,
                           hbm_bytes: int, dtype: str = "bf16",
                           stages=(0, 1, 2, 3),
                           max_micro_batch: int = 64,
-                          meshes: Optional[List[Dict[str, int]]] = None
+                          meshes: Optional[List[Dict[str, int]]] = None,
+                          calibration: float = 1.0
                           ) -> List[Dict[str, Any]]:
     """Candidate (mesh, zero_stage, micro_batch) configs that fit the
-    memory budget (ref tuning-space templates + the mesh sweep)."""
+    memory budget (ref tuning-space templates + the mesh sweep).
+    ``calibration`` scales the analytic estimate by the memory auditor's
+    frozen ``model_drift`` ratio (:func:`load_memory_calibration`) so
+    pruning tracks what XLA actually allocates on this backend."""
     space = []
     # mesh=None = "not sweeping": candidates carry no mesh key, so the
     # caller's base_config mesh passes through trials untouched
@@ -165,9 +274,10 @@ def generate_tuning_space(model_info: ModelInfo, dp_size: int, seq_len: int,
                 continue  # engine: pipeline composes with ZeRO-0/1 specs
             mb = 1
             while mb <= max_micro_batch:
-                need = estimate_memory_per_device(
+                need = int(estimate_memory_per_device(
                     model_info, stage, max(1, dp), mb, seq_len, dtype,
                     tp_size=tp, pp_size=pp, sp_size=sp)
+                    * (float(calibration) or 1.0))
                 if need <= hbm_bytes:
                     cand = {"zero_stage": stage, "micro_batch": mb,
                             "est_bytes": need}
@@ -201,7 +311,8 @@ class Autotuner:
                  hbm_bytes: Optional[int] = None, seed: int = 0,
                  tune_mesh: bool = False, n_devices: Optional[int] = None,
                  isolate_trials: bool = True,
-                 trial_timeout: Optional[float] = None):
+                 trial_timeout: Optional[float] = None,
+                 calibration: Any = None):
         self.model_cfg = model_cfg
         self.base_config = base_config
         self.seq_len = seq_len
@@ -217,6 +328,16 @@ class Autotuner:
         self.isolate_trials = isolate_trials
         # generous default: engine build + XLA compile + timed steps
         self.trial_timeout = trial_timeout or (600.0 + 30.0 * steps_per_trial)
+        # memory-model calibration attached to tuning-space pruning:
+        # None = uncalibrated (1.0, historical behavior), "auto" = the
+        # memory auditor's frozen model_drift ratio for this backend
+        # (tools/memory_baseline.json), or an explicit float
+        if calibration == "auto":
+            import jax
+
+            calibration = load_memory_calibration(
+                backend=jax.default_backend())
+        self.calibration = float(calibration) if calibration else 1.0
         self.results: List[TrialResult] = []
 
     # ------------------------------------------------------------------
@@ -240,7 +361,8 @@ class Autotuner:
             meshes = enumerate_meshes(n, self.model_cfg)
         space = generate_tuning_space(self.model_info(), max(1, dp),
                                       self.seq_len, self.hbm_bytes,
-                                      meshes=meshes)
+                                      meshes=meshes,
+                                      calibration=self.calibration)
         if self.mode == "random":
             rng = np.random.default_rng(self.seed)
             rng.shuffle(space)

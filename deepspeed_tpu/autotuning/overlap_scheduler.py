@@ -60,14 +60,16 @@ SCHEDULE_DECISIONS = ("decomposed_update", "fused_gather_matmul", "noop",
                       "ring_interleave", "zero3_prefetch")
 
 # Frozen evidence key set: every ScheduleDecision carries exactly these.
-# `static_census` is the graph auditor's per-kind collective rollup
-# (analysis/auditor.collective_census_engine — docs/STATIC_ANALYSIS.md):
-# pinned evidence records WHAT the step's comm statically is alongside
-# how well the runtime overlapped it; None when the audit was
-# unavailable during the probe.
+# `static_census` is the graph auditor's per-kind collective rollup and
+# `static_memory` the memory-plan auditor's per-device totals rollup
+# (analysis/auditor.census_and_memory_engine — docs/STATIC_ANALYSIS.md,
+# both off ONE probe-time lowering): pinned evidence records WHAT the
+# step's comm and memory plan statically are alongside how well the
+# runtime overlapped it; None when the audit was unavailable during the
+# probe.
 EVIDENCE_KEYS = ("dominant_collective", "exposed_comm_ms",
                  "overlap_fraction", "overlap_source", "probe_step",
-                 "static_census")
+                 "static_census", "static_memory")
 
 # param_persistence_threshold rungs (same ladder as the DeepCompile
 # SelectiveUnshardPass — compile/backend.py): each step trades spare HBM
@@ -107,10 +109,11 @@ class ScheduleDecision:
     def from_dict(cls, d: Dict[str, Any]) -> "ScheduleDecision":
         ev = dict(d.get("evidence", {}))
         if ev:
-            # configs pinned before the census field existed must keep
-            # loading (pinned-mode reproducibility contract): an absent
-            # census is None, the same value a failed audit records
+            # configs pinned before the census/memory fields existed must
+            # keep loading (pinned-mode reproducibility contract): an
+            # absent block is None, the same value a failed audit records
             ev.setdefault("static_census", None)
+            ev.setdefault("static_memory", None)
         return cls(decision=d["decision"], knobs=dict(d.get("knobs", {})),
                    evidence=ev)
 
@@ -170,6 +173,7 @@ def extract_evidence(report: Dict[str, Any],
         "probe_step": int(report.get("step",
                                      report.get("armed_at_step", 0))),
         "static_census": report.get("static_census"),
+        "static_memory": report.get("static_memory"),
     }
 
 
@@ -346,19 +350,21 @@ class OverlapScheduler:
         engine, _, _, _ = ds.initialize(model=self.model,
                                         config=self._probe_config())
         census = None
+        static_memory = None
         try:
             self.last_context = self._context_from_engine(engine)
             for _ in range(self.probe_steps + 1):
                 engine.train_batch(batch)
             try:
-                # static collective census for the pinned evidence (one
-                # AOT lower+compile — a one-time probe cost, same class
-                # as profile_compiled's); a failed audit must not cost
-                # the probe its runtime report
+                # static collective census + memory-plan rollup for the
+                # pinned evidence, BOTH off one AOT lower+compile (a
+                # one-time probe cost, same class as profile_compiled's);
+                # a failed audit must not cost the probe its runtime
+                # report
                 from deepspeed_tpu.analysis.auditor import \
-                    collective_census_engine
+                    census_and_memory_engine
 
-                census = collective_census_engine(engine)
+                census, static_memory = census_and_memory_engine(engine)
             except Exception as e:
                 logger.warning(f"overlap_scheduler: static census "
                                f"unavailable ({e})")
@@ -382,6 +388,7 @@ class OverlapScheduler:
         with open(paths[-1], "r", encoding="utf-8") as f:
             self.last_report = json.load(f)
         self.last_report["static_census"] = census
+        self.last_report["static_memory"] = static_memory
         return self.last_report
 
     def pin(self, updates: Dict[str, Any],

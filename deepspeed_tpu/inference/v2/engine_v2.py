@@ -293,6 +293,15 @@ class InferenceEngineV2:
                 ids, ids, ids, ids, tables, rows, rows)
         return self._step, args
 
+    def audit_arg_categories(self):
+        """Memory-class manifest for the ``audit_step_args`` tuple (one
+        ``analysis.MEMORY_CLASSES`` entry per top-level argument): the
+        weights, the two paged KV pools (state, not step-local —
+        classed ``other``), and the ragged index arrays."""
+        return ("params", "other", "other",
+                "activations", "activations", "activations", "activations",
+                "other", "other", "other")
+
     def put(self, batch_uids: Sequence[int],
             batch_tokens: Sequence[Sequence[int]]) -> Dict[int, np.ndarray]:
         """Admit prompts and run ONE ragged step (ref engine_v2.py:30 put).

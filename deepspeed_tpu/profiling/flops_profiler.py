@@ -37,10 +37,15 @@ def profile_compiled(jit_fn, *args, **kwargs) -> Dict[str, float]:
     try:
         mem = compiled.memory_analysis()
         if mem is not None:
+            # one shared peak derivation with analysis/memory.audit_memory
+            from deepspeed_tpu.analysis.report import \
+                memory_totals_from_analysis
+
+            totals = memory_totals_from_analysis(mem)
+            out["memory"] = totals
             out["peak_memory_bytes"] = float(
-                getattr(mem, "temp_size_in_bytes", 0)
-                + getattr(mem, "argument_size_in_bytes", 0)
-                + getattr(mem, "output_size_in_bytes", 0))
+                totals["temp_bytes"] + totals["argument_bytes"]
+                + totals["output_bytes"])
     except Exception:  # backend without memory analysis
         pass
     return out

@@ -2009,6 +2009,14 @@ class DeepSpeedEngine:
                     profile_compiled
 
                 prof = profile_compiled(self._train_step_jit, *step_args)
+                if self.telemetry is not None and prof.get("memory"):
+                    # static-memory handshake: the same one-time AOT
+                    # compile that prices flops also reads XLA's memory
+                    # plan — capture reports diff the runtime HBM
+                    # watermarks against it (report.json `hbm` block)
+                    self.telemetry.set_static_memory(
+                        {"backend": jax.default_backend(),
+                         **prof["memory"]})
                 if prof.get("flops"):
                     return float(prof["flops"]), "measured"
             except Exception as e:
@@ -2125,6 +2133,20 @@ class DeepSpeedEngine:
         lr = jnp.float32(self.lr_scheduler(self.global_steps))
         return (self._train_step_jit,
                 self._train_step_args(self.opt_state, batch_stack, lr))
+
+    def audit_arg_categories(self):
+        """Memory-class manifest for the ``audit_step_args`` tuple — one
+        ``analysis.MEMORY_CLASSES`` entry per top-level argument, in the
+        exact ``_train_step_args`` order (the comm-quant error-feedback
+        residual rides between loss-scale state and the batch), so the
+        memory auditor can classify every flat parameter buffer by its
+        tree-path subtree (the same name manifests the PartitionOracle
+        exposes)."""
+        cats = ["params", "opt_state", "opt_state"]
+        if self._comm_quant_state is not None:
+            cats.append("grads")    # error-feedback residual, grad units
+        cats += ["activations", "other"]   # batch stack, lr scalar
+        return tuple(cats)
 
     def _train_batch_traced_body(self, data) -> jnp.ndarray:
         if self._onebit is not None:

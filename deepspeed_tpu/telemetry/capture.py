@@ -19,7 +19,7 @@ from __future__ import annotations
 import json
 import os
 from collections import deque
-from typing import Deque, Dict, Optional
+from typing import Deque, Dict, Optional, Tuple
 
 from deepspeed_tpu.utils.logging import logger
 from deepspeed_tpu.utils.trace import TraceProfiler
@@ -56,8 +56,44 @@ def spans_overlap_estimate(window_totals: Dict[str, Dict]) -> Dict:
             "overlap_estimate": round(est, 4)}
 
 
+def hbm_cross_check(static_memory: Optional[Dict],
+                    step_record=None) -> Tuple[Optional[Dict], str]:
+    """The report's ``hbm`` block: runtime HBM watermarks (the
+    StepRecord's per-device ``memory_stats()`` peaks) diffed against the
+    compiled step's static memory plan (the engine's flops-handshake
+    ``set_static_memory``), so ``model_drift`` has a runtime cross-check.
+
+    Degrades to ``(None, note)`` when no static plan was recorded, when
+    the backend is not a TPU (the CPU accelerator's watermarks are host
+    RSS — process-wide, not device HBM, so the diff would be
+    meaningless), or when the record carries no watermarks."""
+    if not static_memory:
+        return None, "hbm cross-check omitted (no static memory plan " \
+                     "recorded — telemetry.measure_flops off?)"
+    if static_memory.get("backend") != "tpu":
+        return None, ("hbm cross-check omitted on "
+                      f"{static_memory.get('backend', '?')} backend "
+                      "(host RSS watermarks are not device HBM)")
+    marks = dict(getattr(step_record, "hbm", None) or {})
+    peaks = [int(v.get("peak_bytes_in_use", 0)) for v in marks.values()
+             if isinstance(v, dict)]
+    if not any(peaks):
+        return None, "hbm cross-check omitted (no device watermarks in " \
+                     "the capture-window StepRecord)"
+    predicted = int(static_memory.get("peak_bytes", 0))
+    measured = max(peaks)
+    return {
+        "predicted_peak_bytes": predicted,
+        "measured_peak_bytes": measured,
+        "drift_ratio": (round(measured / predicted, 4) if predicted
+                        else None),
+        "per_device": marks,
+    }, ""
+
+
 def build_capture_report(logdir: str, device_substr: str = "TPU",
-                         step_record=None, span_totals=None) -> Dict:
+                         step_record=None, span_totals=None,
+                         static_memory: Optional[Dict] = None) -> Dict:
     """Pure post-processing of one capture directory → report dict.
 
     Degrades explicitly when the capture has no device planes (CPU runs
@@ -102,6 +138,10 @@ def build_capture_report(logdir: str, device_substr: str = "TPU",
                 report["top_ops"])
     except Exception as e:  # a broken trace must not kill training
         report["note"] = f"capture post-processing failed: {e!r}"
+    hbm, hbm_note = hbm_cross_check(static_memory, step_record)
+    report["hbm"] = hbm
+    if hbm_note:
+        report["note"] = (report["note"] + "; " + hbm_note).lstrip("; ")
     if step_record is not None:
         # MFU cross-check: the analytic record's number next to what the
         # capture actually saw, so a disagreement is one diff away
@@ -243,10 +283,11 @@ class AutoCapture:
             # OLDER step than the capture window — cross-checking the
             # trace against it would report a phantom MFU disagreement
             rec = None
-        report = build_capture_report(logdir,
-                                      device_substr=self.device_substr,
-                                      step_record=rec,
-                                      span_totals=self._span_window())
+        report = build_capture_report(
+            logdir, device_substr=self.device_substr, step_record=rec,
+            span_totals=self._span_window(),
+            static_memory=getattr(self.telemetry, "static_memory", None)
+            if self.telemetry is not None else None)
         self._span_base = None
         if rec is None and self.telemetry is not None:
             report["note"] = (report["note"] + "; no StepRecord inside "

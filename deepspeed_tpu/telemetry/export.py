@@ -162,6 +162,10 @@ class Telemetry:
         # (profile_compiled or the analytic model profile)
         self._flops_per_step: Optional[float] = None
         self._flops_source = "none"
+        # static per-device memory plan for the compiled step, set by the
+        # engine's flops handshake ({"backend", "peak_bytes", ...}) —
+        # capture reports diff runtime HBM watermarks against it
+        self.static_memory: Optional[Dict] = None
         self._steps = 0
         self._skipped = 0
         self._tokens = 0
@@ -277,6 +281,11 @@ class Telemetry:
     def set_flops(self, flops_per_step: float, source: str) -> None:
         self._flops_per_step = float(flops_per_step)
         self._flops_source = source
+
+    def set_static_memory(self, totals: Optional[Dict]) -> None:
+        """Record the compiled step's static memory plan (engine flops
+        handshake) for the capture report's ``hbm`` cross-check."""
+        self.static_memory = dict(totals) if totals else None
 
     # -- record paths ----------------------------------------------------
     def record_train_step(self, step: int, wall_time_s: float, tokens: int,

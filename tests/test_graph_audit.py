@@ -365,17 +365,25 @@ def test_scheduler_evidence_carries_static_census():
 
 
 # ----------------------------------------------------------------------
-# the tier-1 gate: every bench-row step config audits clean
+# the tier-1 gate: every bench-row step config audits clean — the graph
+# audit AND the memory-plan audit, both off ONE shared lowering
 # ----------------------------------------------------------------------
 @pytest.mark.parametrize("name", sorted(
     __import__("deepspeed_tpu.analysis.targets",
                fromlist=["BENCH_AUDIT_TARGETS"]).BENCH_AUDIT_TARGETS))
 def test_bench_row_static_audit_clean(name):
-    from deepspeed_tpu.analysis.targets import run_audit_target
+    import jax as _jax
+
+    from deepspeed_tpu.analysis import load_memory_baseline
+    from deepspeed_tpu.analysis.targets import run_target_audits
 
     baseline = load_baseline(
         os.path.join(REPO, "tools", "graft_lint_baseline.json"))
-    rep = run_audit_target(name)
+    mem_base = load_memory_baseline(
+        os.path.join(REPO, "tools", "memory_baseline.json"))
+    budget = mem_base["budgets"].get(name, {}).get(
+        _jax.default_backend())
+    rep, mem = run_target_audits(name, memory=True, budget=budget)
     assert rep.to_dict()["schema"] == 1
     highs = rep.high_findings(baseline)
     assert highs == [], [f.to_dict() for f in highs]
@@ -390,6 +398,13 @@ def test_bench_row_static_audit_clean(name):
         a2a = [c for c in rep.census if c.kind == "all-to-all"
                and "s8" in c.dtype]
         assert a2a, "int8 wire missing from the quantized reduce"
+    # memory gate: zero unbaselined highs against the committed budgets
+    mem_highs = mem.high_findings(baseline)
+    assert mem_highs == [], [f.to_dict() for f in mem_highs]
+    assert mem.totals["peak_bytes"] > 0 and mem.buffers, mem.totals
+    assert budget is not None, \
+        f"no frozen cpu budget for {name} — run graft_lint --memory " \
+        "--write-baseline and commit tools/memory_baseline.json"
 
 
 def test_graft_lint_cli_seam_only(tmp_path):

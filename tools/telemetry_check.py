@@ -104,7 +104,7 @@ EXPECTED_SCHEDULE_DECISIONS = ["decomposed_update", "fused_gather_matmul",
                                "noop", "ring_interleave", "zero3_prefetch"]
 EXPECTED_EVIDENCE_KEYS = ["dominant_collective", "exposed_comm_ms",
                           "overlap_fraction", "overlap_source",
-                          "probe_step", "static_census"]
+                          "probe_step", "static_census", "static_memory"]
 EXPECTED_STEP_SCHEDULE_KEYS = [
     "decisions", "fused_gather_matmul", "fused_reduce_scatter",
     "gather_prefetch_depth", "mode", "overlap_threshold",
@@ -134,8 +134,9 @@ SERVE_MULTI_BENCH_KEYS = ["agg_tokens_per_sec", "ttft_p95_ms",
 STATIC_DOCS = os.path.join(REPO, "docs", "STATIC_ANALYSIS.md")
 EXPECTED_FINDING_KINDS = [
     "collective_mismatch", "donation_miss", "dtype_promotion",
-    "host_callback", "implicit_resharding", "recompile_hazard",
-    "seam_violation", "wire_dtype_mismatch",
+    "host_callback", "implicit_resharding", "model_drift",
+    "peak_regression", "recompile_hazard", "remat_miss",
+    "seam_violation", "unsharded_transient", "wire_dtype_mismatch",
 ]
 EXPECTED_AUDIT_SEVERITIES = ["info", "warning", "high"]
 EXPECTED_AUDIT_REPORT_KEYS = ["backend", "census", "donation", "findings",
@@ -146,6 +147,26 @@ EXPECTED_AUDIT_FINDING_KEYS = ["detail", "fingerprint", "kind", "message",
                                "severity", "where"]
 EXPECTED_AUDIT_DONATION_KEYS = ["aliased", "declared", "missed",
                                 "missed_bytes"]
+
+# frozen memory-plan-audit vocabulary (analysis/report.py MemoryAuditReport;
+# docs/STATIC_ANALYSIS.md): report/totals/buffer/budget/calibration key
+# sets and the buffer-classification classes, plus the peak_params
+# ladder-prediction bench keys — same tripwire contract as the graph
+# audit schema.
+EXPECTED_MEMORY_REPORT_KEYS = ["backend", "budget", "buffers",
+                               "calibration", "class_bytes", "findings",
+                               "label", "num_partitions", "schema",
+                               "totals"]
+EXPECTED_MEMORY_TOTALS_KEYS = ["alias_bytes", "argument_bytes",
+                               "generated_code_bytes", "output_bytes",
+                               "peak_bytes", "temp_bytes"]
+EXPECTED_BUFFER_KEYS = ["bytes", "category", "dtype", "op", "shape"]
+EXPECTED_MEMORY_CLASSES = ["activations", "grads", "opt_state", "other",
+                           "params", "transients"]
+EXPECTED_BUDGET_KEYS = ["bucketed_peak_bytes", "budget_bytes",
+                        "peak_bytes"]
+EXPECTED_CALIBRATION_KEYS = ["analytic_bytes", "measured_bytes", "ratio"]
+MEMORY_BENCH_KEYS = ["predicted_peak_bytes", "predicted_fit"]
 
 # frozen recovery vocabulary (resilience/supervisor.py RECOVERY_STATES;
 # docs/ELASTICITY.md): the supervisor's state machine and the chaos
@@ -409,6 +430,44 @@ def check_graph_audit() -> List[str]:
                      "census-in-evidence")
 
 
+def check_memory_audit() -> List[str]:
+    """Memory-plan-audit vocabulary: the MemoryAuditReport's frozen key
+    sets and classes match deepspeed_tpu/analysis/report.py, every name
+    is documented in docs/STATIC_ANALYSIS.md, the peak_params ladder
+    emits the frozen prediction keys, and docs/AUTOTUNING.md cross-links
+    the model_drift calibration record."""
+    from deepspeed_tpu.analysis import (BUDGET_KEYS, BUFFER_KEYS,
+                                        CALIBRATION_KEYS, MEMORY_CLASSES,
+                                        MEMORY_REPORT_KEYS,
+                                        MEMORY_TOTALS_KEYS)
+
+    return _vocab_check([
+        VocabSpec(name="analysis.MEMORY_REPORT_KEYS",
+                  expected=EXPECTED_MEMORY_REPORT_KEYS,
+                  actual=lambda: MEMORY_REPORT_KEYS,
+                  docs_path=STATIC_DOCS),
+        VocabSpec(name="analysis.MEMORY_TOTALS_KEYS",
+                  expected=EXPECTED_MEMORY_TOTALS_KEYS,
+                  actual=lambda: MEMORY_TOTALS_KEYS,
+                  docs_path=STATIC_DOCS),
+        VocabSpec(name="analysis.BUFFER_KEYS",
+                  expected=EXPECTED_BUFFER_KEYS,
+                  actual=lambda: BUFFER_KEYS, docs_path=STATIC_DOCS),
+        VocabSpec(name="analysis.MEMORY_CLASSES",
+                  expected=EXPECTED_MEMORY_CLASSES,
+                  actual=lambda: MEMORY_CLASSES, docs_path=STATIC_DOCS),
+        VocabSpec(name="analysis.BUDGET_KEYS",
+                  expected=EXPECTED_BUDGET_KEYS,
+                  actual=lambda: BUDGET_KEYS, docs_path=STATIC_DOCS),
+        VocabSpec(name="analysis.CALIBRATION_KEYS",
+                  expected=EXPECTED_CALIBRATION_KEYS,
+                  actual=lambda: CALIBRATION_KEYS, docs_path=STATIC_DOCS),
+        VocabSpec(name="MEMORY_BENCH_KEYS", expected=MEMORY_BENCH_KEYS,
+                  docs_path=STATIC_DOCS,
+                  source_keys=[(_BENCH, MEMORY_BENCH_KEYS)]),
+    ]) + _cross_link(AUTOTUNING_DOCS, "model_drift", "calibration")
+
+
 def check_recovery() -> List[str]:
     """Recovery vocabulary: the supervisor's frozen state machine matches
     the module and docs/ELASTICITY.md, the chaos bench row emits the
@@ -497,8 +556,8 @@ def run_all() -> List[str]:
     return (check_tags_documented() + check_schema() + check_span_names()
             + check_quant_comm() + check_ring_bench()
             + check_router_serving() + check_autotuning()
-            + check_graph_audit() + check_recovery()
-            + check_trace_export())
+            + check_graph_audit() + check_memory_audit()
+            + check_recovery() + check_trace_export())
 
 
 def main() -> int:
