@@ -25,6 +25,10 @@ Rows (BASELINE.json milestone configs scaled to one chip):
      model/data under the static schedule vs the probe→decide→pin
      autotuned one (autotuning/overlap_scheduler.py); mfu_static vs
      mfu_tuned + the ScheduleDecision evidence that picked the schedule
+  8. serve_disagg — disaggregated prefill/decode tiers + speculative
+     decoding vs the homogeneous router at a fixed chip budget, under
+     the mixed scenario load generator (burst / session_heavy /
+     shared_system_prompt / long_prompt_short_decode)
 
 Pass --smoke for a tiny-shape CPU plumbing check (no numbers of record).
 """
@@ -1189,82 +1193,55 @@ def row_serve_load():
 
 def _serve_load_multi_body():
     """Multi-replica serving tier (serving/replica.py + router.py +
-    prefix_cache.py): open-loop exponential arrivals against a Router
-    over 2 replicas on DISJOINT virtual mesh slices, every prompt
-    sharing one system prefix (the dominant production shape).  Two
-    sub-runs on identical workloads — prefix reuse ON vs OFF — report
+    prefix_cache.py): a mixed scenario schedule (shared_system_prompt +
+    session_heavy traffic mixes from the scenario load generator)
+    against a Router over 2 replicas on DISJOINT virtual mesh slices.
+    Two sub-runs on identical workloads — prefix reuse ON vs OFF — report
     aggregate delivered tokens/s and p95 TTFT (measured router-side:
     submit → first token on the routed stream), plus the cache's
     hit-rate and prefill-tokens-saved counters.  Frozen keys linted by
     tools/telemetry_check.py against docs/SERVING.md."""
-    import threading
-
     from deepspeed_tpu.models import get_model_config
     from deepspeed_tpu.runtime.config import TelemetryConfig
-    from deepspeed_tpu.serving import ReplicaSet, Router, SamplingParams
+    from deepspeed_tpu.serving import ReplicaSet, Router
     from deepspeed_tpu.telemetry import Telemetry
 
     n_rep = 2
     if SMOKE:
         model = get_model_config("llama-tiny")
-        n_req, new, sys_len, uniq_len, rate = 12, 8, 16, 7, 100.0
+        n_per_mix, rate = 6, 100.0
         eng_cfg = {"dtype": "float32",
                    "memory_config": {"num_blocks": 64, "block_size": 4},
                    "max_context": 64}
     else:
         model = get_model_config("llama3-8b", num_layers=4,
                                  max_seq_len=2048)
-        n_req, new, sys_len, uniq_len, rate = 128, 64, 512, 32, 64.0
+        n_per_mix, rate = 64, 64.0
         eng_cfg = {"memory_config": {"num_blocks": 1024}}
     rng = np.random.default_rng(11)
-    shared = rng.integers(1, model.vocab_size, size=sys_len).tolist()
-    prompts = [shared + rng.integers(1, model.vocab_size,
-                                     size=uniq_len).tolist()
-               for _ in range(n_req)]
-    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
+    # the cache-relevant half of the scenario vocabulary: one shared
+    # system prompt across everyone + session-sticky per-session prefixes
+    schedule = _scenario_schedule(("shared_system_prompt",
+                                   "session_heavy"), rng, model,
+                                  n_per_mix, rate, SMOKE)
+    warm_prompts = [r["prompt"] for r in schedule[:n_rep]]
 
     def run_once(prefix_enabled, telemetry=None):
         srv_cfg = {"prefix_cache": {"enabled": prefix_enabled}}
         rs = ReplicaSet.build(model, n_rep, eng_cfg, srv_cfg, seed=0)
         router = Router(rs, telemetry=telemetry).start()
         # warmup: compile every replica's buckets off the clock
-        router.generate(prompts[:n_rep], max_new_tokens=new)
+        router.generate(warm_prompts, max_new_tokens=8)
         # baseline the cache counters so the reported hit rate / tokens
         # saved cover only the measured window (warmup hits the cache too)
         warm = rs.snapshot()
-        first_at = [0.0] * n_req
-        threads = []
-
-        def consume(i, stream):
-            for _tok in stream:
-                if first_at[i] == 0.0:
-                    first_at[i] = time.perf_counter()
-
-        t0 = time.perf_counter()
-        for i in range(n_req):
-            lag = arrivals[i] - (time.perf_counter() - t0)
-            if lag > 0:
-                time.sleep(lag)
-            s = router.submit(prompts[i],
-                              SamplingParams(max_new_tokens=new))
-            th = threading.Thread(target=consume, args=(i, s))
-            th.start()
-            threads.append(th)
-        submit_at = [t0 + a for a in arrivals]
-        for th in threads:
-            th.join(timeout=600)
-        dt = time.perf_counter() - t0
-        ttft_ms = sorted((f - s) * 1e3
-                         for f, s in zip(first_at, submit_at) if f > 0)
-        p95 = (ttft_ms[min(len(ttft_ms) - 1,
-                           int(0.95 * (len(ttft_ms) - 1)))]
-               if ttft_ms else 0.0)
+        res = _drive_schedule(router, schedule)
         snap = router.snapshot()
         for key in ("prefix_hits", "prefix_misses", "prefill_tokens_saved"):
             snap["aggregate"][key] -= warm[key]
         router.stop()
         _reset_topology()
-        return n_req * new / dt, p95, snap
+        return res["tokens_per_sec"], res["ttft_p95_ms"], snap
 
     tel = Telemetry(TelemetryConfig(
         enabled=True, jsonl_path=_telemetry_jsonl("serve_load_multi"),
@@ -1328,6 +1305,283 @@ def row_serve_load_multi():
         return {"metric": "serve_load_multi",
                 "error": ("no result line; " + " | ".join(tail[-3:]))[:300]}
     return _serve_load_multi_body()
+
+
+# ---------------------------------------------------------------------------
+# Scenario load generator (docs/SERVING.md "Scenario load generator"):
+# named traffic mixes composed into one open-loop schedule.  The mix
+# names are a frozen vocabulary linted by tools/telemetry_check.py.
+# ---------------------------------------------------------------------------
+
+SCENARIO_MIXES = ("burst", "session_heavy", "shared_system_prompt",
+                  "long_prompt_short_decode")
+
+
+def _scenario_requests(mix: str, rng, model, n_req: int, rate: float,
+                       smoke: bool) -> list:
+    """One named traffic mix → request dicts {at, prompt, max_new,
+    session, mix}.  Shapes scale with --smoke; arrival processes are the
+    point: `burst` clusters arrivals (queue-depth stress),
+    `session_heavy` pins few sessions with per-session shared prefixes
+    (sticky-routing + cache stress), `shared_system_prompt` shares one
+    long system prefix across everyone (the dominant production shape),
+    and `long_prompt_short_decode` is prefill-dominated (the mix that
+    separates the tiers)."""
+    if mix not in SCENARIO_MIXES:
+        raise ValueError(f"unknown scenario mix {mix!r} "
+                         f"(known: {SCENARIO_MIXES})")
+    vocab = model.vocab_size
+    toks = lambda n: rng.integers(1, vocab, size=n).tolist()
+    out = []
+    if mix == "burst":
+        group, uniq, new = (4, 10, 6) if smoke else (16, 64, 32)
+        for i in range(n_req):           # exactly n_req, last burst may
+            g = i // group               # be partial
+            at0 = g * (group / rate) * 4.0   # bursts with idle gaps
+            out.append({"at": at0 + rng.uniform(0, 0.002),
+                        "prompt": toks(uniq), "max_new": new,
+                        "session": None, "mix": mix})
+    elif mix == "session_heavy":
+        n_sessions = max(2, n_req // 3)
+        uniq, new = (4, 6) if smoke else (24, 48)
+        prefix_len = 8 if smoke else 256
+        prefixes = [toks(prefix_len) for _ in range(n_sessions)]
+        at = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
+        for i in range(n_req):
+            s = int(rng.integers(0, n_sessions))
+            out.append({"at": float(at[i]),
+                        "prompt": prefixes[s] + toks(uniq),
+                        "max_new": new, "session": f"sess-{s}",
+                        "mix": mix})
+    elif mix == "shared_system_prompt":
+        sys_len, uniq, new = (16, 6, 6) if smoke else (512, 32, 48)
+        system = toks(sys_len)
+        at = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
+        for i in range(n_req):
+            out.append({"at": float(at[i]), "prompt": system + toks(uniq),
+                        "max_new": new, "session": None, "mix": mix})
+    else:  # long_prompt_short_decode
+        new = 4 if smoke else 8
+        at = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
+        for i in range(n_req):
+            plen = int(rng.integers(24, 33)) if smoke \
+                else int(rng.integers(1024, 1537))
+            out.append({"at": float(at[i]), "prompt": toks(plen),
+                        "max_new": new, "session": None, "mix": mix})
+    return out
+
+
+def _scenario_schedule(mixes, rng, model, n_per_mix: int, rate: float,
+                       smoke: bool) -> list:
+    """Compose named mixes into ONE merged arrival schedule (sorted by
+    arrival time — the mixes interleave, they don't run back-to-back)."""
+    sched = []
+    for mix in mixes:
+        sched.extend(_scenario_requests(mix, rng, model, n_per_mix,
+                                        rate, smoke))
+    sched.sort(key=lambda r: r["at"])
+    return sched
+
+
+def _drive_schedule(router, schedule, speculative: bool = False,
+                    timeout: float = 600.0) -> dict:
+    """Open-loop drive of one schedule against a router front door.
+    Measures router-side per-request TTFT and TPOT (first/last token
+    wall times observed by a consumer thread per stream) and aggregate
+    delivered tokens/s."""
+    import threading
+
+    from deepspeed_tpu.serving import SamplingParams
+
+    n = len(schedule)
+    first_at = [0.0] * n
+    last_at = [0.0] * n
+    counts = [0] * n
+    threads, streams = [], []
+
+    def consume(i, stream):
+        for _tok in stream:
+            now = time.perf_counter()
+            if first_at[i] == 0.0:
+                first_at[i] = now
+            last_at[i] = now
+            counts[i] += 1
+
+    t0 = time.perf_counter()
+    for i, req in enumerate(schedule):
+        lag = req["at"] - (time.perf_counter() - t0)
+        if lag > 0:
+            time.sleep(lag)
+        s = router.submit(req["prompt"],
+                          SamplingParams(max_new_tokens=req["max_new"],
+                                         speculative=speculative),
+                          session=req["session"])
+        streams.append(s)
+        th = threading.Thread(target=consume, args=(i, s))
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=timeout)
+    dt = time.perf_counter() - t0
+    submit_at = [t0 + r["at"] for r in schedule]
+    ttft_ms = sorted((f - s) * 1e3 for f, s in zip(first_at, submit_at)
+                     if f > 0)
+    tpot_ms = sorted((l - f) / (c - 1) * 1e3
+                     for f, l, c in zip(first_at, last_at, counts)
+                     if c > 1 and f > 0)
+
+    def p95(xs):
+        return (xs[min(len(xs) - 1, int(0.95 * (len(xs) - 1)))]
+                if xs else 0.0)
+
+    handoff_ms = sorted(s.handoff_ms for s in streams
+                        if getattr(s, "handoff_ms", None) is not None)
+    handoff_bytes = [s.handoff_bytes for s in streams
+                     if getattr(s, "handoff_bytes", None) is not None]
+    return {
+        "tokens_per_sec": sum(counts) / dt,
+        "ttft_p95_ms": p95(ttft_ms), "tpot_p95_ms": p95(tpot_ms),
+        "delivered": sum(counts), "completed": sum(1 for s in streams
+                                                   if s.error is None),
+        "handoff_ms_p95": p95(handoff_ms),
+        "handoff_bytes_per_req": (sum(handoff_bytes)
+                                  / max(1, len(handoff_bytes))),
+    }
+
+
+def _serve_disagg_body():
+    """Disaggregated tiers vs the homogeneous router at a FIXED chip
+    budget (serving/disagg.py; docs/SERVING.md "Disaggregated tiers &
+    speculative decoding"): the same mixed scenario schedule — every
+    named mix, dominated by long_prompt_short_decode + chat-heavy
+    session traffic — drives (a) a DisaggRouter over 2 prefill + 2
+    decode replicas with KV-block handoff and speculative decoding on
+    the decode tier, and (b) a plain Router over 4 unified replicas on
+    the identical 4×2-device slices.  Frozen keys linted by
+    tools/telemetry_check.py against docs/SERVING.md."""
+    from deepspeed_tpu.models import get_model_config
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    from deepspeed_tpu.serving import DisaggRouter, ReplicaSet, Router
+    from deepspeed_tpu.telemetry import Telemetry
+
+    if SMOKE:
+        model = get_model_config("llama-tiny", num_layers=2)
+        n_per_mix, rate = 5, 50.0
+        eng_cfg = {"dtype": "float32",
+                   "memory_config": {"num_blocks": 96, "block_size": 4},
+                   "max_context": 64}
+    else:
+        model = get_model_config("llama3-8b", num_layers=4,
+                                 max_seq_len=2048)
+        n_per_mix, rate = 32, 48.0
+        eng_cfg = {"memory_config": {"num_blocks": 1024}}
+    # identical-architecture draft (same seed ⇒ same argmax): the row
+    # measures the serving-stack term of speculation — accepted tokens
+    # per dispatch at its ceiling — because the draft-quality term needs
+    # a trained/distilled draft checkpoint the bench does not have
+    # (random-weight heterogeneous drafts agree at ~1/vocab chance)
+    draft = model
+    rng = np.random.default_rng(15)
+    schedule = _scenario_schedule(SCENARIO_MIXES, rng, model, n_per_mix,
+                                  rate, SMOKE)
+    mix_counts = {m: sum(1 for r in schedule if r["mix"] == m)
+                  for m in SCENARIO_MIXES}
+    srv_cfg = {"prefix_cache": {"enabled": True}}
+    # warm set spans the shape buckets: a couple of typical prompts plus
+    # one long-prompt entry (its block-table bucket compiles separately)
+    warm = [r["prompt"] for r in schedule[:2]]
+    warm.append(next(r["prompt"] for r in schedule
+                     if r["mix"] == "long_prompt_short_decode"))
+
+    tel = Telemetry(TelemetryConfig(
+        enabled=True, jsonl_path=_telemetry_jsonl("serve_disagg"),
+        tracing={"enabled": True,
+                 "trace_path": _trace_json("serve_disagg")}))
+
+    # (a) disaggregated: 2 prefill + 2 decode tiers + spec decoding
+    disagg = {"enabled": True, "prefill_replicas": 2,
+              "decode_replicas": 2,
+              "speculative": {"enabled": True, "draft_model": draft,
+                              "spec_k": 3}}
+    rs = ReplicaSet.build(model, 4, eng_cfg, srv_cfg, seed=0,
+                          disagg=disagg)
+    router = DisaggRouter(rs, telemetry=tel).start()
+    # compile off the clock: speculative submits so the draft + verify-k
+    # buckets (not just prefill/decode) are warm before the window opens
+    from deepspeed_tpu.serving import SamplingParams as _SP
+    for s in [router.submit(p, _SP(max_new_tokens=6, speculative=True))
+              for p in warm]:
+        s.result(timeout=600)
+    dis = _drive_schedule(router, schedule, speculative=True)
+    snap = router.snapshot()
+    agg = snap["aggregate"]["replicas"]
+    spec_prop = sum(r.get("spec_proposed", 0) for r in agg.values())
+    spec_acc = sum(r.get("spec_accepted", 0) for r in agg.values())
+    router.stop()
+    _reset_topology()
+    tel.close()
+
+    # (b) homogeneous control: the same 8 chips as 4 unified replicas
+    rs_h = ReplicaSet.build(model, 4, eng_cfg, srv_cfg, seed=0)
+    router_h = Router(rs_h).start()
+    router_h.generate(warm, max_new_tokens=6)
+    hom = _drive_schedule(router_h, schedule, speculative=False)
+    router_h.stop()
+    _reset_topology()
+
+    return {
+        "metric": "serve_disagg_tokens_per_sec",
+        "telemetry_jsonl": _telemetry_jsonl("serve_disagg"),
+        "trace_json": _trace_json("serve_disagg"),
+        "value": round(dis["tokens_per_sec"], 1), "unit": "tokens/s",
+        "agg_tokens_per_sec_disagg": round(dis["tokens_per_sec"], 1),
+        "agg_tokens_per_sec_homog": round(hom["tokens_per_sec"], 1),
+        "vs_baseline": (round(dis["tokens_per_sec"]
+                              / hom["tokens_per_sec"], 3)
+                        if hom["tokens_per_sec"] else 0.0),
+        "ttft_p95_ms_disagg": round(dis["ttft_p95_ms"], 1),
+        "ttft_p95_ms_homog": round(hom["ttft_p95_ms"], 1),
+        "tpot_p95_ms_disagg": round(dis["tpot_p95_ms"], 2),
+        "tpot_p95_ms_homog": round(hom["tpot_p95_ms"], 2),
+        "handoff_ms_p95": round(dis["handoff_ms_p95"], 2),
+        "handoff_bytes_per_req": round(dis["handoff_bytes_per_req"], 1),
+        "handoffs": snap["handoffs"],
+        "spec_accept_rate": round(spec_acc / max(1, spec_prop), 3),
+        "scenario_mix": mix_counts,
+        "completed_disagg": dis["completed"],
+        "completed_homog": hom["completed"],
+    }
+
+
+def row_serve_disagg():
+    """Disaggregated-serving row.  Tier slices need 8 devices; smoke
+    mode pins ONE cpu device, so the smoke variant re-execs itself on a
+    virtual 8-device CPU mesh (same pattern as serve_load_multi)."""
+    if SMOKE and "--disagg-inner" not in sys.argv:
+        import os
+        import subprocess
+
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        env["JAX_PLATFORMS"] = "cpu"
+        cmd = [sys.executable, __file__, "--row", "serve_disagg",
+               "--smoke", "--disagg-inner"]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=900, env=env)
+        except subprocess.TimeoutExpired:
+            return {"metric": "serve_disagg", "error": "smoke timed out"}
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return {"metric": "serve_disagg",
+                "error": ("no result line; " + " | ".join(tail[-3:]))[:300]}
+    return _serve_disagg_body()
 
 
 def _chaos_train_half(base: str, tel) -> dict:
@@ -1550,6 +1804,7 @@ _ROWS = {
     "v2_decode": row_v2_decode,
     "serve_load": row_serve_load,
     "serve_load_multi": row_serve_load_multi,
+    "serve_disagg": row_serve_disagg,
     "chaos_recovery": row_chaos_recovery,
     "gpt2_350m": row_gpt2_350m,
 }
@@ -1620,7 +1875,7 @@ def main() -> None:
                  "longseq_ring", "gpt2_350m_commquant",
                  "gpt2_350m_autosched", "peak_params",
                  "v2_decode", "serve_load", "serve_load_multi",
-                 "chaos_recovery"):
+                 "serve_disagg", "chaos_recovery"):
         if SMOKE:
             try:
                 r = _ROWS[name]()

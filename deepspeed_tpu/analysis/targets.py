@@ -21,6 +21,8 @@ target                 bench row(s) whose step it audits
                        ring_rotation)
 ``v2_decode``          v2_decode / serve_load* (16-token decode step)
 ``v2_prefill``         v2_decode / serve_load* (full-budget prefill)
+``v2_verify``          serve_disagg (speculative target verify-k step)
+``v2_spec_draft``      serve_disagg (draft-model propose/decode step)
 =====================  ==============================================
 
 Each target PREPARES once — build its engine, read the step fn +
@@ -267,13 +269,14 @@ def prep_ring_attention_quant() -> PreparedTarget:
     return _prep_ring("ring_attention_quant", "int8", intent)
 
 
-def _prep_v2(phase: str) -> PreparedTarget:
+def _prep_v2(phase: str, model_name: str = "gpt2-tiny",
+             label: Optional[str] = None, **model_over) -> PreparedTarget:
     from deepspeed_tpu.analysis.auditor import intent_for_v2
     from deepspeed_tpu.analysis.memory import memory_intent_for_v2
     from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
     from deepspeed_tpu.models import get_model_config
 
-    model = get_model_config("gpt2-tiny", max_seq_len=128)
+    model = get_model_config(model_name, max_seq_len=128, **model_over)
     eng = InferenceEngineV2(model, {
         "state_manager": {"max_tracked_sequences": 4,
                           "max_ragged_batch_size": 64},
@@ -286,10 +289,19 @@ def _prep_v2(phase: str) -> PreparedTarget:
         (eng.cfg.num_blocks, eng.state_manager.max_seqs)
     fn, args = eng.audit_step_args(phase)
     return PreparedTarget(
-        label=f"v2_{phase}", fn=fn, args=args,
+        label=label or f"v2_{phase}", fn=fn, args=args,
         intent=intent_for_v2(eng),
         memory_intent=memory_intent_for_v2(eng),
         cleanup=_reset_topology)
+
+
+def prep_v2_spec_draft() -> PreparedTarget:
+    """serve_disagg draft-propose twin: the draft model's decode-phase
+    step (speculative proposals are plain greedy decode dispatches of a
+    SMALLER model sharing the target's vocabulary — serving/disagg.py
+    SpeculativeDecoder)."""
+    return _prep_v2("decode", model_name="llama-tiny", num_layers=1,
+                    label="v2_spec_draft")
 
 
 TARGET_PREPARERS: Dict[str, Callable[[], PreparedTarget]] = {
@@ -304,6 +316,8 @@ TARGET_PREPARERS: Dict[str, Callable[[], PreparedTarget]] = {
     "ring_attention_quant": prep_ring_attention_quant,
     "v2_decode": partial(_prep_v2, "decode"),
     "v2_prefill": partial(_prep_v2, "prefill"),
+    "v2_verify": partial(_prep_v2, "verify"),
+    "v2_spec_draft": prep_v2_spec_draft,
 }
 
 

@@ -26,7 +26,8 @@ import numpy as np
 from deepspeed_tpu.inference.v2.model import (check_sampling_params,
                                               ragged_decode_loop,
                                               ragged_forward,
-                                              ragged_forward_sampled)
+                                              ragged_forward_sampled,
+                                              ragged_forward_verify)
 from deepspeed_tpu.inference.v2.ragged import (DSStateManager,
                                                KVCacheExhausted,
                                                build_ragged_batch)
@@ -106,6 +107,35 @@ class RaggedInferenceEngineConfig:
                 raise ValueError(
                     f"unknown {kind} implementation '{name}' "
                     f"(available: {', '.join(available(kind)) or 'none'})")
+
+
+def _kv_scatter(cache_k, cache_v, rows, k, v):
+    """Write handed-off KV page rows into the paged caches (both cache
+    layouts: plain array [L, nkv, P, d], or the int8 quantized dict
+    {"q": [L, nkv, P, d] int8, "s": [L, nkv, P] fp32})."""
+    if isinstance(cache_k, dict):
+        cache_k = {"q": cache_k["q"].at[:, :, rows, :].set(k["q"]),
+                   "s": cache_k["s"].at[:, :, rows].set(k["s"])}
+        cache_v = {"q": cache_v["q"].at[:, :, rows, :].set(v["q"]),
+                   "s": cache_v["s"].at[:, :, rows].set(v["s"])}
+    else:
+        cache_k = cache_k.at[:, :, rows, :].set(k.astype(cache_k.dtype))
+        cache_v = cache_v.at[:, :, rows, :].set(v.astype(cache_v.dtype))
+    return cache_k, cache_v
+
+
+def _kv_gather(cache, rows):
+    """Read page rows out of either cache layout (host numpy)."""
+    if isinstance(cache, dict):
+        return {"q": np.asarray(jnp.take(cache["q"], rows, axis=2)),
+                "s": np.asarray(jnp.take(cache["s"], rows, axis=2))}
+    return np.asarray(jnp.take(cache, rows, axis=2))
+
+
+def _payload_nbytes(part) -> int:
+    if isinstance(part, dict):
+        return sum(int(a.nbytes) for a in part.values())
+    return int(part.nbytes)
 
 
 class InferenceEngineV2:
@@ -191,6 +221,17 @@ class InferenceEngineV2:
             partial(ragged_decode_loop, cfg=mc, block_size=self.cfg.block_size),
             static_argnames=("n_steps", "greedy", "top_k"),
             donate_argnums=(1, 2))
+        # speculative-decoding verify-k: same argument tuple as _step, but
+        # the greedy argmax comes back for EVERY token row ([T] int32), so
+        # one ragged dispatch scores a whole batch of draft proposals
+        self._verify = jax.jit(
+            partial(ragged_forward_verify, cfg=mc,
+                    block_size=self.cfg.block_size),
+            donate_argnums=(1, 2))
+        # disaggregated-serving KV import: scatter handed-off page rows
+        # into the donated caches in place (rows padded to a pow2 bucket
+        # of block rows; padding points at the reserved garbage block 0)
+        self._kv_write = jax.jit(_kv_scatter, donate_argnums=(0, 1))
         log_dist(f"InferenceEngineV2: budget={self.cfg.max_ragged_batch_size} "
                  f"blocks={self.cfg.num_blocks}×{self.cfg.block_size} "
                  f"max_seqs={self.cfg.max_tracked_sequences} tp={self.cfg.tp_size}")
@@ -275,13 +316,14 @@ class InferenceEngineV2:
     def audit_step_args(self, phase: str = "decode"):
         """``(jitted ragged step, example args)`` for the static graph
         auditor (``analysis/auditor.py``): the decode-shaped (16-token
-        bucket) or prefill-shaped (full token budget) step, buildable
+        bucket), prefill-shaped (full token budget), or speculative
+        verify-k (full budget, per-row argmax) step, buildable
         without admitting any sequence.  Zero-filled index arrays are
         fine — the auditor lowers and compiles, never executes, so the
         donated KV caches are not consumed."""
-        if phase not in ("decode", "prefill"):
+        if phase not in ("decode", "prefill", "verify"):
             raise ValueError(f"audit_step_args: unknown phase {phase!r} "
-                             "(decode|prefill)")
+                             "(decode|prefill|verify)")
         sm = self.state_manager
         t = (min(16, self.scheduler.token_budget) if phase == "decode"
              else self.scheduler.token_budget)
@@ -291,7 +333,7 @@ class InferenceEngineV2:
                            jnp.int32)
         args = (self.params, self.cache_k, self.cache_v,
                 ids, ids, ids, ids, tables, rows, rows)
-        return self._step, args
+        return (self._verify if phase == "verify" else self._step), args
 
     def audit_arg_categories(self):
         """Memory-class manifest for the ``audit_step_args`` tuple (one
@@ -414,6 +456,216 @@ class InferenceEngineV2:
         tokens = list(seq.tokens)
         self.flush(uid)
         return tokens
+
+    # -- disaggregated serving: KV-block handoff -----------------------
+    def kv_geometry(self) -> tuple:
+        """Layout fingerprint a handoff payload must match to be
+        importable: two engines with the same geometry (and the shared
+        same-seed weight contract) hold interchangeable KV pages."""
+        mc = self.model_config
+        return (mc.num_layers, mc.kv_heads, self.cfg.block_size,
+                mc.dim_per_head, str(self.cfg.kv_dtype),
+                str(self.model_config.dtype))
+
+    def export_kv_chain(self, uid: int) -> Optional[Dict[str, Any]]:
+        """Read the FULL KV pages of a live sequence's written prefix —
+        the prefill half of a prefill→decode handoff.
+
+        Returns a host payload {tokens, k, v, geom, nbytes, export_ms}
+        covering ``num_cached // block_size`` full blocks (a partial
+        last block is never transferable: adopted pages are read-only
+        and the adopter would have to append into it), or None when not
+        even one full block is written.  Must run on the thread that
+        owns the engine — the gather reads the live donated caches.
+        """
+        import time as _time
+
+        t0 = _time.perf_counter()
+        seq = self.state_manager.get(uid)
+        bs = self.cfg.block_size
+        n_full = min(seq.num_cached // bs, len(seq.blocks))
+        if n_full < 1:
+            return None
+        rows = np.concatenate(
+            [np.arange(b * bs, (b + 1) * bs, dtype=np.int32)
+             for b in seq.blocks[:n_full]])
+        rows = jnp.asarray(rows)
+        k = _kv_gather(self.cache_k, rows)
+        v = _kv_gather(self.cache_v, rows)
+        return {"tokens": list(seq.tokens[:n_full * bs]), "k": k, "v": v,
+                "geom": self.kv_geometry(),
+                "nbytes": _payload_nbytes(k) + _payload_nbytes(v),
+                "export_ms": (_time.perf_counter() - t0) * 1e3}
+
+    def import_kv_chain(self, payload: Dict[str, Any],
+                        skip_blocks: int = 0) -> tuple:
+        """Write a handoff payload's pages into THIS engine's cache — the
+        decode half of the handoff.  ``skip_blocks`` leading blocks are
+        already covered locally (a prefix-cache hit on the same chain:
+        the zero-copy ref acquire); only the tail is allocated and
+        written.  Returns ``(blocks, n_tokens, bytes_moved)`` where
+        ``blocks`` are freshly-allocated pages (refcount 1, ownership
+        passes to the caller) holding tokens ``[skip·bs, n_tokens)``.
+        Raises ``ValueError`` on a geometry mismatch (caller falls back
+        to re-running prefill) and ``KVCacheExhausted`` when the pool
+        cannot host the tail.  Engine-owning thread only.
+        """
+        if tuple(payload["geom"]) != self.kv_geometry():
+            raise ValueError(
+                f"handoff payload geometry {payload['geom']} does not "
+                f"match this engine's {self.kv_geometry()}; the decode "
+                "tier must share the prefill tier's model + KV layout")
+        bs = self.cfg.block_size
+        n_total = len(payload["tokens"]) // bs
+        n_new = n_total - int(skip_blocks)
+        if n_new <= 0:
+            return [], n_total * bs, 0
+        blocks = self.state_manager.allocator.allocate(n_new)
+        # pow2-bucket the scatter width so a serve lifetime compiles a
+        # handful of import shapes; padding rows land in garbage block 0
+        nb_bucket = 1
+        while nb_bucket < n_new:
+            nb_bucket *= 2
+        rows = np.zeros((nb_bucket * bs,), np.int32)
+        for i, b in enumerate(blocks):
+            rows[i * bs:(i + 1) * bs] = np.arange(b * bs, (b + 1) * bs)
+        lo, hi = skip_blocks * bs, (skip_blocks + n_new) * bs
+
+        def _cut(part):
+            if isinstance(part, dict):
+                return {key: _pad(a[:, :, lo:hi]) for key, a in part.items()}
+            return _pad(part[:, :, lo:hi])
+
+        def _pad(a):
+            width = nb_bucket * bs
+            if a.shape[2] == width:
+                return a
+            pad = [(0, 0)] * a.ndim
+            pad[2] = (0, width - a.shape[2])
+            return np.pad(a, pad)
+
+        try:
+            k, v = _cut(payload["k"]), _cut(payload["v"])
+            self.cache_k, self.cache_v = self._kv_write(
+                self.cache_k, self.cache_v, jnp.asarray(rows), k, v)
+        except BaseException:
+            # a failed scatter must not leak the freshly-allocated pages
+            # (the donated caches are only rebound on success)
+            self.state_manager.allocator.free(blocks)
+            raise
+        moved = _payload_nbytes(k) + _payload_nbytes(v)
+        return blocks, n_total * bs, moved
+
+    # -- speculative decoding: verify-k + draft rewind -----------------
+    def verify_step(self, proposals: Dict[int, Sequence[int]]
+                    ) -> Dict[int, List[int]]:
+        """One ragged verify-k step (greedy only).
+
+        Each uid must be a live sequence with exactly one pending
+        sampled token (``uncached == 1``); its ``proposals`` are the
+        draft model's guesses for the next k tokens (k may vary per uid,
+        and may be 0 — the degenerate case is a plain greedy step).  The
+        pending token plus the proposals run as one prefill-style chunk;
+        the per-row argmax accepts the longest agreeing proposal prefix
+        and appends the target's own argmax after it (the bonus token),
+        so the returned ``{uid: accepted_tokens}`` — always ≥ 1 token —
+        is bit-identical to one-at-a-time greedy decoding.
+
+        Sequence state advances by the accepted tokens only; KV rows
+        written for rejected proposals are dead weight that the next
+        write to those positions overwrites (destinations are derived
+        from absolute positions, and attention masks by ``ctx_lens``).
+        Raises ``KVCacheExhausted`` with every sequence rolled back.
+        """
+        mgr = self.state_manager
+        # validate the WHOLE batch before touching any state: a bad
+        # entry must not leave earlier sequences carrying unverified
+        # draft tokens (same discipline as _ragged_step admission)
+        total = 0
+        for uid, props in proposals.items():
+            if mgr.get(uid).uncached != 1:
+                raise ValueError(
+                    f"verify_step: uid {uid} has "
+                    f"{mgr.get(uid).uncached} uncached tokens; "
+                    "speculative verification needs exactly the one "
+                    "pending sampled token")
+            total += 1 + len(props)
+        if total > self.scheduler.token_budget:
+            raise ValueError(
+                f"verify_step: {total} tokens exceed the ragged budget "
+                f"{self.scheduler.token_budget}; lower spec_k")
+        schedule = []
+        saved: Dict[int, tuple] = {}
+        for uid, props in proposals.items():
+            seq = mgr.get(uid)
+            saved[uid] = (len(seq.tokens), seq.num_cached)
+            seq.tokens.extend(int(t) for t in props)
+            schedule.append((seq, 1 + len(props)))
+        try:
+            rb = build_ragged_batch(schedule, mgr,
+                                    self.scheduler.token_budget)
+        except KVCacheExhausted:
+            for uid, (n_tok, _nc) in saved.items():
+                del mgr.get(uid).tokens[n_tok:]
+            raise
+        t_bucket = 16
+        while t_bucket < rb.n_tokens:
+            t_bucket *= 2
+        t_bucket = min(t_bucket, self.scheduler.token_budget)
+        bs = self.cfg.block_size
+        nb_real = max(1, -(-int(rb.ctx_lens.max()) // bs))
+        nb_bucket = 1
+        while nb_bucket < nb_real:
+            nb_bucket *= 2
+        nb_bucket = min(nb_bucket, self.state_manager.max_blocks_per_seq)
+        nxt, self.cache_k, self.cache_v = self._verify(
+            self.params, self.cache_k, self.cache_v,
+            jnp.asarray(rb.token_ids[:t_bucket]),
+            jnp.asarray(rb.token_slot[:t_bucket]),
+            jnp.asarray(rb.token_pos[:t_bucket]),
+            jnp.asarray(rb.token_dest[:t_bucket]),
+            jnp.asarray(rb.block_tables[:, :nb_bucket]),
+            jnp.asarray(rb.ctx_lens), jnp.asarray(rb.logits_idx))
+        nxt = np.asarray(nxt)
+        out: Dict[int, List[int]] = {}
+        cursor = 0
+        for seq, n_new in schedule:
+            rows = nxt[cursor:cursor + n_new]
+            cursor += n_new
+            n_tok, nc0 = saved[seq.uid]
+            props = seq.tokens[n_tok:]
+            m = 0
+            while m < len(props) and int(props[m]) == int(rows[m]):
+                m += 1
+            accepted = [int(t) for t in props[:m]] + [int(rows[m])]
+            # rewind: keep the accepted prefix + bonus; positions
+            # nc0..nc0+m ran with correct inputs, the rest is garbage
+            del seq.tokens[n_tok + m:]
+            seq.tokens.append(int(rows[m]))
+            seq.num_cached = nc0 + m + 1
+            out[seq.uid] = accepted
+        return out
+
+    def rewind(self, uid: int, tokens: Sequence[int],
+               num_cached: int) -> None:
+        """Reset a live sequence's host-side view (draft-model rewind
+        after speculative rejection): ``tokens`` becomes the full known
+        stream and ``num_cached`` the count of leading positions whose
+        KV was computed from correct inputs.  ``num_cached`` may only
+        shrink — garbage KV beyond it is overwritten when those
+        positions are legitimately re-run.  Allocated pages stay with
+        the sequence (capacity, not content)."""
+        seq = self.state_manager.get(uid)
+        if num_cached > seq.num_cached:
+            raise ValueError(
+                f"rewind: num_cached {num_cached} > written "
+                f"{seq.num_cached} — rewind cannot invent KV")
+        seq.tokens = [int(t) for t in tokens]
+        seq.num_cached = int(num_cached)
+        if seq.uncached > 1:
+            # more than one pending token decodes 1/step from the decode
+            # set; chunked prefill catches the stream up in one step
+            self.scheduler.demote(uid)
 
     @property
     def free_blocks(self) -> int:

@@ -372,6 +372,68 @@ def ragged_forward(params, cache_k, cache_v, token_ids, token_slot, token_pos,
     return logits.astype(jnp.float32), cache_k, cache_v
 
 
+def ragged_forward_verify(params, cache_k, cache_v, token_ids, token_slot,
+                          token_pos, token_dest, block_tables, ctx_lens,
+                          logits_idx, cfg: TransformerConfig,
+                          block_size: int
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Speculative-decoding verify-k step: the same ragged trunk, but the
+    greedy argmax is taken at EVERY token row — [T] int32 — instead of
+    only at each sequence's final row.
+
+    Feeding a sequence's pending token plus its k draft proposals as one
+    "prefill chunk" makes row j's argmax the target model's greedy
+    next-token after the prefix ending at that row, which is exactly the
+    acceptance oracle: proposal i is accepted iff it equals the argmax
+    at the row of proposal i-1 (row of the pending token for i=1), and
+    the argmax at the last accepted row is the free bonus token.  The
+    head matmul contracts the same hidden dimension as the per-sequence
+    gather path, so the emitted chain is bit-identical to one-token-at-
+    a-time greedy decoding (pinned by the spec-decode parity tests).
+
+    ``logits_idx`` is accepted (unused) so the verify step shares the
+    exact argument tuple — and therefore the audit/bench plumbing — of
+    ``ragged_forward``.
+    """
+    del logits_idx
+    dt = cfg.dtype
+    x = params["embed"]["tokens"].astype(dt)[token_ids]
+    if cfg.has_learned_positions and "positions" in params["embed"]:
+        x = x + params["embed"]["positions"].astype(dt)[token_pos]
+    if cfg.embed_norm:
+        x = _norm(x, params["embed"]["norm"], cfg)
+
+    nb = block_tables.shape[1]
+    c = jnp.arange(nb * block_size, dtype=jnp.int32)
+    ctx_idx = block_tables[:, c // block_size] * block_size + c % block_size
+    gather_idx = ctx_idx[token_slot]
+    token_ctx_len = ctx_lens[token_slot]
+    meta = (token_pos, token_dest, gather_idx, token_ctx_len, token_slot,
+            block_tables, block_size)
+
+    if cfg.alt_window or cfg.is_moe:
+        raise NotImplementedError(
+            "speculative verify step supports the plain scanned-layer "
+            "ragged path only (no alt_window, no MoE)")
+
+    def body(h, scanned):
+        lp, ck_l, cv_l, _idx = scanned
+        h, ck_l, cv_l = _ragged_layer(h, lp, ck_l, cv_l, meta, cfg)
+        return h, (ck_l, cv_l)
+
+    layer_idx = jnp.arange(cfg.num_layers)
+    x, (cache_k, cache_v) = lax.scan(
+        body, x, (params["layers"], cache_k, cache_v, layer_idx))
+
+    x = _norm(x, params["final_norm"], cfg)
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"]["tokens"].astype(dt).T
+    else:
+        logits = x @ params["lm_head"].astype(dt)
+    nxt = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+    return nxt, cache_k, cache_v
+
+
 def check_sampling_params(top_k: int, top_p, vocab_size: int):
     """API-boundary validation + normalization (outside jit): rejects
     degenerate values that would silently emit token 0 (top_p <= 0) or

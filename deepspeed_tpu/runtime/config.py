@@ -436,6 +436,55 @@ class PrefixCacheServingConfig:
 
 
 @dataclass
+class SpeculativeServingConfig:
+    """``"serving": {"disagg": {"speculative": {...}}}`` — speculative
+    decoding on the decode tier (serving/disagg.py SpeculativeDecoder):
+    a draft model in the same serve loop proposes ``spec_k`` tokens per
+    sequence, the target verifies them in one ragged step, and greedy
+    acceptance is bit-identical to decoding without a draft."""
+    enabled: bool = False
+    draft_model: str = ""          # models.get_model_config name
+    spec_k: int = 4                # proposals per sequence per round
+
+    def __post_init__(self):
+        if self.spec_k < 1:
+            raise DeepSpeedConfigError(
+                f"serving.disagg.speculative.spec_k={self.spec_k}: "
+                "must be >= 1")
+        if self.enabled and not self.draft_model:
+            raise DeepSpeedConfigError(
+                "serving.disagg.speculative.enabled requires a "
+                "draft_model (a models registry name sharing the "
+                "target's vocabulary)")
+
+
+@dataclass
+class DisaggServingConfig:
+    """``"serving": {"disagg": {...}}`` — disaggregated prefill/decode
+    tiers (serving/disagg.py): the first ``prefill_replicas`` device
+    slices serve compute-bound prompt legs and hand finished KV chains
+    to the ``decode_replicas`` bandwidth-bound slices through the
+    refcounted allocator (docs/SERVING.md "Disaggregated tiers")."""
+    enabled: bool = False
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+    speculative: SpeculativeServingConfig = field(
+        default_factory=SpeculativeServingConfig)
+
+    def __post_init__(self):
+        if isinstance(self.speculative, dict):
+            self.speculative = _from_dict(SpeculativeServingConfig,
+                                          self.speculative,
+                                          "serving.disagg.speculative")
+        if self.enabled and (self.prefill_replicas < 1
+                             or self.decode_replicas < 1):
+            raise DeepSpeedConfigError(
+                "serving.disagg needs >= 1 replica per tier, got "
+                f"prefill_replicas={self.prefill_replicas} "
+                f"decode_replicas={self.decode_replicas}")
+
+
+@dataclass
 class ServingTierConfig:
     """``"serving"`` block — the multi-replica serving tier: N
     data-parallel replicas on disjoint mesh slices behind one router
@@ -448,6 +497,8 @@ class ServingTierConfig:
         default_factory=RouterServingConfig)
     prefix_cache: PrefixCacheServingConfig = field(
         default_factory=PrefixCacheServingConfig)
+    disagg: DisaggServingConfig = field(
+        default_factory=DisaggServingConfig)
 
     def __post_init__(self):
         if isinstance(self.router, dict):
@@ -457,19 +508,34 @@ class ServingTierConfig:
             self.prefix_cache = _from_dict(PrefixCacheServingConfig,
                                            self.prefix_cache,
                                            "serving.prefix_cache")
+        if isinstance(self.disagg, dict):
+            self.disagg = _from_dict(DisaggServingConfig, self.disagg,
+                                     "serving.disagg")
         if self.n_replicas < 1:
             raise DeepSpeedConfigError(
                 f"serving.n_replicas={self.n_replicas}: must be >= 1")
+        if self.disagg.enabled:
+            want = (self.disagg.prefill_replicas
+                    + self.disagg.decode_replicas)
+            if want != self.n_replicas:
+                raise DeepSpeedConfigError(
+                    f"serving.disagg tiers ({self.disagg.prefill_replicas}"
+                    f" prefill + {self.disagg.decode_replicas} decode = "
+                    f"{want}) must sum to serving.n_replicas="
+                    f"{self.n_replicas}")
         # drift tripwire: the serving-side parsers (serving/router.py
-        # RouterConfig, serving/prefix_cache.py PrefixCacheConfig) accept
-        # these dicts and silently IGNORE unknown keys — a field added
-        # here but not there would validate at config load and then be
-        # dropped at runtime.  Round-trip through them and require every
-        # block key to come back as an attribute.
+        # RouterConfig, serving/prefix_cache.py PrefixCacheConfig,
+        # serving/disagg.py DisaggConfig) accept these dicts and silently
+        # IGNORE unknown keys — a field added here but not there would
+        # validate at config load and then be dropped at runtime.
+        # Round-trip through them and require every block key to come
+        # back as an attribute.
+        from deepspeed_tpu.serving.disagg import DisaggConfig
         from deepspeed_tpu.serving.prefix_cache import PrefixCacheConfig
         from deepspeed_tpu.serving.router import RouterConfig
         for block, cls in ((self.router_config(), RouterConfig),
-                           (self.prefix_cache_config(), PrefixCacheConfig)):
+                           (self.prefix_cache_config(), PrefixCacheConfig),
+                           (self.disagg_config(), DisaggConfig)):
             parsed = cls(block)
             missing = set(block) - set(vars(parsed))
             if missing:
@@ -477,6 +543,16 @@ class ServingTierConfig:
                     f"serving config keys {sorted(missing)} are not "
                     f"understood by {cls.__name__} — add them to the "
                     "serving-side parser in the same commit")
+        # ...and one level deeper for the nested speculative block
+        from deepspeed_tpu.serving.disagg import SpeculativeConfig
+        spec_block = dict(vars(self.disagg.speculative))
+        spec_missing = set(spec_block) - set(vars(
+            SpeculativeConfig(spec_block)))
+        if spec_missing:
+            raise DeepSpeedConfigError(
+                f"serving.disagg.speculative keys {sorted(spec_missing)} "
+                "are not understood by SpeculativeConfig — add them to "
+                "the serving-side parser in the same commit")
 
     def prefix_cache_config(self) -> Dict[str, Any]:
         """Per-replica prefix-cache config dict."""
@@ -489,6 +565,14 @@ class ServingTierConfig:
     def router_config(self) -> Dict[str, Any]:
         """``Router`` config dict."""
         return dict(vars(self.router))
+
+    def disagg_config(self) -> Dict[str, Any]:
+        """``serving.disagg`` dict for ``ReplicaSet.build(disagg=...)``
+        (the nested speculative block flattens to a plain dict so the
+        serving-side ``DisaggConfig`` can re-parse it)."""
+        d = dict(vars(self.disagg))
+        d["speculative"] = dict(vars(self.disagg.speculative))
+        return d
 
 
 @dataclass

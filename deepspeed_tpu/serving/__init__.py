@@ -6,6 +6,9 @@ See docs/SERVING.md for the architecture (queue → admission → SplitFuse
 
 from deepspeed_tpu.serving.admission import (AdmissionConfig,
                                              AdmissionController)
+from deepspeed_tpu.serving.disagg import (DisaggConfig, DisaggRouter,
+                                          SpeculativeConfig,
+                                          SpeculativeDecoder)
 from deepspeed_tpu.serving.metrics import RouterMetrics, ServingMetrics
 from deepspeed_tpu.serving.prefix_cache import PrefixCache, PrefixCacheConfig
 from deepspeed_tpu.serving.replica import ReplicaSet, ServingReplica
@@ -18,9 +21,10 @@ from deepspeed_tpu.serving.server import InferenceServer, ServerConfig
 
 __all__ = [
     "AdmissionConfig", "AdmissionController", "DeadlineExceeded",
-    "GenerationRequest", "InferenceServer", "PrefixCache",
-    "PrefixCacheConfig", "QueueFull", "ReplicaSet", "RequestCancelled",
-    "ResponseStream", "Router", "RouterConfig", "RouterMetrics",
-    "SamplingParams", "ServerConfig", "ServingError", "ServingMetrics",
-    "ServingReplica",
+    "DisaggConfig", "DisaggRouter", "GenerationRequest",
+    "InferenceServer", "PrefixCache", "PrefixCacheConfig", "QueueFull",
+    "ReplicaSet", "RequestCancelled", "ResponseStream", "Router",
+    "RouterConfig", "RouterMetrics", "SamplingParams", "ServerConfig",
+    "ServingError", "ServingMetrics", "ServingReplica",
+    "SpeculativeConfig", "SpeculativeDecoder",
 ]

@@ -186,6 +186,21 @@ class AdmissionController:
         return (self.kv_floor(engine, self.cfg.kv_low_watermark)
                 - engine.free_blocks)
 
+    @staticmethod
+    def evictable_headroom(engine, prefix_cache=None) -> int:
+        """Blocks a new request could claim without preempting live
+        work: the allocator free list PLUS pages the prefix cache could
+        evict on demand (solely-cache-owned leaf blocks).  The dispatch
+        score must use this, not ``free_blocks`` alone — a cache-warm
+        replica whose pool is full of evictable pages has the same real
+        capacity as a cold one, and scoring it by the raw free list
+        makes the router spill (or reject) exactly the replica whose
+        warm cache would serve the request best."""
+        free = engine.free_blocks
+        if prefix_cache is not None:
+            free += prefix_cache.evictable_count()
+        return free
+
     def below_low_watermark(self, engine) -> bool:
         return self.low_watermark_deficit(engine) > 0
 

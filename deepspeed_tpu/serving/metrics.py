@@ -50,7 +50,10 @@ class ServingMetrics:
                    for name in ("submitted", "admitted", "rejected",
                                 "preemptions", "tokens_out", "steps",
                                 "flight_dumps", "prefix_hits",
-                                "prefix_misses", "prefill_tokens_saved")
+                                "prefix_misses", "prefill_tokens_saved",
+                                "handoffs_in", "handoffs_out",
+                                "handoff_bytes", "spec_rounds",
+                                "spec_proposed", "spec_accepted")
                    + _OUTCOMES}
         # distributions (seconds)
         self._ttft = reg.histogram("serving_ttft_seconds",
@@ -61,6 +64,10 @@ class ServingMetrics:
         self._queue_wait = reg.histogram("serving_queue_wait_seconds",
                                          "submit to admission",
                                          window=_WINDOW)
+        self._handoff = reg.histogram(
+            "serving_handoff_seconds",
+            "KV-chain export/import time, one observation per side",
+            window=_WINDOW)
         # gauges (set by the serve loop each iteration)
         self._g_queue_depth = reg.gauge("serving_queue_depth")
         self._g_active = reg.gauge("serving_active_requests")
@@ -86,6 +93,12 @@ class ServingMetrics:
     prefix_misses = property(lambda self: self._cv("prefix_misses"))
     prefill_tokens_saved = property(
         lambda self: self._cv("prefill_tokens_saved"))
+    handoffs_in = property(lambda self: self._cv("handoffs_in"))
+    handoffs_out = property(lambda self: self._cv("handoffs_out"))
+    handoff_bytes = property(lambda self: self._cv("handoff_bytes"))
+    spec_rounds = property(lambda self: self._cv("spec_rounds"))
+    spec_proposed = property(lambda self: self._cv("spec_proposed"))
+    spec_accepted = property(lambda self: self._cv("spec_accepted"))
     queue_depth = property(lambda self: int(self._g_queue_depth.value))
     active_requests = property(lambda self: int(self._g_active.value))
     kv_utilization = property(lambda self: self._g_kv_util.value)
@@ -128,6 +141,28 @@ class ServingMetrics:
             self._c["prefill_tokens_saved"].inc(tokens_saved)
         else:
             self._c["prefix_misses"].inc()
+
+    def record_handoff_out(self, export_s: float) -> None:
+        """Prefill-tier side: one KV chain exported for adoption."""
+        self._c["handoffs_out"].inc()
+        self._handoff.observe(export_s)
+
+    def record_handoff_in(self, bytes_moved: int, import_s: float) -> None:
+        """Decode-tier side: one handed-off chain adopted at admission —
+        ``bytes_moved`` is 0 for the zero-copy path (the local prefix
+        cache already held the chain; adoption was a ref acquire)."""
+        self._c["handoffs_in"].inc()
+        self._c["handoff_bytes"].inc(int(bytes_moved))
+        self._handoff.observe(import_s)
+
+    def record_spec_round(self, proposed: int, accepted: int) -> None:
+        """One speculative verify round: the draft proposed ``proposed``
+        tokens across the batch, the target accepted ``accepted`` of
+        them (bonus tokens are not counted — accept rate measures the
+        draft's hit rate, accepted/proposed)."""
+        self._c["spec_rounds"].inc()
+        self._c["spec_proposed"].inc(int(proposed))
+        self._c["spec_accepted"].inc(int(accepted))
 
     def record_finish(self, outcome: str, n_tokens: int,
                       first_token_at: Optional[float],
@@ -176,9 +211,18 @@ class ServingMetrics:
                                       + self.prefix_misses)),
             "prefill_tokens_saved": self.prefill_tokens_saved,
             "prefix_cached_blocks": int(self._g_prefix_blocks.value),
+            "handoffs_in": self.handoffs_in,
+            "handoffs_out": self.handoffs_out,
+            "handoff_bytes": self.handoff_bytes,
+            "spec_rounds": self.spec_rounds,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
+            "spec_accept_rate": (self.spec_accepted
+                                 / max(1, self.spec_proposed)),
             "ttft": self._ttft.snapshot(),
             "tpot": self._tpot.snapshot(),
             "queue_wait": self._queue_wait.snapshot(),
+            "handoff": self._handoff.snapshot(),
         }
 
     def events(self, step: int) -> List[Event]:
@@ -223,10 +267,20 @@ class RouterMetrics:
         self._routed = {i: reg.counter(f"router_routed_r{i}_total")
                         for i in range(n_replicas)}
         self._g_alive = reg.gauge("router_replicas_alive")
+        # disaggregated tiers: per-request prefill→decode KV handoffs
+        # observed at the router (export + import, end to end)
+        self._handoffs = reg.counter("router_handoffs_total")
+        self._handoff_bytes = reg.counter("router_handoff_bytes_total")
+        self._handoff_s = reg.histogram(
+            "router_handoff_seconds",
+            "per-request KV handoff latency (export + import)",
+            window=_WINDOW)
 
     requests = property(lambda self: int(self._requests.value))
     rejected = property(lambda self: int(self._rejected.value))
     failovers = property(lambda self: int(self._failovers.value))
+    handoffs = property(lambda self: int(self._handoffs.value))
+    handoff_bytes = property(lambda self: int(self._handoff_bytes.value))
 
     def routed(self, i: int) -> int:
         c = self._routed.get(i)
@@ -254,6 +308,13 @@ class RouterMetrics:
     def record_failover(self) -> None:
         self._failovers.inc()
 
+    def record_handoff(self, bytes_moved: int, seconds: float) -> None:
+        """One request's prefill→decode KV handoff completed (0 bytes =
+        the zero-copy ref-acquire path)."""
+        self._handoffs.inc()
+        self._handoff_bytes.inc(int(bytes_moved))
+        self._handoff_s.observe(seconds)
+
     def set_alive(self, n: int) -> None:
         self._g_alive.set(n)
 
@@ -263,6 +324,9 @@ class RouterMetrics:
             "rejected": self.rejected,
             "failovers": self.failovers,
             "replicas_alive": int(self._g_alive.value),
+            "handoffs": self.handoffs,
+            "handoff_bytes": self.handoff_bytes,
+            "handoff": self._handoff_s.snapshot(),
             "routed": {f"r{i}": self.routed(i)
                        for i in sorted(self._routed)},
         }

@@ -39,6 +39,7 @@ Python ints, same as the rest of ``serving/``.
 from __future__ import annotations
 
 import itertools
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
@@ -91,11 +92,47 @@ class PrefixCache:
         self._entries: List[_Entry] = []       # flat view for eviction
         # logical LRU clock — deterministic, monotonic, no wall time
         self._clock = itertools.count(1)
+        # (monotonic_ts, value) memo for evictable_count: dispatch
+        # scoring calls it per replica per routed request, and a full
+        # trie walk per call would grow with cache occupancy exactly
+        # when the system is busiest
+        self._evictable_memo = (-1.0, 0)
 
     # -- introspection ---------------------------------------------------
     @property
     def cached_blocks(self) -> int:
         return len(self._entries)
+
+    def evictable_count(self, max_age_s: float = 0.05) -> int:
+        """Pages eviction could free on demand: every entry whose whole
+        subtree the cache solely owns (refcount 1 throughout — no live
+        sequence shares any page below it).  ``evict()`` reaches them
+        leaf-first across repeated passes, so for dispatch scoring
+        (``AdmissionController.evictable_headroom``) they are
+        headroom-in-waiting, not occupancy.  A shared page pins its
+        ancestors (interior entries stay until their subtree drains)
+        but not its fully-cache-owned siblings.  Safe from a non-serve
+        thread — it only reads snapshots of the trie and the
+        allocator's refcounts.  Results are memoized for ``max_age_s``
+        (dispatch scores tolerate a loop-tick of staleness; pass 0 to
+        force a fresh walk)."""
+        now = time.monotonic()
+        ts, value = self._evictable_memo
+        if max_age_s > 0 and ts >= 0 and now - ts < max_age_s:
+            return value
+
+        def walk(entry: _Entry):
+            n = 0
+            fully = self.allocator.refcount(entry.block) == 1
+            for child in list(entry.children.values()):
+                c_n, c_fully = walk(child)
+                n += c_n
+                fully = fully and c_fully
+            return (n + 1, True) if fully else (n, False)
+
+        value = sum(walk(e)[0] for e in list(self._root.values()))
+        self._evictable_memo = (now, value)
+        return value
 
     def _chain(self, tokens: Sequence[int], limit_blocks: int):
         """Yield (block_tokens_tuple, entry-or-None) down the trie."""

@@ -22,18 +22,27 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence
 
+from deepspeed_tpu.serving.admission import AdmissionController
 from deepspeed_tpu.serving.server import InferenceServer
 from deepspeed_tpu.utils.logging import log_dist
 
 
 class ServingReplica:
-    """One engine + serve loop on its mesh slice."""
+    """One engine + serve loop on its mesh slice.
 
-    def __init__(self, index: int, engine: Any, server: InferenceServer):
+    ``tier`` specializes the replica under disaggregated serving
+    (serving/disagg.py): ``"prefill"`` replicas run prompt→first-token
+    legs and export KV chains, ``"decode"`` replicas adopt them and run
+    the token loop (optionally with a draft model for speculative
+    decoding), ``"unified"`` replicas (the default) do both."""
+
+    def __init__(self, index: int, engine: Any, server: InferenceServer,
+                 tier: str = "unified"):
         self.index = index
         self.name = f"r{index}"
         self.engine = engine
         self.server = server
+        self.tier = tier
 
     @property
     def alive(self) -> bool:
@@ -53,6 +62,17 @@ class ServingReplica:
         return eng.free_blocks / max(1, eng.cfg.num_blocks - 1)
 
     @property
+    def dispatch_headroom(self) -> float:
+        """Fraction of the pool a new request could claim without
+        preempting live work: the free list PLUS solely-cache-owned
+        evictable pages (``AdmissionController.evictable_headroom``) —
+        a warm prefix cache is capacity-in-waiting, not occupancy."""
+        eng = self.engine
+        free = AdmissionController.evictable_headroom(
+            eng, self.server.prefix_cache)
+        return free / max(1, eng.cfg.num_blocks - 1)
+
+    @property
     def queue_load(self) -> int:
         """Requests this replica already owes: queued + running."""
         return len(self.server.admission) + len(self.server._active)
@@ -61,6 +81,7 @@ class ServingReplica:
         snap = self.server.metrics.snapshot()
         snap["replica"] = self.index
         snap["alive"] = self.alive
+        snap["tier"] = self.tier
         return snap
 
     def kill(self) -> None:
@@ -109,7 +130,8 @@ class ReplicaSet:
               engine_config: Optional[dict] = None,
               server_config: Optional[dict] = None, seed: int = 0,
               devices: Optional[Sequence[Any]] = None,
-              devices_per_replica: Optional[int] = None) -> "ReplicaSet":
+              devices_per_replica: Optional[int] = None,
+              disagg: Optional[Any] = None) -> "ReplicaSet":
         """Build N engines on disjoint device slices + one server each.
 
         Every replica gets the SAME model/config/seed, so weights are
@@ -118,14 +140,32 @@ class ReplicaSet:
         to all of ``jax.devices()``; the first ``n·(len//n)`` are split
         into N contiguous slices (``mesh_utils`` orders them
         ICI-adjacent, so contiguous slices are intra-slice-fast).
+
+        ``disagg`` (a dict or :class:`~.disagg.DisaggConfig`) splits the
+        set into prefill/decode tiers: the first ``prefill_replicas``
+        slices become the prefill tier, the next ``decode_replicas`` the
+        decode tier (``n_replicas`` must equal their sum), and decode
+        replicas grow a draft engine + :class:`~.disagg.SpeculativeDecoder`
+        when ``disagg.speculative`` is enabled.  Dispatch through a
+        :class:`~.disagg.DisaggRouter`.
         """
         import jax  # lazy: serving/ imports no jax at module scope
 
-        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        from deepspeed_tpu.serving.disagg import DisaggConfig
 
         devices = list(devices if devices is not None else jax.devices())
         if n_replicas < 1:
             raise ValueError(f"n_replicas={n_replicas}: must be >= 1")
+        if disagg is not None and not isinstance(disagg, DisaggConfig):
+            disagg = DisaggConfig(disagg)
+        if disagg is not None and not disagg.enabled:
+            disagg = None
+        if disagg is not None and disagg.n_replicas != n_replicas:
+            raise ValueError(
+                f"disagg tiers ({disagg.prefill_replicas} prefill + "
+                f"{disagg.decode_replicas} decode) must sum to "
+                f"n_replicas={n_replicas}; fix serving.disagg or "
+                "serving.n_replicas")
         ep = dict(engine_config or {}).get("expert_parallel", {})
         ep_size = int(ep.get("ep_size", 1) if isinstance(ep, dict) else ep)
         if n_replicas > 1 and ep_size > 1:
@@ -144,12 +184,19 @@ class ReplicaSet:
         # the default carves the whole device list into n equal slices
         per = int(devices_per_replica or len(devices) // n_replicas)
         if per < 1 or per * n_replicas > len(devices):
+            if disagg is not None:
+                raise ValueError(
+                    f"serving.disagg wants {disagg.prefill_replicas} "
+                    f"prefill + {disagg.decode_replicas} decode replicas "
+                    f"on disjoint {max(per, 1)}-device slices, but only "
+                    f"{len(devices)} device(s) exist — shrink a tier, "
+                    "lower devices_per_replica, or add chips")
             raise ValueError(
                 f"{len(devices)} device(s) cannot host {n_replicas} "
                 f"replicas on disjoint {per}-device slices")
         ctx = {"model": model, "engine_config": dict(engine_config or {}),
                "server_config": dict(server_config or {}), "seed": seed,
-               "devices": devices, "per": per}
+               "devices": devices, "per": per, "disagg": disagg}
         replicas = [cls._build_one(ctx, i) for i in range(n_replicas)]
         rs = cls(replicas)
         rs._ctx = ctx
@@ -159,8 +206,12 @@ class ReplicaSet:
     def _build_one(ctx: Dict[str, Any], index: int) -> ServingReplica:
         """One replica on slice ``index`` of the build context — same
         model/config/seed as every sibling (the bit-identity contract),
-        used by build(), grow() and respawn() alike."""
+        used by build(), grow() and respawn() alike.  Under disagg the
+        index decides the tier, and decode-tier replicas get a draft
+        engine + SpeculativeDecoder on the SAME slice when speculation
+        is configured."""
         from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        from deepspeed_tpu.serving.disagg import SpeculativeDecoder
 
         per = ctx["per"]
         slice_i = ctx["devices"][index * per:(index + 1) * per]
@@ -168,14 +219,30 @@ class ReplicaSet:
             raise ValueError(
                 f"no free device slice for replica r{index} "
                 f"({len(ctx['devices'])} device(s), {per} per replica)")
+        disagg = ctx.get("disagg")
+        tier = disagg.tier_of(index) if disagg is not None else "unified"
         engine = InferenceEngineV2(ctx["model"], dict(ctx["engine_config"]),
                                    seed=ctx["seed"], devices=slice_i)
         scfg = dict(ctx["server_config"])
         scfg.setdefault("metrics_label", f"r{index}")
-        server = InferenceServer(engine, scfg)
-        log_dist(f"replica r{index}: {per} device(s) "
-                 f"[{index * per}..{(index + 1) * per - 1}]", level="info")
-        return ServingReplica(index, engine, server)
+        spec = None
+        if (disagg is not None and disagg.speculative.enabled
+                and tier in ("decode", "unified")):
+            draft_model = disagg.speculative.draft_model
+            if isinstance(draft_model, str):
+                from deepspeed_tpu.models import get_model_config
+
+                draft_model = get_model_config(draft_model)
+            draft = InferenceEngineV2(draft_model,
+                                      dict(ctx["engine_config"]),
+                                      seed=ctx["seed"], devices=slice_i)
+            spec = SpeculativeDecoder(engine, draft,
+                                      spec_k=disagg.speculative.spec_k)
+        server = InferenceServer(engine, scfg, spec_decoder=spec)
+        log_dist(f"replica r{index} [{tier}]: {per} device(s) "
+                 f"[{index * per}..{(index + 1) * per - 1}]"
+                 + (" +draft" if spec is not None else ""), level="info")
+        return ServingReplica(index, engine, server, tier=tier)
 
     # -- live resizing ---------------------------------------------------
     def _require_ctx(self) -> Dict[str, Any]:

@@ -46,6 +46,10 @@ class SamplingParams:
     top_p: float = 1.0
     eos_token_id: Optional[int] = None
     seed: int = 0
+    # opt this request into speculative decoding (greedy only — the
+    # acceptance rule is the bit-identical-to-greedy argmax test; a
+    # server without a draft model ignores the flag)
+    speculative: bool = False
 
     @property
     def greedy(self) -> bool:
@@ -72,6 +76,13 @@ class GenerationRequest:
     # insertion at the first sampled token (0 = nothing pending; only
     # set when the server runs a prefix cache)
     pending_insert: int = 0
+    # -- disaggregated serving (serving/disagg.py) --
+    # handoff=True: at completion, export this sequence's full KV blocks
+    # onto the stream (prefill-tier leg of a prefill→decode handoff)
+    handoff: bool = False
+    # a handoff payload from a prefill replica: admission imports these
+    # pages instead of re-prefilling the covered prefix
+    kv_payload: Any = None
     # distributed-tracing identity: every span this request emits shares
     # this id ("" = tracing disabled; see telemetry/tracing.py).  The
     # span handles are serve-loop-internal (only it starts/ends them).
@@ -113,6 +124,10 @@ class ResponseStream:
         # set by the server at submit when tracing is enabled, so callers
         # can cross-link their stream to the exported Perfetto trace
         self.trace_id = ""
+        # prefill-tier handoff: the exported KV payload, set by the serve
+        # loop BEFORE _finish so a consumer observing the terminal state
+        # always sees it (None = no handoff was requested/possible)
+        self.handoff_payload = None
         self._cond = threading.Condition()
         self._tokens: List[int] = []
         self._done = False

@@ -77,6 +77,11 @@ class RoutedStream(ResponseStream):
     def __init__(self, uid: int):
         super().__init__(uid)
         self._inner: Optional[ResponseStream] = None
+        # per-request disagg handoff report (set at finish by the disagg
+        # router; None under homogeneous routing): end-to-end KV-chain
+        # transfer latency and bytes moved (0 = zero-copy ref acquire)
+        self.handoff_ms: Optional[float] = None
+        self.handoff_bytes: Optional[int] = None
 
     def _attach(self, inner: ResponseStream) -> None:
         with self._cond:
@@ -98,7 +103,7 @@ class _RoutedRequest:
 
     __slots__ = ("uid", "prompt", "params", "priority", "deadline",
                  "stream", "replica", "inner", "delivered", "failovers",
-                 "trace_id", "span")
+                 "trace_id", "span", "phase", "payload")
 
     def __init__(self, uid: int, prompt: List[int], params: SamplingParams,
                  priority: int, deadline: Optional[float],
@@ -115,6 +120,11 @@ class _RoutedRequest:
         self.failovers = 0
         self.trace_id = ""
         self.span = None
+        # disaggregated tiers (serving/disagg.py DisaggRouter): the leg
+        # this request currently runs (None = homogeneous routing) and
+        # the KV payload riding from the prefill leg to the decode leg
+        self.phase: Optional[str] = None
+        self.payload = None
 
 
 class Router:
@@ -186,36 +196,52 @@ class Router:
         self.stop(drain=not any(exc))
 
     # -- dispatch policy -------------------------------------------------
-    def _score(self, rep: ServingReplica) -> float:
+    def _candidates(self, tier: Optional[str],
+                    exclude: Sequence[int]) -> List[ServingReplica]:
+        """Dispatchable replicas for a leg; the disagg router narrows
+        this to the leg's tier (with cross-tier fallback)."""
+        return [r for r in self.replicas.alive if r.index not in exclude]
+
+    def _score(self, rep: ServingReplica,
+               tier: Optional[str] = None) -> float:
         with self._lock:
             # .get, not []: the replica may have been grown/respawned
             # into the set after this router was constructed
             inflight = self._inflight.get(rep.index, 0)
-        return rep.kv_headroom - self.cfg.queue_weight * (rep.queue_load
-                                                          + inflight)
+        # dispatch_headroom, not kv_headroom: pages the prefix cache
+        # could evict on demand are capacity, not occupancy — scoring by
+        # the raw free list makes the router spill away from exactly the
+        # cache-warm replica that would serve the request best
+        return rep.dispatch_headroom - self.cfg.queue_weight * (
+            rep.queue_load + inflight)
 
     def _choose(self, exclude: Sequence[int] = (),
-                session: Optional[str] = None) -> ServingReplica:
-        alive = [r for r in self.replicas.alive if r.index not in exclude]
+                session: Optional[str] = None,
+                tier: Optional[str] = None) -> ServingReplica:
+        alive = self._candidates(tier, exclude)
         if not alive:
             raise ServingError("no live replica to dispatch to")
-        if session is not None and self.cfg.sticky_sessions:
+        # tier-local affinity: under disagg a session pins one replica
+        # PER TIER (its prefill cache and its decode cache both stay warm)
+        skey = (session if session is None or tier is None
+                else f"{tier}:{session}")
+        if skey is not None and self.cfg.sticky_sessions:
             with self._lock:
-                idx = self._sessions.get(session)
+                idx = self._sessions.get(skey)
                 if idx is not None:
                     # refresh on HIT too: an actively-used session must
                     # not be the first one the bound evicts
-                    self._sessions.move_to_end(session)
+                    self._sessions.move_to_end(skey)
             if idx is not None and idx not in exclude:
                 for r in alive:
                     if r.index == idx:
                         return r
         # max score; ties broken by replica index for determinism
-        best = max(alive, key=lambda r: (self._score(r), -r.index))
-        if session is not None and self.cfg.sticky_sessions:
+        best = max(alive, key=lambda r: (self._score(r, tier), -r.index))
+        if skey is not None and self.cfg.sticky_sessions:
             with self._lock:
-                self._sessions[session] = best.index
-                self._sessions.move_to_end(session)
+                self._sessions[skey] = best.index
+                self._sessions.move_to_end(skey)
                 while len(self._sessions) > self.cfg.max_sessions:
                     self._sessions.popitem(last=False)
         return best
@@ -224,16 +250,26 @@ class Router:
                   session: Optional[str] = None) -> None:
         """Pick a replica and submit (the remainder of) the request to
         it.  Replicas whose queue rejects are excluded and the next one
-        tried; raises the last error when every live replica refused."""
+        tried; raises the last error when every live replica refused.
+        Under disagg, ``rr.phase`` selects the tier and the leg shape:
+        a prefill leg runs prompt→1 token with the KV export armed, a
+        decode leg carries the exported payload into admission."""
         remaining = rr.params.max_new_tokens - len(rr.delivered)
         params = (rr.params if not rr.delivered else
                   dataclasses.replace(rr.params, max_new_tokens=remaining))
+        submit_kw = {}
+        if rr.phase == "prefill":
+            params = dataclasses.replace(params, max_new_tokens=1)
+            submit_kw["handoff"] = True
+        elif rr.phase == "decode" and rr.payload is not None:
+            submit_kw["kv_payload"] = rr.payload
         prompt = rr.prompt + rr.delivered
         tried = list(exclude)
         last_error: Optional[ServingError] = None
         while True:
             try:
-                rep = self._choose(exclude=tried, session=session)
+                rep = self._choose(exclude=tried, session=session,
+                                   tier=rr.phase)
             except ServingError:
                 raise (last_error or
                        ServingError("no live replica to dispatch to"))
@@ -242,7 +278,8 @@ class Router:
             try:
                 inner = rep.server.submit(prompt, params,
                                           priority=rr.priority,
-                                          deadline_s=deadline_s)
+                                          deadline_s=deadline_s,
+                                          **submit_kw)
             except QueueFull as e:
                 tried.append(rep.index)
                 last_error = e
@@ -264,11 +301,13 @@ class Router:
     def submit(self, prompt: Sequence[int],
                params: Optional[SamplingParams] = None, priority: int = 0,
                deadline_s: Optional[float] = None,
-               session: Optional[str] = None) -> ResponseStream:
+               session: Optional[str] = None,
+               phase: Optional[str] = None) -> ResponseStream:
         """Same contract as ``InferenceServer.submit`` plus ``session``:
         requests sharing a session key stick to one replica while it
         lives, which is what lets its replica-local prefix cache serve
-        the session's shared prompt."""
+        the session's shared prompt.  ``phase`` is internal — the
+        disagg subclass opens every request on its prefill leg."""
         if not self._started or self._stop_requested:
             raise QueueFull("router not accepting requests")
         params = params or SamplingParams()
@@ -282,6 +321,7 @@ class Router:
             deadline=(None if deadline_s is None
                       else time.monotonic() + deadline_s),
             stream=RoutedStream(uid))
+        rr.phase = phase
         if self.tracer.enabled:
             rr.trace_id = rr.stream.trace_id = self.tracer.new_trace_id()
             rr.span = self.tracer.span("router.request", rr.trace_id).set(

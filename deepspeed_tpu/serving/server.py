@@ -109,10 +109,14 @@ class InferenceServer:
 
     def __init__(self, engine: InferenceEngineV2,
                  config: Optional[dict] = None, monitor: Any = None,
-                 telemetry: Any = None):
+                 telemetry: Any = None, spec_decoder: Any = None):
         self.engine = engine
         self.cfg = ServerConfig(config)
         self.monitor = monitor
+        # speculative decoding (serving/disagg.py SpeculativeDecoder): a
+        # draft model living in this serve loop.  Anything with
+        # round()/flush() works; None disables per-request `speculative`
+        self._spec = spec_decoder
         # a telemetry.Telemetry hub: serving histograms register in ITS
         # registry (one Prometheus exposition for both hot loops) and the
         # loop emits kind="serving" StepRecords to the same JSONL
@@ -204,6 +208,10 @@ class InferenceServer:
         if hasattr(self.engine, "tracer"):
             self.engine.tracer = self.tracer
             self.engine.trace_id = self._loop_trace_id
+        if self._spec is not None:
+            # spec.draft / spec.verify spans + accept-rate counters land
+            # in THIS loop's trace and registry
+            self._spec.bind(self.tracer, self._loop_trace_id, self.metrics)
         self._thread = threading.Thread(target=self._serve_loop,
                                         name="ds-serve-loop", daemon=True)
         self._thread.start()
@@ -272,7 +280,8 @@ class InferenceServer:
     def submit(self, prompt: Sequence[int],
                params: Optional[SamplingParams] = None, priority: int = 0,
                deadline_s: Optional[float] = None,
-               timeout: Optional[float] = None) -> ResponseStream:
+               timeout: Optional[float] = None, handoff: bool = False,
+               kv_payload: Any = None) -> ResponseStream:
         """Enqueue one generation request; returns its stream immediately.
 
         ``deadline_s`` is a wall budget from now — queued or mid-decode,
@@ -281,6 +290,12 @@ class InferenceServer:
         queue policy.  Raises ``QueueFull`` (reject policy / closed
         server) or ``ValueError`` for requests no admission order could
         ever run.
+
+        Disaggregated tiers (serving/disagg.py): ``handoff=True`` makes
+        the serve loop export the sequence's full KV blocks onto
+        ``stream.handoff_payload`` at completion (the prefill leg);
+        ``kv_payload`` hands such an export IN — admission adopts the
+        covered pages instead of re-prefilling them (the decode leg).
         """
         params = params or SamplingParams()
         if not len(prompt):
@@ -307,7 +322,8 @@ class InferenceServer:
             uid=uid, prompt=list(prompt), params=params,
             stream=ResponseStream(uid), priority=priority,
             deadline=(None if deadline_s is None
-                      else time.monotonic() + deadline_s))
+                      else time.monotonic() + deadline_s),
+            handoff=handoff, kv_payload=kv_payload)
         tr = self.tracer
         if tr.enabled:
             req.trace_id = req.stream.trace_id = tr.new_trace_id()
@@ -398,7 +414,7 @@ class InferenceServer:
             req = self._active.pop(uid)
             try:
                 if uid in self.engine.state_manager:
-                    self.engine.flush(uid)
+                    self._flush_seq(uid)
             except Exception:
                 # the crash handler may be running BECAUSE engine state
                 # is inconsistent — a failing flush must not leave the
@@ -430,7 +446,7 @@ class InferenceServer:
                                        f"after {req.n_generated} tokens")
             if err is not None:
                 del self._active[uid]
-                self.engine.flush(uid)
+                self._flush_seq(uid)
                 self._finish(req, error=err)
 
     def _try_admit(self, now: float) -> None:
@@ -484,6 +500,9 @@ class InferenceServer:
                     continue
             popped = self.admission.pop()
             assert popped is req
+            if req.kv_payload is not None:
+                adopted, n_cached = self._import_handoff(req, adopted,
+                                                         n_cached)
             eng.admit(req.uid, req.tokens, priority=req.priority,
                       front=req.preemptions > 0, cached_blocks=adopted,
                       num_cached=n_cached)
@@ -515,6 +534,51 @@ class InferenceServer:
                 self.metrics.record_admit(now - req.submitted_at)
             self._active[req.uid] = req
 
+    def _import_handoff(self, req: GenerationRequest, adopted: List[int],
+                        n_cached: int):
+        """Adopt a prefill replica's handed-off KV chain at admission.
+
+        The payload and the local prefix cache share the chain-keyed
+        identity (both are KV for the same leading tokens of
+        ``req.tokens``), so any locally-adopted blocks are a prefix of
+        the payload's — when the cache already covers the whole payload
+        the handoff is a pure ref acquire (zero bytes moved); otherwise
+        only the uncovered tail is written device-to-device.  Failures
+        degrade to re-running prefill (correctness never depends on the
+        import).  Returns the combined ``(cached_blocks, num_cached)``.
+        """
+        payload = req.kv_payload
+        bs = self.engine.cfg.block_size
+        pay_blocks = len(payload["tokens"]) // bs
+        skip = len(adopted)
+        t0 = time.monotonic()
+        sp = (self.tracer.span("serve.handoff", req.trace_id,
+                               req.span_request)
+              if self.tracer.enabled else None)
+        moved = 0
+        try:
+            if skip < pay_blocks:
+                blocks, n_tok, moved = self.engine.import_kv_chain(
+                    payload, skip_blocks=skip)
+                adopted = list(adopted) + blocks
+                n_cached = n_tok
+        except Exception as e:  # geometry mismatch / transient exhaustion
+            log_dist(f"serving: handoff import for request {req.uid} "
+                     f"failed ({e!r}); re-running prefill", level="warning")
+            req.kv_payload = None
+            if sp is not None:
+                sp.end(uid=req.uid, failed=True)
+            return adopted, n_cached
+        import_s = time.monotonic() - t0
+        self.metrics.record_handoff_in(moved, import_s)
+        # the router reads these back for the per-request report
+        payload["import_ms"] = import_s * 1e3
+        payload["import_bytes"] = moved
+        if sp is not None:
+            sp.end(uid=req.uid, bytes=moved, blocks=len(adopted),
+                   zero_copy=(moved == 0))
+        return adopted, n_cached
+
     def _reserved_decode_blocks(self) -> int:
         """generate()-style worst-case growth of the running set (only
         consulted under ``reserve_decode=True``)."""
@@ -545,10 +609,12 @@ class InferenceServer:
             if self._reclaim_cache(deficit) < deficit:
                 self._preempt_one()
         all_greedy = all(r.params.greedy for r in self._active.values())
+        spec_ready = self._spec_eligible()
         tr = self.tracer
         step_span = tr.span("serve.step", self._loop_trace_id)
         if tr.enabled:
-            step_span.set(n_active=len(self._active), greedy=all_greedy)
+            step_span.set(n_active=len(self._active), greedy=all_greedy,
+                          speculative=spec_ready)
         # the first engine.step of the process pays the jit compile,
         # which can legitimately exceed any sane stall deadline — keep
         # the watchdog disarmed for it (same per-process rule as the
@@ -558,10 +624,21 @@ class InferenceServer:
             self._watchdog.pause()
         try:
             try:
-                if all_greedy:
-                    results = self.engine.step(temperature=0.0)
+                if spec_ready:
+                    # draft proposes, target verifies in ONE ragged step;
+                    # each value is the accepted token burst (>= 1), and
+                    # the engine's sequences already carry them
+                    emitted = self._spec.round(self._active)
+                elif all_greedy:
+                    emitted = {u: [t] for u, t in
+                               self.engine.step(temperature=0.0).items()}
                 else:
-                    results = self.engine.step(return_logits=True)
+                    logits = self.engine.step(return_logits=True)
+                    emitted = {u: [_host_sample(out,
+                                                self._active[u].params,
+                                                self._rngs[u])]
+                               for u, out in logits.items()
+                               if u in self._active}
                 # only a step that actually ran proves the compile is
                 # behind us — KVCacheExhausted rolls back with nothing
                 # run, so the retry still pays the first jit compile and
@@ -594,46 +671,116 @@ class InferenceServer:
                 self.telemetry.record_serving_step(self.metrics.steps,
                                                    self.metrics.snapshot())
         now = time.monotonic()
-        for uid, out in results.items():
+        for uid, burst in emitted.items():
             req = self._active.get(uid)
             if req is None:       # flushed between schedule and fetch
                 continue          # (cannot happen today; belt+braces)
-            tok = (int(out) if all_greedy
-                   else _host_sample(out, req.params, self._rngs[uid]))
-            req.tokens.append(tok)
-            if self.prefix_cache is not None and req.pending_insert:
-                # first sampled token of this admission ⇒ its prefill is
-                # complete: every full page under the admitted prefix now
-                # holds final KV and becomes shareable.  Must run before
-                # any flush below — insert acquires the cache's refs.
-                seq = self.engine.state_manager.get(uid)
-                self.prefix_cache.insert(req.tokens[:req.pending_insert],
-                                         seq.blocks)
-                req.pending_insert = 0
-            self.metrics.record_tokens(1)
-            if req.n_generated == 1:
-                req.first_token_at = now
-                self.metrics.record_first_token(now - req.submitted_at)
+            done = False
+            for tok in burst:
+                tok = int(tok)
+                req.tokens.append(tok)
+                if self.prefix_cache is not None and req.pending_insert:
+                    # first sampled token of this admission ⇒ its prefill
+                    # is complete: every full page under the admitted
+                    # prefix now holds final KV and becomes shareable.
+                    # Must run before any flush below — insert acquires
+                    # the cache's refs.
+                    seq = self.engine.state_manager.get(uid)
+                    self.prefix_cache.insert(
+                        req.tokens[:req.pending_insert], seq.blocks)
+                    req.pending_insert = 0
+                self.metrics.record_tokens(1)
+                if req.n_generated == 1:
+                    req.first_token_at = now
+                    self.metrics.record_first_token(now - req.submitted_at)
+                    if req.span_request is not None:
+                        tr.instant("serve.first_token", req.trace_id,
+                                   uid=uid)
+                if (req.span_phase is not None
+                        and req.span_phase.name == "serve.prefill"):
+                    # prefill → decode at this request's first token of
+                    # the current admission (re-prefills transition too)
+                    req.span_phase.end()
+                    req.span_phase = tr.span("serve.decode", req.trace_id,
+                                             req.span_request).set(uid=uid)
+                req.stream._put_token(tok)
                 if req.span_request is not None:
-                    tr.instant("serve.first_token", req.trace_id, uid=uid)
-            if (req.span_phase is not None
-                    and req.span_phase.name == "serve.prefill"):
-                # prefill → decode at this request's first token of the
-                # current admission (re-prefills transition here too)
-                req.span_phase.end()
-                req.span_phase = tr.span("serve.decode", req.trace_id,
-                                         req.span_request).set(uid=uid)
-            req.stream._put_token(tok)
-            if req.span_request is not None:
-                tr.instant("serve.emit", req.trace_id, uid=uid, token=tok)
-            eos_hit = (req.params.eos_token_id is not None
-                       and tok == req.params.eos_token_id)
-            if eos_hit or req.remaining <= 0:
+                    tr.instant("serve.emit", req.trace_id, uid=uid,
+                               token=tok)
+                eos_hit = (req.params.eos_token_id is not None
+                           and tok == req.params.eos_token_id)
+                if eos_hit or req.remaining <= 0:
+                    # a speculative burst may overshoot eos /
+                    # max_new_tokens — undelivered tokens die with the
+                    # flushed sequence
+                    done = True
+                    break
+            if done:
                 del self._active[uid]
-                self.engine.flush(uid)
+                if req.handoff:
+                    # prefill-tier leg: export the finished chain's full
+                    # KV blocks for adoption by a decode replica (must
+                    # precede the flush that frees them)
+                    self._export_handoff(req)
+                self._flush_seq(uid)
                 self._finish(req)
-            else:
-                self.engine.extend(uid, tok)
+            elif not spec_ready:
+                # speculative bursts were appended to the engine sequence
+                # by verify_step itself; a plain step's token must extend
+                self.engine.extend(uid, burst[-1])
+
+    def _spec_eligible(self) -> bool:
+        """A speculative round needs EVERY active request greedy, opted
+        in, and in steady-state decode (exactly one pending sampled
+        token) — the decode tier's steady state.  Mixed batches (a
+        prefill mid-flight, a non-greedy or non-speculative peer) run
+        the plain step; speculation resumes when the batch is
+        homogeneous again."""
+        if self._spec is None or not self._active:
+            return False
+        if len(self._active) > self.engine.scheduler.token_budget:
+            # even k=0 needs one verify row per sequence; an active set
+            # wider than the ragged budget must take the plain step path
+            # (the scheduler splits it into budget-sized steps)
+            return False
+        sm = self.engine.state_manager
+        for uid, req in self._active.items():
+            p = req.params
+            if not (p.greedy and p.speculative):
+                return False
+            if uid not in sm or sm.get(uid).uncached != 1:
+                return False
+        return True
+
+    def _flush_seq(self, uid: int) -> None:
+        """Release a sequence from the target engine AND the draft
+        model's mirror (the speculative decoder self-heals a missing
+        mirror, but a leaked one would pin draft KV pages forever)."""
+        self.engine.flush(uid)
+        if self._spec is not None:
+            self._spec.flush(uid)
+
+    def _export_handoff(self, req: GenerationRequest) -> None:
+        """Export a completed handoff request's full KV blocks onto its
+        stream (the prefill-tier half of a prefill→decode handoff).
+        Failure degrades to no payload — the decode leg re-runs
+        prefill."""
+        t0 = time.monotonic()
+        sp = (self.tracer.span("serve.handoff", req.trace_id,
+                               req.span_request)
+              if self.tracer.enabled else None)
+        payload = None
+        try:
+            payload = self.engine.export_kv_chain(req.uid)
+        except Exception as e:
+            log_dist(f"serving: handoff export for request {req.uid} "
+                     f"failed: {e!r}", level="warning")
+        if payload is not None:
+            self.metrics.record_handoff_out(time.monotonic() - t0)
+        req.stream.handoff_payload = payload
+        if sp is not None:
+            sp.end(uid=req.uid, exported=payload is not None,
+                   bytes=(payload or {}).get("nbytes", 0))
 
     def _preempt_one(self) -> None:
         """Evict the lowest-priority/youngest runner and requeue it with
@@ -646,13 +793,15 @@ class InferenceServer:
             # preempting the only runner (or a chronically-preempted one)
             # cannot make progress — fail it instead of livelocking
             del self._active[victim.uid]
-            self.engine.flush(victim.uid)
+            self._flush_seq(victim.uid)
             self._finish(victim, error=ServingError(
                 f"request {victim.uid} cannot fit the KV pool "
                 f"(preempted {victim.preemptions}×, "
                 f"{self.engine.free_blocks} blocks free)"))
             return
         tokens = self.engine.preempt(victim.uid)
+        if self._spec is not None:
+            self._spec.flush(victim.uid)
         victim.tokens = tokens
         victim.preemptions += 1
         del self._active[victim.uid]

@@ -48,10 +48,13 @@ SPAN_NAMES = (
     "router.request",          # whole routed-request lifetime (root span)
     "serve.admission_block",   # submit blocked on a full queue ('block' policy)
     "serve.decode",            # first token -> terminal (per request)
+    "serve.handoff",           # KV-chain export/import (disagg tiers)
     "serve.prefill",           # admission -> first token (per request)
     "serve.queue_wait",        # enqueue -> admission (per request)
     "serve.request",           # whole request lifetime (root span)
     "serve.step",              # one serve-loop engine step (whole batch)
+    "spec.draft",              # draft-model proposal loop (one round)
+    "spec.verify",             # target verify-k ragged step (one round)
     "train.data_ingest",       # micro-batch stack + host->device put
     "train.dispatch",          # compiled train step dispatch
     "train.step",              # one whole train_batch (root span)
@@ -74,6 +77,7 @@ EVENT_NAMES = (
     "serve.first_token",       # request's first decoded token
     "serve.preempt",           # request evicted for KV pressure
     "serve.prefix_hit",        # admission adopted cached prefix pages
+    "spec.accept",             # verify round outcome (proposed/accepted)
     "watchdog.fire",           # hang watchdog dumped a flight bundle
 )
 

@@ -1,0 +1,473 @@
+"""Disaggregated serving: prefill/decode tiers, KV handoff, speculation.
+
+Correctness oracle, same as the router tests: everything the disagg
+path produces under greedy sampling must be BIT-IDENTICAL to a single
+engine's one-shot ``generate()`` with the same weights — across the
+prefill→decode handoff (zero-copy and transfer paths), speculative
+decoding (any accept pattern), mid-handoff replica kills, and
+fail-over.  The refcount tests pin that handed-off pages release
+cleanly on finish/cancel/fail-over — nothing leaks a pool block.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import build_engine
+from deepspeed_tpu.inference.v2.ragged import BlockedAllocator
+from deepspeed_tpu.models import get_model_config
+from deepspeed_tpu.serving import (AdmissionController, DisaggRouter,
+                                   PrefixCache, PrefixCacheConfig,
+                                   ReplicaSet, RequestCancelled, Router,
+                                   SamplingParams)
+
+ENG_CFG = {"dtype": "float32",
+           "memory_config": {"num_blocks": 64, "block_size": 4},
+           "max_context": 64}
+
+DISAGG = {"enabled": True, "prefill_replicas": 1, "decode_replicas": 1,
+          "speculative": {"enabled": True, "draft_model": "llama-tiny",
+                          "spec_k": 3}}
+
+
+def _model(layers=1):
+    return get_model_config("llama-tiny", num_layers=layers)
+
+
+def _prompts(model, sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, model.vocab_size, size=n).tolist()
+            for n in sizes]
+
+
+def _pool_whole(engine) -> bool:
+    """Every page back on the free list (block 0 excluded)."""
+    return engine.free_blocks == engine.cfg.num_blocks - 1
+
+
+# ---------------------------------------------------------------------------
+# engine-level: verify-k step + KV chain export/import
+# ---------------------------------------------------------------------------
+
+def test_verify_step_any_accept_pattern_is_greedy_bit_identical():
+    model = _model()
+    ref = build_engine(model, ENG_CFG, seed=0)
+    prompt = _prompts(model, [9])[0]
+    want = ref.generate([prompt], max_new_tokens=12)[0]
+
+    eng = build_engine(model, ENG_CFG, seed=0)
+    eng.admit(0, prompt)
+    out = eng.step(temperature=0.0)
+    emitted = [out[0]]
+    eng.extend(0, out[0])
+    # perfect proposals: all accepted + bonus
+    acc = eng.verify_step({0: want[1:4]})[0]
+    assert acc == want[1:5]
+    emitted += acc
+    # garbage proposals: zero accepted, bonus only — and the KV rows the
+    # rejected tokens wrote must not poison later decoding
+    acc = eng.verify_step({0: [0, 0]})[0]
+    assert acc == [want[5]]
+    emitted += acc
+    # partially-correct proposals (first right, second wrong)
+    acc = eng.verify_step({0: [want[6], 0, 0]})[0]
+    assert acc == want[6:8]
+    emitted += acc
+    # empty proposal = plain greedy step through the verify surface
+    acc = eng.verify_step({0: []})[0]
+    assert acc == [want[8]]
+    emitted += acc
+    while len(emitted) < 12:
+        o = eng.step(temperature=0.0)
+        emitted.append(o[0])
+        eng.extend(0, o[0])
+    assert emitted == want
+
+
+def test_verify_step_rejects_mid_prefill_sequence():
+    model = _model()
+    eng = build_engine(model, ENG_CFG, seed=0)
+    eng.admit(0, _prompts(model, [9])[0])
+    # no step has run: the prompt is still uncached (> 1 pending)
+    with pytest.raises(ValueError, match="uncached"):
+        eng.verify_step({0: [1, 2]})
+
+
+def test_verify_step_bad_entry_leaves_batch_untouched():
+    """All-or-nothing validation: a bad sequence in the batch must not
+    leave EARLIER sequences carrying unverified draft tokens."""
+    model = _model()
+    eng = build_engine(model, ENG_CFG, seed=0)
+    pa, pb = _prompts(model, [9, 7], seed=9)
+    eng.admit(0, pa)
+    t0 = eng.step(temperature=0.0)[0]
+    eng.extend(0, t0)
+    eng.admit(1, pb)                 # mid-prefill: uncached > 1
+    before = list(eng.state_manager.get(0).tokens)
+    with pytest.raises(ValueError, match="uncached"):
+        eng.verify_step({0: [1, 2], 1: [3]})
+    assert eng.state_manager.get(0).tokens == before
+
+
+def test_spec_degrades_to_plain_step_when_actives_exceed_budget():
+    """An active set wider than the ragged token budget cannot verify
+    (even k=0 needs one row per sequence) — the serve loop must fall
+    back to plain budget-split steps, bit-identically, instead of
+    crashing the loop with an over-budget verify."""
+    from deepspeed_tpu.serving import InferenceServer, SpeculativeDecoder
+
+    model = _model()
+    cfg = dict(ENG_CFG,
+               state_manager={"max_tracked_sequences": 8,
+                              "max_ragged_batch_size": 4})
+    ref = build_engine(model, cfg, seed=0)
+    prompts = _prompts(model, [5, 6, 7, 5, 6, 7], seed=10)
+    want = [ref.generate([p], max_new_tokens=4)[0] for p in prompts]
+
+    eng = build_engine(model, cfg, seed=0)
+    draft = build_engine(model, cfg, seed=0)
+    srv = InferenceServer(eng, spec_decoder=SpeculativeDecoder(
+        eng, draft, spec_k=3)).start()
+    try:
+        streams = [srv.submit(p, SamplingParams(max_new_tokens=4,
+                                                speculative=True))
+                   for p in prompts]
+        assert [s.result(timeout=300) for s in streams] == want
+    finally:
+        srv.stop()
+
+
+def test_export_import_chain_decode_parity_and_release():
+    model = _model()
+    prompt = _prompts(model, [10], seed=2)[0]
+    ref = build_engine(model, ENG_CFG, seed=0)
+    want = ref.generate([prompt], max_new_tokens=8)[0]
+
+    a = build_engine(model, ENG_CFG, seed=0)
+    b = build_engine(model, ENG_CFG, seed=0)
+    a.admit(7, prompt)
+    t0 = a.step(temperature=0.0)[7]
+    payload = a.export_kv_chain(7)
+    a.extend(7, t0)
+    a.flush(7)
+    assert _pool_whole(a)
+    assert payload["tokens"] == prompt[:8]      # full blocks only
+    blocks, n_tok, moved = b.import_kv_chain(payload)
+    assert n_tok == 8 and moved == payload["nbytes"] and len(blocks) == 2
+    b.admit(9, prompt + [t0], cached_blocks=blocks, num_cached=n_tok)
+    got = [t0]
+    while len(got) < 8:
+        o = b.step(temperature=0.0)
+        if 9 in o:
+            got.append(o[9])
+            b.extend(9, o[9])
+    assert got == want
+    b.flush(9)
+    assert _pool_whole(b)       # imported pages released with the seq
+
+
+def test_import_rejects_geometry_mismatch():
+    model = _model()
+    prompt = _prompts(model, [10])[0]
+    a = build_engine(model, ENG_CFG, seed=0)
+    other = dict(ENG_CFG, memory_config={"num_blocks": 64,
+                                         "block_size": 8})
+    b = build_engine(model, other, seed=0)
+    a.admit(0, prompt)
+    a.step(temperature=0.0)
+    payload = a.export_kv_chain(0)
+    with pytest.raises(ValueError, match="geometry"):
+        b.import_kv_chain(payload)
+    assert _pool_whole(b)       # the refused import allocated nothing
+
+
+# ---------------------------------------------------------------------------
+# evictable headroom (the router/admission satellite)
+# ---------------------------------------------------------------------------
+
+def test_evictable_headroom_counts_cache_owned_leaves():
+    al = BlockedAllocator(16)
+    pc = PrefixCache(PrefixCacheConfig({"enabled": True}), al,
+                     block_size=4)
+    blocks = al.allocate(3)
+    pc.insert(list(range(12)), blocks)   # 3 full cache-owned blocks
+    al.free(blocks)                      # donor flushes: cache sole owner
+
+    class _Eng:
+        free_blocks = al.free_blocks
+    assert al.free_blocks == 12
+    # the whole chain is solely-cache-owned: eviction reaches all 3
+    # (leaf-first across passes), so all 3 are headroom-in-waiting
+    assert pc.evictable_count(max_age_s=0) == 3
+    assert AdmissionController.evictable_headroom(_Eng, pc) == 15
+    assert AdmissionController.evictable_headroom(_Eng, None) == 12
+    # a live sequence adopting the first 2 blocks pins them (and the
+    # interior entries above), but the unshared leaf below stays
+    # reachable only through the cache — it alone remains evictable
+    al.acquire(blocks[:2])
+    assert pc.evictable_count(max_age_s=0) == 1
+    al.free(blocks[:2])
+    assert pc.evictable_count(max_age_s=0) == 3
+
+
+def test_cache_warm_replica_still_wins_dispatch():
+    """Regression for the headroom satellite: a replica whose pool is
+    full of solely-cache-owned (evictable) pages must score like a cold
+    one — under the old free-list-only score the router would spill
+    AWAY from the warm cache."""
+    model = _model()
+    rs = ReplicaSet.build(model, 2, ENG_CFG,
+                          {"prefix_cache": {"enabled": True}}, seed=0)
+    router = Router(rs).start()
+    try:
+        # warm r1 through a sticky session: a long shared prompt leaves
+        # its full blocks cache-owned after the request finishes
+        warm = _prompts(model, [33], seed=5)[0]
+        router.submit(warm, SamplingParams(max_new_tokens=2),
+                      session="warm").result(timeout=120)
+        deadline = time.monotonic() + 10
+        while (rs[1].server.prefix_cache is None
+               or rs[1].engine.free_blocks == rs[0].engine.free_blocks) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        warm_rep = next(r for r in rs
+                        if r.server.prefix_cache.cached_blocks > 0)
+        cold_rep = next(r for r in rs if r is not warm_rep)
+        # raw free list differs...
+        assert warm_rep.engine.free_blocks < cold_rep.engine.free_blocks
+        # ...but evictable-aware headroom (and hence the score) does not
+        assert warm_rep.dispatch_headroom == cold_rep.dispatch_headroom
+        assert router._score(warm_rep) == router._score(cold_rep)
+        # one queued request on the cold replica and the warm one WINS
+        with router._lock:
+            router._inflight[cold_rep.index] = \
+                router._inflight.get(cold_rep.index, 0) + 1
+        assert router._choose() is warm_rep
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# disagg end-to-end
+# ---------------------------------------------------------------------------
+
+def test_disagg_e2e_bit_identical_with_zero_copy_second_wave():
+    model = _model(layers=2)
+    prompts = _prompts(model, [9, 13, 6], seed=3)
+    ref = build_engine(model, ENG_CFG, seed=0)
+    want = [ref.generate([p], max_new_tokens=8)[0] for p in prompts]
+
+    rs = ReplicaSet.build(model, 2, ENG_CFG,
+                          {"prefix_cache": {"enabled": True}}, seed=0,
+                          disagg=DISAGG)
+    assert [r.tier for r in rs] == ["prefill", "decode"]
+    router = DisaggRouter(rs).start()
+    try:
+        streams = [router.submit(p, SamplingParams(max_new_tokens=8,
+                                                   speculative=True))
+                   for p in prompts]
+        outs = [s.result(timeout=300) for s in streams]
+        assert outs == want
+        # every request paid one handoff; the first wave moved bytes
+        assert all(s.handoff_ms is not None for s in streams)
+        assert all(s.handoff_bytes > 0 for s in streams)
+        # second wave: the decode replica's prefix cache holds the
+        # chains → adoption is a pure ref acquire, zero bytes move
+        streams2 = [router.submit(p, SamplingParams(max_new_tokens=8,
+                                                    speculative=True))
+                    for p in prompts]
+        assert [s.result(timeout=300) for s in streams2] == want
+        assert all(s.handoff_bytes == 0 for s in streams2)
+        snap = router.snapshot()
+        assert snap["handoffs"] == 6
+        dec = rs[1].server.metrics.snapshot()
+        assert dec["handoffs_in"] == 6 and dec["spec_rounds"] > 0
+        pre = rs[0].server.metrics.snapshot()
+        assert pre["handoffs_out"] == 6
+    finally:
+        router.stop()
+    # refcounts: stop() cleared the caches, every pool returns whole
+    for r in rs:
+        assert _pool_whole(r.engine), r.name
+
+
+def test_disagg_cancel_releases_adopted_chain():
+    model = _model(layers=2)
+    rs = ReplicaSet.build(model, 2, ENG_CFG,
+                          {"prefix_cache": {"enabled": True}}, seed=0,
+                          disagg=DISAGG)
+    router = DisaggRouter(rs).start()
+    try:
+        prompt = _prompts(model, [11], seed=4)[0]
+        # an impossible request fails at SUBMIT (the 1-token prefill leg
+        # must not hide the per-sequence cap until mid-decode)
+        with pytest.raises(ValueError, match="KV blocks"):
+            router.submit(prompt, SamplingParams(max_new_tokens=64))
+        s = router.submit(prompt, SamplingParams(max_new_tokens=48))
+        for _tok in s:      # let the handoff land, then cancel mid-decode
+            break
+        s.cancel()
+        with pytest.raises(RequestCancelled):
+            s.result(timeout=120)
+    finally:
+        router.stop()
+    for r in rs:
+        assert _pool_whole(r.engine), r.name
+
+
+def test_disagg_mid_handoff_kill_reruns_prefill_on_survivor():
+    """Kill the decode replica with adopted chains in flight: the leg
+    fails over and the survivor (the prefill replica, as the last-resort
+    stand-in) re-runs prefill — output bit-identical, nothing leaks."""
+    model = _model(layers=2)
+    prompts = _prompts(model, [9, 12], seed=6)
+    ref = build_engine(model, ENG_CFG, seed=0)
+    want = [ref.generate([p], max_new_tokens=10)[0] for p in prompts]
+
+    rs = ReplicaSet.build(model, 2, ENG_CFG,
+                          {"prefix_cache": {"enabled": True}}, seed=0,
+                          disagg=DISAGG)
+    router = DisaggRouter(rs).start()
+    try:
+        streams = [router.submit(p, SamplingParams(max_new_tokens=10))
+                   for p in prompts]
+        # wait until decode legs stream, then kill the decode replica
+        for s in streams:
+            for _tok in s:
+                break
+        rs[1].kill()
+        outs = [s.result(timeout=300) for s in streams]
+        assert outs == want
+        assert router.metrics.failovers >= 1
+    finally:
+        router.stop()
+    assert _pool_whole(rs[0].engine)
+
+
+def test_disagg_prefill_tier_down_falls_back():
+    """A dead prefill tier must not strand requests: the decode replica
+    serves the prefill leg (and its own decode leg) bit-identically."""
+    model = _model(layers=2)
+    prompt = _prompts(model, [10], seed=7)[0]
+    ref = build_engine(model, ENG_CFG, seed=0)
+    want = ref.generate([prompt], max_new_tokens=8)[0]
+
+    rs = ReplicaSet.build(model, 2, ENG_CFG,
+                          {"prefix_cache": {"enabled": True}}, seed=0,
+                          disagg=DISAGG)
+    router = DisaggRouter(rs).start()
+    try:
+        rs[0].kill()    # the whole prefill tier
+        s = router.submit(prompt, SamplingParams(max_new_tokens=8,
+                                                 speculative=True))
+        assert s.result(timeout=300) == want
+    finally:
+        router.stop()
+
+
+def test_spec_parity_across_prefix_hits_and_failover():
+    """The acceptance test: greedy output with `speculative` enabled is
+    bit-identical to greedy without it — across prefix-cache hits (the
+    second submit adopts cached pages) and a forced mid-stream
+    fail-over of the decode replica."""
+    model = _model(layers=2)
+    prompt = _prompts(model, [14], seed=8)[0]
+    ref = build_engine(model, ENG_CFG, seed=0)
+    want = ref.generate([prompt], max_new_tokens=16)[0]
+
+    disagg = {"enabled": True, "prefill_replicas": 1,
+              "decode_replicas": 2,
+              "speculative": {"enabled": True,
+                              "draft_model": "llama-tiny", "spec_k": 3}}
+    rs = ReplicaSet.build(model, 3, ENG_CFG,
+                          {"prefix_cache": {"enabled": True}}, seed=0,
+                          disagg=disagg)
+    router = DisaggRouter(rs).start()
+    try:
+        # plain greedy, then speculative on a prefix-cache-warm fleet
+        assert router.submit(
+            prompt, SamplingParams(max_new_tokens=16)).result(
+                timeout=300) == want
+        s = router.submit(prompt, SamplingParams(max_new_tokens=16,
+                                                 speculative=True))
+        assert s.result(timeout=300) == want
+        assert s.handoff_bytes == 0     # cache hit: zero-copy adoption
+        # forced mid-stream fail-over with speculation on
+        s = router.submit(prompt, SamplingParams(max_new_tokens=16,
+                                                 speculative=True))
+        it = iter(s)
+        next(it)            # first token: the prefill leg completed
+        # wait for the decode leg to own the stream, then kill its host
+        deadline = time.monotonic() + 30
+        owner = None
+        while owner is None and time.monotonic() < deadline:
+            owner = next((r for r in rs
+                          if r.tier == "decode" and r.server._active),
+                         None)
+            if owner is None:
+                time.sleep(0.02)
+        assert owner is not None, "decode leg never started"
+        owner.kill()
+        assert s.result(timeout=300) == want
+    finally:
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# config hygiene
+# ---------------------------------------------------------------------------
+
+def test_disagg_config_roundtrip_and_rejection():
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        "serving": {"n_replicas": 3,
+                    "disagg": {"enabled": True, "prefill_replicas": 1,
+                               "decode_replicas": 2,
+                               "speculative": {"enabled": True,
+                                               "draft_model": "llama-tiny",
+                                               "spec_k": 5}}},
+    })
+    d = cfg.serving.disagg_config()
+    assert d["prefill_replicas"] == 1 and d["decode_replicas"] == 2
+    assert d["speculative"]["spec_k"] == 5
+    # the dict feeds ReplicaSet.build(disagg=...) directly
+    from deepspeed_tpu.serving import DisaggConfig
+    parsed = DisaggConfig(d)
+    assert parsed.n_replicas == 3 and parsed.tier_of(0) == "prefill"
+    assert parsed.tier_of(2) == "decode"
+    for bad in ({"disagg": {"enabled": True, "prefill_replicas": 2,
+                            "decode_replicas": 2}},       # 4 != n_replicas
+                {"disagg": {"enabled": True, "prefill_replicas": 0,
+                            "decode_replicas": 3}},
+                {"disagg": {"speculative": {"spec_k": 0}}},
+                {"disagg": {"speculative": {"enabled": True}}}):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                             "serving": {"n_replicas": 3, **bad}})
+
+
+def test_sampling_params_speculative_field():
+    import dataclasses
+
+    p = SamplingParams(max_new_tokens=4, speculative=True)
+    assert p.speculative and p.greedy
+    p2 = dataclasses.replace(p, max_new_tokens=2)
+    assert p2.speculative       # survives the router's leg re-shaping
+
+
+def test_build_rejects_tiers_that_dont_fit_devices():
+    model = _model()
+    with pytest.raises(ValueError, match="prefill"):
+        ReplicaSet.build(
+            model, 9, ENG_CFG, seed=0,
+            disagg={"enabled": True, "prefill_replicas": 4,
+                    "decode_replicas": 5})
+    with pytest.raises(ValueError, match="must sum"):
+        ReplicaSet.build(
+            model, 2, ENG_CFG, seed=0,
+            disagg={"enabled": True, "prefill_replicas": 2,
+                    "decode_replicas": 2})

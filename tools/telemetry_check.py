@@ -55,8 +55,9 @@ EXPECTED_RECORD_KEYS = [
 # appear in the docs span table — same contract as the record keys)
 EXPECTED_SPAN_NAMES = [
     "recovery.outage", "router.leg", "router.request",
-    "serve.admission_block", "serve.decode", "serve.prefill",
-    "serve.queue_wait", "serve.request", "serve.step",
+    "serve.admission_block", "serve.decode", "serve.handoff",
+    "serve.prefill", "serve.queue_wait", "serve.request", "serve.step",
+    "spec.draft", "spec.verify",
     "train.data_ingest", "train.dispatch", "train.step", "train.sync",
     "train.telemetry", "v2.ragged_step",
 ]
@@ -64,7 +65,7 @@ EXPECTED_EVENT_NAMES = [
     "recovery.detected", "recovery.replan", "recovery.restart",
     "recovery.resumed", "router.dispatch", "router.failover", "serve.emit",
     "serve.enqueue", "serve.finish", "serve.first_token", "serve.preempt",
-    "serve.prefix_hit", "watchdog.fire",
+    "serve.prefix_hit", "spec.accept", "watchdog.fire",
 ]
 EXPECTED_FLIGHT_REASONS = ["watchdog", "serve_crash", "engine_crash",
                            "manual", "recovery"]
@@ -126,6 +127,23 @@ CAPTURE_REPORT_SCHED_KEYS = ["dominant_collective", "exposed_ms",
 SERVING_DOCS = os.path.join(REPO, "docs", "SERVING.md")
 SERVE_MULTI_BENCH_KEYS = ["agg_tokens_per_sec", "ttft_p95_ms",
                           "prefix_hit_rate", "prefill_tokens_saved"]
+
+# frozen disaggregated-serving vocabulary (serving/disagg.py;
+# docs/SERVING.md "Disaggregated tiers & speculative decoding"): the
+# serve_disagg bench row keys, the scenario load generator's traffic-mix
+# names (bench.py SCENARIO_MIXES), and the replica tier names must each
+# match their module, be documented, and (for bench keys) be literally
+# emitted by bench.py.
+DISAGG_BENCH_KEYS = ["agg_tokens_per_sec_disagg",
+                     "agg_tokens_per_sec_homog", "ttft_p95_ms_disagg",
+                     "ttft_p95_ms_homog", "tpot_p95_ms_disagg",
+                     "tpot_p95_ms_homog", "handoff_ms_p95",
+                     "handoff_bytes_per_req", "spec_accept_rate",
+                     "scenario_mix"]
+EXPECTED_SCENARIO_MIXES = ["burst", "session_heavy",
+                           "shared_system_prompt",
+                           "long_prompt_short_decode"]
+EXPECTED_REPLICA_TIERS = ["prefill", "decode", "unified"]
 
 # frozen static-graph-audit vocabulary (deepspeed_tpu/analysis/report.py;
 # docs/STATIC_ANALYSIS.md): finding kinds, severities, and the audit
@@ -345,6 +363,22 @@ def check_router_serving() -> List[str]:
 
     names = [m.name for m in
              RouterMetrics(n_replicas=2).registry.collect()]
+
+    def _mixes():
+        import importlib.util as _ilu
+
+        spec = _ilu.spec_from_file_location("_dstpu_bench", _BENCH)
+        # bench.py guards backend setup behind --smoke; importing it for
+        # the frozen tuple is safe (no row runs at import)
+        mod = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.SCENARIO_MIXES
+
+    def _tiers():
+        from deepspeed_tpu.serving.disagg import REPLICA_TIERS
+
+        return REPLICA_TIERS
+
     return _vocab_check([
         # registry-derived, so no frozen list — the docs contract only
         VocabSpec(name="router metrics", doc_names=names,
@@ -353,6 +387,16 @@ def check_router_serving() -> List[str]:
         VocabSpec(name="SERVE_MULTI_BENCH_KEYS",
                   expected=SERVE_MULTI_BENCH_KEYS, docs_path=SERVING_DOCS,
                   source_keys=[(_BENCH, SERVE_MULTI_BENCH_KEYS)]),
+        VocabSpec(name="DISAGG_BENCH_KEYS",
+                  expected=DISAGG_BENCH_KEYS, docs_path=SERVING_DOCS,
+                  source_keys=[(_BENCH, DISAGG_BENCH_KEYS)]),
+        VocabSpec(name="bench.SCENARIO_MIXES",
+                  expected=EXPECTED_SCENARIO_MIXES, actual=_mixes,
+                  docs_path=SERVING_DOCS,
+                  source_keys=[(_BENCH, EXPECTED_SCENARIO_MIXES)]),
+        VocabSpec(name="disagg.REPLICA_TIERS",
+                  expected=EXPECTED_REPLICA_TIERS, actual=_tiers,
+                  docs_path=SERVING_DOCS),
     ])
 
 
