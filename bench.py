@@ -807,6 +807,18 @@ _PEAK_LADDER = [
           num_heads=32, max_seq_len=512),
      {"stage": 3, "offload_param": {"device": "nvme"},
       "offload_optimizer": {"device": "nvme"}}, 1500.0),
+    # the 6.7B chunked rung: streamed host params (offload_param cpu) +
+    # the chunked host Adam with its masters+moments on DISK
+    # (offload_optimizer nvme + working_set_bytes) — host RAM holds only
+    # the streamed param partition and O(chunk) optimizer working set,
+    # so the ~80GB host that killed the r04 cpu rung suffices
+    ("gpt2-6.7b-chunked", "gpt2-1.3b",
+     dict(hidden_size=4096, intermediate_size=16384, num_layers=32,
+          num_heads=32, max_seq_len=512),
+     {"stage": 3, "offload_param": {"device": "cpu"},
+      "offload_optimizer": {"device": "nvme",
+                            "working_set_bytes": 1 << 30,
+                            "chunk_bytes": 64 << 20}}, 1500.0),
     ("gpt2-6.7b-nvme", "gpt2-1.3b",
      dict(hidden_size=4096, intermediate_size=16384, num_layers=32,
           num_heads=32, max_seq_len=512),
@@ -820,11 +832,16 @@ _PEAK_LADDER = [
     # cpu (host-RAM) rungs: 6.7B needs ~120GB of remote-host RAM for the
     # fp32 masters+moments (observed r04: compiles and streams, dies
     # RESOURCE_EXHAUSTED at runtime) — the 4B rung fits a ~80GB host
+    # cpu-chunked: masters stay host-RESIDENT but the step runs over
+    # 64MB chunks with double-buffered d2h/h2d, so transfer working set
+    # is O(chunk) and the host Adam overlaps the streams
     ("gpt2-4b-stream", "gpt2-1.3b",
      dict(hidden_size=3072, intermediate_size=12288, num_layers=36,
           num_heads=24, max_seq_len=512),
      {"stage": 3, "offload_param": {"device": "cpu"},
-      "offload_optimizer": {"device": "cpu"}}, 700.0),
+      "offload_optimizer": {"device": "cpu",
+                            "working_set_bytes": 8 << 30,
+                            "chunk_bytes": 64 << 20}}, 700.0),
     ("gpt2-2.7b-stream", "gpt2-1.3b",
      dict(hidden_size=2560, intermediate_size=10240, num_layers=32,
           num_heads=32, max_seq_len=512),
@@ -840,15 +857,40 @@ _PEAK_LADDER = [
 
 def _host_ram_bytes() -> int:
     """Host RAM — the budget cpu-offloaded classes must fit (the
-    offload rungs die in HOST RESOURCE_EXHAUSTED — r04)."""
+    offload rungs die in HOST RESOURCE_EXHAUSTED — r04).  Priced against
+    MemAvailable (what the kernel can actually hand out) minus a 10%
+    safety margin, NOT MemTotal: on a busy host the page cache and other
+    tenants hold a big slice of MemTotal, and a rung admitted against
+    the total dies RESOURCE_EXHAUSTED mid-ladder anyway.  Falls back to
+    MemTotal, then 16 GiB."""
+    total = avail = 0
     try:
         with open("/proc/meminfo", "r", encoding="utf-8") as f:
             for line in f:
-                if line.startswith("MemTotal:"):
-                    return int(line.split()[1]) * 1024
+                if line.startswith("MemAvailable:"):
+                    avail = int(line.split()[1]) * 1024
+                elif line.startswith("MemTotal:"):
+                    total = int(line.split()[1]) * 1024
     except OSError:
         pass
-    return 16 << 30
+    if avail:
+        return int(avail * 0.9)
+    return total or (16 << 30)
+
+
+def _host_peak_bytes() -> int:
+    """Measured host high-water mark (VmHWM) of THIS process — the
+    measured counterpart the ladder records next to the predictor's
+    `predicted_peak_bytes` (read via the CPU accelerator's /proc
+    watermark so bench and telemetry agree on the source)."""
+    try:
+        from deepspeed_tpu.accelerator.cpu_accelerator import \
+            CPU_Accelerator
+
+        return int(CPU_Accelerator().memory_stats(0).get(
+            "peak_bytes_in_use", 0))
+    except Exception:
+        return 0
 
 
 def _memory_budget_bytes() -> int:
@@ -868,10 +910,27 @@ def _memory_budget_bytes() -> int:
 
 
 def _peak_rungs():
-    """(name, base, overrides, zero, seq) per ladder rung (the smoke
-    ladder is the single tiny rung the smoke actually runs)."""
+    """(name, base, overrides, zero, seq) per ladder rung.  The smoke
+    ladder runs three tiny rungs so the plumbing check actually
+    EXECUTES every optimizer tier — fused on-device, cpu-chunked host
+    Adam, and the nvme chunk store — not just the base path."""
     if SMOKE:
-        return [("gpt2-tiny", "gpt2-tiny", {}, {"stage": 0}, 64)]
+        nvme_dir = os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "dstpu_bench_nvme_smoke")
+        return [
+            ("gpt2-tiny", "gpt2-tiny", {}, {"stage": 0}, 64),
+            ("gpt2-tiny-cpu-chunk", "gpt2-tiny", {},
+             {"stage": 2,
+              "offload_optimizer": {"device": "cpu",
+                                    "working_set_bytes": 1,
+                                    "chunk_bytes": 1 << 16}}, 64),
+            ("gpt2-tiny-nvme-chunk", "gpt2-tiny", {},
+             {"stage": 2,
+              "offload_optimizer": {"device": "nvme",
+                                    "nvme_path": nvme_dir,
+                                    "working_set_bytes": 1,
+                                    "chunk_bytes": 1 << 16}}, 64),
+        ]
     return [(name, base, over, zero, 512)
             for name, base, over, zero, _ in _PEAK_LADDER]
 
@@ -899,7 +958,12 @@ def _ladder_predictions() -> list:
         # budget (they are the POINT of the offload rungs) — cpu-homed
         # state is priced against host RAM instead, nvme is unbounded
         off_p = (zero.get("offload_param") or {}).get("device")
-        off_o = (zero.get("offload_optimizer") or {}).get("device")
+        off_o_cfg = zero.get("offload_optimizer") or {}
+        off_o = off_o_cfg.get("device")
+        # chunked rungs price the O(chunk) pinned working set instead of
+        # the whole fp32 state (the nvme tier's host need IS the chunk)
+        chunk = (off_o_cfg.get("chunk_bytes")
+                 if off_o_cfg.get("working_set_bytes") else None)
         pred = predict_fit(
             ModelInfo(num_params=prof["params"],
                       hidden_size=model.hidden_size,
@@ -908,8 +972,9 @@ def _ladder_predictions() -> list:
             int(zero.get("stage", 0)), dp_size=1, micro_batch=1,
             seq_len=seq, hbm_bytes=budget, calibration=cal,
             offload_param=off_p, offload_optimizer=off_o,
+            chunk_bytes=chunk,
             host_bytes=_host_ram_bytes()
-            if "cpu" in (off_p, off_o) else None)
+            if ("cpu" in (off_p, off_o) or chunk) else None)
         preds.append({
             "rung": name,
             "predicted_peak_bytes": pred["predicted_peak_bytes"],
@@ -925,8 +990,7 @@ def _peak_entry(idx: int) -> dict:
     from deepspeed_tpu.models import get_model_config
 
     if SMOKE:
-        name, base, over, zero = "gpt2-tiny", "gpt2-tiny", {}, {"stage": 0}
-        seq = 64
+        name, base, over, zero, seq = _peak_rungs()[idx]
     else:
         name, base, over, zero, _ = _PEAK_LADDER[idx]
         seq = 512
@@ -954,7 +1018,18 @@ def _peak_entry(idx: int) -> dict:
 
     n_params = sum(int(np.prod(x.shape))
                    for x in jax.tree_util.tree_leaves(engine.params))
-    return {"name": name, "params_m": round(n_params / 1e6, 1)}
+    entry = {"name": name, "params_m": round(n_params / 1e6, 1),
+             # measured VmHWM next to the predictor's number — the
+             # ladder's predicted-vs-measured host story per rung
+             "host_peak_bytes": _host_peak_bytes(),
+             "offload_overlap_fraction":
+                 getattr(engine, "_last_offload_overlap", None)}
+    if SMOKE:
+        # smoke runs every rung in ONE process — tear down between rungs
+        # or the next engine inherits this one's mesh and swap pools
+        engine.destroy()
+        _reset_topology()
+    return entry
 
 
 def row_peak_params():
@@ -971,10 +1046,19 @@ def row_peak_params():
     best = None
     best_idx = None
     if SMOKE:
-        best = _peak_entry(0)
-        best_idx = 0
-        preds[0]["ran"] = True
-        preds[0]["fit"] = True
+        # run EVERY smoke rung (base, cpu-chunked, nvme-chunked) so the
+        # plumbing check exercises all three optimizer tiers; the base
+        # rung stays the reported metric for comparability
+        for i in range(len(preds)):
+            entry = _peak_entry(i)
+            preds[i]["ran"] = True
+            preds[i]["fit"] = True
+            preds[i]["host_peak_bytes"] = entry["host_peak_bytes"]
+            preds[i]["offload_overlap_fraction"] = \
+                entry["offload_overlap_fraction"]
+            if best is None:
+                best = entry
+                best_idx = i
     else:
         import subprocess
 
@@ -998,6 +1082,9 @@ def row_peak_params():
                     break
             preds[i]["fit"] = best is not None
             if best:
+                preds[i]["host_peak_bytes"] = best.get("host_peak_bytes")
+                preds[i]["offload_overlap_fraction"] = \
+                    best.get("offload_overlap_fraction")
                 best_idx = i
                 break
     if best is None:
@@ -1013,6 +1100,8 @@ def row_peak_params():
         "model": best["name"],
         "predicted_peak_bytes": preds[best_idx]["predicted_peak_bytes"],
         "predicted_fit": preds[best_idx]["predicted_fit"],
+        "host_peak_bytes": best.get("host_peak_bytes"),
+        "offload_overlap_fraction": best.get("offload_overlap_fraction"),
         "ladder": preds,
         "telemetry_jsonl": _telemetry_jsonl("peak_params"),
         "trace_json": _trace_json("peak_params"),

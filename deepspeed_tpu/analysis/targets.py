@@ -160,6 +160,20 @@ def prep_train_fused_gather() -> PreparedTarget:
         step_schedule={"fused_gather_matmul": True})
 
 
+def prep_train_offload_cpu() -> PreparedTarget:
+    """Chunked host-optimizer twin (peak_params cpu-chunked rung):
+    working_set_bytes forces the ChunkedHostOptimizer, so the audited
+    program is the fwd+bwd grads batch — params and moments never enter
+    the device program, which is the memory claim the chunked tier
+    makes.  The frozen budget pins that the device footprint stays
+    params+activations-sized."""
+    return _prep_train(
+        "train_offload_cpu",
+        zero_optimization={"stage": 2,
+                           "offload_optimizer": {"device": "cpu",
+                                                 "working_set_bytes": 1}})
+
+
 def prep_train_resumed() -> PreparedTarget:
     """Self-healing resume twin (chaos_recovery row): state saved under
     a pure-data mesh is universally reloaded onto a data×tensor
@@ -311,6 +325,7 @@ TARGET_PREPARERS: Dict[str, Callable[[], PreparedTarget]] = {
     "train_autosched": prep_train_autosched,
     "train_fused_rs": prep_train_fused_rs,
     "train_fused_gather": prep_train_fused_gather,
+    "train_offload_cpu": prep_train_offload_cpu,
     "train_resumed": prep_train_resumed,
     "ring_attention": prep_ring_attention,
     "ring_attention_quant": prep_ring_attention_quant,

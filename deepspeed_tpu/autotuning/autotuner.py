@@ -137,7 +137,8 @@ def predict_fit(model_info: ModelInfo, zero_stage: int, dp_size: int,
                 tp_size: int = 1, pp_size: int = 1, sp_size: int = 1,
                 offload_param: Optional[str] = None,
                 offload_optimizer: Optional[str] = None,
-                host_bytes: Optional[int] = None) -> Dict[str, Any]:
+                host_bytes: Optional[int] = None,
+                chunk_bytes: Optional[int] = None) -> Dict[str, Any]:
     """The OOM-before-you-run gate: calibrated per-device peak estimate
     vs the device budget, with the dominant class and shortfall when it
     does NOT fit — so a too-big ladder rung reports *why* instead of
@@ -151,7 +152,15 @@ def predict_fit(model_info: ModelInfo, zero_stage: int, dp_size: int,
     counting against ``hbm_bytes``.  Classes homed on ``"cpu"`` are
     instead priced against ``host_bytes`` when the caller provides it
     (the r04 ladder died in HOST resource exhaustion, not HBM); NVMe
-    classes are treated as unbounded."""
+    classes are treated as unbounded.
+
+    ``chunk_bytes`` prices the chunked host-step pipeline
+    (``offload_optimizer.working_set_bytes > 0``): grads stay
+    device-homed (the grads program materializes them in HBM/host-placed
+    shardings and only O(chunk) crosses at a time), the cpu tier adds a
+    double-buffered working set (grad chunk + the (3,n) state rows, two
+    buffers deep) to the host need, and the nvme tier's host need is
+    ONLY that working set — the state itself lives in chunk files."""
     bd = estimate_memory_breakdown(model_info, zero_stage, dp_size,
                                    micro_batch, seq_len, dtype,
                                    tp_size=tp_size, pp_size=pp_size,
@@ -163,17 +172,26 @@ def predict_fit(model_info: ModelInfo, zero_stage: int, dp_size: int,
         home["grads"] = offload_optimizer
     if offload_param:
         home["params"] = offload_param
+    chunk_working_set = 0
+    if chunk_bytes and offload_optimizer in ("cpu", "nvme"):
+        home["grads"] = "device"
+        # per buffered chunk: 1 grad row + 3 state rows, double-buffered
+        chunk_working_set = int(2 * 4 * chunk_bytes)
     device_classes = [k for k, h in home.items() if h == "device"]
     host_classes = [k for k, h in home.items() if h == "cpu"]
     predicted = int(sum(bd[k] for k in device_classes) * cal)
     host_need = int(sum(bd[k] for k in host_classes) * cal)
+    # (nvme-homed state never entered host_classes, so the nvme tier's
+    # host need is exactly this working set)
+    host_need += chunk_working_set
     fit_device = predicted <= int(hbm_bytes)
     fit_host = host_bytes is None or host_need <= int(host_bytes)
     if not fit_device:
         dominant = max(device_classes, key=lambda k: bd[k])
         shortfall = predicted - int(hbm_bytes)
     elif not fit_host:
-        dominant = max(host_classes, key=lambda k: bd[k])
+        dominant = (max(host_classes, key=lambda k: bd[k])
+                    if host_classes else "optimizer")
         shortfall = host_need - int(host_bytes)
     else:
         dominant = max((k for k in bd if k != "total"),
@@ -185,6 +203,7 @@ def predict_fit(model_info: ModelInfo, zero_stage: int, dp_size: int,
         "hbm_bytes": int(hbm_bytes),
         "host_bytes": None if host_bytes is None else int(host_bytes),
         "host_resident_bytes": host_need,
+        "chunk_working_set_bytes": chunk_working_set,
         "calibration": round(cal, 4),
         "breakdown": bd,
         "dominant_class": dominant,

@@ -1,5 +1,6 @@
 """NVMe/AIO performance tuning (ref deepspeed/nvme/)."""
 
+from deepspeed_tpu.nvme.chunk_store import HostChunkStore, NVMeChunkStore
 from deepspeed_tpu.nvme.perf_sweep import run_sweep, sweep_main
 
-__all__ = ["run_sweep", "sweep_main"]
+__all__ = ["HostChunkStore", "NVMeChunkStore", "run_sweep", "sweep_main"]

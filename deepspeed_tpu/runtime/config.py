@@ -139,6 +139,15 @@ class OffloadOptimizerConfig:
     # rollback-on-overflow
     super_offload: bool = False
     cpuadam_cores_perc: float = 0.8
+    # Chunked host optimizer pipeline (ZeRO-Offload chunked CPU Adam +
+    # ZeRO-Infinity NVMe chunk tier; runtime/offload.ChunkedHostOptimizer).
+    # working_set_bytes > 0 opts in: when the fp32 optimizer state
+    # (12 B/param) exceeds this budget, the Adam step runs on the host over
+    # fixed chunk_bytes chunks with double-buffered device↔host streams —
+    # peak host residency O(chunk), not O(state).  0 keeps the legacy
+    # whole-state streaming/store paths.
+    chunk_bytes: int = 64 << 20
+    working_set_bytes: int = 0
 
 
 @dataclass

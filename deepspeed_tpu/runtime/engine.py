@@ -516,6 +516,10 @@ class DeepSpeedEngine:
                        "SuperOffload" if (off_opt_pre is not None
                                           and off_opt_pre.super_offload)
                        else
+                       "chunked host optimizer"
+                       if (off_opt_pre is not None
+                           and off_opt_pre.device in ("cpu", "nvme")
+                           and off_opt_pre.working_set_bytes > 0) else
                        "NVMe optimizer store" if (off_opt_pre is not None
                                                   and off_opt_pre.device
                                                   == "nvme") else
@@ -621,7 +625,22 @@ class DeepSpeedEngine:
                 "offload_optimizer.super_offload cannot combine with "
                 "offload_param streaming (ZeRO-Infinity already steps the "
                 "streamed partition host-side); drop one of the two")
-        if off_opt and off_opt.device == "cpu" and off_opt.super_offload \
+        # Chunked host optimizer pipeline (runtime/offload.
+        # ChunkedHostOptimizer): opted in via working_set_bytes > 0, taken
+        # only when the fp32 state (12 B/param) actually exceeds the
+        # budget — smaller models keep the legacy streaming/store paths.
+        self._chunked_opt = bool(
+            off_opt and off_opt.device in ("cpu", "nvme")
+            and not off_opt.super_offload
+            and off_opt.working_set_bytes > 0
+            and 12 * n_params > off_opt.working_set_bytes)
+        if self._chunked_opt:
+            log_dist(f"ZeRO-Offload chunked: host Adam over "
+                     f"{off_opt.chunk_bytes >> 20}MB chunks "
+                     f"(tier={off_opt.device}, state="
+                     f"{12 * n_params >> 20}MB > working set="
+                     f"{off_opt.working_set_bytes >> 20}MB)")
+        elif off_opt and off_opt.device == "cpu" and off_opt.super_offload \
                 and not self._param_stream:
             # SuperOffload (ref engine.py:935 + superoffload_stage3.py):
             # the full fp32 master + moments live on the host; the step is
@@ -688,7 +707,46 @@ class DeepSpeedEngine:
                                                          prefix="param")
                 log_dist(f"ZeRO-Infinity: layer params → NVMe at {swap_dir}")
 
-        if off_opt and off_opt.device == "cpu" and off_opt.super_offload \
+        if self._chunked_opt:
+            from deepspeed_tpu.runtime.offload import ChunkedHostOptimizer
+
+            opt_type = (cfg.optimizer.type if cfg.optimizer else "adamw").lower()
+            if opt_type not in ("adam", "adamw", "fusedadam"):
+                raise DeepSpeedConfigError(
+                    f"offload_optimizer.working_set_bytes (chunked host "
+                    f"step) supports Adam/AdamW only, got "
+                    f"optimizer.type={opt_type!r}")
+            op = (cfg.optimizer.params if cfg.optimizer else {})
+            store = None
+            if off_opt.device == "nvme":
+                from deepspeed_tpu.nvme.chunk_store import NVMeChunkStore
+
+                swap_dir = off_opt.nvme_path or os.path.join(
+                    os.environ.get("TMPDIR", "/tmp"), "dstpu_nvme_swap")
+                store = NVMeChunkStore(swap_dir, cfg.aio_config,
+                                       buffer_count=off_opt.buffer_count)
+                log_dist(f"ZeRO-Infinity: optimizer chunks → NVMe at "
+                         f"{swap_dir}")
+            # rides the _super_opt slot: the grads-only device program,
+            # the host-stepped train_batch, and the superoffload
+            # checkpoint format are all shared with SuperOffload.
+            # adamw/wd defaults MIRROR build_optimizer's fused chain
+            # (adam_w_mode defaults True, AdamW wd defaults 0.01) — the
+            # chunked host step must be numerically the same update the
+            # fused path would have applied
+            adamw = (opt_type == "adamw"
+                     or bool(op.get("adam_w_mode", True)))
+            self._super_opt = ChunkedHostOptimizer(
+                self.params, lr=self.base_lr,
+                betas=tuple(op.get("betas", (0.9, 0.999))),
+                eps=float(op.get("eps", 1e-8)),
+                weight_decay=float(op.get("weight_decay",
+                                          0.01 if adamw else 0.0)),
+                chunk_bytes=off_opt.chunk_bytes,
+                adamw=adamw,
+                store=store)
+            self.opt_state = None  # host/NVMe chunks are authoritative
+        elif off_opt and off_opt.device == "cpu" and off_opt.super_offload \
                 and not self._param_stream:
             from deepspeed_tpu.runtime.superoffload import SuperOffloadOptimizer
 
@@ -722,7 +780,7 @@ class DeepSpeedEngine:
                                    out_shardings=self.opt_shardings)
             self.opt_state = opt_init_jit(self.params)
 
-        if off_opt and off_opt.device == "nvme":
+        if off_opt and off_opt.device == "nvme" and not self._chunked_opt:
             from deepspeed_tpu.runtime.offload import NVMeOptimizerSwapper
 
             swap_dir = off_opt.nvme_path or os.path.join(
@@ -825,6 +883,12 @@ class DeepSpeedEngine:
                         else NULL_TRACER)
         self._train_trace_id = (self._tracer.new_trace_id()
                                 if self._tracer.enabled else "")
+        if self._super_opt is not None and hasattr(self._super_opt,
+                                                   "_tracer"):
+            # chunked host optimizer (built before telemetry exists): its
+            # pipeline stages emit the offload.* spans through this tracer
+            self._super_opt._tracer = self._tracer
+            self._super_opt._trace_id = self._train_trace_id
         self._step_span = None
         # created here, armed per-step from train_batch: monitoring only
         # covers time spent *inside* a step (eval/checkpoint gaps are
@@ -1725,6 +1789,9 @@ class DeepSpeedEngine:
         if self._swap_pool is not None:
             self._swap_pool.shutdown(wait=True)
             self._swap_pool = None
+        so = self._super_opt
+        if so is not None and hasattr(so, "close"):
+            so.close()  # chunked pipeline: drain d2h/h2d pools + NVMe IO
 
     def __del__(self):  # best-effort: destroy() is the real API
         try:
@@ -2076,7 +2143,9 @@ class DeepSpeedEngine:
                 loss_scale=_f("loss_scale"),
                 skipped=bool(np.asarray(skipped)) if skipped is not None
                 else False,
-                comm=self._comm_delta())
+                comm=self._comm_delta(),
+                offload_overlap_fraction=getattr(
+                    self, "_last_offload_overlap", None))
 
     def _comm_delta(self):
         """Comm volume since THIS engine's construction (the CommsLogger
@@ -2109,12 +2178,14 @@ class DeepSpeedEngine:
         compile the train step WITHOUT running it.  ``data`` defaults to
         a zero-filled batch of the configured geometry (the auditor only
         reads shapes).  Donated example buffers are never consumed: AOT
-        ``lower()``/``compile()`` does not execute."""
-        if self._super_opt is not None or self._opt_store is not None:
-            raise ValueError(
-                "audit_step_args: the host/NVMe-resident optimizer paths "
-                "split the step across several programs — audit the "
-                "fused-step variant of this config instead")
+        ``lower()``/``compile()`` does not execute.
+
+        Host-stepped paths are auditable too: with a SuperOffload/chunked
+        optimizer mounted the device-side program IS the grads batch
+        (params, batch stack, loss-scale scalar) — the Adam update runs
+        on the host and owns no HBM; with an offload store the fused step
+        is lowered against the store's state staged at the device
+        shardings, exactly what the non-pipelined step path executes."""
         if data is None:
             mc = self.model_config
             if mc is None:
@@ -2131,8 +2202,15 @@ class DeepSpeedEngine:
         batch_stack = self._maybe_add_dropout_key(batch_stack)
         batch_stack = self._put_batch(batch_stack, stacked=True)
         lr = jnp.float32(self.lr_scheduler(self.global_steps))
+        if self._super_opt is not None:
+            return (self._grads_batch_jit,
+                    (self.params, batch_stack,
+                     self.loss_scale_state["scale"]))
+        opt_state = self.opt_state
+        if self._opt_store is not None:
+            opt_state = self._swap_in_opt_state()
         return (self._train_step_jit,
-                self._train_step_args(self.opt_state, batch_stack, lr))
+                self._train_step_args(opt_state, batch_stack, lr))
 
     def audit_arg_categories(self):
         """Memory-class manifest for the ``audit_step_args`` tuple — one
@@ -2142,6 +2220,9 @@ class DeepSpeedEngine:
         memory auditor can classify every flat parameter buffer by its
         tree-path subtree (the same name manifests the PartitionOracle
         exposes)."""
+        if self._super_opt is not None:
+            # grads-program signature: params, batch stack, scale scalar
+            return ("params", "activations", "other")
         cats = ["params", "opt_state", "opt_state"]
         if self._comm_quant_state is not None:
             cats.append("grads")    # error-feedback residual, grad units
@@ -2234,6 +2315,7 @@ class DeepSpeedEngine:
         batch_stack = self._maybe_add_pld(batch_stack)
         batch_stack = self._maybe_add_dropout_key(batch_stack)
         batch_stack = self._put_batch(batch_stack, stacked=True)
+        self._swap_in_params()  # chunked mode can ride the NVMe param tier
         lr = float(self.lr_scheduler(self.global_steps))
         gas = self.gradient_accumulation_steps_value
         scale = self.loss_scale_state["scale"]
@@ -2260,6 +2342,12 @@ class DeepSpeedEngine:
             self.params = self._super_opt.step(self.params, grads,
                                                grad_scale=coef)
         self._super_last_skipped = not finite_v
+        # chunked pipeline: how much of the d2h/h2d transfer time the host
+        # Adam hid this step (None on plain SuperOffload → field omitted)
+        self._last_offload_overlap = getattr(
+            self._super_opt, "last_overlap_fraction", None)
+        self._swap_out_params()
+        self._prefetch_stores()
         self._advance_loss_scale_host(finite_v)
         self.global_steps += 1
         self.micro_steps += gas

@@ -294,7 +294,9 @@ class Telemetry:
                           lr: Optional[float] = None,
                           loss_scale: Optional[float] = None,
                           skipped: bool = False,
-                          comm: Optional[Dict] = None) -> StepRecord:
+                          comm: Optional[Dict] = None,
+                          offload_overlap_fraction: Optional[float] = None
+                          ) -> StepRecord:
         self._steps += 1
         self._skipped += int(bool(skipped))
         self._tokens += int(tokens)
@@ -307,6 +309,7 @@ class Telemetry:
             flops_source=self._flops_source,
             goodput=goodput, skipped=bool(skipped),
             loss=loss, grad_norm=grad_norm, lr=lr, loss_scale=loss_scale,
+            offload_overlap_fraction=offload_overlap_fraction,
             hbm=collect_hbm_stats(),
             comm=comm if comm is not None else self._comm_totals())
         self._update_registry(rec)

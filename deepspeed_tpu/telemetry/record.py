@@ -17,7 +17,7 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 # bf16 peak FLOP/s by TPU device kind (matmul peak; the MFU denominator).
 # Sources: public TPU spec sheets; v5e figure matches bench.py's 197e12.
@@ -104,6 +104,9 @@ class StepRecord:
     grad_norm: Optional[float] = None
     lr: Optional[float] = None
     loss_scale: Optional[float] = None
+    # chunked offload pipeline: fraction of the d2h/h2d transfer time the
+    # host optimizer step hid this step (None off the chunked path)
+    offload_overlap_fraction: Optional[float] = None
     # memory watermarks: {"device_0": {"bytes_in_use": ..,
     #                                  "peak_bytes_in_use": ..}, ...}
     hbm: Dict[str, Dict[str, int]] = field(default_factory=dict)

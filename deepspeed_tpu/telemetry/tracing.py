@@ -43,6 +43,9 @@ from typing import Any, Dict, List, Optional
 
 # Duration spans (Chrome "X" complete events).
 SPAN_NAMES = (
+    "offload.d2h",             # chunked offload: grad chunk device->host
+    "offload.h2d",             # chunked offload: updated leaf host->device
+    "offload.host_step",       # chunked offload: host Adam on one chunk
     "recovery.outage",         # detection -> resumed progress (supervisor)
     "router.leg",              # one replica attempt of a routed request
     "router.request",          # whole routed-request lifetime (root span)

@@ -41,19 +41,22 @@ from deepspeed_tpu.analysis.vocab import check_all as _vocab_check  # noqa: E402
 
 DOCS = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 
-# frozen with schema version 1 — tools/telemetry_check.py is the tripwire
-EXPECTED_SCHEMA_VERSION = 1
+# frozen with schema version 2 (v2 added offload_overlap_fraction for
+# the chunked host-optimizer pipeline) — telemetry_check is the tripwire
+EXPECTED_SCHEMA_VERSION = 2
 EXPECTED_RECORD_KEYS = [
     "achieved_flops_per_sec", "comm", "flops_per_step", "flops_source",
     "goodput", "grad_norm", "hbm", "kind", "loss", "loss_scale", "lr",
-    "mfu", "peak_flops_per_sec", "schema", "serving", "skipped", "step",
-    "tokens", "tokens_per_sec", "wall_time_s",
+    "mfu", "offload_overlap_fraction", "peak_flops_per_sec", "schema",
+    "serving", "skipped", "step", "tokens", "tokens_per_sec",
+    "wall_time_s",
 ]
 
 # frozen tracing vocabulary (telemetry/tracing.py SPAN_NAMES/EVENT_NAMES
 # and telemetry/flight.py FLIGHT_REASONS must match, and every name must
 # appear in the docs span table — same contract as the record keys)
 EXPECTED_SPAN_NAMES = [
+    "offload.d2h", "offload.h2d", "offload.host_step",
     "recovery.outage", "router.leg", "router.request",
     "serve.admission_block", "serve.decode", "serve.handoff",
     "serve.prefill", "serve.queue_wait", "serve.request", "serve.step",
@@ -185,6 +188,17 @@ EXPECTED_BUDGET_KEYS = ["bucketed_peak_bytes", "budget_bytes",
                         "peak_bytes"]
 EXPECTED_CALIBRATION_KEYS = ["analytic_bytes", "measured_bytes", "ratio"]
 MEMORY_BENCH_KEYS = ["predicted_peak_bytes", "predicted_fit"]
+
+# frozen host-tiered offload vocabulary (runtime/offload.py
+# ChunkedHostOptimizer + nvme/chunk_store.py; docs/OFFLOAD.md): the
+# peak_params ladder's measured per-rung host keys must be emitted by
+# bench.py and documented, and the chunked config knobs must be real
+# OffloadOptimizerConfig fields documented in the offload doc — same
+# tripwire contract as every other vocabulary.
+OFFLOAD_DOCS = os.path.join(REPO, "docs", "OFFLOAD.md")
+OFFLOAD_BENCH_KEYS = ["host_peak_bytes", "offload_overlap_fraction"]
+OFFLOAD_CONFIG_KEYS = ["buffer_count", "chunk_bytes", "nvme_path",
+                       "working_set_bytes"]
 
 # frozen recovery vocabulary (resilience/supervisor.py RECOVERY_STATES;
 # docs/ELASTICITY.md): the supervisor's state machine and the chaos
@@ -532,6 +546,31 @@ def check_recovery() -> List[str]:
     ]) + _cross_link(DOCS, "ELASTICITY.md", "recovery")
 
 
+def check_offload() -> List[str]:
+    """Host-tiered offload vocabulary: the ladder's measured host keys
+    (`host_peak_bytes` next to the predictor's number, plus the overlap
+    fraction) are emitted by bench.py and documented in docs/OFFLOAD.md,
+    the chunked config knobs are real OffloadOptimizerConfig fields and
+    documented, and the observability doc cross-links the offload doc
+    from its offload span rows."""
+    from dataclasses import fields as dc_fields
+
+    def _cfg_keys():
+        from deepspeed_tpu.runtime.config import OffloadOptimizerConfig
+
+        have = {f.name for f in dc_fields(OffloadOptimizerConfig)}
+        return sorted(k for k in OFFLOAD_CONFIG_KEYS if k in have)
+
+    return _vocab_check([
+        VocabSpec(name="OFFLOAD_BENCH_KEYS", expected=OFFLOAD_BENCH_KEYS,
+                  docs_path=OFFLOAD_DOCS,
+                  source_keys=[(_BENCH, OFFLOAD_BENCH_KEYS)]),
+        VocabSpec(name="OffloadOptimizerConfig chunked keys",
+                  expected=OFFLOAD_CONFIG_KEYS, actual=_cfg_keys,
+                  docs_path=OFFLOAD_DOCS),
+    ]) + _cross_link(DOCS, "OFFLOAD.md", "offload")
+
+
 def validate_chrome_trace(obj: Any) -> List[str]:
     """Structural validation of a Chrome trace-event JSON object (pass a
     path or the loaded dict).  Perfetto/chrome://tracing both accept the
@@ -601,7 +640,7 @@ def run_all() -> List[str]:
             + check_quant_comm() + check_ring_bench()
             + check_router_serving() + check_autotuning()
             + check_graph_audit() + check_memory_audit()
-            + check_recovery() + check_trace_export())
+            + check_offload() + check_recovery() + check_trace_export())
 
 
 def main() -> int:
