@@ -105,6 +105,74 @@ def _span_breakdown(tracer, names) -> dict:
             for short, name in names.items()}
 
 
+def _resolved_config(config: dict, serving: dict = None) -> dict:
+    """The row's pinned placement decisions as one machine-readable blob
+    written next to the metrics (docs/PLANNER.md "Regression gate"):
+    mesh, ZeRO stage, comm wire, step_schedule, offload tier — so the
+    planner's known-good gate reads what a row ACTUALLY ran, not a
+    hand-copied approximation.  The blob is fragment-shaped: it feeds
+    ``planner.rank.plan_rank_of`` directly."""
+    z = dict(config.get("zero_optimization") or {})
+    out = {
+        "mesh": dict(config.get("mesh") or {"data": 1}),
+        "train_micro_batch_size_per_gpu": int(
+            config.get("train_micro_batch_size_per_gpu", 1)),
+        "gradient_accumulation_steps": int(
+            config.get("gradient_accumulation_steps", 1)),
+        "zero_optimization": {"stage": int(z.get("stage", 0))},
+    }
+    for key in ("offload_param", "offload_optimizer"):
+        if z.get(key):
+            out["zero_optimization"][key] = {
+                k: v for k, v in dict(z[key]).items()
+                if k in ("device", "chunk_bytes", "working_set_bytes")}
+    for key in ("comm_quantization", "step_schedule"):
+        if config.get(key):
+            out[key] = json.loads(json.dumps(config[key]))
+    if serving:
+        out["serving"] = json.loads(json.dumps(serving))
+    return out
+
+
+# the known-good pinned configs at the canonical 8-chip fleet — single
+# source for the planner regression gate (tests/test_planner.py asserts
+# each ranks top-3 in its row-mirroring query, planner/audit.py) and for
+# the 6.7B offload rung the planner must propose sight-unseen.  Shapes
+# mirror the rows' real non-smoke configs above/below.
+PINNED_ROW_CONFIGS = {
+    "gpt2_350m": {
+        "mesh": {"data": 8},
+        "zero_optimization": {"stage": 1},
+    },
+    "gpt2_350m_commquant": {
+        "mesh": {"data": 8},
+        "zero_optimization": {"stage": 1},
+        "comm_quantization": {"enabled": True, "grad_reduce": "int8"},
+    },
+    "gpt2_350m_autosched": {
+        "mesh": {"data": 8},
+        "zero_optimization": {"stage": 3},
+        "step_schedule": {"mode": "pinned", "gather_prefetch_depth": 2,
+                          "param_persistence_threshold": 100_000},
+    },
+    "longseq_ring": {
+        "mesh": {"seq": 8},
+        "zero_optimization": {"stage": 2},
+    },
+    # the peak_params ladder's chunked rung (_PEAK_LADDER
+    # gpt2-6.7b-chunked): streamed host params + chunked NVMe optimizer
+    "gpt2_6_7b_chunked": {
+        "mesh": {"data": 1},
+        "zero_optimization": {
+            "stage": 3,
+            "offload_param": {"device": "cpu"},
+            "offload_optimizer": {"device": "nvme",
+                                  "working_set_bytes": 1 << 30,
+                                  "chunk_bytes": 64 << 20}},
+    },
+}
+
+
 def _fwd_flops_per_tok(model, seq):
     """Model fwd FLOPs/token: qkvo (GQA-aware) + ffn + lm_head + attn."""
     h, L, V = model.hidden_size, model.num_layers, model.vocab_size
@@ -168,6 +236,7 @@ def row_gpt2_350m():
         "telemetry_jsonl": _telemetry_jsonl("gpt2_350m"),
         "trace_json": _trace_json("gpt2_350m"),
         "span_ms": span_ms,
+        "resolved_config": _resolved_config(config),
     }
 
 
@@ -218,7 +287,7 @@ def _commquant_once(wire: str, steps: int):
     engine.destroy()
     _reset_topology()
     tps = run_steps * rows * seq / dt / max(1, n)
-    return tps, losses, grad_bytes
+    return tps, losses, grad_bytes, _resolved_config(config)
 
 
 def _commquant_body():
@@ -229,8 +298,8 @@ def _commquant_body():
     reports the measured grad-reduce byte reduction AND the N-step
     loss-curve delta vs the fp32 reduce (docs/QUANTIZED_COMM.md)."""
     steps = 3 if SMOKE else 8
-    tps_q, losses_q, bytes_q = _commquant_once("int8", steps)
-    tps_f, losses_f, bytes_f = _commquant_once("fp32", steps)
+    tps_q, losses_q, bytes_q, resolved = _commquant_once("int8", steps)
+    tps_f, losses_f, bytes_f, _ = _commquant_once("fp32", steps)
     loss_delta = max(abs(a - b) for a, b in zip(losses_q, losses_f))
     return {
         "metric": "gpt2_350m_commquant_int8_train_tokens_per_sec_per_chip",
@@ -245,6 +314,7 @@ def _commquant_body():
         "loss_final_int8": round(losses_q[-1], 5),
         "telemetry_jsonl": _telemetry_jsonl("gpt2_350m_commquant_int8"),
         "trace_json": _trace_json("gpt2_350m_commquant_int8"),
+        "resolved_config": resolved,
     }
 
 
@@ -422,6 +492,7 @@ def _autosched_body():
         **fused_ab,
         "telemetry_jsonl": _telemetry_jsonl(name),
         "trace_json": _trace_json(name),
+        "resolved_config": _resolved_config(tuned_cfg),
     }
 
 
@@ -514,6 +585,7 @@ def row_llama8b_class_zero3():
         "mfu": round(_mfu(tps, model, seq_eff), 3),
         "telemetry_jsonl": _telemetry_jsonl("llama8b_class_zero3"),
         "trace_json": _trace_json("llama8b_class_zero3"),
+        "resolved_config": _resolved_config(config),
     }
 
 
@@ -558,6 +630,7 @@ def _longseq_row(model, seed: int, label: str, steps: int = 3):
         "mfu": round(mfu, 3),
         "telemetry_jsonl": _telemetry_jsonl(f"longseq_{label}"),
         "trace_json": _trace_json(f"longseq_{label}"),
+        "resolved_config": _resolved_config(config),
     }
 
 
@@ -753,6 +826,7 @@ def _longseq_ring_body():
         **wire_ab,
         "telemetry_jsonl": _telemetry_jsonl("longseq_ring"),
         "trace_json": _trace_json("longseq_ring"),
+        "resolved_config": _resolved_config(config),
     }
 
 
@@ -1105,6 +1179,10 @@ def row_peak_params():
         "ladder": preds,
         "telemetry_jsonl": _telemetry_jsonl("peak_params"),
         "trace_json": _trace_json("peak_params"),
+        "resolved_config": _resolved_config({
+            "train_micro_batch_size_per_gpu": 1,
+            "gradient_accumulation_steps": 1,
+            "zero_optimization": _peak_rungs()[best_idx][3]}),
     }
 
 
@@ -1197,6 +1275,8 @@ def row_v2_decode():
         "bf16_tokens_per_sec": round(tps, 1),
         "int8_kv_tokens_per_sec": round(tps_int8, 1),
         "prefill_tokens_per_sec": round(prefill_tps, 1),
+        "resolved_config": _resolved_config(
+            {}, serving={"n_replicas": 1, "engine": eng_cfg}),
     }
 
 
@@ -1277,6 +1357,8 @@ def row_serve_load():
         "tpot_p50_ms": round(snap["tpot"]["p50"] * 1e3, 2),
         "preemption_rate": round(snap["preemptions"] / n_req, 3),
         "completed": snap["completed"],
+        "resolved_config": _resolved_config(
+            {}, serving={"n_replicas": 1, "engine": eng_cfg}),
     }
 
 
@@ -1360,6 +1442,9 @@ def _serve_load_multi_body():
         "n_replicas": n_rep,
         "routed": snap["routed"],
         "failovers": snap["failovers"],
+        "resolved_config": _resolved_config(
+            {}, serving={"n_replicas": n_rep,
+                         "prefix_cache": {"enabled": True}}),
     }
 
 
@@ -1639,6 +1724,12 @@ def _serve_disagg_body():
         "scenario_mix": mix_counts,
         "completed_disagg": dis["completed"],
         "completed_homog": hom["completed"],
+        "resolved_config": _resolved_config(
+            {}, serving={"n_replicas": 4,
+                         "disagg": {"enabled": True,
+                                    "prefill_replicas": 2,
+                                    "decode_replicas": 2,
+                                    "speculative": True, "spec_k": 3}}),
     }
 
 
@@ -1837,6 +1928,9 @@ def _chaos_recovery_body():
         "trace_json": _trace_json("chaos_recovery"),
         "value": train["recovery_s"], "unit": "s",
         **train, **serve,
+        "resolved_config": _resolved_config(
+            {"zero_optimization": {"stage": 1}},
+            serving={"n_replicas": 2}),
     }
 
 
@@ -1874,6 +1968,52 @@ def row_chaos_recovery():
     return _chaos_recovery_body()
 
 
+def row_plan_validate():
+    """Planner regression row (docs/PLANNER.md "Regression gate"): the
+    plan compiler re-derives every pinned known-good bench config from
+    first principles — for each audit row, compile the query mirroring
+    the row's experiment space and report the 1-based rank of the row's
+    pinned config; then propose the 6.7B offload ladder rung
+    sight-unseen on a 1-chip host+NVMe fleet.  Pure analytic CPU work:
+    identical in smoke and on-chip runs.  Keys frozen in
+    tools/telemetry_check.py."""
+    from deepspeed_tpu.planner import (FleetSpec, ModelSpec, compile_plan,
+                                       plan_rank_of)
+    from deepspeed_tpu.planner.audit import PLAN_AUDIT_ROWS, plan_for_row
+
+    ranks = {}
+    for name in PLAN_AUDIT_ROWS:
+        plan = plan_for_row(name)
+        ranks[name] = plan_rank_of(plan, PINNED_ROW_CONFIGS[name])
+    # sight-unseen: the chunked 6.7B rung on a fleet the planner has
+    # never benched — 1 chip, 64 GiB host, NVMe (the r16 ladder box)
+    model = ModelSpec.from_name("gpt2-6.7b", seq_len=512)
+    fleet = FleetSpec(chips=1, hbm_bytes=16 << 30, host_bytes=64 << 30,
+                      nvme=True)
+    plan67 = compile_plan(model, fleet, max_micro_batch=4)
+    ranks["gpt2_6_7b_chunked"] = plan_rank_of(
+        plan67, PINNED_ROW_CONFIGS["gpt2_6_7b_chunked"])
+    hits = sum(1 for r in ranks.values() if r is not None and r <= 3)
+    return {
+        "metric": "plan_validate_known_good_top3",
+        "value": hits, "unit": "rows",
+        "vs_baseline": round(hits / len(ranks), 3),
+        "known_good_ranks": ranks,
+        "proposed_6_7b": (plan67.ranked[0].candidate
+                          if plan67.ranked else None),
+        "pruned_6_7b": len(plan67.pruned),
+        "evidence_keys_ok": _plan_evidence_ok(plan67),
+    }
+
+
+def _plan_evidence_ok(plan) -> bool:
+    from deepspeed_tpu.planner import PLAN_EVIDENCE_KEYS
+
+    want = tuple(sorted(PLAN_EVIDENCE_KEYS))
+    return bool(plan.ranked) and all(
+        tuple(sorted(e.evidence)) == want for e in plan.ranked)
+
+
 def _device_probe_error(timeout_s: float = 120.0):
     """A hung bench run records nothing at all (worse than an error row) —
     probe the backend with a deadline before touching it."""
@@ -1895,6 +2035,7 @@ _ROWS = {
     "serve_load_multi": row_serve_load_multi,
     "serve_disagg": row_serve_disagg,
     "chaos_recovery": row_chaos_recovery,
+    "plan_validate": row_plan_validate,
     "gpt2_350m": row_gpt2_350m,
 }
 
@@ -1964,7 +2105,7 @@ def main() -> None:
                  "longseq_ring", "gpt2_350m_commquant",
                  "gpt2_350m_autosched", "peak_params",
                  "v2_decode", "serve_load", "serve_load_multi",
-                 "serve_disagg", "chaos_recovery"):
+                 "serve_disagg", "chaos_recovery", "plan_validate"):
         if SMOKE:
             try:
                 r = _ROWS[name]()

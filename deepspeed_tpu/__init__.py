@@ -17,7 +17,7 @@ from typing import Any, Dict, Optional, Tuple, Union
 __version__ = "0.1.0"
 __git_branch__ = "main"
 
-from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, load_plan
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 from deepspeed_tpu.parallel.topology import MeshTopology, get_topology, set_topology
 from deepspeed_tpu import comm  # noqa: F401

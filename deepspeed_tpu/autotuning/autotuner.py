@@ -49,10 +49,12 @@ def estimate_memory_breakdown(model_info: ModelInfo, zero_stage: int,
                               dtype: str = "bf16",
                               optimizer_factor: int = 12,
                               tp_size: int = 1, pp_size: int = 1,
-                              sp_size: int = 1) -> Dict[str, int]:
+                              sp_size: int = 1,
+                              comm_quant: bool = False,
+                              comm_group_size: int = 256) -> Dict[str, int]:
     """Per-class bytes per device for params/grads/optimizer/activations/
-    logits (+ ``total``) — the ladder predictor reports WHICH class blew
-    the budget, not just that it did.
+    logits/comm (+ ``total``) — the ladder predictor reports WHICH class
+    blew the budget, not just that it did.
 
     Ref get_instantiation_memory_required_per_gpu (autotuner.py:278):
     optimizer_factor=12 ≈ fp32 master + two Adam moments + fp16 param/grad
@@ -61,6 +63,15 @@ def estimate_memory_breakdown(model_info: ModelInfo, zero_stage: int,
     Model-parallel axes shard everything multiplicatively: tensor/pipe split
     params+grads+optimizer; pipe splits resident layers (activations too);
     seq splits the activation sequence dim.
+
+    ``comm_quant`` prices the comm-quantization error-feedback residual:
+    the engine rides a ``[world, padded]`` fp32 buffer through the step
+    signature (engine.py, quantized-DP grad reduce), sharded over the DP
+    axis — per device that is ``padded * 4`` bytes where ``padded`` rounds
+    the flat param count up to a multiple of ``world * group_size``, i.e.
+    ~4 bytes/param REGARDLESS of dp_size.  It only materializes on the
+    eligible path (dp > 1, pure-DP mesh, stage <= 2), matching the
+    engine's fallback gate.
     """
     p = model_info.num_params // max(1, tp_size * pp_size)
     b = BYTES_PER_PARAM.get(dtype, 2)
@@ -86,9 +97,15 @@ def estimate_memory_breakdown(model_info: ModelInfo, zero_stage: int,
     # buffer, but the tuner prices the default untiled path.
     logits = (micro_batch * seq_len * max(1, model_info.vocab_size) * 4 * 2
               // max(1, sp_size * tp_size))
+    comm_mem = 0
+    if (comm_quant and dp_size > 1 and zero_stage <= 2
+            and tp_size == 1 and pp_size == 1 and sp_size == 1):
+        base = dp_size * max(1, comm_group_size)
+        padded = -(-model_info.num_params // base) * base
+        comm_mem = padded * 4  # fp32 EF residual row per device
     out = {"params": int(params_mem), "grads": int(grads_mem),
            "optimizer": int(opt_mem), "activations": int(act),
-           "logits": int(logits)}
+           "logits": int(logits), "comm": int(comm_mem)}
     out["total"] = sum(out.values())
     return out
 
@@ -138,7 +155,9 @@ def predict_fit(model_info: ModelInfo, zero_stage: int, dp_size: int,
                 offload_param: Optional[str] = None,
                 offload_optimizer: Optional[str] = None,
                 host_bytes: Optional[int] = None,
-                chunk_bytes: Optional[int] = None) -> Dict[str, Any]:
+                chunk_bytes: Optional[int] = None,
+                comm_quant: bool = False,
+                comm_group_size: int = 256) -> Dict[str, Any]:
     """The OOM-before-you-run gate: calibrated per-device peak estimate
     vs the device budget, with the dominant class and shortfall when it
     does NOT fit — so a too-big ladder rung reports *why* instead of
@@ -160,11 +179,17 @@ def predict_fit(model_info: ModelInfo, zero_stage: int, dp_size: int,
     shardings and only O(chunk) crosses at a time), the cpu tier adds a
     double-buffered working set (grad chunk + the (3,n) state rows, two
     buffers deep) to the host need, and the nvme tier's host need is
-    ONLY that working set — the state itself lives in chunk files."""
+    ONLY that working set — the state itself lives in chunk files.
+
+    ``comm_quant`` adds the error-feedback residual under a ``comm``
+    class (see :func:`estimate_memory_breakdown`); it is always
+    device-homed — offload never re-homes it — so quantized-DP configs
+    near the fit boundary stop being under-priced."""
     bd = estimate_memory_breakdown(model_info, zero_stage, dp_size,
                                    micro_batch, seq_len, dtype,
                                    tp_size=tp_size, pp_size=pp_size,
-                                   sp_size=sp_size)
+                                   sp_size=sp_size, comm_quant=comm_quant,
+                                   comm_group_size=comm_group_size)
     cal = float(calibration) if calibration else 1.0
     home = {k: "device" for k in bd if k != "total"}
     if offload_optimizer:
@@ -321,7 +346,10 @@ class Autotuner:
     ``tune`` returns (best_ds_config, results).  ``mode``: "grid" tries the
     whole space; "random" samples ``max_trials``; "model_based" orders by
     estimated memory headroom (bigger batch first) and early-stops after
-    ``patience`` non-improving trials.
+    ``patience`` non-improving trials; "planner" seeds the space with the
+    plan compiler's ranked candidates (deepspeed_tpu.planner — static
+    census-priced step-time model) instead of the blind pow2 ladder,
+    falling back to model_based ordering if planning fails.
     """
 
     def __init__(self, model_cfg, base_config: Dict[str, Any],
@@ -370,6 +398,25 @@ class Autotuner:
                          vocab_size=self.model_cfg.vocab_size)
 
     def _space(self) -> List[Dict[str, Any]]:
+        if self.mode == "planner":
+            # plan-compiler seeding: ranked candidates from the static
+            # planner (census-priced step-time model) replace the blind
+            # pow2 enumeration — trials then confirm the analytic ranking
+            try:
+                import jax
+
+                from deepspeed_tpu.planner import seed_candidates
+
+                n = self.n_devices or len(jax.devices())
+                cands = seed_candidates(
+                    self.model_cfg, seq_len=self.seq_len, chips=n,
+                    hbm_bytes=self.hbm_bytes,
+                    calibration=self.calibration, top=self.max_trials)
+                if cands:
+                    return cands
+            except Exception as e:  # planner unavailable → pow2 fallback
+                logger.warning(f"planner seeding failed ({e}); "
+                               "falling back to model_based space")
         mesh = self.base_config.get("mesh") or {}
         dp = int(mesh.get("data", 1)) * int(mesh.get("expert", 1))
         meshes = None
@@ -386,7 +433,7 @@ class Autotuner:
             rng = np.random.default_rng(self.seed)
             rng.shuffle(space)
             return space[:self.max_trials]
-        if self.mode == "model_based":
+        if self.mode in ("model_based", "planner"):
             space.sort(key=lambda c: (-c["micro_batch"], -c["zero_stage"]))
             return space[:self.max_trials]
         return space  # grid
@@ -399,6 +446,10 @@ class Autotuner:
         cfg.setdefault("zero_optimization", {})["stage"] = cand["zero_stage"]
         if cand.get("mesh"):
             cfg["mesh"] = dict(cand["mesh"])
+        # planner-seeded candidates carry whole config blocks
+        # (comm_quantization / step_schedule / offload) as overrides
+        for k, v in (cand.get("overrides") or {}).items():
+            cfg[k] = copy.deepcopy(v)
         return cfg
 
     def run_trial(self, cand: Dict[str, Any]) -> TrialResult:

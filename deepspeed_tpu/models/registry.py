@@ -39,6 +39,22 @@ register("gpt2-1.3b", TransformerConfig(
     vocab_size=50304, hidden_size=2048, intermediate_size=8192, num_layers=24,
     num_heads=32, max_seq_len=2048, arch="gpt2"))
 
+# GPT-3 6.7B-class geometry — the peak_params ladder's chunked-offload
+# rung builds this shape from gpt2-1.3b overrides; registered so the
+# plan compiler (tools/plan.py --model gpt2-6.7b) can name it directly
+register("gpt2-6.7b", TransformerConfig(
+    vocab_size=50304, hidden_size=4096, intermediate_size=16384,
+    num_layers=32, num_heads=32, max_seq_len=2048, arch="gpt2"))
+
+# ~1B-total MoE with 8 routed experts: the planner's expert-parallel
+# sight-unseen target (moe_1b_ep8) — experts dominate the param count,
+# so expert-parallel meshes beat replicated-expert DP on wire bytes
+register("moe-1b-ep8", TransformerConfig(
+    vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+    num_layers=12, num_heads=16, num_kv_heads=8, max_seq_len=2048,
+    arch="llama", norm="rmsnorm", activation="swiglu", use_rope=True,
+    tie_embeddings=False, num_experts=8, top_k=2, moe_layer_freq=1))
+
 # -- Llama family ------------------------------------------------------
 _llama = dict(arch="llama", norm="rmsnorm", activation="swiglu", use_rope=True,
               tie_embeddings=False, rope_theta=500000.0)

@@ -1,0 +1,39 @@
+"""Parallelism plan compiler (docs/PLANNER.md).
+
+Searches the whole (mesh × ZeRO stage × comm_quantization ×
+step_schedule fusion × offload tier × disagg split) config space
+offline, prunes with the calibrated memory model, prices survivors with
+an analytic step-time model fed by the static collective census, and
+emits ranked, pinned, load-ready ``DeepSpeedConfig`` fragments with
+evidence attached.  CLI: ``tools/plan.py`` (``dstpu-plan``).
+"""
+
+from deepspeed_tpu.planner.cost import (ANCHOR_TOLERANCE, LINK_CLASSES,
+                                        MAX_OVERLAP_FRACTION,
+                                        OFFLOAD_OVERLAP_FRACTION,
+                                        OVERLAP_CREDITS, analytic_census,
+                                        anchor_ratios, apply_anchors,
+                                        offload_traffic,
+                                        schedule_overlap_fraction,
+                                        step_time)
+from deepspeed_tpu.planner.rank import (PLAN_EVIDENCE_KEYS, Plan,
+                                        PlannedConfig, compile_plan,
+                                        config_fragment, load_plan_file,
+                                        plan_rank_of, save_plan,
+                                        seed_candidates,
+                                        validate_fragment)
+from deepspeed_tpu.planner.space import (OFFLOAD_TIERS, Candidate,
+                                         FleetSpec, ModelSpec,
+                                         enumerate_candidates,
+                                         prune_candidates, schedule_for)
+
+__all__ = [
+    "ANCHOR_TOLERANCE", "LINK_CLASSES", "MAX_OVERLAP_FRACTION", "OFFLOAD_OVERLAP_FRACTION",
+    "OVERLAP_CREDITS", "OFFLOAD_TIERS", "PLAN_EVIDENCE_KEYS",
+    "Candidate", "FleetSpec", "ModelSpec", "Plan", "PlannedConfig",
+    "analytic_census", "anchor_ratios", "apply_anchors", "compile_plan",
+    "config_fragment", "enumerate_candidates", "load_plan_file",
+    "offload_traffic", "plan_rank_of", "prune_candidates", "save_plan",
+    "schedule_for", "schedule_overlap_fraction", "seed_candidates",
+    "step_time", "validate_fragment",
+]

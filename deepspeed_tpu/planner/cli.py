@@ -1,0 +1,131 @@
+"""``dstpu-plan`` CLI (tools/plan.py): ranked table + JSON plan file.
+
+Examples::
+
+    dstpu-plan --model gpt2-6.7b --chips 1 --hbm 16GiB \\
+               --host-ram 64GiB --nvme --seq 512 --json plan.json
+    dstpu-plan --model gpt2-350m --chips 8 --top 5
+    dstpu-plan --model llama3-8b --chips 8 --serving
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from typing import Optional
+
+_SIZE_RE = re.compile(r"^\s*([0-9]*\.?[0-9]+)\s*([KMGT]i?B?|B?)\s*$",
+                      re.IGNORECASE)
+_SIZE_MULT = {"": 1, "B": 1,
+              "K": 10 ** 3, "KB": 10 ** 3, "KIB": 1 << 10,
+              "M": 10 ** 6, "MB": 10 ** 6, "MIB": 1 << 20,
+              "G": 10 ** 9, "GB": 10 ** 9, "GIB": 1 << 30,
+              "T": 10 ** 12, "TB": 10 ** 12, "TIB": 1 << 40}
+
+
+def parse_bytes(text: str) -> int:
+    """'16GiB' → 17179869184; bare ints pass through."""
+    m = _SIZE_RE.match(str(text))
+    if not m:
+        raise argparse.ArgumentTypeError(
+            f"cannot parse size {text!r} (try e.g. 16GiB, 64GB, 512MiB)")
+    num, unit = m.groups()
+    unit = unit.upper()
+    if unit in ("", "B"):
+        return int(float(num))
+    if not unit.endswith("B"):
+        unit += "B"
+    if unit not in _SIZE_MULT:
+        raise argparse.ArgumentTypeError(f"unknown size unit {unit!r}")
+    return int(float(num) * _SIZE_MULT[unit])
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dstpu-plan", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--model", required=True,
+                   help="models registry name (e.g. gpt2-6.7b, "
+                        "gpt2-350m, moe-1b-ep8)")
+    p.add_argument("--seq", type=int, default=None,
+                   help="sequence length (default: model max_seq_len)")
+    p.add_argument("--chips", type=int, default=8)
+    p.add_argument("--hbm", type=parse_bytes, default=16 << 30,
+                   metavar="SIZE", help="HBM per chip (default 16GiB)")
+    p.add_argument("--host-ram", type=parse_bytes, default=None,
+                   metavar="SIZE",
+                   help="host RAM budget for cpu-offload tiers "
+                        "(default: unconstrained)")
+    p.add_argument("--nvme", action="store_true",
+                   help="NVMe available (enables nvme offload tiers)")
+    p.add_argument("--gas", type=int, default=1,
+                   help="gradient accumulation steps to price")
+    p.add_argument("--max-micro-batch", type=int, default=64)
+    p.add_argument("--stages", type=int, nargs="*", default=None,
+                   metavar="S", help="restrict ZeRO stages (e.g. 2 3)")
+    p.add_argument("--no-quant", action="store_true",
+                   help="drop comm_quantization candidates")
+    p.add_argument("--no-offload", action="store_true",
+                   help="drop offload-tier candidates")
+    p.add_argument("--no-schedule", action="store_true",
+                   help="drop step_schedule fusion candidates")
+    p.add_argument("--serving", action="store_true",
+                   help="plan disaggregated serving splits instead of "
+                        "training configs")
+    p.add_argument("--calibration", default="auto",
+                   help="memory-model calibration: 'auto' (frozen "
+                        "model_drift ratio), 'none', or a float")
+    p.add_argument("--top", type=int, default=10,
+                   help="ranked entries to keep (default 10)")
+    p.add_argument("--json", dest="json_path", default=None,
+                   help="write the full plan (ranked + pruned + "
+                        "evidence) as JSON")
+    p.add_argument("--show-pruned", type=int, default=3, metavar="N",
+                   help="print the first N pruning reasons (default 3)")
+    return p
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    from deepspeed_tpu.planner.rank import compile_plan, save_plan
+    from deepspeed_tpu.planner.space import FleetSpec, ModelSpec
+
+    if args.calibration == "auto":
+        from deepspeed_tpu.autotuning import load_memory_calibration
+        cal = load_memory_calibration(backend="cpu")
+    elif args.calibration in ("none", "1", "1.0"):
+        cal = 1.0
+    else:
+        cal = float(args.calibration)
+
+    model = ModelSpec.from_name(args.model, seq_len=args.seq)
+    fleet = FleetSpec(chips=args.chips, hbm_bytes=args.hbm,
+                      host_bytes=args.host_ram, nvme=args.nvme)
+    plan = compile_plan(
+        model, fleet,
+        stages=tuple(args.stages) if args.stages else (0, 1, 2, 3),
+        gas=args.gas, max_micro_batch=args.max_micro_batch,
+        enable_quant=not args.no_quant,
+        enable_offload=not args.no_offload,
+        enable_schedule=not args.no_schedule,
+        serving=args.serving, calibration=cal, top=args.top)
+    print(plan.table())
+    if plan.pruned and args.show_pruned:
+        print(f"pruned ({len(plan.pruned)} total, first "
+              f"{min(args.show_pruned, len(plan.pruned))}):")
+        for row in plan.pruned[:args.show_pruned]:
+            print(f"  {row['candidate']}: {row['reason']}")
+    if args.json_path:
+        save_plan(plan, args.json_path)
+        print(f"plan written to {args.json_path} (top entry is a "
+              f"load-ready DeepSpeedConfig fragment)")
+    if not plan.ranked:
+        print("no candidate fits this fleet — see pruning reasons",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

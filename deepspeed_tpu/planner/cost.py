@@ -1,0 +1,300 @@
+"""Analytic step-time model fed by the static collective census.
+
+Pricing protocol (docs/PLANNER.md): each candidate gets an analytic
+census — per collective kind, the ring-model wire bytes its mesh/stage
+shape implies — priced as bytes/hop × link class.  Where a real lowered
+census is available (the audit targets of analysis/targets.py), it
+anchors the analytic rows: measured/analytic ratios scale the
+extrapolated bytes and the rows flip from ``extrapolated`` to
+``anchored``.  Overlap credit from pinned step_schedule fusions is
+clamped so it can never exceed the comm it hides; host-pipeline overlap
+uses the chunked double-buffer fraction (ZeRO-Offload tier model).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from deepspeed_tpu.planner.space import Candidate, FleetSpec, ModelSpec
+
+# link classes the census rows are priced against (FleetSpec carries the
+# actual bytes/s; this table is the frozen vocabulary)
+LINK_CLASSES = ("ici", "dcn", "pcie", "nvme")
+
+# overlap credit per pinned step_schedule fusion (fraction of the
+# overlappable window min(comm, compute) each decision hides), capped at
+# MAX_OVERLAP_FRACTION — mirrors overlap_scheduler.SCHEDULE_DECISIONS
+OVERLAP_CREDITS = {
+    "zero3_prefetch": 0.5,
+    "fused_gather_matmul": 0.15,
+    "ring_interleave": 0.5,
+    "decomposed_update": 0.4,
+    "fused_reduce_scatter": 0.15,
+}
+MAX_OVERLAP_FRACTION = 0.9
+
+# chunked host optimizer: double-buffered chunk pipeline overlaps this
+# fraction of the state traffic behind compute (offload_overlap_fraction
+# analog from the PR 16 stream rung)
+OFFLOAD_OVERLAP_FRACTION = 0.6
+
+# achievable fraction of peak flops the compute term assumes
+COMPUTE_EFFICIENCY = 0.4
+
+# bytes/param a grad reduce puts on the wire: the engine reduces fp32
+# grads when comm_quantization is off (the audit census shows f32
+# all-reduce rows), so the un-quantized default is 4, not 2
+WIRE_BYTES_PER_GRAD = {"fp32": 4, "int8": 1, "fp8": 1, None: 4}
+
+# anchor/extrapolate protocol (docs/PLANNER.md): a measured census row's
+# wire bytes must agree with the analytic row within this multiplicative
+# band on the audit targets, or the analytic formula has drifted from
+# what the compiler actually emits (frozen; tests/test_planner.py)
+ANCHOR_TOLERANCE = 4.0
+
+
+def _axis_link(axis: str, fleet: FleetSpec) -> str:
+    return "dcn" if axis in fleet.dcn_axes else "ici"
+
+
+def analytic_census(model: ModelSpec, cand: Candidate,
+                    gas: int = 1,
+                    fleet: Optional[FleetSpec] = None
+                    ) -> Dict[str, Dict[str, Any]]:
+    """Per-kind wire bytes (per device, per optimizer step) the shape
+    implies — same kind vocabulary as the real census
+    (``report.census_summary()``), each row marked ``extrapolated`` until
+    :func:`apply_anchors` rescales it against a lowered anchor."""
+    fleet = fleet or _DEFAULT_FLEET
+    c = model.config
+    b, s, h = cand.micro_batch, model.seq_len, c.hidden_size
+    d = cand.axis("data")
+    tp, pp, sp, ep = (cand.axis("tensor"), cand.axis("pipe"),
+                      cand.axis("seq"), cand.axis("expert"))
+    f_moe = model.moe_param_fraction
+    # param count per model-parallel shard; expert params shard over ep
+    p_eff = model.num_params * ((1.0 - f_moe) + f_moe / ep) / (tp * pp)
+    grad_bpp = WIRE_BYTES_PER_GRAD[
+        (cand.comm_quantization or {}).get("grad_reduce")]
+    rows: Dict[str, Dict[str, Any]] = {}
+
+    def add(kind: str, wire: float, count: int, link: str) -> None:
+        if wire <= 0 or count <= 0:
+            return
+        row = rows.setdefault(kind, {"count": 0, "wire_bytes": 0,
+                                     "link": link,
+                                     "mode": "extrapolated"})
+        row["count"] += int(count)
+        row["wire_bytes"] += int(wire)
+
+    link_d = _axis_link("data", fleet)
+    if d > 1:
+        if cand.zero_stage <= 1:
+            add("all-reduce", 2.0 * (d - 1) / d * p_eff * grad_bpp, 1,
+                link_d)
+        else:
+            add("reduce-scatter", (d - 1) / d * p_eff * grad_bpp, 1, link_d)
+            # post-update param all-gather (ZeRO-2) / fwd+bwd re-gathers
+            # (ZeRO-3) move bf16 params back out of the shards
+            gathers = 2 if cand.zero_stage >= 3 else 1
+            add("all-gather", gathers * (d - 1) / d * p_eff * 2, gathers,
+                link_d)
+    if tp > 1:
+        # Megatron pattern: 2 fwd + 2 bwd activation all-reduces/layer
+        wire = gas * c.num_layers * 4 * 2.0 * (tp - 1) / tp * b * s * h * 2
+        add("all-reduce", wire, gas * c.num_layers * 4, "ici")
+    if sp > 1:
+        # ring attention: K/V block rotation, (sp-1) hops fwd + bwd
+        kv_frac = c.kv_heads / c.num_heads
+        wire = (gas * c.num_layers * 2 * 2 * (sp - 1)
+                * b * (s / sp) * h * kv_frac * 2)
+        add("collective-permute", wire,
+            gas * c.num_layers * 2 * (sp - 1), "ici")
+    if ep > 1:
+        freq = max(1, getattr(c, "moe_layer_freq", 1) or 1)
+        moe_layers = -(-c.num_layers // freq)
+        topk = getattr(c, "top_k", 2)
+        wire = (gas * moe_layers * 4 * (ep - 1) / ep
+                * b * s * topk * h * 2)
+        add("all-to-all", wire, gas * moe_layers * 4, "ici")
+    if pp > 1:
+        wire = gas * 2 * b * s * h * 2
+        add("collective-permute", wire, gas * 2,
+            _axis_link("pipe", fleet))
+    return rows
+
+
+_DEFAULT_FLEET = FleetSpec()
+
+
+def offload_traffic(model: ModelSpec, cand: Candidate
+                    ) -> Dict[str, Dict[str, Any]]:
+    """Host-link traffic per step for the offload tier: param
+    round-trips over PCIe/NVMe plus the optimizer-state stream (16
+    bytes/shard-param fp32 master + moments), the latter overlappable
+    when chunked (double-buffered chunk pipeline)."""
+    off = cand.offload or {}
+    if not off:
+        return {}
+    shard = model.num_params / max(1, cand.dp_size
+                                   if cand.zero_stage >= 1 else 1)
+    rows: Dict[str, Dict[str, Any]] = {}
+    if off.get("param"):
+        link = "nvme" if off["param"] == "nvme" else "pcie"
+        # params stream up for fwd and again for bwd re-gather
+        rows["param_stream"] = {
+            "wire_bytes": int(2 * model.num_params * 2), "link": link,
+            "overlappable": False}
+    if off.get("optimizer"):
+        link = "nvme" if off["optimizer"] == "nvme" else "pcie"
+        # grads down (bf16) + fresh params up (bf16) + state touch (fp32
+        # master + two moments read/write ≈ 16 B/param on the slow tier)
+        rows["grad_stream"] = {"wire_bytes": int(shard * 4), "link": "pcie",
+                               "overlappable": False}
+        rows["state_stream"] = {
+            "wire_bytes": int(shard * 16), "link": link,
+            "overlappable": bool(off.get("chunked"))}
+    return rows
+
+
+def schedule_overlap_fraction(cand: Candidate) -> float:
+    """Sum of OVERLAP_CREDITS the candidate's pinned fusions earn,
+    capped at MAX_OVERLAP_FRACTION."""
+    sched = cand.step_schedule or {}
+    credit = 0.0
+    if sched.get("gather_prefetch_depth"):
+        credit += OVERLAP_CREDITS["zero3_prefetch"]
+    if sched.get("fused_gather_matmul"):
+        credit += OVERLAP_CREDITS["fused_gather_matmul"]
+    if int(sched.get("ring_interleave", 1) or 1) >= 2:
+        credit += OVERLAP_CREDITS["ring_interleave"]
+    if sched.get("weight_update") == "decomposed":
+        credit += OVERLAP_CREDITS["decomposed_update"]
+    if sched.get("fused_reduce_scatter"):
+        credit += OVERLAP_CREDITS["fused_reduce_scatter"]
+    return min(MAX_OVERLAP_FRACTION, credit)
+
+
+def step_time(model: ModelSpec, cand: Candidate, fleet: FleetSpec, *,
+              gas: int = 1,
+              census: Optional[Dict[str, Dict[str, Any]]] = None
+              ) -> Dict[str, Any]:
+    """compute + exposed comm + exposed host stream, in seconds, with
+    the dominant term named.  Serving (disagg) candidates are priced as
+    a prefill-flops vs decode-bandwidth balance instead."""
+    if cand.disagg:
+        return _disagg_time(model, cand, fleet)
+    from deepspeed_tpu.profiling import get_model_profile
+
+    if census is None:
+        census = analytic_census(model, cand, gas=gas)
+    prof = get_model_profile(model.config, batch_size=cand.micro_batch,
+                             seq_len=model.seq_len)
+    mp = cand.axis("tensor") * cand.axis("pipe") * cand.axis("seq")
+    compute_s = (prof["total_flops_per_step"] * gas
+                 / (fleet.peak_flops * COMPUTE_EFFICIENCY * mp))
+    pp = cand.axis("pipe")
+    if pp > 1:
+        # 1F1B bubble: (pp-1) idle microbatch slots per step — pipeline
+        # only pays off once gas amortizes the fill/drain ramp
+        compute_s *= (gas + pp - 1) / gas
+    comm_s = sum(row["wire_bytes"] / fleet.link_speed(row["link"])
+                 for row in census.values())
+    overlap = schedule_overlap_fraction(cand)
+    credit_s = overlap * min(comm_s, compute_s)
+    exposed_comm_s = comm_s - credit_s
+    host_s = exposed_host_s = 0.0
+    for row in offload_traffic(model, cand).values():
+        t = row["wire_bytes"] / fleet.link_speed(row["link"])
+        host_s += t
+        exposed_host_s += (t * (1.0 - OFFLOAD_OVERLAP_FRACTION)
+                           if row["overlappable"] else t)
+    total = compute_s + exposed_comm_s + exposed_host_s
+    terms = {"compute": compute_s, "comm": exposed_comm_s,
+             "host": exposed_host_s}
+    # the mp chips of one model replica share the same mb×seq×gas tokens
+    tokens = cand.micro_batch * model.seq_len * gas / mp
+    return {
+        "step_seconds": total,
+        "compute_seconds": compute_s,
+        "comm_seconds": comm_s,
+        "exposed_comm_seconds": exposed_comm_s,
+        "overlap_credit_seconds": credit_s,
+        "overlap_fraction": overlap,
+        "host_seconds": host_s,
+        "exposed_host_seconds": exposed_host_s,
+        "dominant_cost_term": max(terms, key=terms.get),
+        "tokens_per_sec_per_chip": tokens / total if total > 0 else 0.0,
+        "wire_bytes_total": int(sum(r["wire_bytes"]
+                                    for r in census.values())),
+    }
+
+
+def _disagg_time(model: ModelSpec, cand: Candidate,
+                 fleet: FleetSpec) -> Dict[str, Any]:
+    """Prefill is compute-bound (prompt flops), decode is
+    bandwidth-bound (weights re-read per token); the tier split is good
+    when neither side waits on the other (docs/SERVING.md)."""
+    from deepspeed_tpu.profiling import get_model_profile
+
+    p = cand.disagg["prefill_replicas"]
+    dec = cand.disagg["decode_replicas"]
+    prof = get_model_profile(model.config, batch_size=1,
+                             seq_len=model.seq_len,
+                             include_backward=False)
+    prefill_s = prof["fwd_flops"] / (
+        fleet.peak_flops * COMPUTE_EFFICIENCY) / p
+    # decode: DECODE_TOKENS_PER_PROMPT tokens, each streaming the weights
+    decode_tokens = max(1, model.seq_len // 4)
+    hbm_stream = 8.19e11  # HBM bytes/s a decode step re-reads weights at
+    decode_s = decode_tokens * (model.num_params * 2) / hbm_stream / dec
+    total = max(prefill_s, decode_s)
+    imbalance = abs(prefill_s - decode_s)
+    terms = {"prefill": prefill_s, "decode": decode_s}
+    return {
+        "step_seconds": total + 0.1 * imbalance,
+        "compute_seconds": prefill_s,
+        "comm_seconds": 0.0,
+        "exposed_comm_seconds": 0.0,
+        "overlap_credit_seconds": 0.0,
+        "overlap_fraction": 0.0,
+        "host_seconds": 0.0,
+        "exposed_host_seconds": 0.0,
+        "dominant_cost_term": max(terms, key=terms.get),
+        "tokens_per_sec_per_chip": ((model.seq_len + decode_tokens)
+                                    / (total + 0.1 * imbalance)
+                                    / max(1, p + dec)),
+        "wire_bytes_total": 0,
+    }
+
+
+def anchor_ratios(measured_census: Dict[str, Dict[str, Any]],
+                  model: ModelSpec, cand: Candidate,
+                  gas: int = 1) -> Dict[str, float]:
+    """measured/analytic wire-byte ratio per collective kind, from a
+    REAL lowered census (``census_summary()`` of an audit target) of the
+    same shape — the anchor half of the anchor/extrapolate protocol."""
+    analytic = analytic_census(model, cand, gas=gas)
+    out: Dict[str, float] = {}
+    for kind, row in analytic.items():
+        meas = measured_census.get(kind)
+        if not isinstance(meas, dict) or "wire_bytes" not in meas:
+            continue
+        if row["wire_bytes"] > 0 and meas["wire_bytes"] > 0:
+            out[kind] = meas["wire_bytes"] / row["wire_bytes"]
+    return out
+
+
+def apply_anchors(census: Dict[str, Dict[str, Any]],
+                  ratios: Dict[str, float]) -> Dict[str, Dict[str, Any]]:
+    """Rescale extrapolated rows by the anchor ratios; anchored rows are
+    marked so the emitted evidence records which bytes were measured-
+    derived vs purely analytic."""
+    out = {}
+    for kind, row in census.items():
+        row = dict(row)
+        if kind in ratios:
+            row["wire_bytes"] = int(row["wire_bytes"] * ratios[kind])
+            row["mode"] = "anchored"
+        out[kind] = row
+    return out

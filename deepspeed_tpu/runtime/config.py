@@ -1064,3 +1064,32 @@ class DeepSpeedConfig:
                  f"zero_stage={self.zero_config.stage}",
                  f"bf16={self.bf16.enabled}", f"fp16={self.fp16.enabled}"]
         return "DeepSpeedConfig(" + ", ".join(parts) + ")"
+
+
+def load_plan(plan: Union[str, Dict[str, Any]],
+              world_size: Optional[int] = 1,
+              rank: int = 0) -> "DeepSpeedConfig":
+    """Load a planner-emitted plan file (``dstpu-plan --json``) as a
+    validated ``DeepSpeedConfig`` — the round-trip half of the plan
+    contract (docs/PLANNER.md "Plan files"): the ``rank``-th ranked
+    entry's config fragment parses with no edits, or this raises
+    ``DeepSpeedConfigError``.  Accepts a path, a plan dict, or a bare
+    config fragment (a dict without a ``ranked`` list)."""
+    if isinstance(plan, str):
+        with open(plan, "r") as f:
+            plan = json.load(f)
+    if not isinstance(plan, dict):
+        raise DeepSpeedConfigError(
+            f"plan must be a dict or JSON path, got {type(plan)}")
+    if "ranked" in plan:
+        ranked = plan["ranked"]
+        if not ranked:
+            raise DeepSpeedConfigError("plan ranked no candidates")
+        if not 0 <= rank < len(ranked):
+            raise DeepSpeedConfigError(
+                f"plan has {len(ranked)} ranked entries; no rank {rank}")
+        fragment = ranked[rank]["config"]
+    else:
+        fragment = plan
+    return DeepSpeedConfig(copy.deepcopy(fragment),
+                           world_size=world_size)

@@ -520,6 +520,21 @@ def test_telemetry_check_lint_passes():
     assert mod.run_all() == []
 
 
+def test_bench_backlog_queue_is_runnable():
+    """Every queued measurement command in BENCH_MEASURED_r07+.json must
+    still parse against the current bench.py flags, row names, tool
+    scripts, and model registry — a renamed row or retired flag rots the
+    queue silently otherwise (tools/bench_backlog.py)."""
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "bench_backlog.py")
+    spec = importlib.util.spec_from_file_location("bench_backlog", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.run_all() == []
+
+
 # ----------------------------------------------------------------------
 # acceptance: 3-step CPU train run with telemetry + forced capture
 # ----------------------------------------------------------------------

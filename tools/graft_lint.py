@@ -17,6 +17,10 @@ Usage::
     python tools/graft_lint.py --memory          # memory audits, all rows
     python tools/graft_lint.py --memory --target train_zero3
     python tools/graft_lint.py --seam            # AST lint only
+    python tools/graft_lint.py --plan            # audit planner output:
+                                                 # top-ranked config per
+                                                 # bench-row query must
+                                                 # lower clean
     python tools/graft_lint.py --list            # show row targets
     python tools/graft_lint.py --json out.json   # machine-readable dump
     python tools/graft_lint.py --write-baseline  # accept current highs
@@ -83,6 +87,12 @@ def main(argv=None) -> int:
                         "(repeatable)")
     p.add_argument("--seam", action="store_true",
                    help="run the AST jax-version-seam lint")
+    p.add_argument("--plan", action="store_true",
+                   help="audit the planner's top-ranked config per "
+                        "registered bench-row query (planner/audit.py): "
+                        "each must lower with 0 unbaselined graph/memory "
+                        "highs — a plan the auditors reject must not "
+                        "ship")
     p.add_argument("--baseline", default=DEFAULT_BASELINE,
                    help="finding-fingerprint suppression file")
     p.add_argument("--memory-baseline", default=DEFAULT_MEMORY_BASELINE,
@@ -103,7 +113,7 @@ def main(argv=None) -> int:
                                                load_memory_baseline)
 
     all_default = (args.rows is None and args.memory is None
-                   and not args.seam)
+                   and not args.seam and not args.plan)
     run_rows = args.rows is not None or all_default
     run_memory = args.memory is not None or all_default
     run_seam = args.seam or all_default
@@ -169,6 +179,29 @@ def main(argv=None) -> int:
         findings.extend(seam)
         print(f"seam: {len(seam)} violation(s)")
 
+    plan_reports = []
+    if args.plan:
+        _setup_mesh_backend()
+        from deepspeed_tpu.planner.audit import (PLAN_AUDIT_ROWS,
+                                                 audit_planned_config)
+        for name in PLAN_AUDIT_ROWS:
+            frag, rep, mem = audit_planned_config(name)
+            # plan twins join the finding gate but NOT mem_reports —
+            # --write-baseline must never freeze budgets for the
+            # synthetic plan:* labels
+            findings.extend(rep.findings)
+            findings.extend(mem.findings)
+            plan_reports.append({"name": name, "fragment": frag,
+                                 "graph": rep.to_dict(),
+                                 "memory": mem.to_dict()})
+            mesh = frag.get("mesh") or {}
+            mesh_s = "x".join(f"{k}{v}"
+                              for k, v in sorted(mesh.items())) or "data1"
+            stage = (frag.get("zero_optimization") or {}).get("stage", 0)
+            print(f"plan {name}: top-ranked zero{stage} mesh {mesh_s} "
+                  f"lowered; {len(rep.findings) + len(mem.findings)} "
+                  f"finding(s)")
+
     baseline = load_baseline(args.baseline)
     highs: List = [f for f in findings if f.severity == "high"]
     new_highs = [f for f in highs if f.fingerprint() not in baseline]
@@ -204,6 +237,7 @@ def main(argv=None) -> int:
             json.dump({"reports": [r.to_dict() for r in reports],
                        "memory_reports": [r.to_dict()
                                           for r in mem_reports],
+                       "plan_reports": plan_reports,
                        "findings": [f.to_dict() for f in findings],
                        "unbaselined_high": [f.to_dict()
                                             for f in new_highs]},

@@ -1,0 +1,19 @@
+#!/usr/bin/env python
+"""dstpu-plan — parallelism plan compiler CLI (docs/PLANNER.md).
+
+Thin launcher for :mod:`deepspeed_tpu.planner.cli`::
+
+    python tools/plan.py --model gpt2-6.7b --chips 1 --hbm 16GiB \
+        --host-ram 64GiB --nvme --seq 512 --json plan.json
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from deepspeed_tpu.planner.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -211,6 +211,24 @@ EXPECTED_RECOVERY_STATES = ["running", "detected", "dumped", "stopped",
 CHAOS_BENCH_KEYS = ["recovery_s", "loss_gap", "goodput_after",
                     "serve_ttft_p99_ms", "failovers", "regrown"]
 
+# frozen plan-compiler vocabulary (deepspeed_tpu/planner; docs/PLANNER.md):
+# the per-candidate evidence keys the planner pins, the link classes its
+# cost model prices, the offload tier ladder it enumerates, and the
+# plan_validate bench-row keys all follow the standard contract — frozen
+# list matches the module, every name documented, bench keys literally
+# emitted by bench.py.
+PLANNER_DOCS = os.path.join(REPO, "docs", "PLANNER.md")
+EXPECTED_PLAN_EVIDENCE_KEYS = [
+    "census", "census_mode", "dominant_class", "dominant_cost_term",
+    "overlap_fraction", "predicted_peak_bytes", "predicted_step_ms",
+    "wire_bytes_total",
+]
+EXPECTED_LINK_CLASSES = ["ici", "dcn", "pcie", "nvme"]
+EXPECTED_OFFLOAD_TIER_NAMES = ["none", "opt_cpu", "cpu", "cpu_chunked",
+                               "nvme_chunked", "nvme"]
+PLAN_BENCH_KEYS = ["plan_validate_known_good_top3", "known_good_ranks",
+                   "proposed_6_7b", "pruned_6_7b", "evidence_keys_ok"]
+
 
 def _exported_monitor_tags() -> List[str]:
     from deepspeed_tpu.serving.metrics import ServingMetrics
@@ -571,6 +589,34 @@ def check_offload() -> List[str]:
     ]) + _cross_link(DOCS, "OFFLOAD.md", "offload")
 
 
+def check_planner() -> List[str]:
+    """Plan-compiler vocabulary: evidence keys / link classes / offload
+    tier names match deepspeed_tpu/planner, every name is documented in
+    docs/PLANNER.md, the plan_validate bench keys are emitted by
+    bench.py, and the planner and autotuning docs cross-link each
+    other (the Autotuner's planner mode consumes seed_candidates)."""
+    from deepspeed_tpu.planner import (LINK_CLASSES, OFFLOAD_TIERS,
+                                       PLAN_EVIDENCE_KEYS)
+
+    return _vocab_check([
+        VocabSpec(name="planner.PLAN_EVIDENCE_KEYS",
+                  expected=EXPECTED_PLAN_EVIDENCE_KEYS,
+                  actual=lambda: PLAN_EVIDENCE_KEYS,
+                  docs_path=PLANNER_DOCS),
+        VocabSpec(name="planner.LINK_CLASSES",
+                  expected=EXPECTED_LINK_CLASSES,
+                  actual=lambda: LINK_CLASSES, docs_path=PLANNER_DOCS),
+        VocabSpec(name="planner offload tiers",
+                  expected=EXPECTED_OFFLOAD_TIER_NAMES,
+                  actual=lambda: [n for n, _ in OFFLOAD_TIERS],
+                  docs_path=PLANNER_DOCS),
+        VocabSpec(name="PLAN_BENCH_KEYS", expected=PLAN_BENCH_KEYS,
+                  docs_path=PLANNER_DOCS,
+                  source_keys=[(_BENCH, PLAN_BENCH_KEYS)]),
+    ]) + _cross_link(AUTOTUNING_DOCS, "PLANNER.md", "planner mode") \
+       + _cross_link(PLANNER_DOCS, "AUTOTUNING.md", "autotuner handoff")
+
+
 def validate_chrome_trace(obj: Any) -> List[str]:
     """Structural validation of a Chrome trace-event JSON object (pass a
     path or the loaded dict).  Perfetto/chrome://tracing both accept the
@@ -640,7 +686,8 @@ def run_all() -> List[str]:
             + check_quant_comm() + check_ring_bench()
             + check_router_serving() + check_autotuning()
             + check_graph_audit() + check_memory_audit()
-            + check_offload() + check_recovery() + check_trace_export())
+            + check_offload() + check_recovery() + check_planner()
+            + check_trace_export())
 
 
 def main() -> int:
