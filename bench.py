@@ -93,6 +93,14 @@ def _trace_json(name: str) -> str:
     return os.path.join(out_dir, f"{name}.trace.json")
 
 
+def _fleet_jsonl(name: str) -> str:
+    """Per-row TierSnapshot log (docs/OBSERVABILITY.md 'Fleet snapshots
+    & SLO ledger'): one frozen-schema JSON line per tier per sampler
+    tick."""
+    out_dir = os.environ.get("DSTPU_TELEMETRY_DIR", "./telemetry")
+    return os.path.join(out_dir, f"{name}.fleet.jsonl")
+
+
 def _telemetry_block(name: str) -> dict:
     return {"enabled": True, "jsonl_path": _telemetry_jsonl(name),
             "tracing": {"enabled": True, "trace_path": _trace_json(name)}}
@@ -1412,7 +1420,7 @@ def _serve_load_multi_body():
             snap["aggregate"][key] -= warm[key]
         router.stop()
         _reset_topology()
-        return res["tokens_per_sec"], res["ttft_p95_ms"], snap
+        return res, snap
 
     tel = Telemetry(TelemetryConfig(
         enabled=True, jsonl_path=_telemetry_jsonl("serve_load_multi"),
@@ -1421,9 +1429,11 @@ def _serve_load_multi_body():
     # reuse run FIRST: the second run inherits this process's warm XLA
     # compile cache, so running the no-reuse control second biases the
     # comparison AGAINST the cache — the reported win is conservative
-    tps_on, p95_on, snap = run_once(True, telemetry=tel)
-    tps_off, p95_off, _ = run_once(False)
+    res_on, snap = run_once(True, telemetry=tel)
+    res_off, _ = run_once(False)
     tel.close()
+    tps_on, p95_on = res_on["tokens_per_sec"], res_on["ttft_p95_ms"]
+    tps_off, p95_off = res_off["tokens_per_sec"], res_off["ttft_p95_ms"]
     agg = snap["aggregate"]
     hits, misses = agg["prefix_hits"], agg["prefix_misses"]
     return {
@@ -1437,6 +1447,10 @@ def _serve_load_multi_body():
         "vs_baseline": round(tps_on / tps_off, 3) if tps_off else 0.0,
         "ttft_p95_ms": round(p95_on, 1),
         "ttft_p95_ms_noreuse": round(p95_off, 1),
+        # frozen-key SLO ledger block (telemetry/slo.py SLO_BLOCK_KEYS):
+        # attainment over the reuse run's per-request measurements, with
+        # per-scenario-phase attainment under by_scenario
+        "slo": _slo_spec().evaluate(res_on["requests"]),
         "prefix_hit_rate": round(hits / max(1, hits + misses), 3),
         "prefill_tokens_saved": int(agg["prefill_tokens_saved"]),
         "n_replicas": n_rep,
@@ -1612,6 +1626,15 @@ def _drive_schedule(router, schedule, speculative: bool = False,
                         if getattr(s, "handoff_ms", None) is not None)
     handoff_bytes = [s.handoff_bytes for s in streams
                      if getattr(s, "handoff_bytes", None) is not None]
+    # per-request measurements keyed by scenario mix — the SLO
+    # evaluator's input (telemetry/slo.py SLOSpec.evaluate)
+    requests = [{
+        "scenario": r["mix"],
+        "ttft_ms": ((first_at[i] - submit_at[i]) * 1e3
+                    if first_at[i] > 0 else None),
+        "tpot_ms": ((last_at[i] - first_at[i]) / (counts[i] - 1) * 1e3
+                    if counts[i] > 1 and first_at[i] > 0 else None),
+    } for i, r in enumerate(schedule)]
     return {
         "tokens_per_sec": sum(counts) / dt,
         "ttft_p95_ms": p95(ttft_ms), "tpot_p95_ms": p95(tpot_ms),
@@ -1620,7 +1643,26 @@ def _drive_schedule(router, schedule, speculative: bool = False,
         "handoff_ms_p95": p95(handoff_ms),
         "handoff_bytes_per_req": (sum(handoff_bytes)
                                   / max(1, len(handoff_bytes))),
+        "requests": requests,
     }
+
+
+def _slo_spec():
+    """The bench rows' SLO targets (serving.slo shape): generous enough
+    that a healthy CPU-smoke run attains them, tight enough that a
+    regression (a stuck tier, a starved queue) shows as burn.  The
+    prefill-dominated mix gets a looser TTFT target — exactly what
+    scenario_overrides exists for."""
+    from deepspeed_tpu.telemetry.slo import SLOSpec
+
+    t = ({"ttft_p95_ms": 20_000.0, "tpot_p95_ms": 10_000.0,
+          "queue_wait_p95_ms": 20_000.0} if SMOKE
+         else {"ttft_p95_ms": 2_000.0, "tpot_p95_ms": 250.0,
+               "queue_wait_p95_ms": 1_000.0})
+    return SLOSpec({"enabled": True, "objective": 0.99, **t,
+                    "scenario_overrides": {
+                        "long_prompt_short_decode":
+                            {"ttft_p95_ms": 2 * t["ttft_p95_ms"]}}})
 
 
 def _serve_disagg_body():
@@ -1660,7 +1702,8 @@ def _serve_disagg_body():
                                   rate, SMOKE)
     mix_counts = {m: sum(1 for r in schedule if r["mix"] == m)
                   for m in SCENARIO_MIXES}
-    srv_cfg = {"prefix_cache": {"enabled": True}}
+    srv_cfg = {"prefix_cache": {"enabled": True},
+               "metrics_window_s": 60.0}
     # warm set spans the shape buckets: a couple of typical prompts plus
     # one long-prompt entry (its block-table bucket compiles separately)
     warm = [r["prompt"] for r in schedule[:2]]
@@ -1682,15 +1725,20 @@ def _serve_disagg_body():
     router = DisaggRouter(rs, telemetry=tel).start()
     # compile off the clock: speculative submits so the draft + verify-k
     # buckets (not just prefill/decode) are warm before the window opens
+    from deepspeed_tpu.serving import FleetSampler
     from deepspeed_tpu.serving import SamplingParams as _SP
     for s in [router.submit(p, _SP(max_new_tokens=6, speculative=True))
               for p in warm]:
         s.result(timeout=600)
+    sampler = FleetSampler(rs, router=router, slo=_slo_spec(),
+                           cadence_s=0.25,
+                           jsonl_path=_fleet_jsonl("serve_disagg"),
+                           telemetry=tel).start()
     dis = _drive_schedule(router, schedule, speculative=True)
     snap = router.snapshot()
-    agg = snap["aggregate"]["replicas"]
-    spec_prop = sum(r.get("spec_proposed", 0) for r in agg.values())
-    spec_acc = sum(r.get("spec_accepted", 0) for r in agg.values())
+    sampler.sample_once()          # final tick covers the drive's tail
+    fleet = sampler.latest()
+    sampler.stop()
     router.stop()
     _reset_topology()
     tel.close()
@@ -1720,7 +1768,13 @@ def _serve_disagg_body():
         "handoff_ms_p95": round(dis["handoff_ms_p95"], 2),
         "handoff_bytes_per_req": round(dis["handoff_bytes_per_req"], 1),
         "handoffs": snap["handoffs"],
-        "spec_accept_rate": round(spec_acc / max(1, spec_prop), 3),
+        "spec_accept_rate": round(
+            snap["aggregate"]["spec_accept_rate"], 3),
+        # frozen-key SLO ledger block (telemetry/slo.py SLO_BLOCK_KEYS)
+        # with per-scenario-phase attainment under by_scenario
+        "slo": _slo_spec().evaluate(dis["requests"]),
+        "fleet_jsonl": _fleet_jsonl("serve_disagg"),
+        "fleet_tiers": sorted(fleet),
         "scenario_mix": mix_counts,
         "completed_disagg": dis["completed"],
         "completed_homog": hom["completed"],

@@ -494,6 +494,40 @@ class DisaggServingConfig:
 
 
 @dataclass
+class SLOServingConfig:
+    """``"serving": {"slo": {...}}`` — latency objectives feeding the
+    fleet SLO ledger (telemetry/slo.py; docs/OBSERVABILITY.md "Fleet
+    snapshots & SLO ledger"): p95 targets in ms (0 = not targeted), an
+    attainment ``objective`` in (0, 1], and per-scenario target
+    overrides keyed by bench scenario-mix name.  Consumed by the
+    ``serve_disagg``/``serve_load_multi`` bench rows (frozen-key ``slo``
+    block) and by ``FleetSampler`` ticks — the PR-19 autoscaler's
+    scale-up evidence."""
+    enabled: bool = False
+    ttft_p95_ms: float = 0.0
+    tpot_p95_ms: float = 0.0
+    queue_wait_p95_ms: float = 0.0
+    objective: float = 0.99
+    scenario_overrides: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        # parse through the telemetry-side SLOSpec: ITS validation is
+        # the contract (bad objective, unknown override keys), and the
+        # round-trip doubles as the drift tripwire for this block
+        from deepspeed_tpu.telemetry.slo import SLOSpec
+        try:
+            parsed = SLOSpec(dict(vars(self)))
+        except ValueError as e:
+            raise DeepSpeedConfigError(f"serving.slo: {e}") from e
+        missing = set(vars(self)) - set(vars(parsed))
+        if missing:
+            raise DeepSpeedConfigError(
+                f"serving.slo keys {sorted(missing)} are not understood "
+                "by telemetry.slo.SLOSpec — add them to the telemetry-"
+                "side parser in the same commit")
+
+
+@dataclass
 class ServingTierConfig:
     """``"serving"`` block — the multi-replica serving tier: N
     data-parallel replicas on disjoint mesh slices behind one router
@@ -502,12 +536,14 @@ class ServingTierConfig:
     serving classes directly, so the block round-trips into
     ``ReplicaSet.build`` + ``Router`` with no translation layer."""
     n_replicas: int = 1
+    metrics_window_s: float = 0.0
     router: RouterServingConfig = field(
         default_factory=RouterServingConfig)
     prefix_cache: PrefixCacheServingConfig = field(
         default_factory=PrefixCacheServingConfig)
     disagg: DisaggServingConfig = field(
         default_factory=DisaggServingConfig)
+    slo: SLOServingConfig = field(default_factory=SLOServingConfig)
 
     def __post_init__(self):
         if isinstance(self.router, dict):
@@ -520,9 +556,16 @@ class ServingTierConfig:
         if isinstance(self.disagg, dict):
             self.disagg = _from_dict(DisaggServingConfig, self.disagg,
                                      "serving.disagg")
+        if isinstance(self.slo, dict):
+            self.slo = _from_dict(SLOServingConfig, self.slo,
+                                  "serving.slo")
         if self.n_replicas < 1:
             raise DeepSpeedConfigError(
                 f"serving.n_replicas={self.n_replicas}: must be >= 1")
+        if self.metrics_window_s < 0:
+            raise DeepSpeedConfigError(
+                f"serving.metrics_window_s={self.metrics_window_s}: "
+                "must be >= 0 (0 = lifetime window)")
         if self.disagg.enabled:
             want = (self.disagg.prefill_replicas
                     + self.disagg.decode_replicas)
@@ -569,7 +612,8 @@ class ServingTierConfig:
 
     def server_config(self) -> Dict[str, Any]:
         """Per-replica ``InferenceServer`` config dict."""
-        return {"prefix_cache": self.prefix_cache_config()}
+        return {"prefix_cache": self.prefix_cache_config(),
+                "metrics_window_s": self.metrics_window_s}
 
     def router_config(self) -> Dict[str, Any]:
         """``Router`` config dict."""
@@ -582,6 +626,10 @@ class ServingTierConfig:
         d = dict(vars(self.disagg))
         d["speculative"] = dict(vars(self.disagg.speculative))
         return d
+
+    def slo_config(self) -> Dict[str, Any]:
+        """``serving.slo`` dict for ``telemetry.slo.SLOSpec``."""
+        return dict(vars(self.slo))
 
 
 @dataclass

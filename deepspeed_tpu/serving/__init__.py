@@ -6,10 +6,15 @@ See docs/SERVING.md for the architecture (queue → admission → SplitFuse
 
 from deepspeed_tpu.serving.admission import (AdmissionConfig,
                                              AdmissionController)
-from deepspeed_tpu.serving.disagg import (DisaggConfig, DisaggRouter,
+from deepspeed_tpu.serving.disagg import (REQUEST_TIMELINE_KEYS,
+                                          DisaggConfig, DisaggRouter,
                                           SpeculativeConfig,
                                           SpeculativeDecoder)
-from deepspeed_tpu.serving.metrics import RouterMetrics, ServingMetrics
+from deepspeed_tpu.serving.fleet import (TIER_SNAPSHOT_KEYS,
+                                         TIER_SNAPSHOT_SCHEMA,
+                                         FleetSampler)
+from deepspeed_tpu.serving.metrics import (RouterMetrics, ServingMetrics,
+                                           spec_accept_rate)
 from deepspeed_tpu.serving.prefix_cache import PrefixCache, PrefixCacheConfig
 from deepspeed_tpu.serving.replica import ReplicaSet, ServingReplica
 from deepspeed_tpu.serving.request import (DeadlineExceeded,
@@ -21,10 +26,11 @@ from deepspeed_tpu.serving.server import InferenceServer, ServerConfig
 
 __all__ = [
     "AdmissionConfig", "AdmissionController", "DeadlineExceeded",
-    "DisaggConfig", "DisaggRouter", "GenerationRequest",
+    "DisaggConfig", "DisaggRouter", "FleetSampler", "GenerationRequest",
     "InferenceServer", "PrefixCache", "PrefixCacheConfig", "QueueFull",
-    "ReplicaSet", "RequestCancelled", "ResponseStream", "Router",
-    "RouterConfig", "RouterMetrics", "SamplingParams", "ServerConfig",
-    "ServingError", "ServingMetrics", "ServingReplica",
-    "SpeculativeConfig", "SpeculativeDecoder",
+    "REQUEST_TIMELINE_KEYS", "ReplicaSet", "RequestCancelled",
+    "ResponseStream", "Router", "RouterConfig", "RouterMetrics",
+    "SamplingParams", "ServerConfig", "ServingError", "ServingMetrics",
+    "ServingReplica", "SpeculativeConfig", "SpeculativeDecoder",
+    "TIER_SNAPSHOT_KEYS", "TIER_SNAPSHOT_SCHEMA", "spec_accept_rate",
 ]

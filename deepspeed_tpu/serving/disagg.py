@@ -49,6 +49,8 @@ scope — engines are built by ``ReplicaSet.build``.
 
 from __future__ import annotations
 
+import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
 from deepspeed_tpu.serving.request import GenerationRequest, ServingError
@@ -57,6 +59,17 @@ from deepspeed_tpu.utils.logging import log_dist
 
 #: replica tier vocabulary (ServingReplica.tier)
 REPLICA_TIERS = ("prefill", "decode", "unified")
+
+#: frozen key set of one RequestTimeline row — the per-request phase
+#: breakdown the DisaggRouter stamps onto ``stream.timeline`` at finish
+#: and keeps in its bounded ring (``DisaggRouter.timelines()``); linted
+#: by tools/telemetry_check.py against docs/OBSERVABILITY.md
+REQUEST_TIMELINE_KEYS = ("decode_ms", "failovers", "handoff_bytes",
+                        "handoff_ms", "prefill_ms", "total_ms",
+                        "trace_id", "uid")
+
+#: RequestTimeline ring bound (oldest dropped)
+_TIMELINE_RING = 1024
 
 
 class SpeculativeConfig:
@@ -279,6 +292,15 @@ class DisaggRouter(Router):
                 "DisaggRouter needs at least one prefill-tier and one "
                 f"decode-tier replica (got tiers {sorted(tiers)}); build "
                 "the ReplicaSet with disagg={'enabled': True, ...}")
+        # finished-request phase breakdowns (REQUEST_TIMELINE_KEYS),
+        # newest last; appended under self._lock by the pump threads
+        self._timelines: deque = deque(maxlen=_TIMELINE_RING)
+
+    def timelines(self) -> List[Dict[str, Any]]:
+        """Recent per-request phase timelines (oldest first) — each row
+        carries exactly :data:`REQUEST_TIMELINE_KEYS`."""
+        with self._lock:
+            return list(self._timelines)
 
     # -- tier-aware dispatch --------------------------------------------
     def _candidates(self, tier: Optional[str],
@@ -339,6 +361,15 @@ class DisaggRouter(Router):
                 or (eos is not None and rr.delivered
                     and rr.delivered[-1] == eos))
 
+    def _leg_done(self, rr: _RoutedRequest) -> None:
+        # bank the leg's wall time under its phase BEFORE releasing the
+        # inflight slot; failed-over legs accumulate (the timeline shows
+        # total time spent in each phase, retries included)
+        phase = rr.phase or "unified"
+        rr.legs[phase] = (rr.legs.get(phase, 0.0)
+                          + (time.monotonic() - rr.leg_t0) * 1e3)
+        super()._leg_done(rr)
+
     def _pump_loop(self, rr: _RoutedRequest,
                    session: Optional[str]) -> None:
         out = rr.stream
@@ -388,4 +419,21 @@ class DisaggRouter(Router):
             rr.stream.handoff_ms = round(ms, 3)
             rr.stream.handoff_bytes = int(nbytes)
             rr.payload = None     # exactly-once accounting
+        # RequestTimeline: the cross-tier phase breakdown, stamped on the
+        # caller's stream AND kept in the ring — terminal errors included
+        # (a failed request's phase split is exactly what triage wants)
+        tl: Dict[str, Any] = {
+            "uid": rr.uid,
+            "trace_id": rr.trace_id,
+            "prefill_ms": round(rr.legs.get("prefill", 0.0), 3),
+            "decode_ms": round(rr.legs.get("decode", 0.0)
+                               + rr.legs.get("unified", 0.0), 3),
+            "handoff_ms": rr.stream.handoff_ms or 0.0,
+            "handoff_bytes": rr.stream.handoff_bytes or 0,
+            "failovers": rr.failovers,
+            "total_ms": round((time.monotonic() - rr.t_submit) * 1e3, 3),
+        }
+        rr.stream.timeline = tl
+        with self._lock:
+            self._timelines.append(tl)
         super()._finish(rr, error)

@@ -33,16 +33,29 @@ _WINDOW = 2048  # per-distribution sample cap
 _OUTCOMES = ("completed", "failed", "cancelled", "expired")
 
 
+def spec_accept_rate(proposed: int, accepted: int) -> float:
+    """THE accept-rate definition: accepted/proposed draft tokens, 0.0
+    when no rounds ran.  One function so ``snapshot()``, the replica-set
+    rollup, the fleet sampler, and bench.py cannot drift on the
+    denominator (bonus tokens are excluded by construction — see
+    :meth:`ServingMetrics.record_spec_round`)."""
+    return accepted / max(1, proposed)
+
+
 class ServingMetrics:
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 label: str = ""):
+                 label: str = "", window_s: float = 0.0):
         """``label`` namespaces the MONITOR tags (``serving/<label>/…``)
         for per-replica export under a router; metric names are
         unchanged, so per-replica instances must use per-replica
         registries (the default) — sharing one registry would merge the
-        replicas' counters."""
+        replicas' counters.  ``window_s > 0`` time-bounds the latency
+        histograms (``max_age_s``) so an idle server's percentiles decay
+        instead of pinning at the last burst — required under a
+        ``FleetSampler`` (server config key ``metrics_window_s``)."""
         self.registry = registry or MetricsRegistry()
         self.label = label
+        self.window_s = float(window_s)
         reg = self.registry
         self._t0 = time.monotonic()
         # counters
@@ -57,17 +70,19 @@ class ServingMetrics:
                    + _OUTCOMES}
         # distributions (seconds)
         self._ttft = reg.histogram("serving_ttft_seconds",
-                                   "submit to first token", window=_WINDOW)
+                                   "submit to first token", window=_WINDOW,
+                                   max_age_s=self.window_s)
         self._tpot = reg.histogram("serving_tpot_seconds",
                                    "steady-state time per output token",
-                                   window=_WINDOW)
+                                   window=_WINDOW, max_age_s=self.window_s)
         self._queue_wait = reg.histogram("serving_queue_wait_seconds",
                                          "submit to admission",
-                                         window=_WINDOW)
+                                         window=_WINDOW,
+                                         max_age_s=self.window_s)
         self._handoff = reg.histogram(
             "serving_handoff_seconds",
             "KV-chain export/import time, one observation per side",
-            window=_WINDOW)
+            window=_WINDOW, max_age_s=self.window_s)
         # gauges (set by the serve loop each iteration)
         self._g_queue_depth = reg.gauge("serving_queue_depth")
         self._g_active = reg.gauge("serving_active_requests")
@@ -185,6 +200,15 @@ class ServingMetrics:
         self._g_prefix_blocks.set(prefix_cached_blocks)
 
     # -- reading ---------------------------------------------------------
+    def latency_values(self) -> Dict[str, List[float]]:
+        """Raw current-window latency samples (seconds), for cross-
+        replica pooling: a tier percentile must be computed over the
+        POOLED samples of its replicas, not an average of per-replica
+        percentiles — the fleet sampler's read path."""
+        return {"ttft": self._ttft.values(),
+                "tpot": self._tpot.values(),
+                "queue_wait": self._queue_wait.values()}
+
     def snapshot(self) -> Dict[str, object]:
         elapsed = max(time.monotonic() - self._t0, 1e-9)
         tokens_out = self.tokens_out
@@ -217,8 +241,8 @@ class ServingMetrics:
             "spec_rounds": self.spec_rounds,
             "spec_proposed": self.spec_proposed,
             "spec_accepted": self.spec_accepted,
-            "spec_accept_rate": (self.spec_accepted
-                                 / max(1, self.spec_proposed)),
+            "spec_accept_rate": spec_accept_rate(self.spec_proposed,
+                                                 self.spec_accepted),
             "ttft": self._ttft.snapshot(),
             "tpot": self._tpot.snapshot(),
             "queue_wait": self._queue_wait.snapshot(),

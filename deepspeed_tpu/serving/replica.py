@@ -23,6 +23,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Sequence
 
 from deepspeed_tpu.serving.admission import AdmissionController
+from deepspeed_tpu.serving.metrics import spec_accept_rate
 from deepspeed_tpu.serving.server import InferenceServer
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -318,12 +319,22 @@ class ReplicaSet:
 
     def snapshot(self) -> Dict[str, Any]:
         per = {r.name: r.snapshot() for r in self.replicas}
+        proposed = sum(s["spec_proposed"] for s in per.values())
+        accepted = sum(s["spec_accepted"] for s in per.values())
         return {
             "replicas": per,
             "alive": len(self.alive),
             "tokens_out": sum(s["tokens_out"] for s in per.values()),
+            "tokens_per_sec": sum(s["tokens_per_sec"]
+                                  for s in per.values()),
             "prefix_hits": sum(s["prefix_hits"] for s in per.values()),
             "prefix_misses": sum(s["prefix_misses"] for s in per.values()),
             "prefill_tokens_saved": sum(s["prefill_tokens_saved"]
                                         for s in per.values()),
+            "handoffs_in": sum(s["handoffs_in"] for s in per.values()),
+            "handoffs_out": sum(s["handoffs_out"] for s in per.values()),
+            "handoff_bytes": sum(s["handoff_bytes"] for s in per.values()),
+            "spec_proposed": proposed,
+            "spec_accepted": accepted,
+            "spec_accept_rate": spec_accept_rate(proposed, accepted),
         }

@@ -82,6 +82,9 @@ class RoutedStream(ResponseStream):
         # transfer latency and bytes moved (0 = zero-copy ref acquire)
         self.handoff_ms: Optional[float] = None
         self.handoff_bytes: Optional[int] = None
+        # per-request phase breakdown (disagg.REQUEST_TIMELINE_KEYS),
+        # stamped at finish by the disagg router
+        self.timeline: Optional[Dict[str, object]] = None
 
     def _attach(self, inner: ResponseStream) -> None:
         with self._cond:
@@ -103,7 +106,8 @@ class _RoutedRequest:
 
     __slots__ = ("uid", "prompt", "params", "priority", "deadline",
                  "stream", "replica", "inner", "delivered", "failovers",
-                 "trace_id", "span", "phase", "payload")
+                 "trace_id", "span", "phase", "payload", "leg_t0", "legs",
+                 "t_submit")
 
     def __init__(self, uid: int, prompt: List[int], params: SamplingParams,
                  priority: int, deadline: Optional[float],
@@ -125,6 +129,11 @@ class _RoutedRequest:
         # the KV payload riding from the prefill leg to the decode leg
         self.phase: Optional[str] = None
         self.payload = None
+        # per-leg wall timing for the RequestTimeline export (disagg):
+        # _dispatch stamps leg_t0, the disagg pump banks phase -> ms
+        self.leg_t0 = 0.0
+        self.legs: Dict[str, float] = {}
+        self.t_submit = time.monotonic()
 
 
 class Router:
@@ -155,9 +164,37 @@ class Router:
         if self._started:
             raise RuntimeError("router already started")
         self._started = True
+        for rep in self.replicas:
+            # before replicas.start(): server.start() then wires the
+            # adopted tracer into its engine itself
+            self._adopt_tracer(rep)
         self.replicas.start()
         self.metrics.set_alive(len(self.replicas.alive))
         return self
+
+    def _adopt_tracer(self, rep: ServingReplica) -> None:
+        """Replica servers built without a telemetry hub carry DISABLED
+        tracers — under a traced router their serve-side spans (queue
+        wait, prefill, decode, handoff) would simply vanish.  Point such
+        a server at the router's tracer so ONE Chrome trace shows a
+        request end to end across tiers.  A server that brought its own
+        enabled tracer keeps it (it owns its export)."""
+        srv = rep.server
+        if not self.tracer.enabled or srv.tracer.enabled:
+            return
+        srv.tracer = self.tracer
+        srv.admission.tracer = self.tracer
+        srv._loop_trace_id = self.tracer.new_trace_id()
+        if srv._thread is not None:
+            # grown/respawned replica, serve loop already running: redo
+            # the tracer wiring start() does (attribute stores are atomic
+            # — the loop picks the new tracer up on its next span)
+            if hasattr(srv.engine, "tracer"):
+                srv.engine.tracer = self.tracer
+                srv.engine.trace_id = srv._loop_trace_id
+            if srv._spec is not None:
+                srv._spec.bind(self.tracer, srv._loop_trace_id,
+                               srv.metrics)
 
     def stop(self, drain: bool = True,
              timeout: Optional[float] = None) -> None:
@@ -185,8 +222,7 @@ class Router:
             # record_serving_step reads tokens_out / tokens_per_sec at the
             # TOP level (the flattened copies carry aggregate_ prefixes)
             flat["tokens_out"] = float(agg["tokens_out"])
-            flat["tokens_per_sec"] = float(sum(
-                r["tokens_per_sec"] for r in agg["replicas"].values()))
+            flat["tokens_per_sec"] = float(agg["tokens_per_sec"])
             self.telemetry.record_serving_step(self.metrics.requests, flat)
 
     def __enter__(self) -> "Router":
@@ -275,17 +311,29 @@ class Router:
                        ServingError("no live replica to dispatch to"))
             deadline_s = (None if rr.deadline is None
                           else rr.deadline - time.monotonic())
+            self._adopt_tracer(rep)   # grown/respawned after start()
+            trace_kw = {}
+            if (self.tracer.enabled
+                    and rep.server.tracer is self.tracer):
+                # same tracer on both sides -> the serve-side request
+                # span chains under the routed-request root span and
+                # keeps the caller-visible trace_id; a server with its
+                # OWN tracer gets neither (span ids are per-tracer
+                # counters — a foreign parent id would alias)
+                trace_kw = {"trace_id": rr.trace_id,
+                            "parent_span": rr.span}
             try:
                 inner = rep.server.submit(prompt, params,
                                           priority=rr.priority,
                                           deadline_s=deadline_s,
-                                          **submit_kw)
+                                          **submit_kw, **trace_kw)
             except QueueFull as e:
                 tried.append(rep.index)
                 last_error = e
                 continue
             rr.replica = rep
             rr.inner = inner
+            rr.leg_t0 = time.monotonic()
             rr.stream._attach(inner)
             with self._lock:
                 self._inflight[rep.index] = \

@@ -96,6 +96,10 @@ class ServerConfig:
         # export metrics through `monitor` every N engine steps (0 = only
         # at stop()); the monitor is any object with write_events()
         self.metrics_interval_steps = int(d.get("metrics_interval_steps", 0))
+        # time-bound the latency percentile windows (seconds; 0 = count-
+        # bounded only): under a FleetSampler an idle replica's p95 must
+        # decay instead of pinning at its last burst
+        self.metrics_window_s = float(d.get("metrics_window_s", 0.0))
         # standalone span tracing / flight recorder (same keys as the
         # engine's telemetry.tracing / telemetry.flight blocks); ignored
         # when a telemetry hub is passed — the hub's tracer/ring win so
@@ -123,7 +127,8 @@ class InferenceServer:
         self.telemetry = telemetry
         self.metrics = ServingMetrics(
             registry=telemetry.registry if telemetry is not None else None,
-            label=self.cfg.metrics_label)
+            label=self.cfg.metrics_label,
+            window_s=self.cfg.metrics_window_s)
         self.admission = AdmissionController(self.cfg.admission)
         # owned and touched ONLY by the serve thread (like the engine);
         # refcounts on the engine's allocator keep shared pages safe
@@ -281,7 +286,8 @@ class InferenceServer:
                params: Optional[SamplingParams] = None, priority: int = 0,
                deadline_s: Optional[float] = None,
                timeout: Optional[float] = None, handoff: bool = False,
-               kv_payload: Any = None) -> ResponseStream:
+               kv_payload: Any = None, trace_id: str = "",
+               parent_span: Any = None) -> ResponseStream:
         """Enqueue one generation request; returns its stream immediately.
 
         ``deadline_s`` is a wall budget from now — queued or mid-decode,
@@ -296,6 +302,13 @@ class InferenceServer:
         ``stream.handoff_payload`` at completion (the prefill leg);
         ``kv_payload`` hands such an export IN — admission adopts the
         covered pages instead of re-prefilling them (the decode leg).
+
+        ``trace_id``/``parent_span`` stitch this request into a caller's
+        existing trace (the router passes its routed-request span so a
+        disagg request's prefill and decode legs chain under ONE
+        trace_id); by default each request roots its own trace.
+        ``parent_span`` must come from THIS server's tracer — span ids
+        are per-tracer counters, so a foreign span would alias.
         """
         params = params or SamplingParams()
         if not len(prompt):
@@ -326,8 +339,10 @@ class InferenceServer:
             handoff=handoff, kv_payload=kv_payload)
         tr = self.tracer
         if tr.enabled:
-            req.trace_id = req.stream.trace_id = tr.new_trace_id()
-            req.span_request = tr.span("serve.request", req.trace_id).set(
+            req.trace_id = req.stream.trace_id = (trace_id
+                                                  or tr.new_trace_id())
+            req.span_request = tr.span("serve.request", req.trace_id,
+                                       parent_span).set(
                 uid=uid, prompt_tokens=len(req.prompt),
                 max_new_tokens=params.max_new_tokens)
             tr.instant("serve.enqueue", req.trace_id, uid=uid)

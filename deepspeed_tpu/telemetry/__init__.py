@@ -21,6 +21,10 @@ from deepspeed_tpu.telemetry.record import (SCHEMA_VERSION, StepRecord,
                                             record_keys)
 from deepspeed_tpu.telemetry.registry import (Counter, Gauge, Histogram,
                                               MetricsRegistry)
+from deepspeed_tpu.telemetry.slo import (SLO_BLOCK_KEYS, SLO_LEDGER_KEYS,
+                                         SLO_SCENARIO_KEYS,
+                                         SLO_TARGET_KEYS, SLOLedger,
+                                         SLOSpec)
 from deepspeed_tpu.telemetry.tracing import (EVENT_NAMES, NULL_SPAN,
                                              NULL_TRACER, SPAN_NAMES, Span,
                                              Tracer)
@@ -40,7 +44,9 @@ __all__ = [
     "AutoCapture", "Counter", "EVENT_NAMES", "EXPORT_TAGS",
     "FLIGHT_REASONS", "FlightRecorder", "Gauge", "Histogram",
     "JsonlExporter", "MetricsRegistry", "NULL_SPAN", "NULL_TRACER",
-    "SCHEMA_VERSION", "SPAN_NAMES", "Span", "StepRecord", "Telemetry",
+    "SCHEMA_VERSION", "SLOLedger", "SLOSpec", "SLO_BLOCK_KEYS",
+    "SLO_LEDGER_KEYS", "SLO_SCENARIO_KEYS", "SLO_TARGET_KEYS",
+    "SPAN_NAMES", "Span", "StepRecord", "Telemetry",
     "Tracer", "Watchdog", "build_capture_report", "collect_hbm_stats",
     "detect_peak_flops_per_sec", "dump_bundle", "events_from_record",
     "make_span_recorder", "read_jsonl", "record_keys",

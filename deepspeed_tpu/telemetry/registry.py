@@ -9,7 +9,13 @@ naming and a text-exposition renderer live in ``telemetry/export.py``.
 Histograms keep a bounded sliding window of the most recent samples
 (long-lived servers must not grow without bound) for the percentile
 snapshot, while ``count``/``sum`` track every observation ever made
-(the Prometheus counter semantics).
+(the Prometheus counter semantics).  The window can additionally be
+TIME-bounded (``max_age_s``): samples older than the horizon fall out
+of the percentile view, so an idle serving tier's p95 decays to empty
+instead of reporting its last burst forever — the property the fleet
+sampler (serving/fleet.py) needs for cadence-tick SLO ledgers.  The
+default (``max_age_s=0``) keeps the original count-bounded behavior
+exactly.
 
 Every primitive is individually thread-safe; the registry is safe for
 concurrent get-or-create.
@@ -19,6 +25,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
@@ -95,27 +102,45 @@ class Histogram:
     """Sliding-window distribution with p50/p95/p99 snapshots.
 
     ``count``/``sum`` are lifetime totals; percentiles are computed over
-    the most recent ``window`` samples.
+    the most recent ``window`` samples — further restricted to the last
+    ``max_age_s`` seconds when a time bound is set (0 = count-bounded
+    only, the original behavior).  Expired samples are pruned lazily on
+    every observe/read, so an idle time-bounded window drains to empty
+    (all-zero percentiles) instead of pinning at its last burst.
     """
 
     kind = "histogram"
 
     def __init__(self, name: str, help: str = "",
-                 window: int = DEFAULT_WINDOW):
+                 window: int = DEFAULT_WINDOW, max_age_s: float = 0.0):
         if window < 1:
             raise ValueError(f"histogram {name}: window must be >= 1")
+        if max_age_s < 0:
+            raise ValueError(f"histogram {name}: max_age_s must be >= 0")
         self.name = name
         self.help = help
         self.window = window
+        self.max_age_s = float(max_age_s)
         self._lock = threading.Lock()
-        self._samples: Deque[float] = deque(maxlen=window)
+        # (monotonic timestamp, value) — the timestamp is dead weight
+        # for pure count-bounded histograms but keeps one code path
+        self._samples: Deque[Tuple[float, float]] = deque(maxlen=window)
         self._count = 0
         self._sum = 0.0
+
+    def _window_values(self) -> List[float]:
+        """Current-window values; caller holds the lock.  Prunes expired
+        samples in place when a time bound is set."""
+        if self.max_age_s > 0:
+            cutoff = time.monotonic() - self.max_age_s
+            while self._samples and self._samples[0][0] < cutoff:
+                self._samples.popleft()
+        return [v for _, v in self._samples]
 
     def observe(self, v: float) -> None:
         v = float(v)
         with self._lock:
-            self._samples.append(v)
+            self._samples.append((time.monotonic(), v))
             self._count += 1
             self._sum += v
 
@@ -124,15 +149,22 @@ class Histogram:
         with self._lock:
             return self._count
 
+    def values(self) -> List[float]:
+        """Raw current-window samples (oldest first).  The fleet sampler
+        pools these across replicas — a tier p95 must be a percentile of
+        the POOLED samples, not an average of per-replica p95s."""
+        with self._lock:
+            return self._window_values()
+
     def snapshot(self) -> Dict[str, float]:
         """{"p50", "p95", "p99", "mean", "count"} over the window (count
         is lifetime).  An empty histogram snapshots to all-zeros."""
         with self._lock:
-            xs = sorted(self._samples)
+            xs = sorted(self._window_values())
             count = self._count
         if not xs:
             return {"p50": 0.0, "p95": 0.0, "p99": 0.0,
-                    "mean": 0.0, "count": 0}
+                    "mean": 0.0, "count": count}
         return {"p50": _percentile(xs, 50.0),
                 "p95": _percentile(xs, 95.0),
                 "p99": _percentile(xs, 99.0),
@@ -141,7 +173,7 @@ class Histogram:
 
     def quantile(self, q: float) -> float:
         with self._lock:
-            xs = sorted(self._samples)
+            xs = sorted(self._window_values())
         return _percentile(xs, q)
 
     def lifetime(self) -> Tuple[int, float]:
@@ -183,8 +215,13 @@ class MetricsRegistry:
         return self._get_or_create(Gauge, name, help)
 
     def histogram(self, name: str, help: str = "",
-                  window: int = DEFAULT_WINDOW) -> Histogram:
-        return self._get_or_create(Histogram, name, help, window=window)
+                  window: int = DEFAULT_WINDOW,
+                  max_age_s: float = 0.0) -> Histogram:
+        """``max_age_s > 0`` time-bounds the percentile window (see
+        :class:`Histogram`); like ``window``, it only applies when this
+        call CREATES the histogram — re-requests return the original."""
+        return self._get_or_create(Histogram, name, help, window=window,
+                                   max_age_s=max_age_s)
 
     def get(self, name: str) -> Optional[object]:
         with self._lock:

@@ -43,6 +43,7 @@ from typing import Any, Dict, List, Optional
 
 # Duration spans (Chrome "X" complete events).
 SPAN_NAMES = (
+    "fleet.sample",            # one fleet-sampler cadence tick (all tiers)
     "offload.d2h",             # chunked offload: grad chunk device->host
     "offload.h2d",             # chunked offload: updated leaf host->device
     "offload.host_step",       # chunked offload: host Adam on one chunk
@@ -80,6 +81,7 @@ EVENT_NAMES = (
     "serve.first_token",       # request's first decoded token
     "serve.preempt",           # request evicted for KV pressure
     "serve.prefix_hit",        # admission adopted cached prefix pages
+    "slo.violation",           # a tier tick breached an SLO target
     "spec.accept",             # verify round outcome (proposed/accepted)
     "watchdog.fire",           # hang watchdog dumped a flight bundle
 )

@@ -1,0 +1,367 @@
+"""Fleet observability plane (serving/fleet.py + telemetry/slo.py):
+SLO specs and ledgers, frozen-schema TierSnapshot sampling — including
+under live ``grow()/shrink()/respawn()`` — and the stitched cross-tier
+disagg trace: prefill leg, KV handoff, and decode leg chained under ONE
+caller-visible trace_id.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models import get_model_config
+from deepspeed_tpu.runtime.config import TelemetryConfig
+from deepspeed_tpu.serving import (REQUEST_TIMELINE_KEYS,
+                                   TIER_SNAPSHOT_KEYS,
+                                   TIER_SNAPSHOT_SCHEMA, DisaggRouter,
+                                   FleetSampler, ReplicaSet, Router,
+                                   SamplingParams, ServingMetrics)
+from deepspeed_tpu.telemetry import Telemetry
+from deepspeed_tpu.telemetry.slo import (SLO_BLOCK_KEYS, SLO_LEDGER_KEYS,
+                                         SLO_SCENARIO_KEYS, SLOLedger,
+                                         SLOSpec)
+
+ENG_CFG = {"dtype": "float32",
+           "memory_config": {"num_blocks": 64, "block_size": 4},
+           "max_context": 64}
+
+DISAGG = {"enabled": True, "prefill_replicas": 1, "decode_replicas": 1,
+          "speculative": {"enabled": True, "draft_model": "llama-tiny",
+                          "spec_k": 3}}
+
+
+def _model(layers=1):
+    return get_model_config("llama-tiny", num_layers=layers)
+
+
+def _prompts(model, sizes, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, model.vocab_size, size=n).tolist()
+            for n in sizes]
+
+
+# ---------------------------------------------------------------------------
+# SLOSpec / SLOLedger (pure stdlib — no serving stack)
+# ---------------------------------------------------------------------------
+
+def test_slo_spec_targets_overrides_and_validation():
+    spec = SLOSpec({"enabled": True, "ttft_p95_ms": 100.0,
+                    "tpot_p95_ms": 10.0,
+                    "scenario_overrides": {
+                        "long_prompt_short_decode": {"ttft_p95_ms": 200.0}}})
+    assert spec.enabled and spec.objective == 0.99
+    assert spec.targets_for() == {"ttft_p95_ms": 100.0,
+                                  "tpot_p95_ms": 10.0,
+                                  "queue_wait_p95_ms": 0.0}
+    # override is partial: unnamed targets keep the base value
+    assert spec.targets_for("long_prompt_short_decode") == {
+        "ttft_p95_ms": 200.0, "tpot_p95_ms": 10.0,
+        "queue_wait_p95_ms": 0.0}
+    assert spec.targets_for("unknown_mix") == spec.targets_for()
+    with pytest.raises(ValueError, match="objective"):
+        SLOSpec({"objective": 1.5})
+    with pytest.raises(ValueError, match="must be >= 0"):
+        SLOSpec({"ttft_p95_ms": -1})
+    with pytest.raises(ValueError, match="unknown"):
+        SLOSpec({"scenario_overrides": {"burst": {"ttft_p50_ms": 5}}})
+
+
+def test_slo_evaluate_frozen_block_and_per_scenario_attainment():
+    spec = SLOSpec({"enabled": True, "ttft_p95_ms": 100.0,
+                    "tpot_p95_ms": 10.0, "objective": 0.9,
+                    "scenario_overrides": {"long": {"ttft_p95_ms": 500.0}}})
+    reqs = (
+        # chat: 3 good, 1 TTFT violation, 1 TPOT violation
+        [{"scenario": "chat", "ttft_ms": 50.0, "tpot_ms": 5.0}] * 3
+        + [{"scenario": "chat", "ttft_ms": 150.0, "tpot_ms": 5.0},
+           {"scenario": "chat", "ttft_ms": 50.0, "tpot_ms": 20.0},
+           # long: 300 ms TTFT violates the base target but NOT the
+           # scenario override — must count as attained
+           {"scenario": "long", "ttft_ms": 300.0, "tpot_ms": 5.0},
+           # one-token request: no TPOT measurement ⇒ attained
+           {"scenario": "long", "ttft_ms": 50.0, "tpot_ms": None}])
+    block = spec.evaluate(reqs)
+    assert tuple(sorted(block)) == SLO_BLOCK_KEYS
+    assert block["violations"] == 2
+    assert block["attainment"] == round(1 - 2 / 7, 3)
+    # burn: 2 violations over the (1-0.9)*7 = 0.7 allowed
+    assert block["error_budget_burn"] == round(2 / 0.7, 3)
+    assert set(block["by_scenario"]) == {"chat", "long"}
+    for entry in block["by_scenario"].values():
+        assert tuple(sorted(entry)) == SLO_SCENARIO_KEYS
+    chat = block["by_scenario"]["chat"]
+    assert (chat["n"], chat["violations"]) == (5, 2)
+    assert chat["ttft_attainment"] == round(1 - 1 / 5, 3)
+    assert chat["tpot_attainment"] == round(1 - 1 / 5, 3)
+    long_ = block["by_scenario"]["long"]
+    assert (long_["n"], long_["violations"]) == (2, 0)
+    # zero-budget objective exports a finite burn, never Infinity
+    tight = SLOSpec({"enabled": True, "ttft_p95_ms": 1.0,
+                     "objective": 1.0})
+    burn = tight.evaluate([{"scenario": "x", "ttft_ms": 99.0}])
+    assert burn["error_budget_burn"] == 999.0
+    assert json.loads(json.dumps(burn))   # JSON-safe throughout
+
+
+def test_slo_ledger_streaming_per_tier():
+    spec = SLOSpec({"enabled": True, "ttft_p95_ms": 100.0})
+    ledger = SLOLedger(spec)
+    assert ledger.observe("decode", 50.0, 0.0, 0.0) is False
+    assert ledger.observe("decode", 150.0, 0.0, 0.0) is True
+    assert ledger.observe("prefill", 10.0, 0.0, 0.0) is False
+    snap = ledger.snapshot()
+    assert set(snap) == {"decode", "prefill"}
+    for row in snap.values():
+        assert tuple(sorted(row)) == SLO_LEDGER_KEYS
+    # 1 violation over the (1-0.99)*2 = 0.02 ticks the budget allows
+    assert snap["decode"] == {"ticks": 2, "violations": 1,
+                              "attainment": 0.5,
+                              "error_budget_burn": 50.0}
+    assert snap["prefill"]["attainment"] == 1.0
+
+
+def test_serving_slo_config_block_round_trips():
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+
+    cfg = DeepSpeedConfig({
+        "train_micro_batch_size_per_gpu": 1,
+        "serving": {"n_replicas": 1, "metrics_window_s": 30.0,
+                    "slo": {"enabled": True, "ttft_p95_ms": 2000.0,
+                            "objective": 0.95,
+                            "scenario_overrides": {
+                                "burst": {"tpot_p95_ms": 50.0}}}},
+    })
+    assert cfg.serving.slo.enabled
+    assert cfg.serving.server_config()["metrics_window_s"] == 30.0
+    spec = SLOSpec(cfg.serving.slo_config())
+    assert spec.objective == 0.95
+    assert spec.targets_for("burst")["tpot_p95_ms"] == 50.0
+    for bad in ({"slo": {"objective": 0.0}},
+                {"slo": {"ttft_p95_ms": -5}},
+                {"slo": {"scenario_overrides": {"b": {"nope": 1}}}},
+                {"metrics_window_s": -1}):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                             "serving": {"n_replicas": 1, **bad}})
+
+
+# ---------------------------------------------------------------------------
+# FleetSampler over a fake fleet (schema, pooling, rates, liveness)
+# ---------------------------------------------------------------------------
+
+class _FakeEngine:
+    free_blocks = 10
+
+
+class _FakeServer:
+    def __init__(self, metrics):
+        self.metrics = metrics
+        self.admission = [None] * 2      # len() == queue depth
+        self._active = {1: None}         # len() == running
+        self.prefix_cache = None
+
+
+class _FakeReplica:
+    def __init__(self, tier, window_s=0.0):
+        self.tier = tier
+        self.alive = True
+        self.engine = _FakeEngine()
+        self.server = _FakeServer(ServingMetrics(window_s=window_s))
+        self.kv_headroom = 0.75
+
+
+def test_fleet_sampler_schema_pooling_rates_and_jsonl(tmp_path):
+    a, b = _FakeReplica("decode"), _FakeReplica("decode")
+    p = _FakeReplica("prefill")
+    jsonl = str(tmp_path / "fleet.jsonl")
+    sampler = FleetSampler([a, b, p], cadence_s=0.01, jsonl_path=jsonl)
+    # pooled percentiles: b's slow outlier must dominate the tier p95
+    # even though a holds most of the samples (never average p95s)
+    for _ in range(9):
+        a.server.metrics.record_first_token(0.010)
+    b.server.metrics.record_first_token(0.200)
+    a.server.metrics.record_tokens(30)
+    a.server.metrics.record_spec_round(proposed=10, accepted=8)
+    snap1 = sampler.sample_once()
+    assert set(snap1) == {"decode", "prefill"}
+    for tier, row in snap1.items():
+        assert tuple(sorted(row)) == TIER_SNAPSHOT_KEYS
+        assert row["schema"] == TIER_SNAPSHOT_SCHEMA
+        assert row["tier"] == tier
+    d = snap1["decode"]
+    assert d["replicas_alive"] == 2
+    assert d["queue_depth"] == 4 and d["running"] == 2
+    assert d["evictable_headroom_blocks"] == 20
+    assert d["kv_utilization"] == 0.25
+    assert d["ttft_p95_ms"] > 100.0          # pooled, not averaged
+    assert d["spec_accept_rate"] == 0.8
+    assert d["tokens_per_sec"] == 0.0        # no previous tick yet
+    # rates are deltas over the tick gap
+    a.server.metrics.record_tokens(50)
+    time.sleep(0.02)
+    snap2 = sampler.sample_once()
+    assert snap2["decode"]["tokens_per_sec"] > 0
+    assert snap2["prefill"]["tokens_per_sec"] == 0.0
+    assert snap2["decode"]["tick"] == 2
+    # standalone registry hosts the per-tier gauges
+    names = {m.name for m in sampler.registry.collect()}
+    assert "fleet_decode_ttft_p95_ms" in names
+    assert "fleet_prefill_queue_depth" in names
+    # JSONL: one sorted-key line per tier per tick, schema-stamped
+    with open(jsonl) as f:
+        lines = [json.loads(ln) for ln in f]
+    assert len(lines) == 4
+    for row in lines:
+        assert tuple(sorted(row)) == TIER_SNAPSHOT_KEYS
+    assert sampler.history()[-1]["tick"] == 2
+    assert sampler.latest() == snap2
+
+
+def test_fleet_sampler_dead_replica_drops_within_one_tick():
+    a, b = _FakeReplica("decode"), _FakeReplica("decode")
+    sampler = FleetSampler([a, b], cadence_s=0.01)
+    assert sampler.sample_once()["decode"]["replicas_alive"] == 2
+    b.alive = False
+    assert sampler.sample_once()["decode"]["replicas_alive"] == 1
+    a.alive = False                      # whole tier dark: no row at all
+    assert sampler.sample_once() == {}
+    assert sampler.latest() == {}
+    b.alive = True                       # revival re-enters cleanly
+    snap = sampler.sample_once()
+    assert snap["decode"]["replicas_alive"] == 1
+    assert snap["decode"]["tokens_per_sec"] == 0.0   # rates restarted
+
+
+def test_fleet_sampler_slo_ledger_and_violation_flag():
+    rep = _FakeReplica("decode")
+    spec = SLOSpec({"enabled": True, "ttft_p95_ms": 50.0})
+    sampler = FleetSampler([rep], slo=spec, cadence_s=0.01)
+    rep.server.metrics.record_first_token(0.010)
+    assert sampler.sample_once()["decode"]["slo_violation"] == 0
+    rep.server.metrics.record_first_token(0.500)
+    assert sampler.sample_once()["decode"]["slo_violation"] == 1
+    ledger = sampler.slo_snapshot()
+    assert tuple(sorted(ledger["decode"])) == SLO_LEDGER_KEYS
+    assert ledger["decode"]["ticks"] == 2
+    assert ledger["decode"]["violations"] == 1
+    # a disabled spec means no ledger at all
+    off = FleetSampler([rep], slo=SLOSpec({"enabled": False,
+                                           "ttft_p95_ms": 50.0}))
+    off.sample_once()
+    assert off.slo_snapshot() == {}
+
+
+def test_fleet_sampler_cadence_thread_and_validation():
+    rep = _FakeReplica("unified")
+    with pytest.raises(ValueError, match="cadence_s"):
+        FleetSampler([rep], cadence_s=0.0)
+    with FleetSampler([rep], cadence_s=0.01) as sampler:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not sampler.latest():
+            time.sleep(0.01)
+        assert sampler.latest()["unified"]["replicas_alive"] == 1
+        with pytest.raises(RuntimeError, match="already started"):
+            sampler.start()
+    assert sampler._thread is None       # stopped on exit
+
+
+# ---------------------------------------------------------------------------
+# live fleet: sampling across grow / shrink / kill / respawn
+# ---------------------------------------------------------------------------
+
+def test_fleet_sampler_live_grow_shrink_respawn():
+    model = _model()
+    eng_cfg = {"dtype": "float32",
+               "memory_config": {"num_blocks": 32, "block_size": 4},
+               "max_context": 64}
+    rs = ReplicaSet.build(model, 2, eng_cfg,
+                          {"metrics_window_s": 60.0}, seed=0,
+                          devices_per_replica=2)
+    router = Router(rs).start()
+    sampler = FleetSampler(rs, router=router, cadence_s=0.02).start()
+    try:
+        prompts = _prompts(model, [8] * 4, seed=7)
+        router.generate(prompts, max_new_tokens=6)
+        snap = sampler.sample_once()
+        assert snap["unified"]["replicas_alive"] == 2
+        assert tuple(sorted(snap["unified"])) == TIER_SNAPSHOT_KEYS
+        assert snap["unified"]["ttft_p95_ms"] > 0.0
+
+        rs.grow()                        # r2 joins on the next free slice
+        assert sampler.sample_once()["unified"]["replicas_alive"] == 3
+        rs.shrink(2)
+        assert sampler.sample_once()["unified"]["replicas_alive"] == 2
+
+        rs[0].kill()                     # dead drops within ONE tick
+        assert sampler.sample_once()["unified"]["replicas_alive"] == 1
+        rs.respawn(0)                    # ...and re-enters the rollup
+        snap = sampler.sample_once()
+        assert snap["unified"]["replicas_alive"] == 2
+        assert tuple(sorted(snap["unified"])) == TIER_SNAPSHOT_KEYS
+        # survivors still serve while the cadence thread keeps ticking
+        out = router.generate([prompts[0]], max_new_tokens=6)
+        assert len(out[0]) == 6
+    finally:
+        sampler.stop()
+        router.stop(timeout=60.0)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: stitched cross-tier trace under ONE trace_id + timeline
+# ---------------------------------------------------------------------------
+
+def test_disagg_trace_stitches_tiers_under_one_trace_id(tmp_path):
+    trace_path = str(tmp_path / "disagg.trace.json")
+    tel = Telemetry(TelemetryConfig(
+        enabled=True, tracing={"enabled": True,
+                               "trace_path": trace_path}))
+    model = _model()
+    rs = ReplicaSet.build(model, 2, ENG_CFG, seed=0, disagg=DISAGG)
+    router = DisaggRouter(rs, telemetry=tel).start()
+    try:
+        prompt = _prompts(model, [9], seed=3)[0]
+        stream = router.submit(prompt, SamplingParams(max_new_tokens=8))
+        toks = [t for t in stream]
+        assert len(toks) == 8
+        trace_id = stream.trace_id
+        assert trace_id
+        # the flat per-request timeline mirrors the same trace_id
+        tl = stream.timeline
+        assert tl is not None
+        assert tuple(sorted(tl)) == REQUEST_TIMELINE_KEYS
+        assert tl["trace_id"] == trace_id
+        assert tl["prefill_ms"] > 0 and tl["decode_ms"] > 0
+        assert tl["handoff_bytes"] > 0 and tl["failovers"] == 0
+        assert tl["total_ms"] >= tl["prefill_ms"]
+        assert router.timelines()[-1] == tl
+    finally:
+        router.stop()
+    tel.close()                          # exports the Chrome trace
+
+    from tools.telemetry_check import validate_chrome_trace
+    assert validate_chrome_trace(trace_path) == []
+    with open(trace_path) as f:
+        events = [e for e in json.load(f)["traceEvents"]
+                  if e["ph"] in ("X", "i")]
+    mine = [e for e in events if e["args"].get("trace_id") == trace_id]
+    names = {e["name"] for e in mine}
+    # prefill leg, KV handoff, and decode leg all chained under the ONE
+    # caller-visible trace_id
+    for want in ("router.request", "router.leg", "serve.request",
+                 "serve.prefill", "serve.handoff", "serve.decode"):
+        assert want in names, (want, sorted(names))
+    # exactly one root; every serve.request (one per tier leg) is
+    # parented under it through its router.leg
+    roots = [e for e in mine if e["name"] == "router.request"]
+    assert len(roots) == 1
+    root_span = roots[0]["args"]["span_id"]
+    leg_parents = {e["args"]["parent_id"] for e in mine
+                   if e["name"] == "router.leg"}
+    assert leg_parents == {root_span}
+    # both tiers ran a serve.request under this trace
+    serve_reqs = [e for e in mine if e["name"] == "serve.request"]
+    assert len(serve_reqs) == 2

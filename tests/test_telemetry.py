@@ -63,6 +63,33 @@ def test_histogram_window_bounds_memory_but_count_is_lifetime():
     assert h.lifetime() == (8, 410.0)
 
 
+def test_histogram_time_window_idle_p95_decays(monkeypatch):
+    """max_age_s > 0: an idle histogram's percentiles fall back to zero
+    once the last burst ages out — count stays lifetime (regression for
+    the fleet sampler: an idle tier must not hold its last-burst p95)."""
+    import deepspeed_tpu.telemetry.registry as reg_mod
+
+    clock = {"t": 1000.0}
+    monkeypatch.setattr(reg_mod.time, "monotonic", lambda: clock["t"])
+    h = Histogram("h", max_age_s=30.0)
+    for x in (5.0, 7.0, 9.0):
+        h.observe(x)
+    snap = h.snapshot()
+    assert snap["count"] == 3 and snap["p95"] > 0.0
+    clock["t"] += 31.0                       # burst ages out
+    snap = h.snapshot()
+    assert snap == {"p50": 0.0, "p95": 0.0, "p99": 0.0,
+                    "mean": 0.0, "count": 3}
+    h.observe(2.0)                           # fresh sample re-populates
+    assert h.snapshot()["p95"] == 2.0
+    assert h.lifetime() == (4, 23.0)
+    # default (max_age_s=0) keeps the historical lifetime behavior
+    h0 = Histogram("h0")
+    h0.observe(5.0)
+    clock["t"] += 1e6
+    assert h0.snapshot()["p95"] == 5.0
+
+
 def test_prometheus_rendering():
     reg = MetricsRegistry()
     reg.counter("steps_total", "steps").inc(3)

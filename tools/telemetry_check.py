@@ -56,6 +56,7 @@ EXPECTED_RECORD_KEYS = [
 # and telemetry/flight.py FLIGHT_REASONS must match, and every name must
 # appear in the docs span table — same contract as the record keys)
 EXPECTED_SPAN_NAMES = [
+    "fleet.sample",
     "offload.d2h", "offload.h2d", "offload.host_step",
     "recovery.outage", "router.leg", "router.request",
     "serve.admission_block", "serve.decode", "serve.handoff",
@@ -68,7 +69,7 @@ EXPECTED_EVENT_NAMES = [
     "recovery.detected", "recovery.replan", "recovery.restart",
     "recovery.resumed", "router.dispatch", "router.failover", "serve.emit",
     "serve.enqueue", "serve.finish", "serve.first_token", "serve.preempt",
-    "serve.prefix_hit", "spec.accept", "watchdog.fire",
+    "serve.prefix_hit", "slo.violation", "spec.accept", "watchdog.fire",
 ]
 EXPECTED_FLIGHT_REASONS = ["watchdog", "serve_crash", "engine_crash",
                            "manual", "recovery"]
@@ -142,7 +143,7 @@ DISAGG_BENCH_KEYS = ["agg_tokens_per_sec_disagg",
                      "ttft_p95_ms_homog", "tpot_p95_ms_disagg",
                      "tpot_p95_ms_homog", "handoff_ms_p95",
                      "handoff_bytes_per_req", "spec_accept_rate",
-                     "scenario_mix"]
+                     "scenario_mix", "slo", "fleet_jsonl"]
 EXPECTED_SCENARIO_MIXES = ["burst", "session_heavy",
                            "shared_system_prompt",
                            "long_prompt_short_decode"]
@@ -228,6 +229,38 @@ EXPECTED_OFFLOAD_TIER_NAMES = ["none", "opt_cpu", "cpu", "cpu_chunked",
                                "nvme_chunked", "nvme"]
 PLAN_BENCH_KEYS = ["plan_validate_known_good_top3", "known_good_ranks",
                    "proposed_6_7b", "pruned_6_7b", "evidence_keys_ok"]
+
+# frozen fleet-observability vocabulary (serving/fleet.py TierSnapshot,
+# telemetry/slo.py SLO ledger, serving/disagg.py request timelines;
+# docs/OBSERVABILITY.md "Fleet snapshots & SLO ledger"): snapshot keys,
+# SLO block/scenario/ledger/target keys, and stitched-timeline keys each
+# follow the standard contract — frozen list matches the module, every
+# key documented, and the serve_disagg `slo`/`fleet_jsonl` row keys are
+# literally emitted by bench.py (they also ride in DISAGG_BENCH_KEYS).
+# Per-tier Prometheus gauges are documented via their `fleet_*_<key>`
+# wildcard rows (tiers substitute into the `*`).
+EXPECTED_TIER_SNAPSHOT_SCHEMA = 1
+EXPECTED_TIER_SNAPSHOT_KEYS = [
+    "evictable_headroom_blocks", "handoff_bytes_per_sec",
+    "handoffs_per_sec", "kv_utilization", "prefix_hit_rate",
+    "queue_depth", "queue_wait_p50_ms", "queue_wait_p95_ms",
+    "queue_wait_p99_ms", "replicas_alive", "running", "schema",
+    "slo_violation", "spec_accept_rate", "tick", "tier",
+    "tokens_per_sec", "tpot_p50_ms", "tpot_p95_ms", "tpot_p99_ms", "ts",
+    "ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms",
+]
+EXPECTED_SLO_TARGET_KEYS = ["queue_wait_p95_ms", "tpot_p95_ms",
+                            "ttft_p95_ms"]
+EXPECTED_SLO_BLOCK_KEYS = ["attainment", "by_scenario",
+                           "error_budget_burn", "objective", "targets",
+                           "violations"]
+EXPECTED_SLO_SCENARIO_KEYS = ["attainment", "n", "tpot_attainment",
+                              "ttft_attainment", "violations"]
+EXPECTED_SLO_LEDGER_KEYS = ["attainment", "error_budget_burn", "ticks",
+                            "violations"]
+EXPECTED_TIMELINE_KEYS = ["decode_ms", "failovers", "handoff_bytes",
+                          "handoff_ms", "prefill_ms", "total_ms",
+                          "trace_id", "uid"]
 
 
 def _exported_monitor_tags() -> List[str]:
@@ -617,6 +650,66 @@ def check_planner() -> List[str]:
        + _cross_link(PLANNER_DOCS, "AUTOTUNING.md", "autotuner handoff")
 
 
+def check_fleet() -> List[str]:
+    """Fleet-observability vocabulary: TierSnapshot schema / SLO ledger
+    / request-timeline key sets match their modules, every key is
+    documented in docs/OBSERVABILITY.md (per-tier gauges via their
+    ``fleet_*_<key>`` wildcard rows), and docs/SERVING.md cross-links
+    the fleet section as the autoscaler-input feed."""
+    import re
+
+    def _snap_keys():
+        from deepspeed_tpu.serving.fleet import (TIER_SNAPSHOT_KEYS,
+                                                 TIER_SNAPSHOT_SCHEMA)
+
+        if TIER_SNAPSHOT_SCHEMA != EXPECTED_TIER_SNAPSHOT_SCHEMA:
+            raise ValueError(
+                f"TIER_SNAPSHOT_SCHEMA is {TIER_SNAPSHOT_SCHEMA}, lint "
+                f"pins {EXPECTED_TIER_SNAPSHOT_SCHEMA}")
+        return TIER_SNAPSHOT_KEYS
+
+    def _slo(name):
+        def thunk():
+            import deepspeed_tpu.telemetry.slo as slo
+
+            return getattr(slo, name)
+        return thunk
+
+    def _timeline_keys():
+        from deepspeed_tpu.serving.disagg import REQUEST_TIMELINE_KEYS
+
+        return REQUEST_TIMELINE_KEYS
+
+    # every tier substitutes into the same gauge wildcard rows: document
+    # `fleet_*_queue_depth` once, not once per tier
+    gauges = [f"fleet_prefill_{k}" for k in EXPECTED_TIER_SNAPSHOT_KEYS
+              if k not in ("tier", "schema")]
+    return _vocab_check([
+        VocabSpec(name="fleet.TIER_SNAPSHOT_KEYS",
+                  expected=EXPECTED_TIER_SNAPSHOT_KEYS, actual=_snap_keys,
+                  docs_path=DOCS),
+        VocabSpec(name="fleet gauges", doc_names=gauges, docs_path=DOCS,
+                  doc_normalize=lambda n: re.sub(
+                      r"^fleet_(prefill|decode|unified)_", "fleet_*_", n)),
+        VocabSpec(name="slo.SLO_TARGET_KEYS",
+                  expected=EXPECTED_SLO_TARGET_KEYS,
+                  actual=_slo("SLO_TARGET_KEYS"), docs_path=DOCS),
+        VocabSpec(name="slo.SLO_BLOCK_KEYS",
+                  expected=EXPECTED_SLO_BLOCK_KEYS,
+                  actual=_slo("SLO_BLOCK_KEYS"), docs_path=DOCS),
+        VocabSpec(name="slo.SLO_SCENARIO_KEYS",
+                  expected=EXPECTED_SLO_SCENARIO_KEYS,
+                  actual=_slo("SLO_SCENARIO_KEYS"), docs_path=DOCS),
+        VocabSpec(name="slo.SLO_LEDGER_KEYS",
+                  expected=EXPECTED_SLO_LEDGER_KEYS,
+                  actual=_slo("SLO_LEDGER_KEYS"), docs_path=DOCS),
+        VocabSpec(name="disagg.REQUEST_TIMELINE_KEYS",
+                  expected=EXPECTED_TIMELINE_KEYS, actual=_timeline_keys,
+                  docs_path=DOCS),
+    ]) + _cross_link(SERVING_DOCS, "OBSERVABILITY.md",
+                     "fleet snapshots / autoscaler inputs")
+
+
 def validate_chrome_trace(obj: Any) -> List[str]:
     """Structural validation of a Chrome trace-event JSON object (pass a
     path or the loaded dict).  Perfetto/chrome://tracing both accept the
@@ -687,7 +780,7 @@ def run_all() -> List[str]:
             + check_router_serving() + check_autotuning()
             + check_graph_audit() + check_memory_audit()
             + check_offload() + check_recovery() + check_planner()
-            + check_trace_export())
+            + check_fleet() + check_trace_export())
 
 
 def main() -> int:
