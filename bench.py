@@ -1736,9 +1736,9 @@ def _serve_disagg_body():
                            telemetry=tel).start()
     dis = _drive_schedule(router, schedule, speculative=True)
     snap = router.snapshot()
-    sampler.sample_once()          # final tick covers the drive's tail
+    sampler.stop()                 # quiesce the cadence thread first so
+    sampler.sample_once()          # the tail tick is the true last row
     fleet = sampler.latest()
-    sampler.stop()
     router.stop()
     _reset_topology()
     tel.close()

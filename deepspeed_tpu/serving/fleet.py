@@ -129,7 +129,12 @@ class FleetSampler:
         self._latest: Dict[str, Dict[str, Any]] = {}
         self._prev: Dict[str, Any] = {}   # tier -> (t, {counter: value})
         self._tick = 0
+        self._export_tiers: set = set()   # tiers with live gauges
         self._lock = threading.Lock()
+        # serialises whole ticks: a manual sample_once() may overlap the
+        # cadence thread, and _prev pairing + ring/JSONL ordering assume
+        # one tick at a time (self._lock alone only guards the fields)
+        self._tick_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -168,7 +173,14 @@ class FleetSampler:
     # -- one cadence tick ------------------------------------------------
     def sample_once(self) -> Dict[str, Dict[str, Any]]:
         """Poll the fleet; returns ``{tier: TierSnapshot}`` (also the
-        value ``latest()`` serves until the next tick)."""
+        value ``latest()`` serves until the next tick).  Safe to call
+        concurrently with the cadence thread: whole ticks are serialised
+        so two ticks can never pair one tick's clock with the other's
+        counters or interleave their ring/JSONL rows."""
+        with self._tick_lock:
+            return self._sample_once_locked()
+
+    def _sample_once_locked(self) -> Dict[str, Dict[str, Any]]:
         span = self.tracer.span("fleet.sample") if self.tracer.enabled \
             else None
         now = time.monotonic()
@@ -277,6 +289,17 @@ class FleetSampler:
 
     # -- export ----------------------------------------------------------
     def _export(self, out: Dict[str, Dict[str, Any]], tick: int) -> None:
+        # a tier that lost its last live replica drops out of `out`, but
+        # its gauges would otherwise hold the final tick's values forever
+        # — a registry consumer would keep seeing a healthy-looking dead
+        # tier.  Zero every gauge of a disappeared tier so monitors see
+        # replicas_alive=0 instead of frozen last-known-good numbers.
+        for tier in self._export_tiers - set(out):
+            for k in TIER_SNAPSHOT_KEYS:
+                if k in ("tier", "schema"):
+                    continue
+                self.registry.gauge(f"fleet_{tier}_{k}").set(0.0)
+        self._export_tiers = set(out)
         for tier, snap in out.items():
             for k, v in snap.items():
                 if k in ("tier", "schema"):

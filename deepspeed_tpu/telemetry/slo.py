@@ -1,0 +1,200 @@
+"""SLO specs and ledgers: latency targets → attainment evidence.
+
+The serving control plane (ROADMAP item 4) scales tiers against
+*objectives*, not raw percentiles — "decode TTFT p95 under 200 ms for
+99% of windows" is an autoscaler input, a bare p95 is not.  This module
+pins that contract:
+
+* :class:`SLOSpec` parses the ``serving.slo`` config block (per-metric
+  p95 targets with per-scenario overrides; the runtime twin is
+  ``runtime.config.SLOServingConfig``, which round-trips through this
+  class under the PR 9 drift tripwire) and evaluates a batch of
+  per-request measurements into a frozen-key ``slo`` block — the bench
+  rows (``serve_disagg``, ``serve_load_multi``) emit it so the
+  shifting-mix scenario schedule doubles as the autoscaler's validation
+  set, with per-scenario-phase attainment.
+* :class:`SLOLedger` is the streaming per-tier form: each fleet-sampler
+  cadence tick feeds one windowed percentile set per tier, and the
+  ledger accumulates attainment / violations / error-budget burn — the
+  numbers a scale-up decision cites.
+
+Key sets are frozen vocabularies linted by ``tools/telemetry_check.py``
+(``check_fleet``) against docs/OBSERVABILITY.md, the same contract as
+the StepRecord schema.  Pure stdlib — serving/ and telemetry/ stay
+jax-free.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Optional, Sequence
+
+#: the three targeted latencies (ms); 0 in a spec means "no target"
+SLO_TARGET_KEYS = ("queue_wait_p95_ms", "tpot_p95_ms", "ttft_p95_ms")
+
+#: frozen key set of the ``slo`` block bench rows emit (SLOSpec.evaluate)
+SLO_BLOCK_KEYS = ("attainment", "by_scenario", "error_budget_burn",
+                  "objective", "targets", "violations")
+
+#: frozen key set of one per-scenario entry inside ``by_scenario``
+SLO_SCENARIO_KEYS = ("attainment", "n", "tpot_attainment",
+                     "ttft_attainment", "violations")
+
+#: frozen key set of one tier's streaming ledger row (SLOLedger.snapshot)
+SLO_LEDGER_KEYS = ("attainment", "error_budget_burn", "ticks",
+                   "violations")
+
+# error-budget burn is violations / allowed-violations; cap it so a
+# zero-budget objective (objective=1.0) exports a finite, JSON-safe
+# number instead of Infinity
+_BURN_CAP = 999.0
+
+
+class SLOSpec:
+    """``serving.slo`` block, serving-side parser.
+
+    ``ttft_p95_ms`` / ``tpot_p95_ms`` / ``queue_wait_p95_ms`` are p95
+    targets in milliseconds (0 = not targeted).  ``objective`` is the
+    attainment goal in (0, 1] — the error budget is ``1 - objective``
+    of requests (or sampler ticks).  ``scenario_overrides`` maps a
+    scenario-mix name to a partial target override, so e.g.
+    ``long_prompt_short_decode`` can carry a looser TTFT target than
+    chat traffic without forking the spec.
+    """
+
+    def __init__(self, d: Optional[dict] = None, **kw):
+        d = {**(d or {}), **kw}
+        self.enabled = bool(d.get("enabled", False))
+        self.ttft_p95_ms = float(d.get("ttft_p95_ms", 0.0))
+        self.tpot_p95_ms = float(d.get("tpot_p95_ms", 0.0))
+        self.queue_wait_p95_ms = float(d.get("queue_wait_p95_ms", 0.0))
+        self.objective = float(d.get("objective", 0.99))
+        if not (0.0 < self.objective <= 1.0):
+            raise ValueError(f"slo.objective={self.objective}: must be "
+                             "in (0, 1]")
+        for key in SLO_TARGET_KEYS:
+            if getattr(self, key) < 0:
+                raise ValueError(f"slo.{key}={getattr(self, key)}: "
+                                 "must be >= 0 (0 = no target)")
+        overrides = d.get("scenario_overrides", {})
+        if not isinstance(overrides, Mapping):
+            raise ValueError("slo.scenario_overrides must be a mapping "
+                             "of scenario name -> partial target dict")
+        self.scenario_overrides: Dict[str, Dict[str, float]] = {}
+        for scenario, ov in overrides.items():
+            bad = set(ov) - set(SLO_TARGET_KEYS)
+            if bad:
+                raise ValueError(
+                    f"slo.scenario_overrides[{scenario!r}] has unknown "
+                    f"keys {sorted(bad)} (targets: {SLO_TARGET_KEYS})")
+            self.scenario_overrides[str(scenario)] = {
+                k: float(v) for k, v in ov.items()}
+
+    def targets_for(self, scenario: Optional[str] = None
+                    ) -> Dict[str, float]:
+        """Effective targets for one scenario (base + override)."""
+        t = {k: getattr(self, k) for k in SLO_TARGET_KEYS}
+        if scenario is not None:
+            t.update(self.scenario_overrides.get(scenario, {}))
+        return t
+
+    def _violates(self, targets: Dict[str, float], metric: str,
+                  value: Optional[float]) -> bool:
+        target = targets[metric]
+        return bool(target > 0 and value is not None and value > target)
+
+    def evaluate(self, requests: Sequence[Mapping]) -> Dict[str, object]:
+        """Per-request measurements → the frozen-key ``slo`` block.
+
+        Each request is ``{"scenario", "ttft_ms", "tpot_ms"}`` (missing
+        / None measurements count as attained — a one-token request has
+        no TPOT).  A request violates when ANY targeted metric exceeds
+        its (scenario-effective) target; attainment is the fraction that
+        do not, and error-budget burn is violations over the budget the
+        objective allows (1.0 = budget exactly spent, >1 = SLO missed).
+        """
+        n = len(requests)
+        by_scenario: Dict[str, Dict[str, float]] = {}
+        violations = 0
+        for scenario in sorted({str(r.get("scenario", "")) for r in requests}):
+            reqs = [r for r in requests
+                    if str(r.get("scenario", "")) == scenario]
+            targets = self.targets_for(scenario or None)
+            ttft_bad = sum(1 for r in reqs if self._violates(
+                targets, "ttft_p95_ms", r.get("ttft_ms")))
+            tpot_bad = sum(1 for r in reqs if self._violates(
+                targets, "tpot_p95_ms", r.get("tpot_ms")))
+            bad = sum(1 for r in reqs
+                      if self._violates(targets, "ttft_p95_ms",
+                                        r.get("ttft_ms"))
+                      or self._violates(targets, "tpot_p95_ms",
+                                        r.get("tpot_ms")))
+            m = len(reqs)
+            violations += bad
+            by_scenario[scenario] = {
+                "n": m,
+                "violations": bad,
+                "attainment": round(1.0 - bad / max(1, m), 3),
+                "ttft_attainment": round(1.0 - ttft_bad / max(1, m), 3),
+                "tpot_attainment": round(1.0 - tpot_bad / max(1, m), 3),
+            }
+        return {
+            "targets": self.targets_for(),
+            "objective": self.objective,
+            "violations": violations,
+            "attainment": round(1.0 - violations / max(1, n), 3),
+            "error_budget_burn": _burn(violations, n, self.objective),
+            "by_scenario": by_scenario,
+        }
+
+
+class SLOLedger:
+    """Streaming per-tier attainment ledger (fleet-sampler cadence).
+
+    One :meth:`observe` call per tier per sampler tick, carrying the
+    tier's TIME-WINDOWED percentiles (registry Histogram ``max_age_s``
+    windows — a stale burst must not burn budget forever).  A tick
+    violates when any targeted percentile exceeds its target; the
+    ledger keeps lifetime tick/violation counts per tier and reports
+    attainment + error-budget burn over ticks.
+    """
+
+    def __init__(self, spec: SLOSpec):
+        self.spec = spec
+        self._tiers: Dict[str, List[int]] = {}   # tier -> [ticks, bad]
+
+    def observe(self, tier: str, ttft_p95_ms: float, tpot_p95_ms: float,
+                queue_wait_p95_ms: float) -> bool:
+        """Record one tier tick; returns True when it violated."""
+        targets = self.spec.targets_for()
+        bad = (self.spec._violates(targets, "ttft_p95_ms", ttft_p95_ms)
+               or self.spec._violates(targets, "tpot_p95_ms", tpot_p95_ms)
+               or self.spec._violates(targets, "queue_wait_p95_ms",
+                                      queue_wait_p95_ms))
+        row = self._tiers.setdefault(tier, [0, 0])
+        row[0] += 1
+        row[1] += int(bad)
+        return bad
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        """{tier: {ticks, violations, attainment, error_budget_burn}}."""
+        out: Dict[str, Dict[str, float]] = {}
+        for tier in sorted(self._tiers):
+            ticks, bad = self._tiers[tier]
+            out[tier] = {
+                "ticks": ticks,
+                "violations": bad,
+                "attainment": round(1.0 - bad / max(1, ticks), 3),
+                "error_budget_burn": _burn(bad, ticks,
+                                           self.spec.objective),
+            }
+        return out
+
+
+def _burn(violations: int, n: int, objective: float) -> float:
+    """Violations over the budget the objective allows, capped finite."""
+    if n <= 0:
+        return 0.0
+    allowed = (1.0 - objective) * n
+    if allowed <= 0:
+        return 0.0 if violations == 0 else _BURN_CAP
+    return round(min(violations / allowed, _BURN_CAP), 3)

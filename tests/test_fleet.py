@@ -230,10 +230,40 @@ def test_fleet_sampler_dead_replica_drops_within_one_tick():
     a.alive = False                      # whole tier dark: no row at all
     assert sampler.sample_once() == {}
     assert sampler.latest() == {}
+    # a dark tier's gauges are zeroed, not left at last-known-good — a
+    # registry consumer must not keep seeing a healthy-looking dead tier
+    g = sampler.registry.get("fleet_decode_replicas_alive")
+    assert g is not None and g.value == 0.0
+    assert sampler.registry.get("fleet_decode_queue_depth").value == 0.0
     b.alive = True                       # revival re-enters cleanly
     snap = sampler.sample_once()
     assert snap["decode"]["replicas_alive"] == 1
     assert snap["decode"]["tokens_per_sec"] == 0.0   # rates restarted
+
+
+def test_fleet_sampler_manual_tick_safe_against_cadence_thread():
+    # a manual sample_once() (bench tail tick) may overlap the cadence
+    # thread; whole ticks are serialised, so ring rows never interleave
+    # across ticks and rates never pair one tick's clock with another's
+    # counters
+    a, b = _FakeReplica("decode"), _FakeReplica("prefill")
+    with FleetSampler([a, b], cadence_s=0.001) as sampler:
+        for _ in range(50):
+            a.server.metrics.record_tokens(5)
+            out = sampler.sample_once()
+            assert set(out) == {"decode", "prefill"}
+            for row in out.values():
+                assert tuple(sorted(row)) == TIER_SNAPSHOT_KEYS
+                assert row["tokens_per_sec"] >= 0.0
+    hist = sampler.history()
+    ticks = [r["tick"] for r in hist]
+    assert ticks == sorted(ticks)        # ticks appended atomically
+    # within a tick the two tier rows are adjacent, never split by
+    # another tick's rows
+    for i in range(0, len(hist) - 1):
+        if ticks[i] == ticks[i + 1]:
+            assert {hist[i]["tier"], hist[i + 1]["tier"]} == \
+                {"decode", "prefill"}
 
 
 def test_fleet_sampler_slo_ledger_and_violation_flag():
