@@ -101,9 +101,53 @@ def _fleet_jsonl(name: str) -> str:
     return os.path.join(out_dir, f"{name}.fleet.jsonl")
 
 
+def _run_id() -> str:
+    """The row's ledger run id (telemetry/ledger.py): ONE id stamped
+    through StepRecords, trace metadata, TierSnapshots, and the row's
+    manifest so the warehouse can stitch them back together.  main()
+    mints one per row into ``DSTPU_RUN_ID`` before the row runs (smoke
+    re-exec and subprocess rows inherit it through the environment);
+    direct ``--row`` invocations mint their own."""
+    return os.environ.get("DSTPU_RUN_ID", "")
+
+
+def _mint_run_id(name: str) -> str:
+    # mirrors telemetry/ledger.py new_run_id WITHOUT importing
+    # deepspeed_tpu — the non-smoke parent must stay jax-free so row
+    # subprocesses grab the chip cleanly
+    stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    return f"{name}-{stamp}-{os.getpid():x}"
+
+
 def _telemetry_block(name: str) -> dict:
     return {"enabled": True, "jsonl_path": _telemetry_jsonl(name),
+            "run_id": _run_id(),
             "tracing": {"enabled": True, "trace_path": _trace_json(name)}}
+
+
+def _write_row_manifest(name: str, row: dict) -> dict:
+    """Stamp the row with its run_id and write the RunManifest next to
+    the row's artifacts (telemetry/ledger.py): the ledger's join point
+    between the summary row, the per-step JSONL, the span trace, the
+    fleet log, and the SLO block.  Best-effort — a manifest failure must
+    never cost the row its number."""
+    if "manifest" in row:       # smoke re-exec inner already wrote it
+        return row
+    rid = _run_id() or _mint_run_id(name)
+    row.setdefault("run_id", rid)
+    try:
+        from deepspeed_tpu.telemetry.ledger import write_manifest
+
+        artifacts = {k: row[k] for k in ("telemetry_jsonl", "trace_json",
+                                         "fleet_jsonl", "slo", "flight_dir",
+                                         "resolved_config") if k in row}
+        out_dir = os.environ.get("DSTPU_TELEMETRY_DIR", "./telemetry")
+        row["manifest"] = write_manifest(
+            os.path.join(out_dir, f"{name}.manifest.json"),
+            name, rid, artifacts, smoke=SMOKE, row=row)
+    except Exception as e:      # noqa: BLE001 — diagnostics only
+        row.setdefault("manifest_error", str(e)[:160])
+    return row
 
 
 def _span_breakdown(tracer, names) -> dict:
@@ -182,20 +226,22 @@ PINNED_ROW_CONFIGS = {
 
 
 def _fwd_flops_per_tok(model, seq):
-    """Model fwd FLOPs/token: qkvo (GQA-aware) + ffn + lm_head + attn."""
-    h, L, V = model.hidden_size, model.num_layers, model.vocab_size
-    ffn = getattr(model, "intermediate_size", 4 * h)
-    act = 3 if getattr(model, "activation", "gelu") == "swiglu" else 2
-    heads = getattr(model, "num_heads", 1)
-    kv_heads = getattr(model, "num_kv_heads", None) or heads
-    qkvo = 2 * h * h + 2 * h * (h * kv_heads // heads)  # q,o + k,v (GQA)
-    matmul = L * (qkvo + act * h * ffn)
-    return 2 * matmul + 2 * h * V + 2 * seq * h * L
+    """Model fwd FLOPs/token: qkvo (GQA-aware) + ffn + lm_head + attn.
+    Delegates to telemetry/derive.py — the single home of the MFU math,
+    shared with the run ledger's rollups so bench numbers and warehouse
+    re-derivations can never disagree.  Import stays function-local:
+    rows pin their backend before touching deepspeed_tpu."""
+    from deepspeed_tpu.telemetry.derive import fwd_flops_per_tok
+
+    return fwd_flops_per_tok(model, seq)
 
 
 def _mfu(tokens_per_sec, model, seq):
-    # ×3 for fwd+bwd, against the v5e bf16 peak of 197 TFLOP/s.
-    return tokens_per_sec * 3 * _fwd_flops_per_tok(model, seq) / 197e12
+    # ×3 for fwd+bwd, against the v5e bf16 peak of 197 TFLOP/s
+    # (derive.V5E_PEAK_FLOPS_PER_SEC).
+    from deepspeed_tpu.telemetry.derive import mfu
+
+    return mfu(tokens_per_sec, model, seq)
 
 
 def row_gpt2_350m():
@@ -1268,7 +1314,8 @@ def row_v2_decode():
     from deepspeed_tpu.telemetry import Telemetry
 
     tel = Telemetry(TelemetryConfig(
-        enabled=True, jsonl_path=_telemetry_jsonl("v2_decode")))
+        enabled=True, jsonl_path=_telemetry_jsonl("v2_decode"),
+        run_id=_run_id()))
     tel.record_serving_step(0, {
         "tokens_out": n_seqs * gen_tokens, "tokens_per_sec": best,
         "bf16_tokens_per_sec": tps, "int8_kv_tokens_per_sec": tps_int8,
@@ -1318,6 +1365,7 @@ def row_serve_load():
 
     tel = Telemetry(TelemetryConfig(
         enabled=True, jsonl_path=_telemetry_jsonl("serve_load"),
+        run_id=_run_id(),
         tracing={"enabled": True, "trace_path": _trace_json("serve_load")}))
     eng = InferenceEngineV2(model, eng_cfg)
     rng = np.random.default_rng(9)
@@ -1424,6 +1472,7 @@ def _serve_load_multi_body():
 
     tel = Telemetry(TelemetryConfig(
         enabled=True, jsonl_path=_telemetry_jsonl("serve_load_multi"),
+        run_id=_run_id(),
         tracing={"enabled": True,
                  "trace_path": _trace_json("serve_load_multi")}))
     # reuse run FIRST: the second run inherits this process's warm XLA
@@ -1618,9 +1667,9 @@ def _drive_schedule(router, schedule, speculative: bool = False,
                      for f, l, c in zip(first_at, last_at, counts)
                      if c > 1 and f > 0)
 
-    def p95(xs):
-        return (xs[min(len(xs) - 1, int(0.95 * (len(xs) - 1)))]
-                if xs else 0.0)
+    # shared percentile derivation (telemetry/derive.py) — same index
+    # formula the run ledger uses when it re-rolls these artifacts
+    from deepspeed_tpu.telemetry.derive import p95
 
     handoff_ms = sorted(s.handoff_ms for s in streams
                         if getattr(s, "handoff_ms", None) is not None)
@@ -1712,6 +1761,7 @@ def _serve_disagg_body():
 
     tel = Telemetry(TelemetryConfig(
         enabled=True, jsonl_path=_telemetry_jsonl("serve_disagg"),
+        run_id=_run_id(),
         tracing={"enabled": True,
                  "trace_path": _trace_json("serve_disagg")}))
 
@@ -1969,6 +2019,7 @@ def _chaos_recovery_body():
     base = tempfile.mkdtemp(prefix="dstpu_chaos_")
     tel = Telemetry(TelemetryConfig(
         enabled=True, jsonl_path=_telemetry_jsonl("chaos_recovery"),
+        run_id=_run_id(),
         tracing={"enabled": True,
                  "trace_path": _trace_json("chaos_recovery")},
         flight={"enabled": True,
@@ -1980,6 +2031,7 @@ def _chaos_recovery_body():
         "metric": "chaos_recovery_s",
         "telemetry_jsonl": _telemetry_jsonl("chaos_recovery"),
         "trace_json": _trace_json("chaos_recovery"),
+        "flight_dir": os.path.join(base, "flight"),
         "value": train["recovery_s"], "unit": "s",
         **train, **serve,
         "resolved_config": _resolved_config(
@@ -2109,7 +2161,8 @@ def _run_row_subprocess(name: str, timeout_s: float = 900.0) -> dict:
         proc = subprocess.run(cmd, capture_output=True, text=True,
                               timeout=timeout_s)
     except subprocess.TimeoutExpired:
-        return {"metric": name, "error": f"row timed out after {timeout_s}s"}
+        return {"metric": name, "error": f"row timed out after {timeout_s}s",
+                "run_id": _run_id()}
     for line in reversed(proc.stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
@@ -2118,7 +2171,7 @@ def _run_row_subprocess(name: str, timeout_s: float = 900.0) -> dict:
             except json.JSONDecodeError:
                 continue
     tail = (proc.stderr or proc.stdout or "").strip().splitlines()
-    return {"metric": name,
+    return {"metric": name, "run_id": _run_id(),
             "error": ("no result line; " + " | ".join(tail[-3:]))[:300]}
 
 
@@ -2135,10 +2188,14 @@ def main() -> None:
         return
     if "--row" in sys.argv:
         name = sys.argv[sys.argv.index("--row") + 1]
+        # inherit the parent's run id (env) or mint one for direct
+        # invocations — smoke re-exec inners must share the outer's id
+        os.environ.setdefault("DSTPU_RUN_ID", _mint_run_id(name))
         try:
-            r = _ROWS[name]()
+            r = _write_row_manifest(name, _ROWS[name]())
         except Exception as e:
-            r = {"metric": name, "error": str(e)[:250]}
+            r = {"metric": name, "error": str(e)[:250],
+                 "run_id": _run_id()}
         print(json.dumps(r), flush=True)
         return
     probe_err = None if SMOKE else _device_probe_error()
@@ -2160,18 +2217,23 @@ def main() -> None:
                  "gpt2_350m_autosched", "peak_params",
                  "v2_decode", "serve_load", "serve_load_multi",
                  "serve_disagg", "chaos_recovery", "plan_validate"):
+        # one run id per row, minted HERE so subprocess rows inherit it
+        # through the environment and every artifact carries the same id
+        os.environ["DSTPU_RUN_ID"] = _mint_run_id(name)
         if SMOKE:
             try:
-                r = _ROWS[name]()
+                r = _write_row_manifest(name, _ROWS[name]())
             except Exception as e:
-                r = {"metric": name, "error": str(e)[:250]}
+                r = {"metric": name, "error": str(e)[:250],
+                     "run_id": _run_id()}
         else:
             r = _run_row_subprocess(name, _ROW_TIMEOUTS.get(name, 900.0))
         rows.append(r)
         print(json.dumps(r), flush=True)
+    os.environ["DSTPU_RUN_ID"] = _mint_run_id("gpt2_350m")
     if SMOKE:
         try:
-            primary = row_gpt2_350m()
+            primary = _write_row_manifest("gpt2_350m", row_gpt2_350m())
         except Exception as e:
             primary = {"metric":
                        "gpt2_350m_zero1_train_tokens_per_sec_per_chip",

@@ -54,7 +54,7 @@ from deepspeed_tpu.telemetry.tracing import NULL_TRACER
 from deepspeed_tpu.utils.logging import log_dist
 
 #: TierSnapshot schema version (bump on any key change)
-TIER_SNAPSHOT_SCHEMA = 1
+TIER_SNAPSHOT_SCHEMA = 2
 
 #: frozen key set of one TierSnapshot row — every signal ROADMAP item 4
 #: names, flat and sorted; linted against docs/OBSERVABILITY.md by
@@ -70,6 +70,7 @@ TIER_SNAPSHOT_KEYS = (
     "queue_wait_p95_ms",
     "queue_wait_p99_ms",
     "replicas_alive",
+    "run_id",                      # owning run (schema 2; "" = unstitched)
     "running",                     # admitted + decoding requests (sum)
     "schema",                      # TIER_SNAPSHOT_SCHEMA
     "slo_violation",               # 1 = this tick breached a target
@@ -110,11 +111,16 @@ class FleetSampler:
     def __init__(self, replicas: Any, router: Any = None,
                  slo: Optional[SLOSpec] = None, cadence_s: float = 1.0,
                  ring: int = 512, jsonl_path: str = "",
-                 telemetry: Any = None, monitor: Any = None):
+                 telemetry: Any = None, monitor: Any = None,
+                 run_id: str = ""):
         if cadence_s <= 0:
             raise ValueError(f"fleet cadence_s={cadence_s}: must be > 0")
         self.replicas = replicas
         self.router = router
+        # the stitching key every snapshot row carries (schema 2):
+        # explicit arg wins, else inherited from the telemetry hub
+        self.run_id = str(run_id
+                          or getattr(telemetry, "run_id", "") or "")
         self.cadence_s = float(cadence_s)
         self.jsonl_path = str(jsonl_path)
         self.telemetry = telemetry
@@ -246,6 +252,7 @@ class FleetSampler:
                 rates[k] = max(0, counters[k] - c_prev.get(k, 0)) / dt
         snap: Dict[str, Any] = {
             "schema": TIER_SNAPSHOT_SCHEMA,
+            "run_id": self.run_id,
             "tick": tick,
             "ts": round(time.time(), 3),
             "tier": tier,
@@ -296,20 +303,20 @@ class FleetSampler:
         # replicas_alive=0 instead of frozen last-known-good numbers.
         for tier in self._export_tiers - set(out):
             for k in TIER_SNAPSHOT_KEYS:
-                if k in ("tier", "schema"):
+                if k in ("tier", "schema", "run_id"):
                     continue
                 self.registry.gauge(f"fleet_{tier}_{k}").set(0.0)
         self._export_tiers = set(out)
         for tier, snap in out.items():
             for k, v in snap.items():
-                if k in ("tier", "schema"):
+                if k in ("tier", "schema", "run_id"):
                     continue
                 self.registry.gauge(f"fleet_{tier}_{k}").set(float(v))
         if self.monitor is not None:
             events = [(f"fleet/{tier}/{k}", float(v), tick)
                       for tier, snap in out.items()
                       for k, v in snap.items()
-                      if k not in ("tier", "schema")]
+                      if k not in ("tier", "schema", "run_id")]
             self.monitor.write_events(events)
         if self.jsonl_path:
             parent = os.path.dirname(os.path.abspath(self.jsonl_path))

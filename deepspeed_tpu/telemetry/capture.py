@@ -192,13 +192,13 @@ class AutoCapture:
 
     # -- trigger logic ---------------------------------------------------
     def _regressed(self) -> bool:
-        if self.regression_factor <= 0 \
-                or len(self._times) < self.MIN_SAMPLES:
-            return False
-        xs = sorted(self._times)
-        median = xs[len(xs) // 2]
-        p95 = xs[min(len(xs) - 1, int(0.95 * (len(xs) - 1)))]
-        return median > 0 and p95 > self.regression_factor * median
+        # shared with the ledger's anomaly scan (telemetry/derive.py):
+        # windowed p95 > factor × median over the trailing deque
+        from deepspeed_tpu.telemetry.derive import trailing_regressed
+
+        return trailing_regressed(list(self._times),
+                                  self.regression_factor,
+                                  self.MIN_SAMPLES)
 
     def observe_step_time(self, wall_time_s: float) -> None:
         self._times.append(float(wall_time_s))

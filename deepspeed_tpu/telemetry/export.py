@@ -153,6 +153,10 @@ class Telemetry:
         self.cfg = cfg
         self.monitor = monitor
         self.registry = registry or MetricsRegistry()
+        # one run_id per bench row / training run, stamped into every
+        # StepRecord, the Tracer's trace metadata, and (via FleetSampler)
+        # every TierSnapshot row — the manifest stitching key
+        self.run_id = str(getattr(cfg, "run_id", "") or "")
         self.peak_flops_per_sec = (
             float(cfg.peak_flops_per_sec) if cfg.peak_flops_per_sec
             else detect_peak_flops_per_sec())
@@ -218,6 +222,8 @@ class Telemetry:
         # disabled tracing must not get a trace written at shutdown
         self.trace_path = (getattr(tr_cfg, "trace_path", "") or ""
                            if getattr(tr_cfg, "enabled", False) else "")
+        if self.run_id:
+            self.tracer.run_id = self.run_id
 
     # -- tracing / flight recorder ---------------------------------------
     def make_watchdog(self, name: str):
@@ -302,7 +308,8 @@ class Telemetry:
         self._tokens += int(tokens)
         goodput = 1.0 - self._skipped / max(1, self._steps)
         rec = StepRecord(
-            step=step, kind="train", wall_time_s=float(wall_time_s),
+            step=step, kind="train", run_id=self.run_id,
+            wall_time_s=float(wall_time_s),
             tokens=int(tokens),
             flops_per_step=float(self._flops_per_step or 0.0),
             peak_flops_per_sec=self.peak_flops_per_sec,
@@ -333,7 +340,8 @@ class Telemetry:
         self._skipped += 1
         goodput = 1.0 - self._skipped / max(1, self._steps)
         rec = StepRecord(
-            step=step, kind="recovery", wall_time_s=float(outage_s),
+            step=step, kind="recovery", run_id=self.run_id,
+            wall_time_s=float(outage_s),
             peak_flops_per_sec=self.peak_flops_per_sec,
             goodput=goodput, skipped=True, comm={})
         self.g_goodput.set(goodput)
@@ -357,7 +365,7 @@ class Telemetry:
             else:
                 flat[k] = float(v)
         rec = StepRecord(
-            step=step, kind="serving",
+            step=step, kind="serving", run_id=self.run_id,
             tokens=int(snapshot.get("tokens_out", 0)),
             tokens_per_sec=float(snapshot.get("tokens_per_sec", 0.0)),
             peak_flops_per_sec=self.peak_flops_per_sec,

@@ -17,7 +17,7 @@ import json
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 # bf16 peak FLOP/s by TPU device kind (matmul peak; the MFU denominator).
 # Sources: public TPU spec sheets; v5e figure matches bench.py's 197e12.
@@ -85,6 +85,9 @@ class StepRecord:
     step: int
     kind: str = "train"                    # train | serving
     schema: int = SCHEMA_VERSION
+    # the run this record belongs to (one bench row = one run_id, shared
+    # with Tracer metadata and FleetSampler rows; "" = unstitched)
+    run_id: str = ""
     # timing / throughput
     wall_time_s: float = 0.0
     tokens: int = 0

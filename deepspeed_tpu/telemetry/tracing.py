@@ -197,6 +197,9 @@ class Tracer:
     def __init__(self, enabled: bool = True,
                  max_events: int = DEFAULT_MAX_EVENTS, ring: Any = None):
         self.enabled = bool(enabled)
+        # the owning run's id (Telemetry sets it); exported as a trace
+        # metadata event so a trace file names the run it belongs to
+        self.run_id = ""
         self._lock = threading.Lock()
         self._events: deque = deque(maxlen=max(1, int(max_events)))
         self._ring = ring          # FlightRecorder (flight.py) or None
@@ -282,6 +285,10 @@ class Tracer:
             "name": "process_name", "ph": "M", "pid": self._pid, "tid": 0,
             "ts": 0, "args": {"name": "deepspeed_tpu"},
         }]
+        if self.run_id:
+            meta.append({"name": "run_id", "ph": "M", "pid": self._pid,
+                         "tid": 0, "ts": 0,
+                         "args": {"run_id": self.run_id}})
         for tid, tname in sorted(names.items()):
             meta.append({"name": "thread_name", "ph": "M", "pid": self._pid,
                          "tid": tid, "ts": 0, "args": {"name": tname}})

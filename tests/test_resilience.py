@@ -503,7 +503,7 @@ def test_record_recovery_goodput_gap():
     assert rec.wall_time_s == 42.5
     assert rec.goodput == pytest.approx(3 / 4)
     d = json.loads(rec.to_json())
-    assert list(d) == sorted(d) and d["schema"] == 2
+    assert list(d) == sorted(d) and d["schema"] == 3
 
 
 # ---------------------------------------------------------------------------

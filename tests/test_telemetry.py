@@ -118,7 +118,7 @@ def test_step_record_derived_fields_and_sorted_json():
     assert rec.achieved_flops_per_sec == pytest.approx(2e9)
     assert 0.0 < rec.mfu <= 1.0
     d = json.loads(rec.to_json())
-    assert d["schema"] == 2
+    assert d["schema"] == 3
     assert list(d.keys()) == sorted(d.keys())
     # mfu clamps at 1.0 even when "achieved" exceeds the peak estimate
     hot = StepRecord(step=1, wall_time_s=0.1, tokens=1,
@@ -597,7 +597,7 @@ def test_train_run_emits_step_records_and_capture_report(tmp_path):
     recs = read_jsonl(jsonl)
     assert len(recs) == 3
     for i, r in enumerate(recs):
-        assert r["schema"] == 2 and r["kind"] == "train"
+        assert r["schema"] == 3 and r["kind"] == "train"
         assert r["step"] == i + 1
         assert r["tokens"] == 8 * 32
         assert r["tokens_per_sec"] > 0

@@ -19,9 +19,15 @@ tree (run from tier-1 via tests/test_telemetry.py):
 - env-prefixed and ``for ...; do ...; done`` wrapped commands are
   unwrapped first; ``see BENCH_MEASURED_...`` cross-references must
   point at an existing round file.
+- staleness: every on-chip row the run ledger flags as ``stale``
+  (carried forward since r04 — telemetry/ledger.py
+  ``LAST_MEASURED_ROUND``) must have a re-measurement command attached,
+  and that command must itself pass the checks above.  The stale set is
+  printed with its commands so the next silicon window has a ready-made
+  worklist (same view as ``tools/obs_report.py``).
 
-Exit 1 with one line per finding; silent exit 0 when the queue is
-clean.
+Exit 1 with one line per finding; exit 0 when the queue is clean (the
+stale-row worklist is informational, not a finding).
 """
 
 from __future__ import annotations
@@ -160,6 +166,26 @@ def _check_cmd(cmd: str, where: str, rows, ladder_len,
                           f"{toks[1]!r}")
 
 
+def check_stale(rows, ladder_len, errors: List[str]):
+    """Ledger staleness lint: every row still carrying an on-chip number
+    measured at r04 must have a validated re-measurement command.
+    Returns {row: cmd} for the worklist printout."""
+    from deepspeed_tpu.telemetry import ledger
+
+    history = ledger.load_bench_history(REPO)
+    requeue = ledger.attach_requeue_cmds(
+        history, ledger.collect_queued_cmds(REPO))
+    for row, cmd in sorted(requeue.items()):
+        where = f"stale[{row}]"
+        if not cmd:
+            errors.append(f"{where}: carried since "
+                          f"r{ledger.LAST_MEASURED_ROUND:02d} with no "
+                          f"re-measurement command attached")
+            continue
+        _check_cmd(cmd, where, rows, ladder_len, errors)
+    return requeue
+
+
 def run_all() -> List[str]:
     errors: List[str] = []
     rows, ladder_len = _bench_rows()
@@ -185,6 +211,7 @@ def run_all() -> List[str]:
             _check_cmd(entry["cmd"], where, rows, ladder_len, errors)
     if not seen_any:
         errors.append("no queued commands found — backlog files moved?")
+    check_stale(rows, ladder_len, errors)
     return errors
 
 
@@ -192,6 +219,13 @@ def main() -> int:
     errors = run_all()
     for e in errors:
         print(e)
+    rows, ladder_len = _bench_rows()
+    stale = check_stale(rows, ladder_len, [])
+    if stale:
+        print(f"stale rows ({len(stale)} carried forward; re-measure "
+              f"with):")
+        for row, cmd in sorted(stale.items()):
+            print(f"  {row}: {cmd}")
     n = sum(1 for _ in glob.glob(os.path.join(REPO, ROUND_GLOB)))
     print(f"bench_backlog: {len(errors)} finding(s) across {n} round "
           f"file(s)")

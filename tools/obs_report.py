@@ -1,0 +1,277 @@
+#!/usr/bin/env python
+"""Run ledger & regression sentinel CLI (docs/OBSERVABILITY.md "Run
+ledger & regression sentinel").
+
+The read side of the telemetry layer: ingest the committed bench
+history (``BENCH_r*.json`` / ``BENCH_MEASURED_r*.json``) and any
+``*.manifest.json`` run manifests into typed per-run rollups
+(telemetry/ledger.py), then
+
+* **report** (default) — the r01→rNN per-row trajectory, the latest
+  rollup per row, and every sentinel finding vs the committed baseline
+  (``tools/obs_baseline.json``), staleness flagged with the queued
+  re-measurement command attached;
+* ``--scan DIR`` — also ingest the run manifests under ``DIR`` and
+  anomaly-scan their artifacts (step-time spikes, MFU cliffs, goodput
+  gaps, SLO-burn spikes — each cross-linked to the covering trace span
+  and the latest flight bundle);
+* ``--gate`` — exit 1 when any finding is ``regressed`` and its
+  fingerprint is not suppressed in the baseline (the PR gate; run from
+  tier-1 by tests/test_obs_ledger.py);
+* ``--drift`` — join the planner's evidence blocks (planner/audit.py)
+  with measured rollups into plan-vs-actual drift ratios (ROADMAP
+  item 3's calibration input);
+* ``--write-baseline`` — re-pin the baseline to the current rollups
+  (suppress list and comment are preserved).
+
+Verdict vocabulary (frozen in telemetry/ledger.py, linted by
+tools/telemetry_check.py): ``improved`` / ``flat`` / ``regressed`` /
+``new`` / ``missing`` / ``stale``.  Only ``regressed`` gates.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "obs_baseline.json")
+
+
+def _ledger():
+    from deepspeed_tpu.telemetry import ledger
+
+    return ledger
+
+
+def collect_rollups(scan_dir: Optional[str],
+                    with_history: bool = True) -> List[Dict[str, Any]]:
+    """History rollups (committed BENCH files) + one rollup per run
+    manifest under ``scan_dir``."""
+    led = _ledger()
+    rollups: List[Dict[str, Any]] = []
+    if with_history:
+        rollups.extend(led.load_bench_history(REPO))
+    for path in sorted(glob.glob(
+            os.path.join(scan_dir or "", "*.manifest.json"))):
+        try:
+            rollups.append(led.rollup_from_manifest(path))
+        except Exception as e:  # noqa: BLE001 — one bad manifest ≠ no report
+            print(f"obs_report: skipping unreadable manifest {path}: {e}",
+                  file=sys.stderr)
+    return rollups
+
+
+def scan_anomalies(scan_dir: str) -> List[Dict[str, Any]]:
+    led = _ledger()
+    out: List[Dict[str, Any]] = []
+    for path in sorted(glob.glob(os.path.join(scan_dir,
+                                              "*.manifest.json"))):
+        try:
+            out.extend(led.scan_manifest(path))
+        except Exception as e:  # noqa: BLE001
+            print(f"obs_report: anomaly scan failed for {path}: {e}",
+                  file=sys.stderr)
+    return out
+
+
+def drift_report(rollups: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Plan-vs-actual: the planner's evidence for each audit row joined
+    with that row's latest measured rollup.  Import-guarded — a broken
+    planner must not take the sentinel down with it."""
+    led = _ledger()
+    try:
+        from deepspeed_tpu.planner.audit import PLAN_AUDIT_ROWS, plan_for_row
+    except Exception as e:  # noqa: BLE001
+        print(f"obs_report: planner unavailable, no drift report ({e})",
+              file=sys.stderr)
+        return []
+    latest = led.latest_rollups(rollups)
+    # manifest rollups carry the actual-side signals (step-time p50,
+    # HBM watermark, comm census) that summary-only history rows lack —
+    # prefer them per row
+    measured = led.latest_rollups(
+        [r for r in rollups if r.get("source") == "manifest"])
+    out: List[Dict[str, Any]] = []
+    for name in PLAN_AUDIT_ROWS:
+        rollup = measured.get(name) or latest.get(name)
+        if rollup is None:
+            continue
+        plan = plan_for_row(name)
+        if not plan.ranked:
+            continue
+        out.extend(led.plan_drift(rollup, plan.ranked[0].evidence))
+    return out
+
+
+def _trend(rollups: List[Dict[str, Any]]) -> Dict[str, Dict[int, Any]]:
+    """{row: {round: value}} for the history rollups (trajectory view)."""
+    out: Dict[str, Dict[int, Any]] = {}
+    for r in rollups:
+        if r.get("source") != "chip" or r.get("round") is None:
+            continue
+        cell = "ERR" if r.get("error") else r.get("value")
+        out.setdefault(r["row"], {})[int(r["round"])] = cell
+    return out
+
+
+def build_report(args) -> Dict[str, Any]:
+    led = _ledger()
+    rollups = collect_rollups(args.scan, with_history=not args.no_history)
+    baseline = led.load_baseline(args.baseline)
+    requeue = led.attach_requeue_cmds(rollups, led.collect_queued_cmds(REPO))
+    findings = led.diff_rollups(rollups, baseline, requeue)
+    gate = led.gate_findings(findings, baseline.get("suppress", ()))
+    report: Dict[str, Any] = {
+        "baseline": args.baseline,
+        "rollups": len(rollups),
+        "rows": sorted({r["row"] for r in rollups}),
+        "trend": _trend(rollups),
+        "latest": {k: v for k, v in sorted(
+            led.latest_rollups(rollups).items())},
+        "stale_rows": requeue,
+        "findings": findings,
+        "gate_failures": gate,
+        "anomalies": scan_anomalies(args.scan) if args.scan else [],
+        "drift": drift_report(rollups) if args.drift else [],
+    }
+    return report
+
+
+def _fmt_val(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def print_report(report: Dict[str, Any]) -> None:
+    trend = report["trend"]
+    rounds = sorted({rnd for cells in trend.values() for rnd in cells})
+    if rounds:
+        print("== trajectory (primary value per row per round) ==")
+        head = "row".ljust(26) + " ".join(f"r{r:02d}".rjust(9)
+                                          for r in rounds)
+        print(head)
+        for row in sorted(trend):
+            cells = trend[row]
+            print(row.ljust(26) + " ".join(
+                _fmt_val(cells.get(r)).rjust(9) for r in rounds))
+    print(f"\n== rollups: {report['rollups']} across "
+          f"{len(report['rows'])} rows ==")
+    if report["stale_rows"]:
+        print("\n== stale rows (carried forward; re-measure with) ==")
+        for row, cmd in sorted(report["stale_rows"].items()):
+            print(f"  {row}: {cmd}")
+    counts: Dict[str, int] = {}
+    for f in report["findings"]:
+        counts[f["verdict"]] = counts.get(f["verdict"], 0) + 1
+    print("\n== sentinel vs " + os.path.relpath(report["baseline"], REPO)
+          + " ==")
+    print("  " + (", ".join(f"{k}: {v}" for k, v in sorted(counts.items()))
+                  or "no findings"))
+    for f in report["findings"]:
+        if f["verdict"] in ("regressed", "improved", "missing"):
+            print(f"  [{f['verdict']}] {f['row']}.{f['metric']}: "
+                  f"{_fmt_val(f['baseline'])} -> {_fmt_val(f['current'])}"
+                  f" (fp {f['fingerprint']})")
+    if report["anomalies"]:
+        print(f"\n== anomalies ({len(report['anomalies'])}) ==")
+        for a in report["anomalies"]:
+            where = f" tier={a['tier']}" if a.get("tier") else ""
+            span = a.get("trace_span") or {}
+            link = f" span={span.get('name')}" if span else ""
+            print(f"  [{a['kind']}] step {a['step']}{where}: "
+                  f"{_fmt_val(a['value'])} vs {_fmt_val(a['threshold'])}"
+                  f"{link} (run {a['run_id']})")
+    if report["drift"]:
+        print(f"\n== plan-vs-actual drift ({len(report['drift'])}) ==")
+        for d in report["drift"]:
+            print(f"  {d['row']}.{d['metric']}: predicted "
+                  f"{_fmt_val(d['predicted'])} actual "
+                  f"{_fmt_val(d['actual'])} ratio {d['ratio']}")
+    if report["gate_failures"]:
+        print(f"\nGATE: {len(report['gate_failures'])} unbaselined "
+              f"regression(s)")
+        for f in report["gate_failures"]:
+            print(f"  {f['row']}.{f['metric']} fp {f['fingerprint']}")
+    else:
+        print("\nGATE: clean")
+
+
+def write_baseline(args, report: Dict[str, Any]) -> None:
+    led = _ledger()
+    old = led.load_baseline(args.baseline)
+    rollups = collect_rollups(args.scan, with_history=not args.no_history)
+    rows: Dict[str, Dict[str, float]] = {}
+    smoke_rows: Dict[str, Dict[str, float]] = {}
+    # partition before taking latest — a chip history row must not
+    # shadow the smoke run of the same name (ledger.diff_rollups does
+    # the same split when comparing)
+    for smoke_flag, dest in ((False, rows), (True, smoke_rows)):
+        subset = [r for r in rollups
+                  if bool(r.get("smoke")) == smoke_flag]
+        for row, rollup in led.latest_rollups(subset).items():
+            flat = led.flatten_metrics(rollup)
+            if flat:
+                dest[row] = flat
+    doc = {
+        "comment": old.get("comment",
+                           "Pinned by tools/obs_report.py --write-baseline; "
+                           "rows = chip history, smoke_rows = deterministic "
+                           "smoke metrics only, suppress = acknowledged "
+                           "finding fingerprints."),
+        "rows": rows,
+        "smoke_rows": smoke_rows or old.get("smoke_rows", {}),
+        "suppress": sorted(old.get("suppress", [])),
+    }
+    tmp = f"{args.baseline}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, args.baseline)
+    print(f"obs_report: wrote {args.baseline} "
+          f"({len(rows)} rows, {len(doc['smoke_rows'])} smoke rows)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scan", metavar="DIR", default=None,
+                    help="ingest + anomaly-scan run manifests under DIR")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON (default tools/obs_baseline.json)")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 on unbaselined regressions")
+    ap.add_argument("--drift", action="store_true",
+                    help="plan-vs-actual drift report (planner join)")
+    ap.add_argument("--no-history", action="store_true",
+                    help="skip the committed BENCH_r* history")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="re-pin the baseline to the current rollups")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="print the full report as JSON")
+    args = ap.parse_args(argv)
+
+    report = build_report(args)
+    if args.write_baseline:
+        write_baseline(args, report)
+        return 0
+    if args.as_json:
+        print(json.dumps(report, indent=1, sort_keys=True, default=float))
+    else:
+        print_report(report)
+    if args.gate and report["gate_failures"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

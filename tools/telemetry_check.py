@@ -41,14 +41,15 @@ from deepspeed_tpu.analysis.vocab import check_all as _vocab_check  # noqa: E402
 
 DOCS = os.path.join(REPO, "docs", "OBSERVABILITY.md")
 
-# frozen with schema version 2 (v2 added offload_overlap_fraction for
-# the chunked host-optimizer pipeline) — telemetry_check is the tripwire
-EXPECTED_SCHEMA_VERSION = 2
+# frozen with schema version 3 (v2 added offload_overlap_fraction for
+# the chunked host-optimizer pipeline; v3 added run_id, the run-ledger
+# stitching key) — telemetry_check is the tripwire
+EXPECTED_SCHEMA_VERSION = 3
 EXPECTED_RECORD_KEYS = [
     "achieved_flops_per_sec", "comm", "flops_per_step", "flops_source",
     "goodput", "grad_norm", "hbm", "kind", "loss", "loss_scale", "lr",
-    "mfu", "offload_overlap_fraction", "peak_flops_per_sec", "schema",
-    "serving", "skipped", "step", "tokens", "tokens_per_sec",
+    "mfu", "offload_overlap_fraction", "peak_flops_per_sec", "run_id",
+    "schema", "serving", "skipped", "step", "tokens", "tokens_per_sec",
     "wall_time_s",
 ]
 
@@ -239,12 +240,12 @@ PLAN_BENCH_KEYS = ["plan_validate_known_good_top3", "known_good_ranks",
 # literally emitted by bench.py (they also ride in DISAGG_BENCH_KEYS).
 # Per-tier Prometheus gauges are documented via their `fleet_*_<key>`
 # wildcard rows (tiers substitute into the `*`).
-EXPECTED_TIER_SNAPSHOT_SCHEMA = 1
+EXPECTED_TIER_SNAPSHOT_SCHEMA = 2      # v2 added run_id (run ledger)
 EXPECTED_TIER_SNAPSHOT_KEYS = [
     "evictable_headroom_blocks", "handoff_bytes_per_sec",
     "handoffs_per_sec", "kv_utilization", "prefix_hit_rate",
     "queue_depth", "queue_wait_p50_ms", "queue_wait_p95_ms",
-    "queue_wait_p99_ms", "replicas_alive", "running", "schema",
+    "queue_wait_p99_ms", "replicas_alive", "run_id", "running", "schema",
     "slo_violation", "spec_accept_rate", "tick", "tier",
     "tokens_per_sec", "tpot_p50_ms", "tpot_p95_ms", "tpot_p99_ms", "ts",
     "ttft_p50_ms", "ttft_p95_ms", "ttft_p99_ms",
@@ -261,6 +262,43 @@ EXPECTED_SLO_LEDGER_KEYS = ["attainment", "error_budget_burn", "ticks",
 EXPECTED_TIMELINE_KEYS = ["decode_ms", "failovers", "handoff_bytes",
                           "handoff_ms", "prefill_ms", "total_ms",
                           "trace_id", "uid"]
+
+# frozen run-ledger vocabulary (telemetry/ledger.py; docs/OBSERVABILITY.md
+# "Run ledger & regression sentinel"): manifest / rollup / finding /
+# anomaly / drift key sets, the sentinel verdicts, and the anomaly kinds
+# each follow the standard contract — frozen list matches the module,
+# every name documented, and bench.py literally stamps the run_id +
+# manifest keys into every row.
+EXPECTED_LEDGER_SCHEMA = 1
+EXPECTED_MANIFEST_KEYS = ["artifacts", "created_utc", "ledger_schema",
+                          "row", "run_id", "schema_versions", "smoke"]
+EXPECTED_MANIFEST_ARTIFACT_KEYS = ["fleet_jsonl", "flight_dir",
+                                   "resolved_config", "slo",
+                                   "telemetry_jsonl", "trace_json"]
+EXPECTED_ROLLUP_KEYS = ["error", "metric", "recovery", "round", "row",
+                        "run_id", "serve", "smoke", "source", "stale",
+                        "train", "unit", "value", "vs_baseline"]
+EXPECTED_ROLLUP_TRAIN_KEYS = ["comm_bytes_by_collective", "goodput",
+                              "hbm_peak_bytes", "mfu",
+                              "offload_overlap_fraction",
+                              "step_time_p50_ms", "step_time_p95_ms",
+                              "tokens_per_sec"]
+EXPECTED_ROLLUP_SERVE_KEYS = ["error_budget_burn", "handoff_bytes_per_req",
+                              "prefix_hit_rate", "queue_wait_p95_ms",
+                              "slo_attainment", "spec_accept_rate",
+                              "tokens_per_sec", "tpot_p50_ms",
+                              "tpot_p95_ms", "ttft_p50_ms", "ttft_p95_ms"]
+EXPECTED_ROLLUP_RECOVERY_KEYS = ["goodput_after", "loss_gap", "outage_s"]
+EXPECTED_VERDICTS = ["flat", "improved", "missing", "new", "regressed",
+                     "stale"]
+EXPECTED_ANOMALY_KINDS = ["goodput_gap", "mfu_cliff", "slo_burn_spike",
+                          "step_time_spike"]
+EXPECTED_ANOMALY_KEYS = ["flight_bundle", "kind", "run_id", "step",
+                         "threshold", "tier", "trace_span", "value"]
+EXPECTED_OBS_FINDING_KEYS = ["baseline", "current", "delta", "fingerprint",
+                             "metric", "requeue_cmd", "row", "verdict"]
+EXPECTED_DRIFT_KEYS = ["actual", "metric", "predicted", "ratio", "row"]
+LEDGER_BENCH_KEYS = ["run_id", "manifest"]
 
 
 def _exported_monitor_tags() -> List[str]:
@@ -681,9 +719,10 @@ def check_fleet() -> List[str]:
         return REQUEST_TIMELINE_KEYS
 
     # every tier substitutes into the same gauge wildcard rows: document
-    # `fleet_*_queue_depth` once, not once per tier
+    # `fleet_*_queue_depth` once, not once per tier (tier/schema/run_id
+    # are identity fields, never exported as gauges)
     gauges = [f"fleet_prefill_{k}" for k in EXPECTED_TIER_SNAPSHOT_KEYS
-              if k not in ("tier", "schema")]
+              if k not in ("tier", "schema", "run_id")]
     return _vocab_check([
         VocabSpec(name="fleet.TIER_SNAPSHOT_KEYS",
                   expected=EXPECTED_TIER_SNAPSHOT_KEYS, actual=_snap_keys,
@@ -708,6 +747,62 @@ def check_fleet() -> List[str]:
                   docs_path=DOCS),
     ]) + _cross_link(SERVING_DOCS, "OBSERVABILITY.md",
                      "fleet snapshots / autoscaler inputs")
+
+
+def check_obs_ledger() -> List[str]:
+    """Run-ledger vocabulary: manifest/rollup/finding/anomaly/drift key
+    sets, the sentinel verdicts, and the anomaly kinds match
+    telemetry/ledger.py; every name is documented in the
+    docs/OBSERVABILITY.md "Run ledger & regression sentinel" section;
+    bench.py stamps run_id + manifest into every row; and the ledger
+    schema version is pinned."""
+    def _led(name):
+        def thunk():
+            from deepspeed_tpu.telemetry import ledger
+
+            if ledger.LEDGER_SCHEMA != EXPECTED_LEDGER_SCHEMA:
+                raise ValueError(
+                    f"LEDGER_SCHEMA is {ledger.LEDGER_SCHEMA}, lint pins "
+                    f"{EXPECTED_LEDGER_SCHEMA}")
+            return getattr(ledger, name)
+        return thunk
+
+    return _vocab_check([
+        VocabSpec(name="ledger.MANIFEST_KEYS",
+                  expected=EXPECTED_MANIFEST_KEYS,
+                  actual=_led("MANIFEST_KEYS"), docs_path=DOCS),
+        VocabSpec(name="ledger.MANIFEST_ARTIFACT_KEYS",
+                  expected=EXPECTED_MANIFEST_ARTIFACT_KEYS,
+                  actual=_led("MANIFEST_ARTIFACT_KEYS"), docs_path=DOCS),
+        VocabSpec(name="ledger.ROLLUP_KEYS",
+                  expected=EXPECTED_ROLLUP_KEYS,
+                  actual=_led("ROLLUP_KEYS"), docs_path=DOCS),
+        VocabSpec(name="ledger.ROLLUP_TRAIN_KEYS",
+                  expected=EXPECTED_ROLLUP_TRAIN_KEYS,
+                  actual=_led("ROLLUP_TRAIN_KEYS"), docs_path=DOCS),
+        VocabSpec(name="ledger.ROLLUP_SERVE_KEYS",
+                  expected=EXPECTED_ROLLUP_SERVE_KEYS,
+                  actual=_led("ROLLUP_SERVE_KEYS"), docs_path=DOCS),
+        VocabSpec(name="ledger.ROLLUP_RECOVERY_KEYS",
+                  expected=EXPECTED_ROLLUP_RECOVERY_KEYS,
+                  actual=_led("ROLLUP_RECOVERY_KEYS"), docs_path=DOCS),
+        VocabSpec(name="ledger.VERDICTS", expected=EXPECTED_VERDICTS,
+                  actual=_led("VERDICTS"), docs_path=DOCS),
+        VocabSpec(name="ledger.ANOMALY_KINDS",
+                  expected=EXPECTED_ANOMALY_KINDS,
+                  actual=_led("ANOMALY_KINDS"), docs_path=DOCS),
+        VocabSpec(name="ledger.ANOMALY_KEYS",
+                  expected=EXPECTED_ANOMALY_KEYS,
+                  actual=_led("ANOMALY_KEYS"), docs_path=DOCS),
+        VocabSpec(name="ledger.FINDING_KEYS",
+                  expected=EXPECTED_OBS_FINDING_KEYS,
+                  actual=_led("FINDING_KEYS"), docs_path=DOCS),
+        VocabSpec(name="ledger.DRIFT_KEYS", expected=EXPECTED_DRIFT_KEYS,
+                  actual=_led("DRIFT_KEYS"), docs_path=DOCS),
+        VocabSpec(name="LEDGER_BENCH_KEYS", expected=LEDGER_BENCH_KEYS,
+                  docs_path=DOCS,
+                  source_keys=[(_BENCH, LEDGER_BENCH_KEYS)]),
+    ]) + _cross_link(PLANNER_DOCS, "obs_report", "calibration")
 
 
 def validate_chrome_trace(obj: Any) -> List[str]:
@@ -780,7 +875,7 @@ def run_all() -> List[str]:
             + check_router_serving() + check_autotuning()
             + check_graph_audit() + check_memory_audit()
             + check_offload() + check_recovery() + check_planner()
-            + check_fleet() + check_trace_export())
+            + check_fleet() + check_obs_ledger() + check_trace_export())
 
 
 def main() -> int:
