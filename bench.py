@@ -2074,6 +2074,231 @@ def row_chaos_recovery():
     return _chaos_recovery_body()
 
 
+def _chaos_serve_body():
+    """Serving chaos drill (docs/SERVING.md "Fault injection &
+    self-healing"): a scripted, seeded FaultPlan — all six fault kinds —
+    against a supervised 2 prefill + 2 decode disagg fleet.  The control
+    phase records fault-free greedy outputs on the same fleet; the chaos
+    phase then demands every request terminate typed (zero hangs), every
+    dead/stuck replica quarantined + respawned within the heal deadline,
+    the decode tier collapse into degraded homogeneous routing and
+    restore after healing, and every chaos-phase completion bit-identical
+    to its control twin.  Frozen keys linted by tools/telemetry_check.py
+    against docs/SERVING.md."""
+    import tempfile
+
+    from deepspeed_tpu.models import get_model_config
+    from deepspeed_tpu.resilience.chaos import FaultPlan, attach_chaos
+    from deepspeed_tpu.runtime.config import TelemetryConfig
+    from deepspeed_tpu.serving import (DisaggRouter, FleetSampler,
+                                       FleetSupervisor, ReplicaSet,
+                                       RequestCancelled, RequestShed,
+                                       SamplingParams, ServingError)
+    from deepspeed_tpu.telemetry import Telemetry
+
+    model = get_model_config("llama-tiny", num_layers=2)
+    if SMOKE:
+        n_req, new, rate, wait_s = 24, 8, 40.0, 240.0
+        eng_cfg = {"dtype": "float32",
+                   "memory_config": {"num_blocks": 96, "block_size": 4},
+                   "max_context": 64}
+    else:
+        n_req, new, rate, wait_s = 64, 16, 48.0, 600.0
+        eng_cfg = {"memory_config": {"num_blocks": 512}}
+    rng = np.random.default_rng(31)
+    prompts = [rng.integers(1, model.vocab_size, size=12).tolist()
+               for _ in range(n_req)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_req))
+    # every 6th request is below-floor priority: the shed_low_priority
+    # rung (if pressure climbs that far) must take exactly this class
+    prios = [-1 if i % 6 == 5 else 0 for i in range(n_req)]
+
+    base = tempfile.mkdtemp(prefix="dstpu_chaos_serve_")
+    flight_dir = os.path.join(base, "flight")
+    tel = Telemetry(TelemetryConfig(
+        enabled=True, jsonl_path=_telemetry_jsonl("chaos_serve"),
+        run_id=_run_id(),
+        tracing={"enabled": True,
+                 "trace_path": _trace_json("chaos_serve")}))
+
+    rs = ReplicaSet.build(model, 4, eng_cfg,
+                          {"admission": {"max_queue_size": 32}}, seed=0,
+                          disagg={"enabled": True, "prefill_replicas": 2,
+                                  "decode_replicas": 2})
+    router = DisaggRouter(rs, telemetry=tel).start()
+
+    # control phase: fault-free greedy outputs through the SAME disagg
+    # path (this also pays every compile before the chaos clock starts);
+    # respawned replicas rebuild from the same seed, so chaos-phase
+    # completions must reproduce these bit-for-bit
+    control = router.generate(prompts, max_new_tokens=new)
+    assert all(len(o) == new for o in control), "control run incomplete"
+
+    sampler = FleetSampler(rs, router=router, slo=_slo_spec(),
+                           cadence_s=0.25,
+                           jsonl_path=_fleet_jsonl("chaos_serve"),
+                           telemetry=tel).start()
+    sup = FleetSupervisor(
+        rs, router=router, sampler=sampler, telemetry=tel,
+        flight_dir=flight_dir,
+        config={"cadence_s": 0.2, "suspect_ticks": 2,
+                "stuck_after_s": 1.0, "straggler_factor": 8.0,
+                "heal_deadline_s": 60.0 if SMOKE else 30.0,
+                "max_heals": 6,
+                "brownout": {"enter": 0.5, "exit": 0.2, "dwell_s": 0.3,
+                             "priority_floor": 0}}).start()
+
+    # the scripted fault plan — all six kinds, offsets from arm time.
+    # Both decode replicas (r2, r3) crash ~together so the decode pool
+    # empties while healing is still in flight: the supervisor must
+    # collapse the tiers, heal, then restore them.
+    plan = FaultPlan([
+        {"kind": "slow_replica", "target": "r0", "at": 0.1,
+         "duration_s": 3.0, "params": {"delay_ms": 30.0}},
+        {"kind": "handoff_fail", "target": "r2", "at": 0.2},
+        {"kind": "admission_storm", "target": "r0", "at": 0.4,
+         "params": {"burst": 4, "priority": -100, "max_new_tokens": 4}},
+        {"kind": "cancel_storm", "target": "r2", "at": 0.5,
+         "params": {"count": 2}},
+        {"kind": "replica_hang", "target": "r1", "at": 0.8},
+        {"kind": "replica_crash", "target": "r2", "at": 0.9},
+        {"kind": "replica_crash", "target": "r3", "at": 0.95},
+    ], seed=7)
+    injectors = attach_chaos(rs, plan, router=router)
+
+    streams, shed_at_submit = {}, 0
+    t0 = time.perf_counter()
+    for i in range(n_req):
+        lag = arrivals[i] - (time.perf_counter() - t0)
+        if lag > 0:
+            time.sleep(lag)
+        try:
+            streams[i] = router.submit(
+                prompts[i], SamplingParams(max_new_tokens=new),
+                priority=prios[i])
+        except RequestShed:
+            shed_at_submit += 1
+
+    completed, shed, cancelled, failed, hung = 0, shed_at_submit, 0, 0, 0
+    outs = {}
+    for i, s in streams.items():
+        try:
+            outs[i] = s.result(timeout=wait_s)
+            completed += 1
+        except RequestShed:
+            shed += 1
+        except RequestCancelled:
+            cancelled += 1
+        except TimeoutError:
+            hung += 1
+        except ServingError:
+            failed += 1
+
+    # settle: every casualty healed, tiers restored, before reading out
+    deadline = time.monotonic() + wait_s
+    while time.monotonic() < deadline:
+        snap = sup.snapshot()
+        if (not snap["failed"] and not router.collapsed
+                and all(st in ("healthy", "respawned")
+                        for st in snap["states"].values())):
+            break
+        time.sleep(0.25)
+    sup.stop()
+    sup.check()                    # heal budget must NOT have blown
+    snap = sup.snapshot()
+    heals = [e for e in sup.events if e.get("state") == "respawned"]
+    brownouts = [e for e in sup.events if e.get("state") == "brownout"]
+    sampler.stop()
+    sampler.sample_once()
+    hist = sampler.history()
+    router.stop()
+    _reset_topology()
+    tel.close()
+
+    kinds = set()
+    for inj in injectors.values():
+        kinds |= inj.fired_kinds
+    faults_injected = sum(inj.injected for inj in injectors.values())
+    mismatch = [i for i in outs if outs[i] != control[i]]
+    curve = {}
+    for row in hist:
+        curve[row["tick"]] = (curve.get(row["tick"], 0)
+                              + int(row["slo_violation"]))
+    heal_s = [e["heal_s"] for e in heals]
+    from deepspeed_tpu.serving.admission import brownout_index
+
+    # the acceptance gates — each failure names the evidence
+    assert hung == 0, f"{hung} requests never terminated"
+    assert len(kinds) >= 4, f"only {sorted(kinds)} fired"
+    assert snap["heals"] >= 3 and len(heals) >= 3, (snap, len(heals))
+    assert all(st in ("healthy", "respawned")
+               for st in snap["states"].values()), snap["states"]
+    assert snap["collapses"] >= 1 and snap["restores"] >= 1, snap
+    assert not mismatch, f"chaos outputs diverged on requests {mismatch}"
+    assert completed >= n_req // 2, (completed, n_req)
+    return {
+        "metric": "chaos_serve_completed",
+        "telemetry_jsonl": _telemetry_jsonl("chaos_serve"),
+        "trace_json": _trace_json("chaos_serve"),
+        "fleet_jsonl": _fleet_jsonl("chaos_serve"),
+        "flight_dir": flight_dir,
+        "value": completed, "unit": "requests",
+        "vs_baseline": round(completed / n_req, 3),
+        "faults_injected": faults_injected,
+        "fault_kinds": sorted(kinds),
+        "completed_chaos": completed,
+        "shed_chaos": shed,
+        "cancelled_chaos": cancelled,
+        "failed_chaos": failed,
+        "heals": snap["heals"],
+        "time_to_heal_s": round(max(heal_s), 3) if heal_s else -1.0,
+        "collapses": snap["collapses"],
+        "restores": snap["restores"],
+        "bit_identical": int(not mismatch),
+        "brownout_peak": max([brownout_index(e["level"])
+                              for e in brownouts] or [0]),
+        "slo_violations_curve": [curve[t] for t in sorted(curve)],
+        "resolved_config": _resolved_config(
+            {}, serving={"n_replicas": 4,
+                         "disagg": {"enabled": True,
+                                    "prefill_replicas": 2,
+                                    "decode_replicas": 2},
+                         "supervisor": {"max_heals": 6,
+                                        "brownout": True}}),
+    }
+
+
+def row_chaos_serve():
+    """Serving chaos-drill row.  The disagg fleet needs 8 devices; smoke
+    mode pins ONE cpu device, so the smoke variant re-execs itself on a
+    virtual 8-device CPU mesh (same pattern as serve_disagg)."""
+    if SMOKE and "--chaos-serve-inner" not in sys.argv:
+        import os
+        import subprocess
+
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        env["JAX_PLATFORMS"] = "cpu"
+        cmd = [sys.executable, __file__, "--row", "chaos_serve",
+               "--smoke", "--chaos-serve-inner"]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=900, env=env)
+        except subprocess.TimeoutExpired:
+            return {"metric": "chaos_serve", "error": "smoke timed out"}
+        for line in reversed(proc.stdout.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        return {"metric": "chaos_serve",
+                "error": ("no result line; " + " | ".join(tail[-3:]))[:300]}
+    return _chaos_serve_body()
+
+
 def row_plan_validate():
     """Planner regression row (docs/PLANNER.md "Regression gate"): the
     plan compiler re-derives every pinned known-good bench config from
@@ -2141,6 +2366,7 @@ _ROWS = {
     "serve_load_multi": row_serve_load_multi,
     "serve_disagg": row_serve_disagg,
     "chaos_recovery": row_chaos_recovery,
+    "chaos_serve": row_chaos_serve,
     "plan_validate": row_plan_validate,
     "gpt2_350m": row_gpt2_350m,
 }
@@ -2216,7 +2442,8 @@ def main() -> None:
                  "longseq_ring", "gpt2_350m_commquant",
                  "gpt2_350m_autosched", "peak_params",
                  "v2_decode", "serve_load", "serve_load_multi",
-                 "serve_disagg", "chaos_recovery", "plan_validate"):
+                 "serve_disagg", "chaos_recovery", "chaos_serve",
+                 "plan_validate"):
         # one run id per row, minted HERE so subprocess rows inherit it
         # through the environment and every artifact carries the same id
         os.environ["DSTPU_RUN_ID"] = _mint_run_id(name)

@@ -16,6 +16,7 @@ the same collectives AutoTP injection produces in the reference.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -187,6 +188,9 @@ class InferenceEngineV2:
         # under the serve loop's trace id instead of one-off orphan ids
         self.tracer = None
         self.trace_id = ""
+        # fault injection (resilience/chaos.py ChaosInjector): attached by
+        # attach_chaos; None keeps step() at one attribute check per call
+        self.chaos = None
 
         pages = self.cfg.num_blocks * self.cfg.block_size
         # [L, nkv, P, d]: kv-head-major so the paged-attention kernel's page
@@ -398,6 +402,16 @@ class InferenceEngineV2:
         run) when the step needs more KV pages than remain — preempt a
         victim and retry.
         """
+        if self.chaos is not None:
+            # "engine.step" injection point: specs pinned here (see
+            # resilience/chaos.py FaultSpec.point) delay or kill the
+            # ragged dispatch itself rather than the serve loop around it
+            for f in self.chaos.fire("engine.step"):
+                if f.kind == "slow_replica":
+                    time.sleep(float(f.params.get("delay_ms", 50.0)) / 1e3)
+                elif f.kind == "replica_crash":
+                    from deepspeed_tpu.resilience.chaos import ChaosError
+                    raise ChaosError("injected replica_crash (engine.step)")
         tr = self.tracer
         sp = None
         if tr is not None and tr.enabled:

@@ -45,7 +45,6 @@ from __future__ import annotations
 
 import json
 import os
-import signal
 import sys
 import time
 
@@ -67,22 +66,6 @@ if os.environ.get("DSTPU_FORCE_CPU", "0") == "1":
     jax.config.update("jax_platforms", "cpu")
 
 
-def _chaos_cfg() -> dict:
-    return json.loads(os.environ.get("DSTPU_CHAOS") or "{}")
-
-
-def _chaos_armed(ckpt_dir: str) -> bool:
-    """Fault injection fires in exactly one incarnation: the sentinel is
-    written BEFORE the fatal action, so the restarted worker sees it and
-    trains through."""
-    return not os.path.exists(os.path.join(ckpt_dir, ".chaos_fired"))
-
-
-def _arm_sentinel(ckpt_dir: str) -> None:
-    with open(os.path.join(ckpt_dir, ".chaos_fired"), "w") as f:
-        f.write(str(os.getpid()))
-
-
 def main() -> int:
     import numpy as np
 
@@ -91,6 +74,9 @@ def main() -> int:
                                                     load_universal,
                                                     resolve_universal_dir)
     from deepspeed_tpu.models import get_model_config
+    # the shared chaos module (resilience/chaos.py): same DSTPU_CHAOS env
+    # contract and exactly-once sentinel, one vocabulary with serving
+    from deepspeed_tpu.resilience.chaos import TrainChaos
 
     rank = int(os.environ.get("DSTPU_PROC_ID", "0"))
     ckpt_dir = os.environ["DSTPU_CKPT_DIR"]
@@ -104,11 +90,9 @@ def main() -> int:
     resume = os.environ.get("DSTPU_RESUME", "0") == "1"
     incarnation = int(os.environ.get("DSTPU_INCARNATION", "0"))
 
-    chaos = _chaos_cfg()
-    chaos_mine = (int(chaos.get("rank", 0)) == rank and chaos
-                  and _chaos_armed(ckpt_dir))
-    if chaos_mine and chaos.get("ignore_term"):
-        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    chaos = TrainChaos.from_env(rank, ckpt_dir)
+    if chaos is not None:
+        chaos.install_signals()
 
     model = get_model_config(os.environ.get("DSTPU_MODEL", "gpt2-tiny"),
                              max_seq_len=max(seq, 16))
@@ -152,18 +136,11 @@ def main() -> int:
             os.fsync(f.fileno())
 
         done = engine.global_steps
-        if chaos_mine and chaos.get("die_at") is not None \
-                and done >= int(chaos["die_at"]):
-            # BEFORE the save: the step we just ran is lost and the
+        if chaos is not None:
+            # BEFORE the save: a die loses the step we just ran and the
             # resumed incarnation must recompute it from the previous
             # committed checkpoint — the real mid-train crash shape
-            _arm_sentinel(ckpt_dir)
-            os._exit(13)
-        if chaos_mine and chaos.get("hang_at") is not None \
-                and done >= int(chaos["hang_at"]):
-            _arm_sentinel(ckpt_dir)
-            while True:  # simulated wedge: alive, silent, not progressing
-                time.sleep(3600)
+            chaos.fire(done)
 
         if rank == 0 and done % save_every == 0:
             tag = f"step{done}"

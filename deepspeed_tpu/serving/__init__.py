@@ -1,11 +1,16 @@
 """deepspeed_tpu.serving — MII-style async serving over InferenceEngineV2.
 
 See docs/SERVING.md for the architecture (queue → admission → SplitFuse
-→ streams), the preemption/watermark policy, and a runnable CPU example.
+→ streams), the preemption/watermark policy, fault injection and the
+self-healing supervisor, and a runnable CPU example.
 """
 
-from deepspeed_tpu.serving.admission import (AdmissionConfig,
-                                             AdmissionController)
+from deepspeed_tpu.serving.admission import (BROWNOUT_LEVELS,
+                                             AdmissionConfig,
+                                             AdmissionController,
+                                             BrownoutConfig,
+                                             BrownoutController,
+                                             brownout_index)
 from deepspeed_tpu.serving.disagg import (REQUEST_TIMELINE_KEYS,
                                           DisaggConfig, DisaggRouter,
                                           SpeculativeConfig,
@@ -19,18 +24,26 @@ from deepspeed_tpu.serving.prefix_cache import PrefixCache, PrefixCacheConfig
 from deepspeed_tpu.serving.replica import ReplicaSet, ServingReplica
 from deepspeed_tpu.serving.request import (DeadlineExceeded,
                                            GenerationRequest, QueueFull,
-                                           RequestCancelled, ResponseStream,
-                                           SamplingParams, ServingError)
+                                           RequestCancelled, RequestShed,
+                                           ResponseStream, SamplingParams,
+                                           ServingError)
 from deepspeed_tpu.serving.router import Router, RouterConfig
 from deepspeed_tpu.serving.server import InferenceServer, ServerConfig
+from deepspeed_tpu.serving.supervisor import (HEALTH_STATES,
+                                              FleetHealFailed,
+                                              FleetSupervisor,
+                                              FleetSupervisorConfig)
 
 __all__ = [
-    "AdmissionConfig", "AdmissionController", "DeadlineExceeded",
-    "DisaggConfig", "DisaggRouter", "FleetSampler", "GenerationRequest",
-    "InferenceServer", "PrefixCache", "PrefixCacheConfig", "QueueFull",
-    "REQUEST_TIMELINE_KEYS", "ReplicaSet", "RequestCancelled",
-    "ResponseStream", "Router", "RouterConfig", "RouterMetrics",
-    "SamplingParams", "ServerConfig", "ServingError", "ServingMetrics",
-    "ServingReplica", "SpeculativeConfig", "SpeculativeDecoder",
-    "TIER_SNAPSHOT_KEYS", "TIER_SNAPSHOT_SCHEMA", "spec_accept_rate",
+    "AdmissionConfig", "AdmissionController", "BROWNOUT_LEVELS",
+    "BrownoutConfig", "BrownoutController", "DeadlineExceeded",
+    "DisaggConfig", "DisaggRouter", "FleetHealFailed", "FleetSampler",
+    "FleetSupervisor", "FleetSupervisorConfig", "GenerationRequest",
+    "HEALTH_STATES", "InferenceServer", "PrefixCache", "PrefixCacheConfig",
+    "QueueFull", "REQUEST_TIMELINE_KEYS", "ReplicaSet", "RequestCancelled",
+    "RequestShed", "ResponseStream", "Router", "RouterConfig",
+    "RouterMetrics", "SamplingParams", "ServerConfig", "ServingError",
+    "ServingMetrics", "ServingReplica", "SpeculativeConfig",
+    "SpeculativeDecoder", "TIER_SNAPSHOT_KEYS", "TIER_SNAPSHOT_SCHEMA",
+    "brownout_index", "spec_accept_rate",
 ]

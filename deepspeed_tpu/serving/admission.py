@@ -24,10 +24,103 @@ optimistic admission + preemption tracks the *actual* output lengths.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Deque, Iterable, Optional
 
 from deepspeed_tpu.serving.request import GenerationRequest, QueueFull
+
+#: Graceful-degradation ladder, mildest first — frozen vocabulary
+#: (docs/SERVING.md brownout table; linted by tools/telemetry_check.py).
+#: Each level includes every level below it:
+#:   normal            — full service
+#:   shed_speculation  — disable speculative decoding (greedy outputs are
+#:                       bit-identical by construction, so this level is
+#:                       invisible to callers except in latency)
+#:   cap_decode        — cap concurrently-running requests at
+#:                       ``decode_cap`` (admission slows, outputs intact)
+#:   shed_low_priority — reject/shed requests below ``priority_floor``
+#:   reject_new        — reject every new request; finish what's running
+BROWNOUT_LEVELS = ("normal", "shed_speculation", "cap_decode",
+                   "shed_low_priority", "reject_new")
+
+
+def brownout_index(level: str) -> int:
+    """Ladder position of ``level`` (raises on unknown names — the same
+    tripwire as every other frozen vocabulary)."""
+    try:
+        return BROWNOUT_LEVELS.index(level)
+    except ValueError:
+        raise ValueError(f"unknown brownout level {level!r} "
+                         f"(one of {BROWNOUT_LEVELS})") from None
+
+
+class BrownoutConfig:
+    def __init__(self, d: Optional[dict] = None, **kw):
+        d = {**(d or {}), **kw}
+        # pressure thresholds: step UP a level at >= enter, DOWN at
+        # <= exit.  The gap is the hysteresis band; inside it the level
+        # holds, so a pressure signal oscillating around one threshold
+        # cannot flap the ladder.
+        self.enter = float(d.get("enter", 0.85))
+        self.exit = float(d.get("exit", 0.6))
+        if not (0.0 <= self.exit < self.enter):
+            raise ValueError(f"brownout thresholds must satisfy 0 <= exit "
+                             f"({self.exit}) < enter ({self.enter})")
+        # minimum dwell between level changes (either direction): even a
+        # pressure step function walks the ladder one level per dwell
+        self.dwell_s = float(d.get("dwell_s", 0.5))
+        # cap_decode: max concurrently-running requests per replica
+        self.decode_cap = int(d.get("decode_cap", 2))
+        # shed_low_priority: requests with priority < floor are shed
+        self.priority_floor = int(d.get("priority_floor", 0))
+        # pressure normalization: SLO error-budget burn at which the burn
+        # term saturates to 1.0 (burn 1.0 = exactly on budget)
+        self.burn_limit = float(d.get("burn_limit", 4.0))
+
+
+class BrownoutController:
+    """The ladder's state machine: feed it a pressure scalar (0 = idle,
+    1 = saturated) on a cadence; it walks :data:`BROWNOUT_LEVELS` up and
+    down **one level per observation** with hysteresis + minimum dwell.
+
+    Pure and single-threaded by design (the fleet supervisor's cadence
+    thread is the only caller); actuation — what each level *does* — is
+    enforced by the servers via ``InferenceServer.set_brownout``.
+    """
+
+    def __init__(self, cfg: Optional[BrownoutConfig] = None):
+        self.cfg = cfg or BrownoutConfig()
+        self._index = 0
+        self._changed_at: Optional[float] = None
+        self.transitions = 0   # lifetime level changes (tests/bench)
+
+    @property
+    def level(self) -> str:
+        return BROWNOUT_LEVELS[self._index]
+
+    @property
+    def index(self) -> int:
+        return self._index
+
+    def observe(self, pressure: float,
+                now: Optional[float] = None) -> Optional[str]:
+        """One cadence tick: returns the NEW level name when the ladder
+        moved, else ``None``."""
+        now = time.monotonic() if now is None else now
+        if self._changed_at is not None \
+                and now - self._changed_at < self.cfg.dwell_s:
+            return None
+        if pressure >= self.cfg.enter \
+                and self._index < len(BROWNOUT_LEVELS) - 1:
+            self._index += 1
+        elif pressure <= self.cfg.exit and self._index > 0:
+            self._index -= 1
+        else:
+            return None
+        self._changed_at = now
+        self.transitions += 1
+        return self.level
 
 
 class AdmissionConfig:

@@ -53,7 +53,8 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
-from deepspeed_tpu.serving.request import GenerationRequest, ServingError
+from deepspeed_tpu.serving.request import (DeadlineExceeded,
+                                           GenerationRequest, ServingError)
 from deepspeed_tpu.serving.router import _RETRY, Router, _RoutedRequest
 from deepspeed_tpu.utils.logging import log_dist
 
@@ -295,6 +296,10 @@ class DisaggRouter(Router):
         # finished-request phase breakdowns (REQUEST_TIMELINE_KEYS),
         # newest last; appended under self._lock by the pump threads
         self._timelines: deque = deque(maxlen=_TIMELINE_RING)
+        # degraded homogeneous mode: True while a whole tier is gone
+        # (fleet supervisor actuation) — requests run ONE full leg on
+        # any survivor instead of the prefill→handoff→decode split
+        self._collapsed = False
 
     def timelines(self) -> List[Dict[str, Any]]:
         """Recent per-request phase timelines (oldest first) — each row
@@ -302,22 +307,57 @@ class DisaggRouter(Router):
         with self._lock:
             return list(self._timelines)
 
+    # -- degraded homogeneous mode --------------------------------------
+    @property
+    def collapsed(self) -> bool:
+        with self._lock:
+            return self._collapsed
+
+    def collapse_tiers(self) -> None:
+        """Fold the prefill/decode split into homogeneous routing: new
+        requests run a single full leg on whichever replicas survive.
+        The fleet supervisor calls this when a tier's dispatchable pool
+        empties; in-flight two-leg requests finish through the ordinary
+        cross-tier fallback.  Greedy outputs are unchanged — a unified
+        leg is just prefill+decode on one replica."""
+        with self._lock:
+            if self._collapsed:
+                return
+            self._collapsed = True
+        log_dist("disagg: tier collapsed — routing homogeneous until "
+                 "the fleet heals", level="warning")
+
+    def restore_tiers(self) -> None:
+        """Re-enable tiered prefill→decode routing (both tiers have
+        dispatchable replicas again)."""
+        with self._lock:
+            if not self._collapsed:
+                return
+            self._collapsed = False
+        log_dist("disagg: tiers restored — prefill/decode routing back",
+                 level="warning")
+
     # -- tier-aware dispatch --------------------------------------------
     def _candidates(self, tier: Optional[str],
                     exclude: Sequence[int]) -> List[Any]:
+        masked = self.masked_indices()
         alive = [r for r in self.replicas.alive if r.index not in exclude]
-        if tier is None:
-            return alive
-        pool = [r for r in alive if r.tier == tier]
+        clean = [r for r in alive if r.index not in masked]
+        if tier is None or self.collapsed:
+            # homogeneous: prefer unmasked survivors, but availability
+            # beats cleanliness when the mask covers everyone
+            return clean or alive
+        pool = [r for r in clean if r.tier == tier]
         if pool:
             return pool
-        uni = [r for r in alive if r.tier == "unified"]
+        uni = [r for r in clean if r.tier == "unified"]
         if uni:
             return uni
-        # last resort: any survivor serves the leg (a decode leg landing
-        # on a prefill replica just re-runs prefill — the recompute
-        # contract fail-over already rests on)
-        return alive
+        # last resort: any unmasked survivor serves the leg (a decode
+        # leg landing on a prefill replica just re-runs prefill — the
+        # recompute contract fail-over already rests on); a fully-masked
+        # fleet still dispatches rather than failing the request
+        return clean or alive
 
     def _score(self, rep, tier: Optional[str] = None) -> float:
         if tier == "prefill":
@@ -353,7 +393,7 @@ class DisaggRouter(Router):
                     "shorten the request")
         return super().submit(prompt, params, priority=priority,
                               deadline_s=deadline_s, session=session,
-                              phase="prefill")
+                              phase=None if self.collapsed else "prefill")
 
     def _request_complete(self, rr: _RoutedRequest) -> bool:
         eos = rr.params.eos_token_id
@@ -390,6 +430,19 @@ class DisaggRouter(Router):
                     # payload (export failed, replica died between token
                     # and export) is fine — admission just re-prefills.
                     rr.payload = getattr(rr.inner, "handoff_payload", None)
+                    if (rr.deadline is not None
+                            and time.monotonic() >= rr.deadline):
+                        # deadline died BETWEEN legs: surface the typed
+                        # terminal error here rather than burning a
+                        # decode admission that would only expire in
+                        # queue.  The un-adopted payload is dropped —
+                        # its exported chain was released with the
+                        # prefill request, so no blocks leak.
+                        rr.payload = None
+                        self._finish(rr, DeadlineExceeded(
+                            f"request {rr.uid}: deadline exceeded after "
+                            f"prefill leg ({len(rr.delivered)} tokens out)"))
+                        return
                     rr.phase = "decode"
                     try:
                         self._dispatch(rr, session=session)

@@ -29,8 +29,10 @@ Event = Tuple[str, float, int]
 
 _WINDOW = 2048  # per-distribution sample cap
 
-# outcome name (record_finish) → counter attribute
-_OUTCOMES = ("completed", "failed", "cancelled", "expired")
+# outcome name (record_finish) → counter attribute; "shed" covers both
+# submit-time brownout rejections (record_shed) and queued requests
+# terminated with RequestShed by the degradation ladder
+_OUTCOMES = ("completed", "failed", "cancelled", "expired", "shed")
 
 
 def spec_accept_rate(proposed: int, accepted: int) -> float:
@@ -99,6 +101,7 @@ class ServingMetrics:
     failed = property(lambda self: self._cv("failed"))
     cancelled = property(lambda self: self._cv("cancelled"))
     expired = property(lambda self: self._cv("expired"))
+    shed = property(lambda self: self._cv("shed"))
     rejected = property(lambda self: self._cv("rejected"))
     preemptions = property(lambda self: self._cv("preemptions"))
     tokens_out = property(lambda self: self._cv("tokens_out"))
@@ -124,6 +127,11 @@ class ServingMetrics:
 
     def record_reject(self) -> None:
         self._c["rejected"].inc()
+
+    def record_shed(self) -> None:
+        """A submit shed by the brownout ladder before a stream existed
+        (queued sheds arrive through ``record_finish("shed", ...)``)."""
+        self._c["shed"].inc()
 
     def record_admit(self, queue_wait_s: float) -> None:
         self._c["admitted"].inc()
@@ -182,7 +190,7 @@ class ServingMetrics:
     def record_finish(self, outcome: str, n_tokens: int,
                       first_token_at: Optional[float],
                       finished_at: float) -> None:
-        """``outcome``: completed | failed | cancelled | expired."""
+        """``outcome``: completed | failed | cancelled | expired | shed."""
         if outcome not in _OUTCOMES:
             raise ValueError(f"unknown outcome {outcome!r}")
         self._c[outcome].inc()
@@ -219,6 +227,7 @@ class ServingMetrics:
             "failed": self.failed,
             "cancelled": self.cancelled,
             "expired": self.expired,
+            "shed": self.shed,
             "rejected": self.rejected,
             "preemptions": self.preemptions,
             "flight_dumps": self.flight_dumps,

@@ -36,6 +36,13 @@ class QueueFull(ServingError):
     """Admission queue at capacity under the 'reject' policy."""
 
 
+class RequestShed(ServingError):
+    """The request was shed by the graceful-degradation ladder (brownout
+    levels ``shed_low_priority`` / ``reject_new``) — a typed, load-caused
+    terminal state distinct from a failure: the request was well-formed
+    and the server healthy, but capacity was deliberately withheld."""
+
+
 @dataclass(frozen=True)
 class SamplingParams:
     """Per-request sampling knobs (mirrors ``engine.generate()``'s

@@ -37,10 +37,11 @@ the request it serves.
 from __future__ import annotations
 
 import dataclasses
+import random
 import threading
 import time
 from collections import OrderedDict
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Set
 
 from deepspeed_tpu.serving.metrics import RouterMetrics
 from deepspeed_tpu.serving.replica import ReplicaSet, ServingReplica
@@ -64,6 +65,23 @@ class RouterConfig:
         # a request is failed over at most this many times before its
         # last error propagates to the caller
         self.max_failovers = int(d.get("max_failovers", 2))
+        # fail-over pacing: the k-th re-dispatch of a request sleeps
+        # min(base · 2^(k-1), cap) · U[0.5, 1.0) in its own pump thread
+        # before picking a new replica, so a crash burst doesn't slam
+        # every orphaned request onto the survivors in the same instant.
+        # base=0 disables the backoff (tests that pin instant fail-over).
+        self.backoff_base_s = float(d.get("backoff_base_s", 0.05))
+        self.backoff_cap_s = float(d.get("backoff_cap_s", 1.0))
+        if self.backoff_base_s < 0 or self.backoff_cap_s < self.backoff_base_s:
+            raise ValueError(
+                f"router backoff: need 0 <= base ({self.backoff_base_s}) "
+                f"<= cap ({self.backoff_cap_s})")
+        # after this many CONSECUTIVE failed legs on one replica, new
+        # dispatches skip it for mask_cooldown_s (a flapping replica
+        # stops being everyone's first retry target); a completed leg
+        # resets its counter.  0 disables the cooldown.
+        self.mask_after_failures = int(d.get("mask_after_failures", 3))
+        self.mask_cooldown_s = float(d.get("mask_cooldown_s", 2.0))
         # session -> replica affinity map bound (oldest evicted)
         self.sticky_sessions = bool(d.get("sticky_sessions", True))
         self.max_sessions = int(d.get("max_sessions", 4096))
@@ -158,6 +176,20 @@ class Router:
         self._pumps: List[threading.Thread] = []
         self._started = False
         self._stop_requested = False
+        # dispatch mask: replica index -> monotonic expiry (None =
+        # indefinite, i.e. supervisor quarantine).  Masked replicas take
+        # no NEW legs; their in-flight streams keep pumping (and fail
+        # over organically if the replica then dies).
+        self._mask: Dict[int, Optional[float]] = {}
+        # consecutive failed legs per replica (cleared by a completed leg
+        # or an unmask) — feeds the mask_after_failures cooldown
+        self._leg_failures: Dict[int, int] = {}
+        # deterministic jitter source for fail-over backoff: chaos runs
+        # stay reproducible under a fixed fault plan
+        self._rng = random.Random(0x0D15)
+        # fault-injection hook (resilience/chaos.py attach_chaos); None
+        # keeps the dispatch path injection-free
+        self._chaos = None
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> "Router":
@@ -231,12 +263,57 @@ class Router:
     def __exit__(self, *exc) -> None:
         self.stop(drain=not any(exc))
 
+    # -- dispatch masking ------------------------------------------------
+    def mask(self, index: int, cooldown_s: Optional[float] = None) -> None:
+        """Stop NEW legs landing on a replica.  ``cooldown_s`` bounds the
+        mask (leg-failure cooldown); ``None`` masks until :meth:`unmask`
+        (supervisor quarantine).  In-flight streams on the replica keep
+        pumping — masking is an admission decision, not an eviction."""
+        with self._lock:
+            self._mask[index] = (None if cooldown_s is None
+                                 else time.monotonic() + float(cooldown_s))
+
+    def unmask(self, index: int) -> None:
+        """Readmit a replica to dispatch and forget its failure streak
+        (the supervisor calls this after a successful respawn)."""
+        with self._lock:
+            self._mask.pop(index, None)
+            self._leg_failures.pop(index, None)
+
+    def masked_indices(self) -> Set[int]:
+        """Currently-masked replica indices; expired cooldowns are
+        dropped on read, so this is also the mask GC."""
+        now = time.monotonic()
+        with self._lock:
+            for i in [i for i, until in self._mask.items()
+                      if until is not None and until <= now]:
+                del self._mask[i]
+            return set(self._mask)
+
+    def set_brownout(self, level: str) -> None:
+        """Fan a brownout level out to every replica server (the fleet
+        supervisor's actuation point — one ladder, N enforcers)."""
+        for rep in self.replicas:
+            rep.server.set_brownout(level)
+
     # -- dispatch policy -------------------------------------------------
     def _candidates(self, tier: Optional[str],
                     exclude: Sequence[int]) -> List[ServingReplica]:
         """Dispatchable replicas for a leg; the disagg router narrows
         this to the leg's tier (with cross-tier fallback)."""
-        return [r for r in self.replicas.alive if r.index not in exclude]
+        return self._unmasked(
+            [r for r in self.replicas.alive if r.index not in exclude])
+
+    def _unmasked(self, reps: List[ServingReplica]) -> List[ServingReplica]:
+        masked = self.masked_indices()
+        if not masked:
+            return reps
+        keep = [r for r in reps if r.index not in masked]
+        # availability beats cleanliness: when EVERY candidate is masked
+        # (tiny fleet mid-heal), dispatching to a suspect replica still
+        # dominates failing the request outright — fail-over covers us
+        # if the suspicion was right
+        return keep or reps
 
     def _score(self, rep: ServingReplica,
                tier: Optional[str] = None) -> float:
@@ -290,6 +367,14 @@ class Router:
         Under disagg, ``rr.phase`` selects the tier and the leg shape:
         a prefill leg runs prompt→1 token with the KV export armed, a
         decode leg carries the exported payload into admission."""
+        if self._chaos is not None:
+            for f in self._chaos.fire("router.dispatch"):
+                if f.kind == "slow_replica":
+                    time.sleep(float(f.params.get("delay_ms", 50.0)) / 1e3)
+                elif f.kind == "handoff_fail" and rr.payload is not None:
+                    # payload lost in transit: the decode leg re-prefills
+                    # from the prompt (the documented degrade path)
+                    rr.payload = None
         remaining = rr.params.max_new_tokens - len(rr.delivered)
         params = (rr.params if not rr.delivered else
                   dataclasses.replace(rr.params, max_new_tokens=remaining))
@@ -433,6 +518,9 @@ class Router:
                     rr.delivered.append(tok)
                     out._put_token(tok)
                 self._leg_done(rr)
+                with self._lock:
+                    # a completed leg ends the replica's failure streak
+                    self._leg_failures.pop(rr.replica.index, None)
                 if leg is not None:
                     leg.end(outcome="completed")
                 self._finish(rr, None)
@@ -481,6 +569,16 @@ class Router:
                 f"up") if rr.failovers else e
         rr.failovers += 1
         self.metrics.record_failover()
+        # failure streak -> cooldown mask: after N consecutive failed
+        # legs the replica stops being anyone's dispatch target for
+        # mask_cooldown_s (a crash-looping replica otherwise keeps
+        # winning the score race the moment it respawns empty)
+        with self._lock:
+            streak = self._leg_failures.get(rep.index, 0) + 1
+            self._leg_failures[rep.index] = streak
+        if (self.cfg.mask_after_failures > 0
+                and streak >= self.cfg.mask_after_failures):
+            self.mask(rep.index, cooldown_s=self.cfg.mask_cooldown_s)
         if self.tracer.enabled:
             self.tracer.instant("router.failover", rr.trace_id, uid=rr.uid,
                                 from_replica=rep.index,
@@ -488,6 +586,17 @@ class Router:
         log_dist(f"router: replica r{rep.index} died with request "
                  f"{rr.uid} in flight ({len(delivered)} tokens out) — "
                  "failing over", level="warning")
+        # bounded exponential backoff with jitter, slept in THIS
+        # request's own pump thread (nobody else waits on it): the k-th
+        # fail-over of a request waits ~base·2^(k-1), so a mass crash
+        # spreads its re-dispatch burst instead of thundering onto the
+        # first surviving replica
+        if self.cfg.backoff_base_s > 0:
+            delay = min(self.cfg.backoff_base_s * (2 ** (rr.failovers - 1)),
+                        self.cfg.backoff_cap_s)
+            with self._lock:
+                delay *= 0.5 + 0.5 * self._rng.random()
+            time.sleep(delay)
         try:
             self._dispatch(rr, exclude=[rep.index], session=session)
         except ServingError as e2:

@@ -40,18 +40,28 @@ import numpy as np
 
 from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
 from deepspeed_tpu.inference.v2.ragged import KVCacheExhausted
-from deepspeed_tpu.serving.admission import (AdmissionConfig,
-                                             AdmissionController)
+from deepspeed_tpu.serving.admission import (BROWNOUT_LEVELS,
+                                             AdmissionConfig,
+                                             AdmissionController,
+                                             BrownoutConfig, brownout_index)
 from deepspeed_tpu.serving.metrics import ServingMetrics
 from deepspeed_tpu.serving.prefix_cache import PrefixCache, PrefixCacheConfig
 from deepspeed_tpu.serving.request import (DeadlineExceeded,
                                            GenerationRequest,
-                                           RequestCancelled, ResponseStream,
-                                           SamplingParams, ServingError)
+                                           RequestCancelled, RequestShed,
+                                           ResponseStream, SamplingParams,
+                                           ServingError)
 from deepspeed_tpu.telemetry.flight import (Watchdog, dump_bundle,
                                             make_span_recorder,
                                             make_watchdog)
 from deepspeed_tpu.utils.logging import log_dist
+
+# ladder positions consulted on the hot paths (admission/spec/submit) —
+# resolved once so enforcement is integer compares, not tuple scans
+_BL_SHED_SPEC = brownout_index("shed_speculation")
+_BL_CAP_DECODE = brownout_index("cap_decode")
+_BL_SHED_LOW = brownout_index("shed_low_priority")
+_BL_REJECT_NEW = brownout_index("reject_new")
 
 
 def _softmax(x: np.ndarray) -> np.ndarray:
@@ -106,6 +116,10 @@ class ServerConfig:
         # train + serve spans land in ONE trace file
         self.tracing = dict(d.get("tracing", {}))
         self.flight = dict(d.get("flight", {}))
+        # graceful-degradation ladder knobs (admission.py BrownoutConfig);
+        # the LEVEL is pushed by a FleetSupervisor via set_brownout — a
+        # standalone server stays at "normal" forever
+        self.brownout = BrownoutConfig(d.get("brownout", {}))
 
 
 class InferenceServer:
@@ -180,6 +194,21 @@ class InferenceServer:
                 telemetry=telemetry, tracer=self.tracer)
             if self._watchdog is not None:
                 self._flight_dir = self._watchdog.output_dir
+        # fault injection (resilience/chaos.py): attach_chaos wires an
+        # injector here; None keeps the loop at one attr check per tick
+        self._chaos = None
+        # graceful-degradation ladder position (index into
+        # BROWNOUT_LEVELS); written via set_brownout from the supervisor
+        # thread, read by the serve loop + submit — int store/load, no lock
+        self._brownout = 0
+        # liveness-probe surface (serving/supervisor.py FleetSupervisor):
+        # the serve loop stamps loop_beat_t every iteration and folds each
+        # engine-step wall time into step_ema_s — a stale beat with queued
+        # work means "stuck", a step EMA far above the peer median means
+        # "straggler".  Plain attribute writes: probes tolerate staleness.
+        self.loop_beat_t: Optional[float] = None
+        self.loop_iters = 0
+        self.step_ema_s = 0.0
         self._active: Dict[int, GenerationRequest] = {}
         self._uid = itertools.count()
         self._uid_lock = threading.Lock()
@@ -281,6 +310,18 @@ class InferenceServer:
     def __exit__(self, *exc) -> None:
         self.stop(drain=not any(exc))
 
+    # -- graceful degradation (admission.py BROWNOUT_LEVELS) -------------
+    @property
+    def brownout_level(self) -> str:
+        return BROWNOUT_LEVELS[self._brownout]
+
+    def set_brownout(self, level: str) -> None:
+        """Move this server to a ladder level (idempotent; any thread).
+        The supervisor is the normal caller — levels compose downward, so
+        ``reject_new`` also sheds low priority, caps decode concurrency
+        and disables speculation."""
+        self._brownout = brownout_index(level)
+
     # -- client API ------------------------------------------------------
     def submit(self, prompt: Sequence[int],
                params: Optional[SamplingParams] = None, priority: int = 0,
@@ -329,6 +370,19 @@ class InferenceServer:
                 f"prompt+output needs {need} KV blocks but the engine "
                 f"allows {self.engine.max_seq_blocks} per sequence; raise "
                 "num_blocks/max_context or shorten the request")
+        # brownout gate: a shed submit is load shedding, not a failure —
+        # typed RequestShed, counted as submitted + rejected + shed (the
+        # same accounting shape as a QueueFull reject)
+        lvl = self._brownout
+        if lvl >= _BL_SHED_LOW:
+            if lvl >= _BL_REJECT_NEW \
+                    or priority < self.cfg.brownout.priority_floor:
+                self.metrics.record_submit()
+                self.metrics.record_reject()
+                self.metrics.record_shed()
+                raise RequestShed(
+                    f"request shed at brownout level "
+                    f"{BROWNOUT_LEVELS[lvl]!r} (priority={priority})")
         with self._uid_lock:
             uid = next(self._uid)
         req = GenerationRequest(
@@ -378,6 +432,10 @@ class InferenceServer:
             while True:
                 if wd is not None:
                     wd.beat()
+                self.loop_beat_t = time.monotonic()
+                self.loop_iters += 1
+                if self._chaos is not None:
+                    self._chaos_tick(self._chaos)
                 if self._abort:
                     self._fail_everything(
                         RequestCancelled("server shutdown"))
@@ -409,6 +467,48 @@ class InferenceServer:
             self._dump_flight("serve_crash", e)
             self._fail_everything(ServingError(f"serve loop died: {e!r}"))
 
+    def _chaos_tick(self, ch: Any) -> None:
+        """The ``server.step`` injection point: act on every due fault
+        (resilience/chaos.py decides *when*; the semantics live here).
+        Crashes/hangs deliberately ride the loop's real failure paths —
+        a ChaosError is indistinguishable from an organic death."""
+        from deepspeed_tpu.resilience.chaos import ChaosError
+        for f in ch.fire("server.step"):
+            kind = f.kind
+            if kind == "replica_crash":
+                raise ChaosError(
+                    f"injected replica_crash on {ch.target}")
+            if kind == "replica_hang":
+                # simulated wedge: thread alive, no beats, no progress.
+                # Only stop()/kill() (the supervisor's quarantine path)
+                # clears it; surfacing as a crash afterwards fails the
+                # in-flight streams over instead of hanging them forever.
+                while not self._stop_requested:
+                    time.sleep(0.01)
+                raise ChaosError(
+                    f"injected replica_hang on {ch.target} "
+                    "(cleared by stop)")
+            if kind == "slow_replica":
+                time.sleep(float(f.params.get("delay_ms", 50.0)) / 1e3)
+            elif kind == "cancel_storm":
+                # deterministic victims: the lowest-priority actives
+                n = int(f.params.get("count", 2))
+                victims = sorted(self._active.values(),
+                                 key=lambda r: (r.priority, r.uid))[:n]
+                for v in victims:
+                    v.stream.cancel()
+            elif kind == "admission_storm":
+                burst = int(f.params.get("burst", 8))
+                pr = int(f.params.get("priority", -100))
+                mnt = int(f.params.get("max_new_tokens", 4))
+                for _ in range(burst):
+                    try:
+                        self.submit([1, 2, 3],
+                                    SamplingParams(max_new_tokens=mnt),
+                                    priority=pr)
+                    except ServingError:
+                        break  # queue full / brownout already shedding
+
     def _dump_flight(self, reason: str,
                      error: Optional[BaseException] = None) -> None:
         """Crash forensics: ring + stacks + telemetry snapshot bundle
@@ -438,7 +538,12 @@ class InferenceServer:
             self._finish(req, error=err)
 
     def _sweep_queue(self, now: float) -> None:
-        """Cancelled/expired requests that never got admitted."""
+        """Cancelled/expired requests that never got admitted; under
+        ``shed_low_priority``+ the below-floor queued requests shed too
+        (strictly the lowest-priority class — the floor rule is the same
+        one the submit gate applies to new arrivals)."""
+        shed_floor = (self.cfg.brownout.priority_floor
+                      if self._brownout >= _BL_SHED_LOW else None)
         # snapshot: drain() would drop healthy requests, so walk a copy
         for req in self.admission.snapshot():
             if req.stream.cancel_requested:
@@ -449,6 +554,12 @@ class InferenceServer:
                 if self.admission.remove(req):
                     self._finish(req, error=DeadlineExceeded(
                         f"request {req.uid} deadline passed while queued"))
+            elif shed_floor is not None and req.priority < shed_floor:
+                if self.admission.remove(req):
+                    self._finish(req, error=RequestShed(
+                        f"request {req.uid} (priority={req.priority}) "
+                        "shed from queue at brownout level "
+                        f"{self.brownout_level!r}"))
 
     def _sweep_active(self, now: float) -> None:
         for uid in list(self._active):
@@ -471,6 +582,12 @@ class InferenceServer:
         eng = self.engine
         pc = self.prefix_cache
         while eng.state_manager.n_active < eng.state_manager.max_seqs:
+            if self._brownout >= _BL_CAP_DECODE \
+                    and len(self._active) >= self.cfg.brownout.decode_cap:
+                # cap_decode: hold admissions so the running set stays
+                # small — queued requests wait (outputs stay intact;
+                # truncating decode lengths would not be bit-identical)
+                break
             req = self.admission.peek()
             if req is None:
                 break
@@ -572,6 +689,13 @@ class InferenceServer:
               if self.tracer.enabled else None)
         moved = 0
         try:
+            if self._chaos is not None:
+                # "server.handoff" injection point (import side): ride the
+                # organic failure path below — degrade to re-prefill
+                for f in self._chaos.fire("server.handoff"):
+                    if f.kind == "handoff_fail":
+                        from deepspeed_tpu.resilience.chaos import ChaosError
+                        raise ChaosError("injected handoff_fail (import)")
             if skip < pay_blocks:
                 blocks, n_tok, moved = self.engine.import_kv_chain(
                     payload, skip_blocks=skip)
@@ -637,6 +761,7 @@ class InferenceServer:
         warm = not self._first_engine_step_done
         if warm and self._watchdog is not None:
             self._watchdog.pause()
+        step_t0 = time.monotonic()
         try:
             try:
                 if spec_ready:
@@ -678,6 +803,13 @@ class InferenceServer:
             raise
         step_span.end()
         self.metrics.record_step()
+        if not warm:
+            # straggler signal for the fleet supervisor: EMA of steady-
+            # state step wall time (the compile-paying first step would
+            # poison the average for the whole early window)
+            dt = time.monotonic() - step_t0
+            self.step_ema_s = (dt if self.step_ema_s == 0.0
+                               else 0.8 * self.step_ema_s + 0.2 * dt)
         if (self.cfg.metrics_interval_steps and self.metrics.steps
                 % self.cfg.metrics_interval_steps == 0):
             if self.monitor is not None:
@@ -753,6 +885,11 @@ class InferenceServer:
         homogeneous again."""
         if self._spec is None or not self._active:
             return False
+        if self._brownout >= _BL_SHED_SPEC:
+            # shed_speculation: drop to plain greedy steps — outputs are
+            # bit-identical by the acceptance rule, only latency changes,
+            # and the draft model's step cost comes off the replica
+            return False
         if len(self._active) > self.engine.scheduler.token_budget:
             # even k=0 needs one verify row per sequence; an active set
             # wider than the ragged budget must take the plain step path
@@ -786,6 +923,13 @@ class InferenceServer:
               if self.tracer.enabled else None)
         payload = None
         try:
+            if self._chaos is not None:
+                # "server.handoff" injection point (export side): the
+                # decode leg sees no payload and re-runs prefill
+                for f in self._chaos.fire("server.handoff"):
+                    if f.kind == "handoff_fail":
+                        from deepspeed_tpu.resilience.chaos import ChaosError
+                        raise ChaosError("injected handoff_fail (export)")
             payload = self.engine.export_kv_chain(req.uid)
         except Exception as e:
             log_dist(f"serving: handoff export for request {req.uid} "
@@ -842,6 +986,7 @@ class InferenceServer:
         outcome = ("completed" if error is None else
                    "cancelled" if isinstance(error, RequestCancelled) else
                    "expired" if isinstance(error, DeadlineExceeded) else
+                   "shed" if isinstance(error, RequestShed) else
                    "failed")
         self.metrics.record_finish(outcome, req.n_generated,
                                    getattr(req, "first_token_at", None), now)

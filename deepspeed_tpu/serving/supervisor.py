@@ -1,0 +1,434 @@
+"""FleetSupervisor: liveness probe → quarantine → respawn → brownout.
+
+The serving twin of :class:`~deepspeed_tpu.resilience.supervisor.
+RecoverySupervisor`.  Training recovery restarts a whole worker group
+from the last checkpoint; a serving fleet instead heals IN PLACE — one
+replica at a time, behind a router that keeps streaming — and when
+healing lags demand it degrades SERVICE (the brownout ladder) rather
+than correctness.  Three loops, one cadence thread:
+
+* **Health state machine** (frozen vocabulary :data:`HEALTH_STATES`,
+  linted like the recovery states)::
+
+      healthy ──(probe miss)──▶ suspect ──(N ticks)──▶ dead ─┐
+         │                         │ (probe ok)               │
+         │◀────────────────────────┘                          ▼
+         │   stuck      (beat stale + work queued) ────▶ quarantined
+         │   straggler  (step EMA ≫ peer median)   ────▶    │ mask+kill+bundle
+         │◀──(next tick)── respawned ◀──(ReplicaSet.respawn)─┤
+         └──────────────────────────── retired ◀──(respawn failed)
+
+  Every transition emits a ``fleet.heal`` trace instant; quarantine
+  dumps a flight bundle (reason ``"fleet"``) carrying the sampler's
+  recent tier history, and ``max_heals`` exhaustion fails loudly
+  through :meth:`check` — exactly the RecoverySupervisor budget
+  contract.
+
+* **Tier collapse/restore** (disagg fleets): when a whole tier's
+  dispatchable pool empties, the supervisor folds the router into
+  degraded homogeneous routing (``DisaggRouter.collapse_tiers``) so
+  requests keep completing on the survivors, and restores the tiers
+  the moment both pools are live again.
+
+* **Brownout ladder**: fleet pressure — max of queue fraction, KV
+  occupancy, and SLO error-budget burn (PR 18 ledger) — feeds a
+  :class:`~.admission.BrownoutController`; level changes fan out to
+  every replica server and emit a ``fleet.brownout`` instant.  The
+  ladder is monotone with hysteresis (enter high, exit low, dwell
+  between moves), so the fleet never flaps between levels.
+
+The supervisor only ACTUATES through public surfaces — ``Router.mask/
+unmask``, ``ReplicaSet.respawn``, ``InferenceServer.set_brownout``,
+``DisaggRouter.collapse_tiers/restore_tiers`` — so every move it makes
+is one a human operator could.  Like the rest of ``serving/``, this
+module imports no jax.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from deepspeed_tpu.serving.admission import (BrownoutConfig,
+                                             BrownoutController)
+from deepspeed_tpu.telemetry.flight import dump_bundle
+from deepspeed_tpu.telemetry.tracing import NULL_TRACER
+from deepspeed_tpu.utils.logging import log_dist
+
+#: frozen replica health-state machine (docs/SERVING.md table; linted by
+#: tools/telemetry_check.py like the recovery states)
+HEALTH_STATES = ("healthy", "suspect", "stuck", "straggler", "dead",
+                 "quarantined", "respawned", "retired")
+
+
+class FleetHealFailed(RuntimeError):
+    """The supervisor ran out of healing budget (``max_heals``) — the
+    fleet is losing replicas faster than it can respawn them, which is
+    an incident, not a steady state."""
+
+
+class FleetSupervisorConfig:
+    def __init__(self, d: Optional[dict] = None, **kw):
+        d = {**(d or {}), **kw}
+        self.cadence_s = float(d.get("cadence_s", 0.25))
+        if self.cadence_s <= 0:
+            raise ValueError(f"supervisor cadence_s={self.cadence_s}: "
+                             "must be > 0")
+        # probe misses (consecutive ticks not alive) before suspect
+        # hardens into dead — one missed tick is a race, two is a corpse
+        self.suspect_ticks = int(d.get("suspect_ticks", 2))
+        # serve-loop beat staleness (with work queued) that means stuck:
+        # generous against GC pauses, tiny against a real hang
+        self.stuck_after_s = float(d.get("stuck_after_s", 5.0))
+        # a replica whose steady-state step EMA exceeds factor × the
+        # peer median for this many consecutive ticks is a straggler
+        # (needs >= 2 peers with an EMA — no median, no verdict)
+        self.straggler_factor = float(d.get("straggler_factor", 4.0))
+        self.straggler_ticks = int(d.get("straggler_ticks", 4))
+        # quarantine→respawned wall-clock target; exceeding it is the
+        # heal_latency anomaly the run ledger scans for
+        self.heal_deadline_s = float(d.get("heal_deadline_s", 30.0))
+        # healing budget: the (max_heals+1)-th quarantine fails loudly
+        self.max_heals = int(d.get("max_heals", 8))
+        # actuation switches (observe-only supervisors set both False)
+        self.respawn = bool(d.get("respawn", True))
+        self.manage_brownout = bool(d.get("manage_brownout", True))
+        self.brownout = BrownoutConfig(d.get("brownout", {}))
+
+
+class FleetSupervisor:
+    """Cadence thread healing a :class:`~.replica.ReplicaSet`.
+
+    ``router`` enables dispatch masking and (for a ``DisaggRouter``)
+    tier collapse; ``sampler`` supplies the SLO burn signal and the
+    tier history attached to flight bundles; both are optional — a bare
+    supervisor still probes, quarantines and respawns.  ``tick()`` is
+    the whole control loop and is callable directly (tests, bench rows)
+    without ``start()``.
+    """
+
+    def __init__(self, replicas: Any, router: Any = None,
+                 sampler: Any = None, config: Optional[dict] = None,
+                 telemetry: Any = None, flight_dir: str = ""):
+        self.replicas = replicas
+        self.router = router
+        self.sampler = sampler
+        self.cfg = (config if isinstance(config, FleetSupervisorConfig)
+                    else FleetSupervisorConfig(config))
+        self.telemetry = telemetry
+        self.flight_dir = str(flight_dir)
+        self.tracer = (telemetry.tracer if telemetry is not None
+                       else NULL_TRACER)
+        self._ring = (telemetry.flight_ring if telemetry is not None
+                      else None)
+        self._trace_id = (self.tracer.new_trace_id()
+                          if self.tracer.enabled else "")
+        self.brownout = BrownoutController(self.cfg.brownout)
+        self.heals = 0
+        self.collapses = 0
+        self.restores = 0
+        self.events: List[Dict[str, Any]] = []
+        # replica index -> mutable probe record; replicas enter lazily
+        # so grow()/respawn() need no registration call
+        self._track: Dict[int, Dict[str, Any]] = {}
+        self._collapsed = False
+        self._error: Optional[FleetHealFailed] = None
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> "FleetSupervisor":
+        if self._thread is not None:
+            raise RuntimeError("fleet supervisor already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="ds-fleet-supervisor",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=max(5.0, 8 * self.cfg.cadence_s))
+            self._thread = None
+
+    def __enter__(self) -> "FleetSupervisor":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def check(self) -> None:
+        """Re-raise a heal-budget failure caught on the cadence thread —
+        the caller-side half of failing loudly (benches and tests call
+        this after the run; a silent supervisor death would otherwise
+        read as a healthy fleet)."""
+        if self._error is not None:
+            raise self._error
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.cfg.cadence_s):
+            try:
+                self.tick()
+            except FleetHealFailed:
+                break            # stored by tick(); check() re-raises
+            except Exception as e:   # probing must never kill serving
+                log_dist(f"fleet supervisor: tick failed: {e!r}",
+                         level="warning")
+
+    # -- bookkeeping -----------------------------------------------------
+    def _rec(self, rep: Any) -> Dict[str, Any]:
+        return self._track.setdefault(rep.index, {
+            "state": "healthy", "miss": 0, "slow": 0,
+            "since": time.monotonic(), "quarantined_at": 0.0})
+
+    def _transition(self, rep: Any, rec: Dict[str, Any], state: str,
+                    **detail) -> None:
+        assert state in HEALTH_STATES, state
+        rec["state"] = state
+        rec["since"] = time.monotonic()
+        ev = {"replica": rep.name, "state": state, "t": time.time(),
+              **detail}
+        with self._lock:
+            self.events.append(ev)
+        log_dist(f"fleet supervisor: {rep.name} -> {state} {detail}",
+                 level="warning" if state not in ("healthy", "respawned")
+                 else "info")
+        if self.tracer.enabled:
+            self.tracer.instant("fleet.heal", self._trace_id,
+                                replica=rep.name, state=state, **detail)
+
+    def _dump(self, **extra) -> str:
+        if not self.flight_dir:
+            return ""
+        history = (self.sampler.history()[-64:]
+                   if self.sampler is not None else [])
+        return dump_bundle(self.flight_dir, "fleet", ring=self._ring,
+                           telemetry=self.telemetry,
+                           extra={**extra, "heals": self.heals,
+                                  "fleet_history": history})
+
+    # -- one control-loop tick ------------------------------------------
+    def tick(self, now: Optional[float] = None) -> Dict[str, str]:
+        """Probe → classify → quarantine → respawn → tiers → brownout.
+        Returns the post-tick ``{replica_name: state}`` map."""
+        self.check()
+        now = time.monotonic() if now is None else now
+        reps = list(self.replicas)
+        for rep in reps:
+            rec = self._rec(rep)
+            if rec["state"] in ("quarantined", "retired"):
+                continue
+            if rec["state"] == "respawned":
+                # one full tick of health after the respawn closes the
+                # heal; the instant-worthy transition already fired
+                rec["state"] = "healthy"
+                rec["miss"] = rec["slow"] = 0
+            if not rep.alive:
+                rec["miss"] += 1
+                if rec["miss"] >= max(1, self.cfg.suspect_ticks):
+                    self._transition(rep, rec, "dead", misses=rec["miss"])
+                    self._quarantine(rep, rec, "dead")
+                elif rec["state"] != "suspect":
+                    self._transition(rep, rec, "suspect")
+                continue
+            rec["miss"] = 0
+            if rec["state"] == "suspect":
+                self._transition(rep, rec, "healthy")
+            if self._probe_stuck(rep, now):
+                self._transition(rep, rec, "stuck",
+                                 beat_age_s=round(
+                                     now - rep.server.loop_beat_t, 3))
+                self._quarantine(rep, rec, "stuck")
+                continue
+            if self._probe_straggler(rep, rec, reps):
+                self._transition(rep, rec, "straggler",
+                                 step_ema_s=round(rep.server.step_ema_s, 4))
+                self._quarantine(rep, rec, "straggler")
+                continue
+        # tiers BEFORE healing: the tick that quarantines a tier's last
+        # replica must observe (and actuate) the collapse before the
+        # respawn in the same tick refills the pool — otherwise a fast
+        # heal hides the degraded window from routing entirely
+        self._manage_tiers()
+        self._heal_quarantined()
+        self._manage_tiers()
+        if self.cfg.manage_brownout:
+            self._manage_brownout()
+        return {r.name: self._rec(r)["state"] for r in self.replicas}
+
+    # -- probes ----------------------------------------------------------
+    def _probe_stuck(self, rep: Any, now: float) -> bool:
+        """Alive thread, queued work, stale serve-loop beat = hung (the
+        thread exists but its loop stopped turning).  An IDLE replica is
+        never stuck — its loop may legitimately block waiting for
+        work."""
+        beat = rep.server.loop_beat_t
+        return (beat is not None and rep.queue_load > 0
+                and now - beat > self.cfg.stuck_after_s)
+
+    def _probe_straggler(self, rep: Any, rec: Dict[str, Any],
+                         reps: List[Any]) -> bool:
+        """Steady-state step EMA ≫ peer median, sustained.  Needs two
+        peers with a warm EMA — no distribution, no verdict (a fleet of
+        two can't tell slow from different)."""
+        mine = rep.server.step_ema_s
+        peers = [r.server.step_ema_s for r in reps
+                 if r.index != rep.index and r.alive
+                 and r.server.step_ema_s > 0]
+        if mine <= 0 or len(peers) < 2:
+            rec["slow"] = 0
+            return False
+        if mine > self.cfg.straggler_factor * statistics.median(peers):
+            rec["slow"] += 1
+        else:
+            rec["slow"] = 0
+        return rec["slow"] >= max(1, self.cfg.straggler_ticks)
+
+    # -- actuation -------------------------------------------------------
+    def _quarantine(self, rep: Any, rec: Dict[str, Any],
+                    why: str) -> None:
+        """Mask, kill, bundle — and charge the healing budget."""
+        self.heals += 1
+        if self.heals > self.cfg.max_heals:
+            self._dump(replica=rep.name, health_state=why,
+                       budget_exhausted=True)
+            self._error = FleetHealFailed(
+                f"healing budget exhausted ({self.cfg.max_heals}); "
+                f"last casualty {rep.name} ({why})")
+            self._transition(rep, rec, "retired", why=why,
+                             budget_exhausted=True)
+            raise self._error
+        if self.router is not None:
+            self.router.mask(rep.index)     # indefinite: no new legs
+        if rep.alive:
+            rep.kill()   # stuck/straggler: in-flight legs fail over
+        bundle = self._dump(replica=rep.name, health_state=why)
+        rec["quarantined_at"] = time.monotonic()
+        self._transition(rep, rec, "quarantined", why=why,
+                         bundle=os.path.basename(bundle) if bundle else "")
+
+    def _heal_quarantined(self) -> None:
+        if not self.cfg.respawn:
+            return
+        for rep in list(self.replicas):
+            rec = self._track.get(rep.index)
+            if rec is None or rec["state"] != "quarantined":
+                continue
+            try:
+                fresh = self.replicas.respawn(rep.index)
+            except Exception as e:
+                self._transition(rep, rec, "retired", error=repr(e))
+                continue
+            heal_s = time.monotonic() - rec["quarantined_at"]
+            if self.router is not None:
+                self.router.unmask(rep.index)
+                # the fresh server starts at brownout "normal"; keep the
+                # fleet's ladder uniform
+                fresh.server.set_brownout(self.brownout.level)
+            # the tracked record carries over to the fresh replica (same
+            # index); heal_s vs deadline_s is the run ledger's
+            # heal_latency anomaly signal
+            self._transition(fresh, rec, "respawned",
+                             heal_s=round(heal_s, 3),
+                             deadline_s=self.cfg.heal_deadline_s)
+            if heal_s > self.cfg.heal_deadline_s:
+                log_dist(f"fleet supervisor: {fresh.name} healed in "
+                         f"{heal_s:.1f}s (deadline "
+                         f"{self.cfg.heal_deadline_s:.1f}s)",
+                         level="warning")
+
+    def _manage_tiers(self) -> None:
+        """Collapse disagg routing while a tier's dispatchable pool is
+        empty; restore once both pools live again."""
+        router = self.router
+        if router is None or not hasattr(router, "collapse_tiers"):
+            return
+        masked = router.masked_indices()
+        pools = {"prefill": 0, "decode": 0}
+        for rep in self.replicas:
+            if rep.tier in pools and rep.alive and rep.index not in masked:
+                pools[rep.tier] += 1
+        empty = [t for t, n in pools.items() if n == 0]
+        if empty and not self._collapsed:
+            self._collapsed = True
+            self.collapses += 1
+            router.collapse_tiers()
+            self._dump(tier_collapse=empty)
+            with self._lock:
+                self.events.append({"state": "collapsed", "tiers": empty,
+                                    "t": time.time()})
+            if self.tracer.enabled:
+                self.tracer.instant("fleet.heal", self._trace_id,
+                                    action="tier_collapse",
+                                    tiers=",".join(empty))
+        elif not empty and self._collapsed:
+            self._collapsed = False
+            self.restores += 1
+            router.restore_tiers()
+            with self._lock:
+                self.events.append({"state": "restored", "t": time.time()})
+            if self.tracer.enabled:
+                self.tracer.instant("fleet.heal", self._trace_id,
+                                    action="tier_restore")
+
+    # -- brownout --------------------------------------------------------
+    def fleet_pressure(self) -> float:
+        """Max of the three load signals, each normalised to ~[0, 1]:
+        queue fraction (worst replica), KV occupancy (worst replica),
+        and SLO error-budget burn over ``brownout.burn_limit`` (worst
+        tier, PR 18 ledger)."""
+        q = kv = 0.0
+        for rep in self.replicas:
+            if not rep.alive:
+                continue
+            cap = max(1, rep.server.admission.cfg.max_queue_size)
+            q = max(q, len(rep.server.admission) / cap)
+            kv = max(kv, 1.0 - rep.kv_headroom)
+        burn = 0.0
+        if self.sampler is not None:
+            for row in self.sampler.slo_snapshot().values():
+                burn = max(burn, float(row.get("error_budget_burn", 0.0)))
+        burn = min(1.0, burn / max(1e-9, self.cfg.brownout.burn_limit))
+        return max(q, kv, burn)
+
+    def _manage_brownout(self) -> None:
+        pressure = self.fleet_pressure()
+        level = self.brownout.observe(pressure)
+        if level is None:
+            return
+        if self.router is not None:
+            self.router.set_brownout(level)
+        else:
+            for rep in self.replicas:
+                rep.server.set_brownout(level)
+        with self._lock:
+            self.events.append({"state": "brownout", "level": level,
+                                "pressure": round(pressure, 3),
+                                "t": time.time()})
+        log_dist(f"fleet supervisor: brownout -> {level} "
+                 f"(pressure {pressure:.2f})", level="warning")
+        if self.tracer.enabled:
+            self.tracer.instant("fleet.brownout", self._trace_id,
+                                level=level, pressure=round(pressure, 3))
+
+    # -- reading ---------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            n_events = len(self.events)
+        return {
+            "states": {r.name: self._rec(r)["state"]
+                       for r in self.replicas},
+            "heals": self.heals,
+            "collapses": self.collapses,
+            "restores": self.restores,
+            "brownout_level": self.brownout.level,
+            "events": n_events,
+            "failed": self._error is not None,
+        }

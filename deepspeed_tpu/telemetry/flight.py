@@ -43,7 +43,7 @@ from deepspeed_tpu.utils.logging import logger
 # Frozen bundle-reason vocabulary (linted against the docs table by
 # tools/telemetry_check.py, like span names).
 FLIGHT_REASONS = ("watchdog", "serve_crash", "engine_crash", "manual",
-                  "recovery")
+                  "recovery", "fleet")
 
 DEFAULT_RING_SIZE = 2048
 

@@ -92,8 +92,8 @@ ROLLUP_RECOVERY_KEYS = ("goodput_after", "loss_gap", "outage_s")
 VERDICTS = ("flat", "improved", "missing", "new", "regressed", "stale")
 
 #: frozen anomaly kinds the in-run scan can emit
-ANOMALY_KINDS = ("goodput_gap", "mfu_cliff", "slo_burn_spike",
-                 "step_time_spike")
+ANOMALY_KINDS = ("goodput_gap", "heal_latency", "mfu_cliff",
+                 "slo_burn_spike", "step_time_spike")
 
 #: key set of one anomaly record
 ANOMALY_KEYS = ("flight_bundle", "kind", "run_id", "step", "threshold",
@@ -708,6 +708,10 @@ def scan_run(records: Sequence[dict], fleet_rows: Sequence[dict] = (),
       recovery record interrupted progress.
     * ``slo_burn_spike`` — a tier's windowed error-budget burn crossed
       1.0 (budget for the window exhausted).
+    * ``heal_latency`` — a ``fleet.heal`` respawn instant reported
+      ``heal_s`` over the supervisor's ``deadline_s`` (the replica
+      healed, but too slowly to count as self-healing); the anomaly's
+      ``tier`` field carries the replica name.
     """
     bundle = _latest_flight_bundle(flight_dir)
 
@@ -765,6 +769,18 @@ def scan_run(records: Sequence[dict], fleet_rows: Sequence[dict] = (),
                 out.append(anomaly("slo_burn_spike", i, burn, 1.0,
                                    tier=tier))
             prev_burn = burn
+
+    for ev in trace_events:
+        if ev.get("ph") != "i" or ev.get("name") != "fleet.heal":
+            continue
+        args = ev.get("args") or {}
+        if args.get("state") != "respawned":
+            continue
+        heal_s = num_of(args.get("heal_s"))
+        deadline = num_of(args.get("deadline_s"))
+        if heal_s is not None and deadline and heal_s > deadline:
+            out.append(anomaly("heal_latency", None, heal_s, deadline,
+                               tier=str(args.get("replica", ""))))
     return out
 
 

@@ -69,6 +69,9 @@ SPAN_NAMES = (
 
 # Instant events (Chrome "i" events).
 EVENT_NAMES = (
+    "chaos.inject",            # a scheduled fault fired (resilience/chaos.py)
+    "fleet.brownout",          # degradation ladder changed level
+    "fleet.heal",              # fleet supervisor state transition / action
     "recovery.detected",       # worker crash / hang noticed by supervisor
     "recovery.replan",         # surviving hosts -> new mesh plan
     "recovery.restart",        # group relaunched (possibly resized)

@@ -471,3 +471,127 @@ def test_build_rejects_tiers_that_dont_fit_devices():
             model, 2, ENG_CFG, seed=0,
             disagg={"enabled": True, "prefill_replicas": 2,
                     "decode_replicas": 2})
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation + kill-between-legs (chaos PR satellites)
+# ---------------------------------------------------------------------------
+
+def test_disagg_deadline_between_legs_typed_no_hang():
+    """A deadline that dies mid-prefill (the engine step outlives it, so
+    no queue sweep can catch it) surfaces as typed DeadlineExceeded from
+    the between-legs guard — never a hang, never a decode admission that
+    could only expire in queue — and the dropped un-adopted payload
+    leaks nothing."""
+    from deepspeed_tpu.resilience.chaos import FaultPlan, attach_chaos
+    from deepspeed_tpu.serving import DeadlineExceeded
+
+    model = _model(layers=2)
+    prompt = _prompts(model, [10], seed=21)[0]
+    rs = ReplicaSet.build(model, 2, ENG_CFG,
+                          {"prefix_cache": {"enabled": True}}, seed=0,
+                          disagg=DISAGG)
+    router = DisaggRouter(rs).start()
+    try:
+        # the prefill engine step takes >= 400ms, every time
+        attach_chaos(rs, FaultPlan([
+            {"kind": "slow_replica", "target": "r0", "at": 0.0,
+             "duration_s": 120.0, "point": "engine.step",
+             "params": {"delay_ms": 400.0}}]))
+        t0 = time.monotonic()
+        s = router.submit(prompt, SamplingParams(max_new_tokens=8),
+                          deadline_s=0.2)
+        with pytest.raises(DeadlineExceeded):
+            s.result(timeout=120)       # a hang would raise TimeoutError
+        assert time.monotonic() - t0 < 60
+    finally:
+        router.stop()
+    for r in rs:
+        assert _pool_whole(r.engine), r.name
+
+
+def test_disagg_deadline_mid_decode_releases_adopted_chain():
+    """Expiry AFTER the handoff landed: the decode leg dies mid-decode
+    with typed DeadlineExceeded and the adopted chain's pages all go
+    back to the pool (same refcount bar as the cancel test)."""
+    from deepspeed_tpu.resilience.chaos import FaultPlan, attach_chaos
+    from deepspeed_tpu.serving import DeadlineExceeded
+
+    model = _model(layers=2)
+    prompts = _prompts(model, [9, 11], seed=22)
+    rs = ReplicaSet.build(model, 2, ENG_CFG,
+                          {"prefix_cache": {"enabled": True}}, seed=0,
+                          disagg=DISAGG)
+    router = DisaggRouter(rs).start()
+    try:
+        # warm both tiers so compile cost can't eat the deadline budget
+        assert len(router.submit(
+            prompts[0], SamplingParams(max_new_tokens=4)).result(
+                timeout=300)) == 4
+        # now every decode step costs >= 200ms: 48 tokens can't finish
+        # inside a 2s budget, so expiry lands mid-decode
+        attach_chaos(rs, FaultPlan([
+            {"kind": "slow_replica", "target": "r1", "at": 0.0,
+             "duration_s": 120.0, "point": "engine.step",
+             "params": {"delay_ms": 200.0}}]))
+        s = router.submit(prompts[1], SamplingParams(max_new_tokens=48),
+                          deadline_s=2.0)
+        got = []
+        try:
+            for tok in s:               # handoff landed: tokens flow...
+                got.append(tok)
+        except DeadlineExceeded:
+            pass                        # ...then the deadline kills it
+        assert 0 < len(got) < 48, \
+            "expiry should land mid-decode, after the handoff"
+        with pytest.raises(DeadlineExceeded):
+            s.result(timeout=120)
+    finally:
+        router.stop()
+    for r in rs:
+        assert _pool_whole(r.engine), r.name
+
+
+def test_disagg_kill_between_export_and_import_no_leak():
+    """Kill the decode replica while an exported chain sits QUEUED on it
+    (exported but not yet imported — the decode cap holds admission):
+    the orphaned payload is dropped without touching any pool, both
+    requests fail over to the survivor, outputs bit-identical, and the
+    survivor's pool drains to whole."""
+    model = _model(layers=2)
+    prompts = _prompts(model, [9, 12], seed=23)
+    ref = build_engine(model, ENG_CFG, seed=0)
+    want = [ref.generate([prompts[0]], max_new_tokens=20)[0],
+            ref.generate([prompts[1]], max_new_tokens=8)[0]]
+
+    rs = ReplicaSet.build(model, 2, ENG_CFG,
+                          {"prefix_cache": {"enabled": True}}, seed=0,
+                          disagg=DISAGG)
+    router = DisaggRouter(rs).start()
+    try:
+        # one decode slot: the filler takes it, the target's decode leg
+        # must wait in r1's queue with its adopted-to-be payload
+        rs[1].server.set_brownout("cap_decode")
+        filler = router.submit(prompts[0],
+                               SamplingParams(max_new_tokens=20))
+        deadline = time.monotonic() + 120
+        while (not rs[1].server._active
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert rs[1].server._active, "filler should be decoding on r1"
+        target = router.submit(prompts[1],
+                               SamplingParams(max_new_tokens=8))
+        # target's prefill completed on r0, its decode leg (carrying the
+        # exported chain) is queued behind the cap on r1
+        while (len(rs[1].server.admission) < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert len(rs[1].server.admission) == 1, \
+            "target's decode leg should be queued (exported, unimported)"
+        rs[1].kill()
+        assert filler.result(timeout=300) == want[0]
+        assert target.result(timeout=300) == want[1]
+        assert router.metrics.failovers >= 2
+    finally:
+        router.stop()
+    assert _pool_whole(rs[0].engine)

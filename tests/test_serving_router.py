@@ -362,3 +362,58 @@ def test_router_invalid_request_rejected_cleanly():
         assert len(out.result(timeout=120)) == 3
     finally:
         router.stop()
+
+
+def test_router_mask_cooldown_backoff_then_recovery():
+    """Fail-over hygiene (chaos PR satellite): a replica that fails
+    mask_after_failures legs in a row is masked out of dispatch for
+    mask_cooldown_s; the failed legs retry elsewhere after a bounded
+    backoff and stay bit-identical; once the replica is respawned and
+    the cooldown lapses, dispatch uses it again."""
+    model = _model()
+    n_req, new = 4, 24
+    prompts = _prompts(model, [8] * n_req, seed=11)
+    ref_eng = build_engine(model, dict(ENG_CFG), seed=0)
+    ref = ref_eng.generate(prompts, max_new_tokens=new)
+    ref_short = ref_eng.generate(prompts, max_new_tokens=4)
+
+    rs = ReplicaSet.build(model, 2, ENG_CFG, seed=0)
+    router = Router(rs, {"mask_after_failures": 2, "mask_cooldown_s": 2.0,
+                         "backoff_base_s": 0.01,
+                         "backoff_cap_s": 0.05}).start()
+    try:
+        streams = [router.submit(p, SamplingParams(max_new_tokens=new))
+                   for p in prompts]
+        # wait until r1 demonstrably owns >= mask_after_failures legs
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if (len(rs[1].server._active) >= 2
+                    and all(len(s.tokens) >= 2 for s in streams)):
+                break
+            time.sleep(0.01)
+        assert len(rs[1].server._active) >= 2, \
+            "r1 should hold two in-flight legs before the kill"
+        rs[1].kill()
+        # every leg finishes on the survivor, outputs untouched
+        outs = [s.result(timeout=300) for s in streams]
+        assert outs == ref
+        snap = router.snapshot()
+        assert snap["failovers"] >= 2
+        # two consecutive leg failures crossed the mask threshold
+        assert router.masked_indices() == {1}
+
+        rs.respawn(1)
+        # the cooldown mask expires on its own (no operator unmask)
+        deadline = time.monotonic() + 10
+        while router.masked_indices() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert router.masked_indices() == set()
+        # dispatch trusts the recovered replica again — and correctness
+        # still holds through the respawn
+        outs = [router.submit(p, SamplingParams(max_new_tokens=4))
+                for p in prompts]
+        assert [s.result(timeout=300) for s in outs] == ref_short
+        assert rs[1].server.metrics.snapshot()["submitted"] >= 1, \
+            "recovered replica should serve again after the cooldown"
+    finally:
+        router.stop()

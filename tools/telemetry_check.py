@@ -67,13 +67,14 @@ EXPECTED_SPAN_NAMES = [
     "train.telemetry", "v2.ragged_step",
 ]
 EXPECTED_EVENT_NAMES = [
+    "chaos.inject", "fleet.brownout", "fleet.heal",
     "recovery.detected", "recovery.replan", "recovery.restart",
     "recovery.resumed", "router.dispatch", "router.failover", "serve.emit",
     "serve.enqueue", "serve.finish", "serve.first_token", "serve.preempt",
     "serve.prefix_hit", "slo.violation", "spec.accept", "watchdog.fire",
 ]
 EXPECTED_FLIGHT_REASONS = ["watchdog", "serve_crash", "engine_crash",
-                           "manual", "recovery"]
+                           "manual", "recovery", "fleet"]
 
 # frozen quantized-collective comm-op vocabulary (comm/quantized.py
 # QUANT_COMM_OPS): every wire movement of the quantized ZeRO collectives
@@ -291,8 +292,28 @@ EXPECTED_ROLLUP_SERVE_KEYS = ["error_budget_burn", "handoff_bytes_per_req",
 EXPECTED_ROLLUP_RECOVERY_KEYS = ["goodput_after", "loss_gap", "outage_s"]
 EXPECTED_VERDICTS = ["flat", "improved", "missing", "new", "regressed",
                      "stale"]
-EXPECTED_ANOMALY_KINDS = ["goodput_gap", "mfu_cliff", "slo_burn_spike",
-                          "step_time_spike"]
+
+# frozen chaos / self-healing vocabulary (resilience/chaos.py fault
+# kinds + injection points, serving/supervisor.py health states,
+# serving/admission.py brownout ladder; docs/SERVING.md "Fault injection
+# & self-healing"): each frozen list matches its module, every name is
+# documented, and the chaos_serve bench row literally emits the frozen
+# keys — the standard vocabulary contract.
+EXPECTED_FAULT_KINDS = ["admission_storm", "cancel_storm", "handoff_fail",
+                        "replica_crash", "replica_hang", "slow_replica"]
+EXPECTED_INJECTION_POINTS = ["engine.step", "router.dispatch",
+                             "server.handoff", "server.step", "train.step"]
+EXPECTED_HEALTH_STATES = ["healthy", "suspect", "stuck", "straggler",
+                          "dead", "quarantined", "respawned", "retired"]
+EXPECTED_BROWNOUT_LEVELS = ["normal", "shed_speculation", "cap_decode",
+                            "shed_low_priority", "reject_new"]
+CHAOS_SERVE_BENCH_KEYS = ["faults_injected", "completed_chaos",
+                          "shed_chaos", "failed_chaos", "heals",
+                          "time_to_heal_s", "collapses", "restores",
+                          "bit_identical", "brownout_peak",
+                          "slo_violations_curve"]
+EXPECTED_ANOMALY_KINDS = ["goodput_gap", "heal_latency", "mfu_cliff",
+                          "slo_burn_spike", "step_time_spike"]
 EXPECTED_ANOMALY_KEYS = ["flight_bundle", "kind", "run_id", "step",
                          "threshold", "tier", "trace_span", "value"]
 EXPECTED_OBS_FINDING_KEYS = ["baseline", "current", "delta", "fingerprint",
@@ -805,6 +826,52 @@ def check_obs_ledger() -> List[str]:
     ]) + _cross_link(PLANNER_DOCS, "obs_report", "calibration")
 
 
+def check_chaos_fleet() -> List[str]:
+    """Chaos / self-healing vocabulary: fault kinds, injection points,
+    health states and brownout levels match their modules and are
+    documented in docs/SERVING.md; the chaos_serve bench row emits the
+    frozen keys; and docs/ELASTICITY.md cross-links the serving doc
+    from its chaos section (the training and serving chaos halves share
+    resilience/chaos.py)."""
+    def _kinds():
+        from deepspeed_tpu.resilience.chaos import FAULT_KINDS
+
+        return FAULT_KINDS
+
+    def _points():
+        from deepspeed_tpu.resilience.chaos import INJECTION_POINTS
+
+        return INJECTION_POINTS
+
+    def _states():
+        from deepspeed_tpu.serving.supervisor import HEALTH_STATES
+
+        return HEALTH_STATES
+
+    def _levels():
+        from deepspeed_tpu.serving.admission import BROWNOUT_LEVELS
+
+        return BROWNOUT_LEVELS
+
+    return _vocab_check([
+        VocabSpec(name="chaos.FAULT_KINDS",
+                  expected=EXPECTED_FAULT_KINDS, actual=_kinds,
+                  docs_path=SERVING_DOCS),
+        VocabSpec(name="chaos.INJECTION_POINTS",
+                  expected=EXPECTED_INJECTION_POINTS, actual=_points,
+                  docs_path=SERVING_DOCS),
+        VocabSpec(name="supervisor.HEALTH_STATES",
+                  expected=EXPECTED_HEALTH_STATES, actual=_states,
+                  docs_path=SERVING_DOCS),
+        VocabSpec(name="admission.BROWNOUT_LEVELS",
+                  expected=EXPECTED_BROWNOUT_LEVELS, actual=_levels,
+                  docs_path=SERVING_DOCS),
+        VocabSpec(name="CHAOS_SERVE_BENCH_KEYS",
+                  expected=CHAOS_SERVE_BENCH_KEYS, docs_path=SERVING_DOCS,
+                  source_keys=[(_BENCH, CHAOS_SERVE_BENCH_KEYS)]),
+    ]) + _cross_link(ELASTICITY_DOCS, "SERVING.md", "chaos")
+
+
 def validate_chrome_trace(obj: Any) -> List[str]:
     """Structural validation of a Chrome trace-event JSON object (pass a
     path or the loaded dict).  Perfetto/chrome://tracing both accept the
@@ -875,7 +942,8 @@ def run_all() -> List[str]:
             + check_router_serving() + check_autotuning()
             + check_graph_audit() + check_memory_audit()
             + check_offload() + check_recovery() + check_planner()
-            + check_fleet() + check_obs_ledger() + check_trace_export())
+            + check_fleet() + check_obs_ledger() + check_chaos_fleet()
+            + check_trace_export())
 
 
 def main() -> int:
